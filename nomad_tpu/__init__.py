@@ -1,0 +1,9 @@
+"""nomad_tpu — a TPU-native cluster workload orchestrator.
+
+A from-scratch rebuild of the capabilities of HashiCorp Nomad v0.10.2
+(reference at /root/reference), with the placement hot path implemented as a
+batched, vectorized JAX engine (`tpu_binpack`) instead of the reference's
+per-node Go iterator chain.
+"""
+
+__version__ = "0.1.0"

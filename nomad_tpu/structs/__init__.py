@@ -1,0 +1,17 @@
+"""Data model for the TPU-native orchestrator (reference nomad/structs/)."""
+from .structs import *  # noqa: F401,F403
+from .funcs import (  # noqa: F401
+    BIN_PACKING_MAX_FIT_SCORE,
+    allocs_fit,
+    filter_terminal_allocs,
+    remove_allocs,
+    score_fit,
+)
+from .network import NetworkIndex, parse_port_ranges  # noqa: F401
+from .devices import DeviceAccounter  # noqa: F401
+from .node_class import (  # noqa: F401
+    compute_node_class,
+    constraint_target_escapes,
+    escaped_constraints,
+    is_unique_namespace,
+)

@@ -1,0 +1,66 @@
+"""Device instance accounting (reference ``nomad/structs/devices.go``)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .structs import (
+    AllocatedDeviceResource,
+    Allocation,
+    DeviceIdTuple,
+    Node,
+    NodeDeviceResource,
+)
+
+
+class DeviceAccounterInstance:
+    def __init__(self, device: NodeDeviceResource) -> None:
+        self.device = device
+        # instance id -> use count; 0 means free
+        self.instances: Dict[str, int] = {
+            inst.id: 0 for inst in device.instances if inst.healthy
+        }
+
+    def free_count(self) -> int:
+        return sum(1 for v in self.instances.values() if v == 0)
+
+
+class DeviceAccounter:
+    """Tracks device usage on a node to detect oversubscription."""
+
+    def __init__(self, node: Node) -> None:
+        self.devices: Dict[DeviceIdTuple, DeviceAccounterInstance] = {}
+        for dev in node.node_resources.devices:
+            self.devices[dev.id()] = DeviceAccounterInstance(dev)
+
+    def add_allocs(self, allocs: List[Allocation]) -> bool:
+        """Mark devices used by allocs; True if any instance is double-used."""
+        collision = False
+        for a in allocs:
+            if a.terminal_status():
+                continue
+            if a.allocated_resources is None:
+                continue
+            for tr in a.allocated_resources.tasks.values():
+                for device in tr.devices:
+                    dev_inst = self.devices.get(device.id())
+                    if dev_inst is None:
+                        continue
+                    for instance_id in device.device_ids:
+                        if instance_id in dev_inst.instances:
+                            if dev_inst.instances[instance_id] != 0:
+                                collision = True
+                            dev_inst.instances[instance_id] += 1
+        return collision
+
+    def add_reserved(self, res: AllocatedDeviceResource) -> bool:
+        collision = False
+        dev_inst = self.devices.get(res.id())
+        if dev_inst is None:
+            return False
+        for instance_id in res.device_ids:
+            if instance_id not in dev_inst.instances:
+                continue
+            if dev_inst.instances[instance_id] != 0:
+                collision = True
+            dev_inst.instances[instance_id] += 1
+        return collision

@@ -1,0 +1,108 @@
+"""Pure scheduling fit functions.
+
+Semantics match the reference ``nomad/structs/funcs.go`` (AllocsFit :102,
+ScoreFit :154, FilterTerminalAllocs :74, RemoveAllocs :51).  These host-side
+scalar versions are the oracle for the vectorized TPU implementations in
+``nomad_tpu/tpu/engine.py``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .network import NetworkIndex
+from .devices import DeviceAccounter
+from .structs import Allocation, ComparableResources, Node
+
+# ScoreFit's normalization ceiling: a perfectly empty node scores 18
+# (20 - 10^1 - 10^1 ... inverted); see reference funcs.go:154-188.
+BIN_PACKING_MAX_FIT_SCORE = 18.0
+
+
+def remove_allocs(allocs: List[Allocation], remove: List[Allocation]) -> List[Allocation]:
+    """Remove by alloc ID (order NOT preserved beyond filtering)."""
+    remove_set = {a.id for a in remove}
+    return [a for a in allocs if a.id not in remove_set]
+
+
+def filter_terminal_allocs(
+    allocs: List[Allocation],
+) -> Tuple[List[Allocation], Dict[str, Allocation]]:
+    """Split off terminal allocs, keeping the latest terminal alloc per name."""
+    terminal: Dict[str, Allocation] = {}
+    live: List[Allocation] = []
+    for a in allocs:
+        if a.terminal_status():
+            prev = terminal.get(a.name)
+            if prev is None or prev.create_index < a.create_index:
+                terminal[a.name] = a
+        else:
+            live.append(a)
+    return live, terminal
+
+
+def allocs_fit(
+    node: Node,
+    allocs: List[Allocation],
+    net_idx: Optional[NetworkIndex] = None,
+    check_devices: bool = False,
+) -> Tuple[bool, str, ComparableResources]:
+    """Check whether a set of allocations fits on a node.
+
+    Returns (fit, exhausted_dimension, used). Mirrors reference funcs.go:102.
+    """
+    used = ComparableResources()
+
+    reserved = node.comparable_reserved_resources()
+    if reserved is not None:
+        used.add(reserved)
+
+    for alloc in allocs:
+        if alloc.terminal_status():
+            continue
+        used.add(alloc.comparable_resources())
+
+    superset, dimension = node.comparable_resources().superset(used)
+    if not superset:
+        return False, dimension, used
+
+    if net_idx is None:
+        net_idx = NetworkIndex()
+        if net_idx.set_node(node) or net_idx.add_allocs(allocs):
+            return False, "reserved port collision", used
+
+    if net_idx.overcommitted():
+        return False, "bandwidth exceeded", used
+
+    if check_devices:
+        accounter = DeviceAccounter(node)
+        if accounter.add_allocs(allocs):
+            return False, "device oversubscribed", used
+
+    return True, "", used
+
+
+def score_fit(node: Node, util: ComparableResources) -> float:
+    """Google BestFit-v3 scoring (reference funcs.go:154).
+
+    20 - (10^freePctCpu + 10^freePctMem); clamped to [0, 18].
+    """
+    reserved = node.comparable_reserved_resources()
+    res = node.comparable_resources()
+
+    node_cpu = float(res.flattened.cpu_shares)
+    node_mem = float(res.flattened.memory_mb)
+    if reserved is not None:
+        node_cpu -= float(reserved.flattened.cpu_shares)
+        node_mem -= float(reserved.flattened.memory_mb)
+
+    free_pct_cpu = 1.0 - (float(util.flattened.cpu_shares) / node_cpu)
+    free_pct_ram = 1.0 - (float(util.flattened.memory_mb) / node_mem)
+
+    total = 10.0**free_pct_cpu + 10.0**free_pct_ram
+    score = 20.0 - total
+
+    if score > 18.0:
+        score = 18.0
+    elif score < 0.0:
+        score = 0.0
+    return score

@@ -1,0 +1,210 @@
+"""Port/bandwidth accounting for node networks.
+
+Same semantics as the reference ``nomad/structs/network.go`` (NetworkIndex
+:43, AssignNetwork, Overcommitted, AddReserved), but implemented with Python
+``set``s of used ports instead of pooled 8KB bitmaps, and with a
+deterministic-mode port picker so the TPU parity harness can compare plans.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+from .structs import (
+    MAX_DYNAMIC_PORT,
+    MAX_VALID_PORT,
+    MIN_DYNAMIC_PORT,
+    Allocation,
+    NetworkResource,
+    Node,
+    Port,
+)
+
+MAX_RAND_PORT_ATTEMPTS = 20
+
+
+def parse_port_ranges(spec: str) -> List[int]:
+    """Parse "80,100-200,205" into a sorted port list."""
+    ports: Set[int] = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo_s, hi_s = part.split("-", 1)
+            lo, hi = int(lo_s), int(hi_s)
+            if lo > hi:
+                raise ValueError(f"invalid port range {part}")
+            ports.update(range(lo, hi + 1))
+        else:
+            ports.add(int(part))
+    return sorted(ports)
+
+
+class NetworkIndex:
+    """Tracks available and used network resources on one node."""
+
+    def __init__(self, deterministic: bool = False) -> None:
+        self.avail_networks: List[NetworkResource] = []
+        self.avail_bandwidth: Dict[str, int] = {}
+        self.used_ports: Dict[str, Set[int]] = {}
+        self.used_bandwidth: Dict[str, int] = {}
+        # Deterministic mode picks the lowest free dynamic ports, for parity
+        # testing; the reference always randomizes (network.go stochastic pick).
+        self.deterministic = deterministic
+
+    def release(self) -> None:  # compat no-op; no pooled bitmaps here
+        pass
+
+    def overcommitted(self) -> bool:
+        for device, used in self.used_bandwidth.items():
+            if used > self.avail_bandwidth.get(device, 0):
+                return True
+        return False
+
+    def set_node(self, node: Node) -> bool:
+        """Set up available networks; returns True on collision."""
+        collide = False
+        for n in node.node_resources.networks:
+            if n.device:
+                self.avail_networks.append(n)
+                self.avail_bandwidth[n.device] = n.mbits
+        if node.reserved_resources is not None and node.reserved_resources.reserved_host_ports:
+            if self.add_reserved_port_range(node.reserved_resources.reserved_host_ports):
+                collide = True
+        return collide
+
+    def add_allocs(self, allocs: List[Allocation]) -> bool:
+        collide = False
+        for alloc in allocs:
+            if alloc.terminal_status():
+                continue
+            if alloc.allocated_resources is None:
+                continue
+            for network in alloc.allocated_resources.shared.networks:
+                if self.add_reserved(network):
+                    collide = True
+            for task in alloc.allocated_resources.tasks.values():
+                if not task.networks:
+                    continue
+                if self.add_reserved(task.networks[0]):
+                    collide = True
+        return collide
+
+    def add_reserved(self, n: NetworkResource) -> bool:
+        collide = False
+        used = self.used_ports.setdefault(n.ip, set())
+        for ports in (n.reserved_ports, n.dynamic_ports):
+            for port in ports:
+                if port.value < 0 or port.value >= MAX_VALID_PORT:
+                    return True
+                if port.value in used:
+                    collide = True
+                else:
+                    used.add(port.value)
+        self.used_bandwidth[n.device] = self.used_bandwidth.get(n.device, 0) + n.mbits
+        return collide
+
+    def add_reserved_port_range(self, ports: str) -> bool:
+        try:
+            res_ports = parse_port_ranges(ports)
+        except ValueError:
+            return False
+        collide = False
+        for n in self.avail_networks:
+            self.used_ports.setdefault(n.ip, set())
+        for used in self.used_ports.values():
+            for port in res_ports:
+                if port < 0 or port >= MAX_VALID_PORT:
+                    return True
+                if port in used:
+                    collide = True
+                else:
+                    used.add(port)
+        return collide
+
+    def assign_network(self, ask: NetworkResource) -> Tuple[Optional[NetworkResource], str]:
+        """Assign an offer for the ask; returns (offer|None, error_reason)."""
+        err = "no networks available"
+        for n in self.avail_networks:
+            ip = n.ip or (n.cidr.split("/")[0] if n.cidr else "")
+            if not ip:
+                continue
+
+            avail_bw = self.avail_bandwidth.get(n.device, 0)
+            used_bw = self.used_bandwidth.get(n.device, 0)
+            if used_bw + ask.mbits > avail_bw:
+                err = "bandwidth exceeded"
+                continue
+
+            used = self.used_ports.get(ip, set())
+
+            reserved_ok = True
+            for port in ask.reserved_ports:
+                if port.value < 0 or port.value >= MAX_VALID_PORT:
+                    err = f"invalid port {port.value} (out of range)"
+                    reserved_ok = False
+                    break
+                if port.value in used:
+                    err = "reserved port collision"
+                    reserved_ok = False
+                    break
+            if not reserved_ok:
+                continue
+
+            dyn_ports = self._pick_dynamic_ports(used, ask)
+            if dyn_ports is None:
+                err = "dynamic port selection failed"
+                continue
+
+            offer = NetworkResource(
+                mode=ask.mode,
+                device=n.device,
+                ip=ip,
+                mbits=ask.mbits,
+                reserved_ports=[Port(p.label, p.value, p.to) for p in ask.reserved_ports],
+                dynamic_ports=[
+                    Port(p.label, v, v if p.to == -1 else p.to)
+                    for p, v in zip(ask.dynamic_ports, dyn_ports)
+                ],
+            )
+            return offer, ""
+        return None, err
+
+    def _pick_dynamic_ports(self, used: Set[int], ask: NetworkResource) -> Optional[List[int]]:
+        needed = len(ask.dynamic_ports)
+        if needed == 0:
+            return []
+        blocked = set(used)
+        blocked.update(p.value for p in ask.reserved_ports)
+
+        if self.deterministic:
+            out: List[int] = []
+            for port in range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT):
+                if port not in blocked:
+                    out.append(port)
+                    blocked.add(port)
+                    if len(out) == needed:
+                        return out
+            return None
+
+        # Stochastic pick with precise fallback (reference network.go:318/:281)
+        picked: List[int] = []
+        for _ in range(needed):
+            for _attempt in range(MAX_RAND_PORT_ATTEMPTS):
+                cand = random.randint(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT - 1)
+                if cand not in blocked:
+                    picked.append(cand)
+                    blocked.add(cand)
+                    break
+            else:
+                break
+        if len(picked) == needed:
+            return picked
+
+        available = [p for p in range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT) if p not in blocked]
+        remaining = needed - len(picked)
+        if len(available) < remaining:
+            return None
+        picked.extend(random.sample(available, remaining))
+        return picked

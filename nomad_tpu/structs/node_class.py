@@ -1,0 +1,64 @@
+"""Computed node classes (reference ``nomad/structs/node_class.go``).
+
+A computed class is a stable hash over the *non-unique* identifying fields of
+a node: datacenter, node class, attributes, meta, and device signatures.
+Nodes sharing a computed class are interchangeable for constraint
+feasibility, which collapses O(nodes) checks to O(classes) — and, in the TPU
+engine, lets mask tensors be computed per class and gathered per node.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import TYPE_CHECKING, List
+
+if TYPE_CHECKING:
+    from .structs import Constraint, Node
+
+NODE_UNIQUE_NAMESPACE = "unique."
+
+
+def is_unique_namespace(key: str) -> bool:
+    return key.startswith(NODE_UNIQUE_NAMESPACE)
+
+
+def compute_node_class(node: "Node") -> str:
+    """Stable content hash of the node's class-relevant fields."""
+    devices = sorted(
+        (
+            d.vendor,
+            d.type,
+            d.name,
+            tuple(sorted((k, str(v)) for k, v in d.attributes.items() if not is_unique_namespace(k))),
+        )
+        for d in node.node_resources.devices
+    )
+    payload = {
+        "datacenter": node.datacenter,
+        "node_class": node.node_class,
+        "attributes": {k: v for k, v in sorted(node.attributes.items()) if not is_unique_namespace(k)},
+        "meta": {k: v for k, v in sorted(node.meta.items()) if not is_unique_namespace(k)},
+        "devices": devices,
+    }
+    digest = hashlib.blake2b(
+        json.dumps(payload, sort_keys=True, default=str).encode(), digest_size=8
+    ).hexdigest()
+    return f"v1:{digest}"
+
+
+def constraint_target_escapes(target: str) -> bool:
+    """Whether a constraint target defeats class-level memoization."""
+    return (
+        target.startswith("${node.unique.")
+        or target.startswith("${attr.unique.")
+        or target.startswith("${meta.unique.")
+    )
+
+
+def escaped_constraints(constraints: List["Constraint"]) -> List["Constraint"]:
+    """Constraints whose targets escape computed node classes."""
+    return [
+        c
+        for c in constraints
+        if constraint_target_escapes(c.ltarget) or constraint_target_escapes(c.rtarget)
+    ]

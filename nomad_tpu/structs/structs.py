@@ -1,0 +1,1290 @@
+"""Core data model for the TPU-native orchestrator.
+
+This is a fresh design with the same semantics as the reference's
+``nomad/structs/structs.go`` data model (Node structs.go:1508, Job :3285,
+TaskGroup :4687, Task :5263, Allocation :7466, Evaluation :8352, Plan :8645).
+Unlike the reference, resources are modelled with a single flattened
+``ComparableResources`` representation from the start (the reference carries
+legacy 0.8-era shapes alongside; we only implement the 0.9+ semantics), and
+every struct is designed so the scheduler can *densify* it into device tensors
+(see nomad_tpu/tpu/encode.py).
+"""
+from __future__ import annotations
+
+import time as _time
+import uuid as _uuid
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Constants (reference: nomad/structs/structs.go)
+# ---------------------------------------------------------------------------
+
+JOB_TYPE_CORE = "_core"
+JOB_TYPE_SERVICE = "service"
+JOB_TYPE_BATCH = "batch"
+JOB_TYPE_SYSTEM = "system"
+
+JOB_STATUS_PENDING = "pending"
+JOB_STATUS_RUNNING = "running"
+JOB_STATUS_DEAD = "dead"
+
+JOB_MIN_PRIORITY = 1
+JOB_DEFAULT_PRIORITY = 50
+JOB_MAX_PRIORITY = 100
+
+NODE_STATUS_INIT = "initializing"
+NODE_STATUS_READY = "ready"
+NODE_STATUS_DOWN = "down"
+
+NODE_SCHED_ELIGIBLE = "eligible"
+NODE_SCHED_INELIGIBLE = "ineligible"
+
+ALLOC_DESIRED_RUN = "run"
+ALLOC_DESIRED_STOP = "stop"
+ALLOC_DESIRED_EVICT = "evict"
+
+ALLOC_CLIENT_PENDING = "pending"
+ALLOC_CLIENT_RUNNING = "running"
+ALLOC_CLIENT_COMPLETE = "complete"
+ALLOC_CLIENT_FAILED = "failed"
+ALLOC_CLIENT_LOST = "lost"
+
+EVAL_STATUS_BLOCKED = "blocked"
+EVAL_STATUS_PENDING = "pending"
+EVAL_STATUS_COMPLETE = "complete"
+EVAL_STATUS_FAILED = "failed"
+EVAL_STATUS_CANCELLED = "canceled"
+
+EVAL_TRIGGER_JOB_REGISTER = "job-register"
+EVAL_TRIGGER_JOB_DEREGISTER = "job-deregister"
+EVAL_TRIGGER_PERIODIC_JOB = "periodic-job"
+EVAL_TRIGGER_NODE_DRAIN = "node-drain"
+EVAL_TRIGGER_NODE_UPDATE = "node-update"
+EVAL_TRIGGER_ALLOC_STOP = "alloc-stop"
+EVAL_TRIGGER_SCHEDULED = "scheduled"
+EVAL_TRIGGER_ROLLING_UPDATE = "rolling-update"
+EVAL_TRIGGER_DEPLOYMENT_WATCHER = "deployment-watcher"
+EVAL_TRIGGER_FAILED_FOLLOW_UP = "failed-follow-up"
+EVAL_TRIGGER_MAX_PLANS = "max-plan-attempts"
+EVAL_TRIGGER_RETRY_FAILED_ALLOC = "alloc-failure"
+EVAL_TRIGGER_QUEUED_ALLOCS = "queued-allocs"
+EVAL_TRIGGER_PREEMPTION = "preemption"
+
+CORE_JOB_EVAL_GC = "eval-gc"
+CORE_JOB_NODE_GC = "node-gc"
+CORE_JOB_JOB_GC = "job-gc"
+CORE_JOB_DEPLOYMENT_GC = "deployment-gc"
+CORE_JOB_FORCE_GC = "force-gc"
+
+# Constraint operands (reference structs.go:6619-6631)
+CONSTRAINT_DISTINCT_PROPERTY = "distinct_property"
+CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
+CONSTRAINT_REGEX = "regexp"
+CONSTRAINT_VERSION = "version"
+CONSTRAINT_SEMVER = "semver"
+CONSTRAINT_SET_CONTAINS = "set_contains"
+CONSTRAINT_SET_CONTAINS_ALL = "set_contains_all"
+CONSTRAINT_SET_CONTAINS_ANY = "set_contains_any"
+CONSTRAINT_ATTRIBUTE_IS_SET = "is_set"
+CONSTRAINT_ATTRIBUTE_IS_NOT_SET = "is_not_set"
+
+DEPLOYMENT_STATUS_RUNNING = "running"
+DEPLOYMENT_STATUS_PAUSED = "paused"
+DEPLOYMENT_STATUS_FAILED = "failed"
+DEPLOYMENT_STATUS_SUCCESSFUL = "successful"
+DEPLOYMENT_STATUS_CANCELLED = "cancelled"
+
+DEPLOYMENT_ACTIVE_STATUSES = (DEPLOYMENT_STATUS_RUNNING, DEPLOYMENT_STATUS_PAUSED)
+
+# Dynamic port range (reference structs/network.go:11-15)
+MIN_DYNAMIC_PORT = 20000
+MAX_DYNAMIC_PORT = 32000
+MAX_VALID_PORT = 65536
+
+
+def generate_uuid() -> str:
+    return str(_uuid.uuid4())
+
+
+def now_ns() -> int:
+    return _time.time_ns()
+
+
+# ---------------------------------------------------------------------------
+# Resources
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Port:
+    label: str = ""
+    value: int = 0
+    to: int = 0
+
+
+@dataclass
+class NetworkResource:
+    """A network ask or offer (reference structs.go NetworkResource)."""
+
+    mode: str = ""
+    device: str = ""
+    cidr: str = ""
+    ip: str = ""
+    mbits: int = 0
+    reserved_ports: List[Port] = field(default_factory=list)
+    dynamic_ports: List[Port] = field(default_factory=list)
+
+    def copy(self) -> "NetworkResource":
+        return NetworkResource(
+            mode=self.mode,
+            device=self.device,
+            cidr=self.cidr,
+            ip=self.ip,
+            mbits=self.mbits,
+            reserved_ports=[replace(p) for p in self.reserved_ports],
+            dynamic_ports=[replace(p) for p in self.dynamic_ports],
+        )
+
+
+@dataclass
+class RequestedDevice:
+    """A device ask on a task (reference structs.go RequestedDevice).
+
+    ``name`` may be "<vendor>/<type>/<name>", "<type>/<name>" or "<type>".
+    """
+
+    name: str = ""
+    count: int = 1
+    constraints: List["Constraint"] = field(default_factory=list)
+    affinities: List["Affinity"] = field(default_factory=list)
+
+    def id(self) -> "DeviceIdTuple":
+        parts = self.name.split("/")
+        if len(parts) >= 3:
+            return DeviceIdTuple(parts[0], parts[1], "/".join(parts[2:]))
+        if len(parts) == 2:
+            return DeviceIdTuple("", parts[0], parts[1])
+        return DeviceIdTuple("", self.name, "")
+
+
+@dataclass(frozen=True)
+class DeviceIdTuple:
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+
+    def matches(self, ask: "DeviceIdTuple") -> bool:
+        """Whether this concrete device group satisfies the (possibly
+        partially-specified) ask id (reference structs/devices semantics)."""
+        if ask.name and ask.name != self.name:
+            return False
+        if ask.type and ask.type != self.type:
+            return False
+        if ask.vendor and ask.vendor != self.vendor:
+            return False
+        return True
+
+
+@dataclass
+class Resources:
+    """Per-task resource ask (reference structs.go Resources)."""
+
+    cpu: int = 0  # MHz
+    memory_mb: int = 0
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[RequestedDevice] = field(default_factory=list)
+
+
+@dataclass
+class AllocatedDeviceResource:
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    device_ids: List[str] = field(default_factory=list)
+
+    def id(self) -> DeviceIdTuple:
+        return DeviceIdTuple(self.vendor, self.type, self.name)
+
+
+@dataclass
+class AllocatedTaskResources:
+    cpu_shares: int = 0
+    memory_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[AllocatedDeviceResource] = field(default_factory=list)
+
+    def add(self, other: "AllocatedTaskResources") -> None:
+        self.cpu_shares += other.cpu_shares
+        self.memory_mb += other.memory_mb
+
+    def subtract(self, other: "AllocatedTaskResources") -> None:
+        self.cpu_shares -= other.cpu_shares
+        self.memory_mb -= other.memory_mb
+
+
+@dataclass
+class AllocatedSharedResources:
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+
+
+@dataclass
+class AllocatedResources:
+    tasks: Dict[str, AllocatedTaskResources] = field(default_factory=dict)
+    shared: AllocatedSharedResources = field(default_factory=AllocatedSharedResources)
+
+    def comparable(self) -> "ComparableResources":
+        c = ComparableResources()
+        for tr in self.tasks.values():
+            c.flattened.add(tr)
+            c.flattened.networks.extend(tr.networks)
+        c.shared.disk_mb = self.shared.disk_mb
+        c.flattened.networks.extend(self.shared.networks)
+        return c
+
+
+@dataclass
+class ComparableResources:
+    """Flattened task-group resources (reference structs.go:3192)."""
+
+    flattened: AllocatedTaskResources = field(default_factory=AllocatedTaskResources)
+    shared: AllocatedSharedResources = field(default_factory=AllocatedSharedResources)
+
+    def add(self, other: Optional["ComparableResources"]) -> None:
+        if other is None:
+            return
+        self.flattened.add(other.flattened)
+        self.shared.disk_mb += other.shared.disk_mb
+
+    def subtract(self, other: Optional["ComparableResources"]) -> None:
+        if other is None:
+            return
+        self.flattened.subtract(other.flattened)
+        self.shared.disk_mb -= other.shared.disk_mb
+
+    def superset(self, other: "ComparableResources") -> Tuple[bool, str]:
+        """Reference structs.go:3227 — ignores networks."""
+        if self.flattened.cpu_shares < other.flattened.cpu_shares:
+            return False, "cpu"
+        if self.flattened.memory_mb < other.flattened.memory_mb:
+            return False, "memory"
+        if self.shared.disk_mb < other.shared.disk_mb:
+            return False, "disk"
+        return True, ""
+
+    def copy(self) -> "ComparableResources":
+        c = ComparableResources()
+        c.add(self)
+        return c
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeDeviceInstance:
+    id: str = ""
+    healthy: bool = True
+    locality: str = ""
+
+
+@dataclass
+class NodeDeviceResource:
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    instances: List[NodeDeviceInstance] = field(default_factory=list)
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    def id(self) -> DeviceIdTuple:
+        return DeviceIdTuple(self.vendor, self.type, self.name)
+
+
+@dataclass
+class NodeResources:
+    cpu_shares: int = 0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[NodeDeviceResource] = field(default_factory=list)
+
+    def comparable(self) -> ComparableResources:
+        c = ComparableResources()
+        c.flattened.cpu_shares = self.cpu_shares
+        c.flattened.memory_mb = self.memory_mb
+        c.shared.disk_mb = self.disk_mb
+        c.flattened.networks = list(self.networks)
+        return c
+
+
+@dataclass
+class NodeReservedResources:
+    cpu_shares: int = 0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    reserved_host_ports: str = ""
+
+    def comparable(self) -> ComparableResources:
+        c = ComparableResources()
+        c.flattened.cpu_shares = self.cpu_shares
+        c.flattened.memory_mb = self.memory_mb
+        c.shared.disk_mb = self.disk_mb
+        return c
+
+
+@dataclass
+class DriverInfo:
+    detected: bool = False
+    healthy: bool = False
+
+
+@dataclass
+class HostVolume:
+    name: str = ""
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Node:
+    """A client node (reference structs.go:1508)."""
+
+    id: str = field(default_factory=generate_uuid)
+    name: str = ""
+    datacenter: str = "dc1"
+    node_class: str = ""
+    attributes: Dict[str, str] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+    node_resources: NodeResources = field(default_factory=NodeResources)
+    reserved_resources: Optional[NodeReservedResources] = None
+    drivers: Dict[str, DriverInfo] = field(default_factory=dict)
+    host_volumes: Dict[str, HostVolume] = field(default_factory=dict)
+    status: str = NODE_STATUS_READY
+    status_description: str = ""
+    scheduling_eligibility: str = NODE_SCHED_ELIGIBLE
+    drain: bool = False
+    computed_class: str = ""
+    http_addr: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def comparable_resources(self) -> ComparableResources:
+        return self.node_resources.comparable()
+
+    def comparable_reserved_resources(self) -> Optional[ComparableResources]:
+        if self.reserved_resources is None:
+            return None
+        return self.reserved_resources.comparable()
+
+    def terminal_status(self) -> bool:
+        return self.status == NODE_STATUS_DOWN
+
+    def ready(self) -> bool:
+        return (
+            self.status == NODE_STATUS_READY
+            and not self.drain
+            and self.scheduling_eligibility == NODE_SCHED_ELIGIBLE
+        )
+
+    def compute_class(self) -> None:
+        from .node_class import compute_node_class
+
+        self.computed_class = compute_node_class(self)
+
+    def copy(self) -> "Node":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Job spec
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Constraint:
+    ltarget: str = ""
+    rtarget: str = ""
+    operand: str = "="
+
+    def __str__(self) -> str:
+        return f"{self.ltarget} {self.operand} {self.rtarget}"
+
+
+@dataclass
+class Affinity:
+    ltarget: str = ""
+    rtarget: str = ""
+    operand: str = "="
+    weight: int = 0  # [-100, 100]
+
+
+@dataclass
+class SpreadTarget:
+    value: str = ""
+    percent: int = 0
+
+
+@dataclass
+class Spread:
+    attribute: str = ""
+    weight: int = 0
+    spread_target: List[SpreadTarget] = field(default_factory=list)
+
+
+@dataclass
+class EphemeralDisk:
+    sticky: bool = False
+    size_mb: int = 150
+    migrate: bool = False
+
+
+@dataclass
+class ReschedulePolicy:
+    attempts: int = 0
+    interval_ns: int = 0
+    delay_ns: int = 0
+    delay_function: str = "constant"  # constant | exponential | fibonacci
+    max_delay_ns: int = 0
+    unlimited: bool = False
+
+
+@dataclass
+class RestartPolicy:
+    attempts: int = 2
+    interval_ns: int = 30 * 60 * 10**9
+    delay_ns: int = 15 * 10**9
+    mode: str = "fail"
+
+
+@dataclass
+class UpdateStrategy:
+    """Task-group update strategy (reference structs.go UpdateStrategy)."""
+
+    stagger_ns: int = 30 * 10**9
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_ns: int = 10 * 10**9
+    healthy_deadline_ns: int = 5 * 60 * 10**9
+    progress_deadline_ns: int = 10 * 60 * 10**9
+    auto_revert: bool = False
+    auto_promote: bool = False
+    canary: int = 0
+
+    def rolling(self) -> bool:
+        return self.max_parallel > 0
+
+
+@dataclass
+class MigrateStrategy:
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_ns: int = 10 * 10**9
+    healthy_deadline_ns: int = 5 * 60 * 10**9
+
+
+@dataclass
+class VolumeRequest:
+    name: str = ""
+    type: str = "host"
+    source: str = ""
+    read_only: bool = False
+
+
+VOLUME_TYPE_HOST = "host"
+
+
+@dataclass
+class Service:
+    name: str = ""
+    port_label: str = ""
+    tags: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Task:
+    name: str = ""
+    driver: str = ""
+    user: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+    resources: Resources = field(default_factory=Resources)
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    services: List[Service] = field(default_factory=list)
+    artifacts: List[Dict[str, Any]] = field(default_factory=list)
+    templates: List[Dict[str, Any]] = field(default_factory=list)
+    vault: Optional[Dict[str, Any]] = None
+    leader: bool = False
+    kill_timeout_ns: int = 5 * 10**9
+
+
+@dataclass
+class TaskGroup:
+    name: str = ""
+    count: int = 1
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    tasks: List[Task] = field(default_factory=list)
+    restart_policy: RestartPolicy = field(default_factory=RestartPolicy)
+    reschedule_policy: Optional[ReschedulePolicy] = None
+    ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
+    update: Optional[UpdateStrategy] = None
+    migrate: Optional[MigrateStrategy] = None
+    networks: List[NetworkResource] = field(default_factory=list)
+    volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+
+    def lookup_task(self, name: str) -> Optional[Task]:
+        for t in self.tasks:
+            if t.name == name:
+                return t
+        return None
+
+
+@dataclass
+class PeriodicConfig:
+    enabled: bool = False
+    spec: str = ""
+    spec_type: str = "cron"
+    prohibit_overlap: bool = False
+    timezone: str = "UTC"
+
+
+@dataclass
+class ParameterizedJobConfig:
+    payload: str = "optional"
+    meta_required: List[str] = field(default_factory=list)
+    meta_optional: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Job:
+    """A job specification (reference structs.go:3285)."""
+
+    id: str = ""
+    name: str = ""
+    namespace: str = "default"
+    region: str = "global"
+    type: str = JOB_TYPE_SERVICE
+    priority: int = JOB_DEFAULT_PRIORITY
+    all_at_once: bool = False
+    datacenters: List[str] = field(default_factory=lambda: ["dc1"])
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    task_groups: List[TaskGroup] = field(default_factory=list)
+    update: Optional[UpdateStrategy] = None
+    periodic: Optional[PeriodicConfig] = None
+    parameterized: Optional[ParameterizedJobConfig] = None
+    payload: bytes = b""
+    meta: Dict[str, str] = field(default_factory=dict)
+    stop: bool = False
+    parent_id: str = ""
+    status: str = JOB_STATUS_PENDING
+    status_description: str = ""
+    stable: bool = False
+    version: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    job_modify_index: int = 0
+
+    def stopped(self) -> bool:
+        return self.stop
+
+    def namespaced_id(self) -> Tuple[str, str]:
+        return (self.namespace, self.id)
+
+    def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
+        for tg in self.task_groups:
+            if tg.name == name:
+                return tg
+        return None
+
+    def combined_task_meta(self, tg_name: str, task_name: str) -> Dict[str, str]:
+        """Job -> group -> task meta, task wins (reference Job.CombinedTaskMeta)."""
+        out = dict(self.meta)
+        tg = self.lookup_task_group(tg_name)
+        if tg is not None:
+            out.update(tg.meta)
+            task = tg.lookup_task(task_name)
+            if task is not None:
+                out.update(task.meta)
+        return out
+
+    def is_periodic(self) -> bool:
+        return self.periodic is not None and self.periodic.enabled
+
+    def is_parameterized(self) -> bool:
+        return self.parameterized is not None
+
+    def copy(self) -> "Job":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Deployment
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeploymentState:
+    auto_revert: bool = False
+    auto_promote: bool = False
+    promoted: bool = False
+    placed_canaries: List[str] = field(default_factory=list)
+    desired_canaries: int = 0
+    desired_total: int = 0
+    placed_allocs: int = 0
+    healthy_allocs: int = 0
+    unhealthy_allocs: int = 0
+    progress_deadline_ns: int = 0
+    require_progress_by_ns: int = 0
+
+
+@dataclass
+class Deployment:
+    id: str = field(default_factory=generate_uuid)
+    namespace: str = "default"
+    job_id: str = ""
+    job_version: int = 0
+    job_modify_index: int = 0
+    job_create_index: int = 0
+    task_groups: Dict[str, DeploymentState] = field(default_factory=dict)
+    status: str = DEPLOYMENT_STATUS_RUNNING
+    status_description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def active(self) -> bool:
+        return self.status in DEPLOYMENT_ACTIVE_STATUSES
+
+    def get_id(self) -> str:
+        return self.id
+
+    def has_placed_canaries(self) -> bool:
+        return any(len(s.placed_canaries) > 0 for s in self.task_groups.values())
+
+    def requires_promotion(self) -> bool:
+        return any(
+            s.desired_canaries > 0 and not s.promoted for s in self.task_groups.values()
+        )
+
+    def copy(self) -> "Deployment":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+
+@dataclass
+class DeploymentStatusUpdate:
+    deployment_id: str = ""
+    status: str = ""
+    status_description: str = ""
+
+
+def deployment_get_id(d: Optional[Deployment]) -> str:
+    return d.id if d is not None else ""
+
+
+# ---------------------------------------------------------------------------
+# Allocation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RescheduleEvent:
+    reschedule_time_ns: int = 0
+    prev_alloc_id: str = ""
+    prev_node_id: str = ""
+    delay_ns: int = 0
+
+
+@dataclass
+class RescheduleTracker:
+    events: List[RescheduleEvent] = field(default_factory=list)
+
+
+@dataclass
+class DesiredTransition:
+    migrate: Optional[bool] = None
+    reschedule: Optional[bool] = None
+    force_reschedule: Optional[bool] = None
+
+    def should_migrate(self) -> bool:
+        return self.migrate is True
+
+    def should_force_reschedule(self) -> bool:
+        return self.force_reschedule is True
+
+
+@dataclass
+class AllocDeploymentStatus:
+    healthy: Optional[bool] = None
+    timestamp_ns: int = 0
+    canary: bool = False
+    modify_index: int = 0
+
+    def is_unhealthy(self) -> bool:
+        return self.healthy is False
+
+    def is_healthy(self) -> bool:
+        return self.healthy is True
+
+
+@dataclass
+class TaskState:
+    state: str = "pending"  # pending | running | dead
+    failed: bool = False
+    restarts: int = 0
+    started_at_ns: int = 0
+    finished_at_ns: int = 0
+
+    def successful(self) -> bool:
+        return self.state == "dead" and not self.failed
+
+
+@dataclass
+class Allocation:
+    """A placement of a task group on a node (reference structs.go:7466)."""
+
+    id: str = field(default_factory=generate_uuid)
+    namespace: str = "default"
+    eval_id: str = ""
+    name: str = ""
+    node_id: str = ""
+    node_name: str = ""
+    job_id: str = ""
+    job: Optional[Job] = None
+    task_group: str = ""
+    allocated_resources: Optional[AllocatedResources] = None
+    desired_status: str = ALLOC_DESIRED_RUN
+    desired_description: str = ""
+    desired_transition: DesiredTransition = field(default_factory=DesiredTransition)
+    client_status: str = ALLOC_CLIENT_PENDING
+    client_description: str = ""
+    task_states: Dict[str, TaskState] = field(default_factory=dict)
+    deployment_id: str = ""
+    deployment_status: Optional[AllocDeploymentStatus] = None
+    reschedule_tracker: Optional[RescheduleTracker] = None
+    previous_allocation: str = ""
+    next_allocation: str = ""
+    preempted_allocations: List[str] = field(default_factory=list)
+    preempted_by_allocation: str = ""
+    followup_eval_id: str = ""
+    metrics: Optional["AllocMetric"] = None
+    create_index: int = 0
+    modify_index: int = 0
+    alloc_modify_index: int = 0
+    create_time_ns: int = 0
+    modify_time_ns: int = 0
+
+    # -- status ------------------------------------------------------------
+
+    def server_terminal_status(self) -> bool:
+        return self.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT)
+
+    def client_terminal_status(self) -> bool:
+        return self.client_status in (
+            ALLOC_CLIENT_COMPLETE,
+            ALLOC_CLIENT_FAILED,
+            ALLOC_CLIENT_LOST,
+        )
+
+    def terminal_status(self) -> bool:
+        return self.server_terminal_status() or self.client_terminal_status()
+
+    def ran_successfully(self) -> bool:
+        if not self.task_states:
+            return False
+        return all(s.successful() for s in self.task_states.values())
+
+    # -- resources ---------------------------------------------------------
+
+    def comparable_resources(self) -> ComparableResources:
+        if self.allocated_resources is not None:
+            return self.allocated_resources.comparable()
+        return ComparableResources()
+
+    # -- rescheduling ------------------------------------------------------
+
+    def reschedule_policy(self) -> Optional[ReschedulePolicy]:
+        if self.job is None:
+            return None
+        tg = self.job.lookup_task_group(self.task_group)
+        if tg is None:
+            return None
+        return tg.reschedule_policy
+
+    def last_event_time_ns(self) -> int:
+        """Latest task finished/started timestamp (reference :7725)."""
+        last = 0
+        for s in self.task_states.values():
+            if s.finished_at_ns > last:
+                last = s.finished_at_ns
+        if last == 0:
+            last = self.modify_time_ns
+        return last
+
+    def next_delay_ns(self) -> int:
+        """Delay before this alloc may be rescheduled (reference :7779)."""
+        policy = self.reschedule_policy()
+        if policy is None:
+            return 0
+        delay = policy.delay_ns
+        tracker = self.reschedule_tracker
+        if tracker is None or not tracker.events:
+            return delay
+        events = tracker.events
+        if policy.delay_function == "exponential":
+            delay = events[-1].delay_ns * 2
+        elif policy.delay_function == "fibonacci":
+            if len(events) >= 2:
+                fib_n1 = events[-1].delay_ns
+                fib_n2 = events[-2].delay_ns
+                if fib_n2 == policy.max_delay_ns and fib_n1 == policy.delay_ns:
+                    delay = fib_n1
+                else:
+                    delay = fib_n1 + fib_n2
+        else:
+            return delay
+        if policy.max_delay_ns > 0 and delay > policy.max_delay_ns:
+            delay = policy.max_delay_ns
+            time_diff = self.last_event_time_ns() - events[-1].reschedule_time_ns
+            if time_diff > delay:
+                delay = policy.delay_ns
+        return delay
+
+    def next_reschedule_time(self) -> Tuple[int, bool]:
+        """(reschedule_time_ns, eligible) — reference :7752."""
+        fail_time = self.last_event_time_ns()
+        policy = self.reschedule_policy()
+        if (
+            self.desired_status == ALLOC_DESIRED_STOP
+            or self.client_status != ALLOC_CLIENT_FAILED
+            or fail_time == 0
+            or policy is None
+        ):
+            return 0, False
+        next_delay = self.next_delay_ns()
+        next_time = fail_time + next_delay
+        eligible = policy.unlimited or (
+            policy.attempts > 0 and self.reschedule_tracker is None
+        )
+        if policy.attempts > 0 and self.reschedule_tracker and self.reschedule_tracker.events:
+            attempted = 0
+            for ev in reversed(self.reschedule_tracker.events):
+                if fail_time - ev.reschedule_time_ns < policy.interval_ns:
+                    attempted += 1
+            eligible = attempted < policy.attempts and next_delay < policy.interval_ns
+        return next_time, eligible
+
+    def should_reschedule(self, policy: Optional[ReschedulePolicy], fail_time_ns: int) -> bool:
+        if self.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+            return False
+        if self.client_status != ALLOC_CLIENT_FAILED:
+            return False
+        return self.reschedule_eligible(policy, fail_time_ns)
+
+    def reschedule_eligible(self, policy: Optional[ReschedulePolicy], fail_time_ns: int) -> bool:
+        if policy is None:
+            return False
+        enabled = policy.attempts > 0 or policy.unlimited
+        if not enabled:
+            return False
+        if policy.unlimited:
+            return True
+        if self.reschedule_tracker is None or not self.reschedule_tracker.events:
+            return True
+        attempted = 0
+        for ev in reversed(self.reschedule_tracker.events):
+            if fail_time_ns - ev.reschedule_time_ns < policy.interval_ns:
+                attempted += 1
+        return attempted < policy.attempts
+
+    def copy(self) -> "Allocation":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+    def copy_skip_job(self) -> "Allocation":
+        import copy as _copy
+
+        job, self.job = self.job, None
+        try:
+            c = _copy.deepcopy(self)
+        finally:
+            self.job = job
+        c.job = job
+        return c
+
+
+# ---------------------------------------------------------------------------
+# Alloc metrics
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeScoreMeta:
+    node_id: str = ""
+    scores: Dict[str, float] = field(default_factory=dict)
+    norm_score: float = 0.0
+
+
+@dataclass
+class AllocMetric:
+    """Scheduling diagnostics carried on each alloc (reference structs.go:8035)."""
+
+    nodes_evaluated: int = 0
+    nodes_filtered: int = 0
+    nodes_available: Dict[str, int] = field(default_factory=dict)
+    class_filtered: Dict[str, int] = field(default_factory=dict)
+    constraint_filtered: Dict[str, int] = field(default_factory=dict)
+    nodes_exhausted: int = 0
+    class_exhausted: Dict[str, int] = field(default_factory=dict)
+    dimension_exhausted: Dict[str, int] = field(default_factory=dict)
+    quota_exhausted: List[str] = field(default_factory=list)
+    score_meta: List[NodeScoreMeta] = field(default_factory=list)
+    allocation_time_ns: int = 0
+    coalesced_failures: int = 0
+    # transient scratch, not serialized
+    _topk: int = 5
+
+    def evaluate_node(self) -> None:
+        self.nodes_evaluated += 1
+
+    def filter_node(self, node: Optional[Node], reason: str) -> None:
+        self.nodes_filtered += 1
+        if node is not None and node.node_class:
+            self.class_filtered[node.node_class] = self.class_filtered.get(node.node_class, 0) + 1
+        if reason:
+            self.constraint_filtered[reason] = self.constraint_filtered.get(reason, 0) + 1
+
+    def exhausted_node(self, node: Optional[Node], dimension: str) -> None:
+        self.nodes_exhausted += 1
+        if node is not None and node.node_class:
+            self.class_exhausted[node.node_class] = self.class_exhausted.get(node.node_class, 0) + 1
+        if dimension:
+            self.dimension_exhausted[dimension] = self.dimension_exhausted.get(dimension, 0) + 1
+
+    def score_node(self, node: Optional[Node], name: str, score: float) -> None:
+        if node is None:
+            return
+        for m in self.score_meta:
+            if m.node_id == node.id:
+                m.scores[name] = score
+                if name == "normalized-score":
+                    m.norm_score = score
+                return
+        m = NodeScoreMeta(node_id=node.id, scores={name: score})
+        if name == "normalized-score":
+            m.norm_score = score
+        self.score_meta.append(m)
+
+    def populate_score_meta_data(self) -> None:
+        """Keep only the top-K scored nodes (reference uses a kheap of 5)."""
+        self.score_meta.sort(key=lambda m: m.norm_score, reverse=True)
+        del self.score_meta[self._topk :]
+
+    def copy(self) -> "AllocMetric":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Evaluation:
+    """A scheduling trigger (reference structs.go:8352)."""
+
+    id: str = field(default_factory=generate_uuid)
+    namespace: str = "default"
+    priority: int = JOB_DEFAULT_PRIORITY
+    type: str = JOB_TYPE_SERVICE
+    triggered_by: str = EVAL_TRIGGER_JOB_REGISTER
+    job_id: str = ""
+    job_modify_index: int = 0
+    node_id: str = ""
+    node_modify_index: int = 0
+    deployment_id: str = ""
+    status: str = EVAL_STATUS_PENDING
+    status_description: str = ""
+    wait_ns: int = 0
+    wait_until_ns: int = 0
+    next_eval: str = ""
+    previous_eval: str = ""
+    blocked_eval: str = ""
+    failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
+    class_eligibility: Dict[str, bool] = field(default_factory=dict)
+    escaped_computed_class: bool = False
+    quota_limit_reached: str = ""
+    annotate_plan: bool = False
+    queued_allocations: Dict[str, int] = field(default_factory=dict)
+    leader_ack: str = ""
+    snapshot_index: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    create_time_ns: int = 0
+    modify_time_ns: int = 0
+
+    def terminal_status(self) -> bool:
+        return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, EVAL_STATUS_CANCELLED)
+
+    def should_enqueue(self) -> bool:
+        if self.status == EVAL_STATUS_PENDING:
+            return True
+        if self.status in (
+            EVAL_STATUS_COMPLETE,
+            EVAL_STATUS_FAILED,
+            EVAL_STATUS_BLOCKED,
+            EVAL_STATUS_CANCELLED,
+        ):
+            return False
+        raise ValueError(f"unhandled evaluation ({self.id}) status {self.status}")
+
+    def should_block(self) -> bool:
+        if self.status == EVAL_STATUS_BLOCKED:
+            return True
+        if self.status in (
+            EVAL_STATUS_COMPLETE,
+            EVAL_STATUS_FAILED,
+            EVAL_STATUS_PENDING,
+            EVAL_STATUS_CANCELLED,
+        ):
+            return False
+        raise ValueError(f"unhandled evaluation ({self.id}) status {self.status}")
+
+    def make_plan(self, job: Optional[Job]) -> "Plan":
+        p = Plan(
+            eval_id=self.id,
+            priority=self.priority,
+            job=job,
+        )
+        if job is not None:
+            p.all_at_once = job.all_at_once
+        return p
+
+    def next_rolling_eval(self, wait_ns: int) -> "Evaluation":
+        now = now_ns()
+        return Evaluation(
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=EVAL_TRIGGER_ROLLING_UPDATE,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait_ns=wait_ns,
+            previous_eval=self.id,
+            create_time_ns=now,
+            modify_time_ns=now,
+        )
+
+    def create_blocked_eval(
+        self,
+        class_eligibility: Optional[Dict[str, bool]],
+        escaped: bool,
+        quota_reached: str,
+    ) -> "Evaluation":
+        now = now_ns()
+        return Evaluation(
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=EVAL_TRIGGER_QUEUED_ALLOCS,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_BLOCKED,
+            previous_eval=self.id,
+            class_eligibility=class_eligibility or {},
+            escaped_computed_class=escaped,
+            quota_limit_reached=quota_reached,
+            create_time_ns=now,
+            modify_time_ns=now,
+        )
+
+    def create_failed_follow_up_eval(self, wait_ns: int) -> "Evaluation":
+        now = now_ns()
+        return Evaluation(
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=EVAL_TRIGGER_FAILED_FOLLOW_UP,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait_ns=wait_ns,
+            previous_eval=self.id,
+            create_time_ns=now,
+            modify_time_ns=now,
+        )
+
+    def update_modify_time(self) -> None:
+        now = now_ns()
+        self.modify_time_ns = max(now, self.create_time_ns + 1)
+
+    def copy(self) -> "Evaluation":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DesiredUpdates:
+    ignore: int = 0
+    place: int = 0
+    migrate: int = 0
+    stop: int = 0
+    in_place_update: int = 0
+    destructive_update: int = 0
+    canary: int = 0
+    preemptions: int = 0
+
+
+@dataclass
+class PlanAnnotations:
+    desired_tg_updates: Dict[str, DesiredUpdates] = field(default_factory=dict)
+    preempted_allocs: List[Allocation] = field(default_factory=list)
+
+
+@dataclass
+class Plan:
+    """A proposed set of mutations, submitted to the leader (reference structs.go:8645)."""
+
+    eval_id: str = ""
+    eval_token: str = ""
+    priority: int = JOB_DEFAULT_PRIORITY
+    all_at_once: bool = False
+    job: Optional[Job] = None
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    annotations: Optional[PlanAnnotations] = None
+    deployment: Optional[Deployment] = None
+    deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    snapshot_index: int = 0
+
+    def append_stopped_alloc(
+        self, alloc: Allocation, desired_desc: str, client_status: str = ""
+    ) -> None:
+        """Reference Plan.AppendStoppedAlloc (structs.go:8707)."""
+        new_alloc = alloc.copy_skip_job()
+        if self.job is None and alloc.job is not None:
+            self.job = alloc.job
+        new_alloc.job = None
+        new_alloc.desired_status = ALLOC_DESIRED_STOP
+        new_alloc.desired_description = desired_desc
+        if client_status:
+            new_alloc.client_status = client_status
+        self.node_update.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def append_preempted_alloc(self, alloc: Allocation, preempting_alloc_id: str) -> None:
+        new_alloc = Allocation(
+            id=alloc.id,
+            job_id=alloc.job_id,
+            namespace=alloc.namespace,
+            node_id=alloc.node_id,
+            desired_status=ALLOC_DESIRED_EVICT,
+            preempted_by_allocation=preempting_alloc_id,
+            desired_description=f"Preempted by alloc ID {preempting_alloc_id}",
+            allocated_resources=alloc.allocated_resources,
+            task_group=alloc.task_group,
+        )
+        self.node_preemptions.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def pop_update(self, alloc: Allocation) -> None:
+        existing = self.node_update.get(alloc.node_id, [])
+        if existing and existing[-1].id == alloc.id:
+            existing.pop()
+            if not existing:
+                self.node_update.pop(alloc.node_id, None)
+
+    def append_alloc(self, alloc: Allocation) -> None:
+        alloc.job = None
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def is_noop(self) -> bool:
+        return (
+            not self.node_update
+            and not self.node_allocation
+            and self.deployment is None
+            and not self.deployment_updates
+        )
+
+
+@dataclass
+class PlanResult:
+    """What the leader committed (reference structs.go:8819)."""
+
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    deployment: Optional[Deployment] = None
+    deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    refresh_index: int = 0
+    alloc_index: int = 0
+
+    def is_noop(self) -> bool:
+        return (
+            not self.node_update
+            and not self.node_allocation
+            and not self.deployment_updates
+            and self.deployment is None
+        )
+
+    def full_commit(self, plan: Plan) -> Tuple[bool, int, int]:
+        expected = 0
+        actual = 0
+        for node, alloc_list in plan.node_allocation.items():
+            expected += len(alloc_list)
+            actual += len(self.node_allocation.get(node, []))
+        return actual == expected, expected, actual
+
+
+# ---------------------------------------------------------------------------
+# Operator / scheduler configuration
+# ---------------------------------------------------------------------------
+
+
+SCHED_ALG_BINPACK = "binpack"
+SCHED_ALG_TPU_BINPACK = "tpu_binpack"
+
+
+@dataclass
+class PreemptionConfig:
+    system_scheduler_enabled: bool = True
+    batch_scheduler_enabled: bool = False
+    service_scheduler_enabled: bool = False
+
+
+@dataclass
+class SchedulerConfiguration:
+    """Runtime-mutable scheduler config (reference structs/operator.go:124).
+
+    ``scheduler_algorithm`` selects the placement backend:
+    ``binpack`` = host iterator pipeline (parity oracle),
+    ``tpu_binpack`` = batched JAX engine (the default).
+    """
+
+    scheduler_algorithm: str = SCHED_ALG_TPU_BINPACK
+    preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
+    create_index: int = 0
+    modify_index: int = 0

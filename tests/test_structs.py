@@ -1,0 +1,220 @@
+"""Structs-layer semantics tests (mirrors reference nomad/structs/funcs_test.go)."""
+import math
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    Allocation,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    ComparableResources,
+    Constraint,
+    NetworkIndex,
+    NetworkResource,
+    Port,
+    allocs_fit,
+    compute_node_class,
+    escaped_constraints,
+    filter_terminal_allocs,
+    remove_allocs,
+    score_fit,
+)
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP,
+)
+
+
+def _alloc_with(cpu, mem, disk=0):
+    return Allocation(
+        allocated_resources=AllocatedResources(
+            tasks={"web": AllocatedTaskResources(cpu_shares=cpu, memory_mb=mem)},
+            shared=AllocatedSharedResources(disk_mb=disk),
+        )
+    )
+
+
+def test_allocs_fit_single():
+    n = mock.node()
+    a = _alloc_with(1000, 1024, disk=5000)
+    fit, dim, used = allocs_fit(n, [a])
+    assert fit, dim
+    # reserved (100/256) + alloc (1000/1024)
+    assert used.flattened.cpu_shares == 1100
+    assert used.flattened.memory_mb == 1280
+
+
+def test_allocs_fit_overcommit_cpu():
+    n = mock.node()
+    a = _alloc_with(4000, 1024)  # node has 4000 total but 100 reserved
+    fit, dim, _ = allocs_fit(n, [a])
+    assert not fit
+    assert dim == "cpu"
+
+
+def test_allocs_fit_terminal_ignored():
+    n = mock.node()
+    live = _alloc_with(2000, 2048)
+    dead = _alloc_with(4000, 8192)
+    dead.desired_status = ALLOC_DESIRED_STOP
+    fit, dim, used = allocs_fit(n, [live, dead])
+    assert fit, dim
+    assert used.flattened.cpu_shares == 2100
+
+
+def test_allocs_fit_port_collision():
+    n = mock.node()
+    net = NetworkResource(device="eth0", ip="192.168.0.100", mbits=50,
+                          reserved_ports=[Port("main", 8000)])
+    mk = lambda: Allocation(
+        allocated_resources=AllocatedResources(
+            tasks={"web": AllocatedTaskResources(cpu_shares=100, memory_mb=100,
+                                                 networks=[net.copy()])},
+        )
+    )
+    fit, reason, _ = allocs_fit(n, [mk(), mk()])
+    assert not fit
+    assert reason == "reserved port collision"
+
+
+def test_score_fit_empty_node():
+    n = mock.node()
+    n.reserved_resources = None
+    util = ComparableResources()
+    # Empty node: 20 - (10^1 + 10^1) = 0... wait free pct = 1 each -> 20-20=0
+    assert score_fit(n, util) == 0.0
+
+
+def test_score_fit_full_node():
+    n = mock.node()
+    n.reserved_resources = None
+    util = ComparableResources(
+        flattened=AllocatedTaskResources(cpu_shares=4000, memory_mb=8192)
+    )
+    # Fully used: 20 - (10^0 + 10^0) = 18
+    assert score_fit(n, util) == 18.0
+
+
+def test_score_fit_half():
+    n = mock.node()
+    n.reserved_resources = None
+    util = ComparableResources(
+        flattened=AllocatedTaskResources(cpu_shares=2000, memory_mb=4096)
+    )
+    expected = 20.0 - 2 * math.pow(10, 0.5)
+    assert abs(score_fit(n, util) - expected) < 1e-9
+
+
+def test_filter_terminal_allocs():
+    a_live = _alloc_with(1, 1)
+    a_live.name = "x[0]"
+    t1 = _alloc_with(1, 1)
+    t1.name = "x[1]"
+    t1.desired_status = ALLOC_DESIRED_STOP
+    t1.create_index = 5
+    t2 = _alloc_with(1, 1)
+    t2.name = "x[1]"
+    t2.desired_status = ALLOC_DESIRED_STOP
+    t2.create_index = 10
+    live, terminal = filter_terminal_allocs([a_live, t1, t2])
+    assert live == [a_live]
+    assert terminal["x[1]"] is t2
+
+
+def test_remove_allocs():
+    a, b, c = _alloc_with(1, 1), _alloc_with(1, 1), _alloc_with(1, 1)
+    out = remove_allocs([a, b, c], [b])
+    assert [x.id for x in out] == [a.id, c.id]
+
+
+def test_terminal_status():
+    a = _alloc_with(1, 1)
+    assert not a.terminal_status()
+    a.client_status = ALLOC_CLIENT_FAILED
+    assert a.terminal_status()
+    a.client_status = ALLOC_CLIENT_RUNNING
+    a.desired_status = ALLOC_DESIRED_STOP
+    assert a.terminal_status()
+
+
+def test_network_index_assign():
+    n = mock.node()
+    idx = NetworkIndex(deterministic=True)
+    assert not idx.set_node(n)
+    ask = NetworkResource(mbits=50, dynamic_ports=[Port("http"), Port("admin")])
+    offer, err = idx.assign_network(ask)
+    assert offer is not None, err
+    assert offer.device == "eth0"
+    assert len(offer.dynamic_ports) == 2
+    assert offer.dynamic_ports[0].value != offer.dynamic_ports[1].value
+
+
+def test_network_index_reserved_collision():
+    n = mock.node()
+    idx = NetworkIndex(deterministic=True)
+    idx.set_node(n)  # reserves port 22 via reserved_host_ports
+    ask = NetworkResource(mbits=10, reserved_ports=[Port("ssh", 22)])
+    offer, err = idx.assign_network(ask)
+    assert offer is None
+    assert err == "reserved port collision"
+
+
+def test_network_index_bandwidth():
+    n = mock.node()
+    idx = NetworkIndex(deterministic=True)
+    idx.set_node(n)
+    ask = NetworkResource(mbits=2000)  # node has 1000
+    offer, err = idx.assign_network(ask)
+    assert offer is None
+    assert err == "bandwidth exceeded"
+
+
+def test_computed_class_stable_and_distinct():
+    n1 = mock.node()
+    n2 = mock.node()
+    # ids/names differ but class-relevant fields match
+    assert compute_node_class(n1) == compute_node_class(n2)
+    n2.attributes["kernel.name"] = "windows"
+    assert compute_node_class(n1) != compute_node_class(n2)
+    # unique-namespaced attributes are excluded
+    n3 = mock.node()
+    n3.attributes["unique.hostname"] = "zzz"
+    assert compute_node_class(n1) == compute_node_class(n3)
+
+
+def test_escaped_constraints():
+    escaped = Constraint(ltarget="${node.unique.id}", rtarget="x", operand="=")
+    unescaped = Constraint(ltarget="${attr.kernel.name}", rtarget="linux", operand="=")
+    out = escaped_constraints([escaped, unescaped])
+    assert out == [escaped]
+
+
+def test_plan_append_pop():
+    a = mock.alloc()
+    plan = mock.eval().make_plan(a.job)
+    plan.append_stopped_alloc(a, "test", "")
+    assert len(plan.node_update[a.node_id]) == 1
+    assert plan.node_update[a.node_id][0].desired_status == ALLOC_DESIRED_STOP
+    # Original untouched
+    assert a.desired_status == ALLOC_DESIRED_RUN
+    plan.pop_update(a)
+    assert a.node_id not in plan.node_update
+    assert plan.is_noop()
+
+
+def test_reschedule_next_delay_exponential():
+    from nomad_tpu.structs.structs import RescheduleEvent, ReschedulePolicy, RescheduleTracker
+
+    a = mock.alloc()
+    tg = a.job.task_groups[0]
+    tg.reschedule_policy = ReschedulePolicy(
+        unlimited=True, delay_function="exponential", delay_ns=5, max_delay_ns=100
+    )
+    assert a.next_delay_ns() == 5
+    a.reschedule_tracker = RescheduleTracker(events=[RescheduleEvent(delay_ns=5)])
+    assert a.next_delay_ns() == 10
+    a.reschedule_tracker.events.append(RescheduleEvent(delay_ns=10))
+    assert a.next_delay_ns() == 20
