@@ -1,0 +1,3 @@
+"""Scheduler (reference scheduler/): host parity pipeline + TPU engine entry."""
+from .context import EvalContext, EvalEligibility  # noqa: F401
+from .stack import GenericStack, SelectOptions, SystemStack  # noqa: F401

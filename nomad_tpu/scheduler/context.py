@@ -1,0 +1,133 @@
+"""Evaluation context and computed-class eligibility memoization.
+
+Semantics follow reference ``scheduler/context.go`` (EvalContext :75,
+ProposedAllocs :120, EvalEligibility :191).
+"""
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Dict, List, Optional
+
+from ..structs.node_class import escaped_constraints
+from ..structs.structs import Allocation, AllocMetric, Job, Plan
+from ..structs.funcs import remove_allocs
+
+
+class ComputedClassFeasibility(enum.Enum):
+    UNKNOWN = 0
+    INELIGIBLE = 1
+    ELIGIBLE = 2
+    ESCAPED = 3
+
+
+class EvalEligibility:
+    """Tracks per-computed-class eligibility over the course of an eval.
+
+    This is the reference's key O(classes) << O(nodes) optimization; the TPU
+    engine reuses it to compute feasibility masks per class and gather them
+    per node.
+    """
+
+    def __init__(self) -> None:
+        self.job: Dict[str, ComputedClassFeasibility] = {}
+        self.job_escaped = False
+        self.task_groups: Dict[str, Dict[str, ComputedClassFeasibility]] = {}
+        self.tg_escaped_constraints: Dict[str, bool] = {}
+        self.quota_reached = ""
+
+    def set_job(self, job: Job) -> None:
+        self.job_escaped = len(escaped_constraints(job.constraints)) != 0
+        for tg in job.task_groups:
+            constraints = list(tg.constraints)
+            for task in tg.tasks:
+                constraints.extend(task.constraints)
+            self.tg_escaped_constraints[tg.name] = len(escaped_constraints(constraints)) != 0
+
+    def has_escaped(self) -> bool:
+        return self.job_escaped or any(self.tg_escaped_constraints.values())
+
+    def get_classes(self) -> Dict[str, bool]:
+        elig: Dict[str, bool] = {}
+        for classes in self.task_groups.values():
+            for cls, feas in classes.items():
+                if feas == ComputedClassFeasibility.ELIGIBLE:
+                    elig[cls] = True
+                elif feas == ComputedClassFeasibility.INELIGIBLE:
+                    elig.setdefault(cls, False)
+        for cls, feas in self.job.items():
+            if feas == ComputedClassFeasibility.ELIGIBLE:
+                elig.setdefault(cls, True)
+            elif feas == ComputedClassFeasibility.INELIGIBLE:
+                elig[cls] = False
+        return elig
+
+    def job_status(self, cls: str) -> ComputedClassFeasibility:
+        if self.job_escaped:
+            return ComputedClassFeasibility.ESCAPED
+        return self.job.get(cls, ComputedClassFeasibility.UNKNOWN)
+
+    def set_job_eligibility(self, eligible: bool, cls: str) -> None:
+        self.job[cls] = (
+            ComputedClassFeasibility.ELIGIBLE if eligible else ComputedClassFeasibility.INELIGIBLE
+        )
+
+    def task_group_status(self, tg: str, cls: str) -> ComputedClassFeasibility:
+        if self.tg_escaped_constraints.get(tg, False):
+            return ComputedClassFeasibility.ESCAPED
+        return self.task_groups.get(tg, {}).get(cls, ComputedClassFeasibility.UNKNOWN)
+
+    def set_task_group_eligibility(self, eligible: bool, tg: str, cls: str) -> None:
+        self.task_groups.setdefault(tg, {})[cls] = (
+            ComputedClassFeasibility.ELIGIBLE if eligible else ComputedClassFeasibility.INELIGIBLE
+        )
+
+    def set_quota_limit_reached(self, quota: str) -> None:
+        self.quota_reached = quota
+
+    def quota_limit_reached(self) -> str:
+        return self.quota_reached
+
+
+class EvalContext:
+    """Contextual state for one evaluation (state snapshot, plan, metrics)."""
+
+    def __init__(self, state, plan: Plan, logger: Optional[logging.Logger] = None,
+                 deterministic: bool = False) -> None:
+        self.state = state
+        self.plan = plan
+        self.logger = logger or logging.getLogger("nomad_tpu.scheduler")
+        self.metrics = AllocMetric()
+        self.eligibility: Optional[EvalEligibility] = None
+        # caches
+        self.regexp_cache: Dict[str, object] = {}
+        self.version_constraint_cache: Dict[str, object] = {}
+        self.semver_constraint_cache: Dict[str, object] = {}
+        # deterministic scheduling (no shuffle, lowest-index dynamic ports);
+        # used by the host/TPU parity harness
+        self.deterministic = deterministic
+
+    def reset(self) -> None:
+        self.metrics = AllocMetric()
+
+    def proposed_allocs(self, node_id: str) -> List[Allocation]:
+        """Existing non-terminal allocs - planned evictions - preemptions
+        + planned placements (reference context.go:120)."""
+        existing = self.state.allocs_by_node_terminal(node_id, False)
+        proposed = existing
+        update = self.plan.node_update.get(node_id, [])
+        if update:
+            proposed = remove_allocs(existing, update)
+        preempted = self.plan.node_preemptions.get(node_id, [])
+        if preempted:
+            proposed = remove_allocs(proposed, preempted)
+        # Index by ID so in-place updates override rather than double count.
+        by_id = {a.id: a for a in proposed}
+        for alloc in self.plan.node_allocation.get(node_id, []):
+            by_id[alloc.id] = alloc
+        return list(by_id.values())
+
+    def get_eligibility(self) -> EvalEligibility:
+        if self.eligibility is None:
+            self.eligibility = EvalEligibility()
+        return self.eligibility

@@ -1,0 +1,660 @@
+"""Host-side feasibility checking.
+
+This is the semantics oracle mirroring reference ``scheduler/feasible.go``:
+each checker here corresponds 1:1 to a mask tensor in the TPU engine
+(nomad_tpu/tpu/engine.py). StaticIterator :44, HostVolumeChecker :102,
+DriverChecker :182, DistinctHostsIterator :254, DistinctPropertyIterator
+:353, ConstraintChecker :458, checkConstraint :534, FeasibilityWrapper :778,
+DeviceChecker :893.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..structs.structs import (
+    CONSTRAINT_ATTRIBUTE_IS_NOT_SET,
+    CONSTRAINT_ATTRIBUTE_IS_SET,
+    CONSTRAINT_DISTINCT_HOSTS,
+    CONSTRAINT_DISTINCT_PROPERTY,
+    CONSTRAINT_REGEX,
+    CONSTRAINT_SEMVER,
+    CONSTRAINT_SET_CONTAINS,
+    CONSTRAINT_SET_CONTAINS_ALL,
+    CONSTRAINT_SET_CONTAINS_ANY,
+    CONSTRAINT_VERSION,
+    VOLUME_TYPE_HOST,
+    Constraint,
+    Job,
+    Node,
+    NodeDeviceResource,
+    RequestedDevice,
+    TaskGroup,
+    VolumeRequest,
+)
+from .context import ComputedClassFeasibility, EvalContext
+from .versions import Constraints as VersionConstraints, Version
+from .util import shuffle_nodes
+
+
+# ---------------------------------------------------------------------------
+# Target resolution / constraint evaluation
+# ---------------------------------------------------------------------------
+
+
+def resolve_target(target: str, node: Node) -> Tuple[Any, bool]:
+    """Resolve ``${node.*}`` / ``${attr.*}`` / ``${meta.*}`` interpolations;
+    a non-interpolated target is a literal (reference feasible.go:497)."""
+    if not target.startswith("${"):
+        return target, True
+    if target == "${node.unique.id}":
+        return node.id, True
+    if target == "${node.datacenter}":
+        return node.datacenter, True
+    if target == "${node.unique.name}":
+        return node.name, True
+    if target == "${node.class}":
+        return node.node_class, True
+    if target.startswith("${attr."):
+        attr = target[len("${attr.") : -1]
+        if attr in node.attributes:
+            return node.attributes[attr], True
+        return None, False
+    if target.startswith("${meta."):
+        meta = target[len("${meta.") : -1]
+        if meta in node.meta:
+            return node.meta[meta], True
+        return None, False
+    return None, False
+
+
+def check_lexical_order(op: str, lval: Any, rval: Any) -> bool:
+    if not isinstance(lval, str) or not isinstance(rval, str):
+        return False
+    if op == "<":
+        return lval < rval
+    if op == "<=":
+        return lval <= rval
+    if op == ">":
+        return lval > rval
+    if op == ">=":
+        return lval >= rval
+    return False
+
+
+def check_version_match(ctx: EvalContext, lval: Any, rval: Any, strict: bool) -> bool:
+    if isinstance(lval, int):
+        lval = str(lval)
+    if not isinstance(lval, str) or not isinstance(rval, str):
+        return False
+    # The version value itself is always leniently parsed; only the
+    # constraint syntax differs between version/semver (reference semver.go).
+    vers = Version.parse(lval, strict=strict)
+    if vers is None:
+        return False
+    cache = ctx.semver_constraint_cache if strict else ctx.version_constraint_cache
+    cons = cache.get(rval)
+    if cons is None:
+        cons = VersionConstraints.parse(rval, strict=strict)
+        if cons is None:
+            return False
+        cache[rval] = cons
+    return cons.check(vers)
+
+
+def check_regexp_match(ctx: EvalContext, lval: Any, rval: Any) -> bool:
+    if not isinstance(lval, str) or not isinstance(rval, str):
+        return False
+    regex = ctx.regexp_cache.get(rval)
+    if regex is None:
+        try:
+            regex = re.compile(rval)
+        except re.error:
+            return False
+        ctx.regexp_cache[rval] = regex
+    return regex.search(lval) is not None
+
+
+def _split_set(s: str) -> List[str]:
+    return [p.strip() for p in s.split(",")]
+
+
+def check_set_contains_all(lval: Any, rval: Any) -> bool:
+    if not isinstance(lval, str) or not isinstance(rval, str):
+        return False
+    have = set(_split_set(lval))
+    return all(item in have for item in _split_set(rval))
+
+
+def check_set_contains_any(lval: Any, rval: Any) -> bool:
+    if not isinstance(lval, str) or not isinstance(rval, str):
+        return False
+    have = set(_split_set(lval))
+    return any(item in have for item in _split_set(rval))
+
+
+def check_constraint(
+    ctx: EvalContext, operand: str, lval: Any, rval: Any, lfound: bool, rfound: bool
+) -> bool:
+    """Reference feasible.go:534 — full operand table."""
+    if operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY):
+        return True
+    if operand in ("=", "==", "is"):
+        return lfound and rfound and lval == rval
+    if operand in ("!=", "not"):
+        return lval != rval
+    if operand in ("<", "<=", ">", ">="):
+        return lfound and rfound and check_lexical_order(operand, lval, rval)
+    if operand == CONSTRAINT_ATTRIBUTE_IS_SET:
+        return lfound
+    if operand == CONSTRAINT_ATTRIBUTE_IS_NOT_SET:
+        return not lfound
+    if operand == CONSTRAINT_VERSION:
+        return lfound and rfound and check_version_match(ctx, lval, rval, strict=False)
+    if operand == CONSTRAINT_SEMVER:
+        return lfound and rfound and check_version_match(ctx, lval, rval, strict=True)
+    if operand == CONSTRAINT_REGEX:
+        return lfound and rfound and check_regexp_match(ctx, lval, rval)
+    if operand in (CONSTRAINT_SET_CONTAINS, CONSTRAINT_SET_CONTAINS_ALL):
+        return lfound and rfound and check_set_contains_all(lval, rval)
+    if operand == CONSTRAINT_SET_CONTAINS_ANY:
+        return lfound and rfound and check_set_contains_any(lval, rval)
+    return False
+
+
+def check_affinity(ctx, operand, lval, rval, lfound, rfound) -> bool:
+    return check_constraint(ctx, operand, lval, rval, lfound, rfound)
+
+
+def matches_affinity(ctx: EvalContext, affinity, node: Node) -> bool:
+    lval, lok = resolve_target(affinity.ltarget, node)
+    rval, rok = resolve_target(affinity.rtarget, node)
+    return check_affinity(ctx, affinity.operand, lval, rval, lok, rok)
+
+
+# ---------------------------------------------------------------------------
+# Device attribute constraints (reference feasible.go:1054)
+# ---------------------------------------------------------------------------
+
+
+def resolve_device_target(target: str, d: NodeDeviceResource) -> Tuple[Any, bool]:
+    if not target.startswith("${"):
+        return _parse_attribute(target), True
+    if target == "${device.model}":
+        return d.name, True
+    if target == "${device.vendor}":
+        return d.vendor, True
+    if target == "${device.type}":
+        return d.type, True
+    if target.startswith("${device.attr."):
+        attr = target[len("${device.attr.") : -1]
+        if attr in d.attributes:
+            return d.attributes[attr], True
+        return None, False
+    return None, False
+
+
+def _parse_attribute(s: str) -> Any:
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        pass
+    try:
+        return float(s)
+    except (TypeError, ValueError):
+        pass
+    if isinstance(s, str):
+        if s.lower() == "true":
+            return True
+        if s.lower() == "false":
+            return False
+    return s
+
+
+def _attr_compare(lval: Any, rval: Any) -> Optional[int]:
+    """Typed comparison; None if the types aren't comparable."""
+    if isinstance(lval, bool) != isinstance(rval, bool):
+        return None
+    if isinstance(lval, (int, float)) and isinstance(rval, (int, float)):
+        return (lval > rval) - (lval < rval)
+    if isinstance(lval, str) and isinstance(rval, str):
+        return (lval > rval) - (lval < rval)
+    if isinstance(lval, bool) and isinstance(rval, bool):
+        return (lval > rval) - (lval < rval)
+    return None
+
+
+def check_attribute_constraint(
+    ctx: EvalContext, operand: str, lval: Any, rval: Any, lfound: bool, rfound: bool
+) -> bool:
+    if operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY):
+        return True
+    if operand in ("!=", "not"):
+        if not (lfound or rfound):
+            return False
+        if lfound != rfound:
+            return True
+        v = _attr_compare(lval, rval)
+        return v is not None and v != 0
+    if operand in ("<", "<=", ">", ">=", "=", "==", "is"):
+        if not (lfound and rfound):
+            return False
+        v = _attr_compare(lval, rval)
+        if v is None:
+            return False
+        return {
+            "is": v == 0, "==": v == 0, "=": v == 0,
+            "<": v < 0, "<=": v <= 0, ">": v > 0, ">=": v >= 0,
+        }[operand]
+    if operand in (CONSTRAINT_VERSION, CONSTRAINT_SEMVER):
+        if not (lfound and rfound):
+            return False
+        return check_version_match(ctx, str(lval), str(rval), strict=operand == CONSTRAINT_SEMVER)
+    if operand == CONSTRAINT_REGEX:
+        if not (lfound and rfound):
+            return False
+        return isinstance(lval, str) and isinstance(rval, str) and check_regexp_match(ctx, lval, rval)
+    if operand in (CONSTRAINT_SET_CONTAINS, CONSTRAINT_SET_CONTAINS_ALL):
+        return lfound and rfound and check_set_contains_all(lval, rval)
+    if operand == CONSTRAINT_SET_CONTAINS_ANY:
+        return lfound and rfound and check_set_contains_any(lval, rval)
+    if operand == CONSTRAINT_ATTRIBUTE_IS_SET:
+        return lfound
+    if operand == CONSTRAINT_ATTRIBUTE_IS_NOT_SET:
+        return not lfound
+    return False
+
+
+def check_attribute_affinity(ctx, operand, lval, rval, lfound, rfound) -> bool:
+    return check_attribute_constraint(ctx, operand, lval, rval, lfound, rfound)
+
+
+def node_device_matches(ctx: EvalContext, d: NodeDeviceResource, req: RequestedDevice) -> bool:
+    """Reference feasible.go:998 — id match plus attr constraints (no count)."""
+    if not d.id().matches(req.id()):
+        return False
+    for c in req.constraints:
+        lval, lok = resolve_device_target(c.ltarget, d)
+        rval, rok = resolve_device_target(c.rtarget, d)
+        if not check_attribute_constraint(ctx, c.operand, lval, rval, lok, rok):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Source iterators
+# ---------------------------------------------------------------------------
+
+
+class StaticIterator:
+    """Yields nodes in fixed order; Reset() replays from the start of the
+    ring so every node is seen at most once per pass (feasible.go:44)."""
+
+    def __init__(self, ctx: EvalContext, nodes: Optional[List[Node]]) -> None:
+        self.ctx = ctx
+        self.nodes: List[Node] = nodes or []
+        self.offset = 0
+        self.seen = 0
+
+    def next(self) -> Optional[Node]:
+        n = len(self.nodes)
+        if self.offset == n or self.seen == n:
+            if self.seen != n:
+                self.offset = 0
+            else:
+                return None
+        option = self.nodes[self.offset]
+        self.offset += 1
+        self.seen += 1
+        self.ctx.metrics.evaluate_node()
+        return option
+
+    def reset(self) -> None:
+        self.seen = 0
+
+    def set_nodes(self, nodes: List[Node]) -> None:
+        self.nodes = nodes
+        self.offset = 0
+        self.seen = 0
+
+
+def new_random_iterator(ctx: EvalContext, nodes: List[Node]) -> StaticIterator:
+    if not ctx.deterministic:
+        shuffle_nodes(nodes)
+    return StaticIterator(ctx, nodes)
+
+
+# ---------------------------------------------------------------------------
+# Checkers
+# ---------------------------------------------------------------------------
+
+
+class HostVolumeChecker:
+    def __init__(self, ctx: EvalContext) -> None:
+        self.ctx = ctx
+        self.volumes: Dict[str, List[VolumeRequest]] = {}
+
+    def set_volumes(self, volumes: Dict[str, VolumeRequest]) -> None:
+        lookup: Dict[str, List[VolumeRequest]] = {}
+        for req in volumes.values():
+            if req.type != VOLUME_TYPE_HOST:
+                continue
+            lookup.setdefault(req.source, []).append(req)
+        self.volumes = lookup
+
+    def feasible(self, node: Node) -> bool:
+        if self._has_volumes(node):
+            return True
+        self.ctx.metrics.filter_node(node, "missing compatible host volumes")
+        return False
+
+    def _has_volumes(self, node: Node) -> bool:
+        if not self.volumes:
+            return True
+        if len(self.volumes) > len(node.host_volumes):
+            return False
+        for source, requests in self.volumes.items():
+            vol = node.host_volumes.get(source)
+            if vol is None:
+                return False
+            if not vol.read_only:
+                continue
+            if any(not req.read_only for req in requests):
+                return False
+        return True
+
+
+class DriverChecker:
+    def __init__(self, ctx: EvalContext, drivers: Optional[Iterable[str]] = None) -> None:
+        self.ctx = ctx
+        self.drivers = set(drivers or ())
+
+    def set_drivers(self, drivers: Iterable[str]) -> None:
+        self.drivers = set(drivers)
+
+    def feasible(self, node: Node) -> bool:
+        if self._has_drivers(node):
+            return True
+        self.ctx.metrics.filter_node(node, "missing drivers")
+        return False
+
+    def _has_drivers(self, node: Node) -> bool:
+        for driver in self.drivers:
+            info = node.drivers.get(driver)
+            if info is not None:
+                if info.detected and info.healthy:
+                    continue
+                return False
+            value = node.attributes.get(f"driver.{driver}")
+            if value is None:
+                return False
+            if str(value).lower() not in ("1", "true"):
+                return False
+        return True
+
+
+class ConstraintChecker:
+    def __init__(self, ctx: EvalContext, constraints: Optional[List[Constraint]] = None) -> None:
+        self.ctx = ctx
+        self.constraints = constraints or []
+
+    def set_constraints(self, constraints: List[Constraint]) -> None:
+        self.constraints = constraints
+
+    def feasible(self, node: Node) -> bool:
+        for constraint in self.constraints:
+            if not self._meets_constraint(constraint, node):
+                self.ctx.metrics.filter_node(node, str(constraint))
+                return False
+        return True
+
+    def _meets_constraint(self, constraint: Constraint, node: Node) -> bool:
+        lval, lok = resolve_target(constraint.ltarget, node)
+        rval, rok = resolve_target(constraint.rtarget, node)
+        return check_constraint(self.ctx, constraint.operand, lval, rval, lok, rok)
+
+
+class DeviceChecker:
+    def __init__(self, ctx: EvalContext) -> None:
+        self.ctx = ctx
+        self.required: List[RequestedDevice] = []
+
+    def set_task_group(self, tg: TaskGroup) -> None:
+        self.required = []
+        for task in tg.tasks:
+            self.required.extend(task.resources.devices)
+
+    def feasible(self, node: Node) -> bool:
+        if self._has_devices(node):
+            return True
+        self.ctx.metrics.filter_node(node, "missing devices")
+        return False
+
+    def _has_devices(self, node: Node) -> bool:
+        if not self.required:
+            return True
+        node_devs = node.node_resources.devices
+        if not node_devs:
+            return False
+        available = {}
+        for d in node_devs:
+            healthy = sum(1 for inst in d.instances if inst.healthy)
+            if healthy:
+                available[id(d)] = (d, healthy)
+        for req in self.required:
+            matched = False
+            for key, (d, unused) in available.items():
+                if unused == 0 or unused < req.count:
+                    continue
+                if node_device_matches(self.ctx, d, req):
+                    available[key] = (d, unused - req.count)
+                    matched = True
+                    break
+            if not matched:
+                return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Distinct hosts / distinct property iterators
+# ---------------------------------------------------------------------------
+
+
+class DistinctHostsIterator:
+    def __init__(self, ctx: EvalContext, source) -> None:
+        self.ctx = ctx
+        self.source = source
+        self.tg: Optional[TaskGroup] = None
+        self.job: Optional[Job] = None
+        self.tg_distinct_hosts = False
+        self.job_distinct_hosts = False
+
+    @staticmethod
+    def _has_distinct_hosts(constraints: List[Constraint]) -> bool:
+        return any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in constraints)
+
+    def set_task_group(self, tg: TaskGroup) -> None:
+        self.tg = tg
+        self.tg_distinct_hosts = self._has_distinct_hosts(tg.constraints)
+
+    def set_job(self, job: Job) -> None:
+        self.job = job
+        self.job_distinct_hosts = self._has_distinct_hosts(job.constraints)
+
+    def next(self) -> Optional[Node]:
+        while True:
+            option = self.source.next()
+            if option is None or not (self.job_distinct_hosts or self.tg_distinct_hosts):
+                return option
+            if not self._satisfies(option):
+                self.ctx.metrics.filter_node(option, CONSTRAINT_DISTINCT_HOSTS)
+                continue
+            return option
+
+    def _satisfies(self, option: Node) -> bool:
+        proposed = self.ctx.proposed_allocs(option.id)
+        for alloc in proposed:
+            job_collision = alloc.job_id == self.job.id
+            task_collision = alloc.task_group == self.tg.name
+            if (self.job_distinct_hosts and job_collision) or (job_collision and task_collision):
+                return False
+        return True
+
+    def reset(self) -> None:
+        self.source.reset()
+
+
+class DistinctPropertyIterator:
+    def __init__(self, ctx: EvalContext, source) -> None:
+        from .propertyset import PropertySet
+
+        self.ctx = ctx
+        self.source = source
+        self.tg: Optional[TaskGroup] = None
+        self.job: Optional[Job] = None
+        self.has_distinct_property_constraints = False
+        self.job_property_sets: List = []
+        self.group_property_sets: Dict[str, List] = {}
+        self._PropertySet = PropertySet
+
+    def set_task_group(self, tg: TaskGroup) -> None:
+        self.tg = tg
+        if tg.name not in self.group_property_sets:
+            sets = []
+            for c in tg.constraints:
+                if c.operand != CONSTRAINT_DISTINCT_PROPERTY:
+                    continue
+                pset = self._PropertySet(self.ctx, self.job)
+                pset.set_tg_constraint(c, tg.name)
+                sets.append(pset)
+            self.group_property_sets[tg.name] = sets
+        self.has_distinct_property_constraints = bool(
+            self.job_property_sets or self.group_property_sets[tg.name]
+        )
+
+    def set_job(self, job: Job) -> None:
+        self.job = job
+        for c in job.constraints:
+            if c.operand != CONSTRAINT_DISTINCT_PROPERTY:
+                continue
+            pset = self._PropertySet(self.ctx, job)
+            pset.set_job_constraint(c)
+            self.job_property_sets.append(pset)
+
+    def next(self) -> Optional[Node]:
+        while True:
+            option = self.source.next()
+            if option is None or not self.has_distinct_property_constraints:
+                return option
+            if not self._satisfies(option, self.job_property_sets):
+                continue
+            if not self._satisfies(option, self.group_property_sets.get(self.tg.name, [])):
+                continue
+            return option
+
+    def _satisfies(self, option: Node, psets) -> bool:
+        for ps in psets:
+            satisfies, reason = ps.satisfies_distinct_properties(option, self.tg.name)
+            if not satisfies:
+                self.ctx.metrics.filter_node(option, reason)
+                return False
+        return True
+
+    def reset(self) -> None:
+        self.source.reset()
+        for ps in self.job_property_sets:
+            ps.populate_proposed()
+        for sets in self.group_property_sets.values():
+            for ps in sets:
+                ps.populate_proposed()
+
+
+# ---------------------------------------------------------------------------
+# Feasibility wrapper with computed-class memoization
+# ---------------------------------------------------------------------------
+
+
+class FeasibilityWrapper:
+    """Skips per-node checks when the node's computed class is already known
+    eligible/ineligible (reference feasible.go:778)."""
+
+    def __init__(self, ctx: EvalContext, source, job_checkers, tg_checkers) -> None:
+        self.ctx = ctx
+        self.source = source
+        self.job_checkers = job_checkers
+        self.tg_checkers = tg_checkers
+        self.tg = ""
+
+    def set_task_group(self, tg_name: str) -> None:
+        self.tg = tg_name
+
+    def reset(self) -> None:
+        self.source.reset()
+
+    def next(self) -> Optional[Node]:
+        elig = self.ctx.get_eligibility()
+        metrics = self.ctx.metrics
+        while True:
+            option = self.source.next()
+            if option is None:
+                return None
+
+            job_escaped = job_unknown = False
+            status = elig.job_status(option.computed_class)
+            if status == ComputedClassFeasibility.INELIGIBLE:
+                metrics.filter_node(option, "computed class ineligible")
+                continue
+            elif status == ComputedClassFeasibility.ESCAPED:
+                job_escaped = True
+            elif status == ComputedClassFeasibility.UNKNOWN:
+                job_unknown = True
+
+            failed_job = False
+            for check in self.job_checkers:
+                if not check.feasible(option):
+                    if not job_escaped:
+                        elig.set_job_eligibility(False, option.computed_class)
+                    failed_job = True
+                    break
+            if failed_job:
+                continue
+            if not job_escaped and job_unknown:
+                elig.set_job_eligibility(True, option.computed_class)
+
+            tg_escaped = tg_unknown = False
+            status = elig.task_group_status(self.tg, option.computed_class)
+            if status == ComputedClassFeasibility.INELIGIBLE:
+                metrics.filter_node(option, "computed class ineligible")
+                continue
+            elif status == ComputedClassFeasibility.ELIGIBLE:
+                return option
+            elif status == ComputedClassFeasibility.ESCAPED:
+                tg_escaped = True
+            elif status == ComputedClassFeasibility.UNKNOWN:
+                tg_unknown = True
+
+            failed_tg = False
+            for check in self.tg_checkers:
+                if not check.feasible(option):
+                    if not tg_escaped:
+                        elig.set_task_group_eligibility(False, self.tg, option.computed_class)
+                    failed_tg = True
+                    break
+            if failed_tg:
+                continue
+            if not tg_escaped and tg_unknown:
+                elig.set_task_group_eligibility(True, self.tg, option.computed_class)
+            return option
+
+
+class QuotaIterator:
+    """OSS pass-through (quotas are an enterprise feature in the reference)."""
+
+    def __init__(self, ctx: EvalContext, source) -> None:
+        self.source = source
+
+    def next(self) -> Optional[Node]:
+        return self.source.next()
+
+    def reset(self) -> None:
+        self.source.reset()

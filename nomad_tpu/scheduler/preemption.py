@@ -1,0 +1,412 @@
+"""Preemption: finding lower-priority allocations to evict.
+
+Semantics follow reference ``scheduler/preemption.go`` — Preemptor :96,
+PreemptForTaskGroup :198, PreemptForNetwork :270, PreemptForDevice :472,
+distance metrics :608-660, filterAndGroupPreemptibleAllocs :663.
+Greedy combinatorial search stays host-side; only distance scoring is a
+candidate for vectorization.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..structs.funcs import remove_allocs
+from ..structs.network import NetworkIndex
+from ..structs.structs import (
+    AllocatedResources,
+    Allocation,
+    ComparableResources,
+    NetworkResource,
+    Node,
+    RequestedDevice,
+)
+
+# Penalty applied once more than max_parallel allocs of one job are preempted.
+MAX_PARALLEL_PENALTY = 50.0
+
+# Minimum priority delta for preemption eligibility.
+PRIORITY_DELTA = 10
+
+
+def basic_resource_distance(
+    ask: ComparableResources, used: ComparableResources
+) -> float:
+    memory_coord = cpu_coord = disk_coord = 0.0
+    if ask.flattened.memory_mb > 0:
+        memory_coord = (ask.flattened.memory_mb - used.flattened.memory_mb) / float(
+            ask.flattened.memory_mb
+        )
+    if ask.flattened.cpu_shares > 0:
+        cpu_coord = (ask.flattened.cpu_shares - used.flattened.cpu_shares) / float(
+            ask.flattened.cpu_shares
+        )
+    if ask.shared.disk_mb > 0:
+        disk_coord = (ask.shared.disk_mb - used.shared.disk_mb) / float(ask.shared.disk_mb)
+    return math.sqrt(memory_coord**2 + cpu_coord**2 + disk_coord**2)
+
+
+def network_resource_distance(
+    used: Optional[NetworkResource], needed: Optional[NetworkResource]
+) -> float:
+    if used is None or needed is None or needed.mbits == 0:
+        return float("inf")
+    return abs(float(needed.mbits - used.mbits) / float(needed.mbits))
+
+
+def score_for_task_group(
+    ask: ComparableResources,
+    used: ComparableResources,
+    max_parallel: int,
+    num_preempted: int,
+) -> float:
+    penalty = 0.0
+    if max_parallel > 0 and num_preempted >= max_parallel:
+        penalty = float((num_preempted + 1) - max_parallel) * MAX_PARALLEL_PENALTY
+    return basic_resource_distance(ask, used) + penalty
+
+
+def score_for_network(
+    used: Optional[NetworkResource],
+    needed: Optional[NetworkResource],
+    max_parallel: int,
+    num_preempted: int,
+) -> float:
+    if used is None or needed is None:
+        return float("inf")
+    penalty = 0.0
+    if max_parallel > 0 and num_preempted >= max_parallel:
+        penalty = float((num_preempted + 1) - max_parallel) * MAX_PARALLEL_PENALTY
+    return network_resource_distance(used, needed) + penalty
+
+
+def filter_and_group_preemptible_allocs(
+    job_priority: int, current: List[Allocation]
+) -> List[Tuple[int, List[Allocation]]]:
+    """Group by job priority ascending, dropping allocs within 10 points."""
+    by_priority: Dict[int, List[Allocation]] = {}
+    for alloc in current:
+        if alloc.job is None:
+            continue
+        if job_priority - alloc.job.priority < PRIORITY_DELTA:
+            continue
+        by_priority.setdefault(alloc.job.priority, []).append(alloc)
+    return sorted(by_priority.items(), key=lambda kv: kv[0])
+
+
+class _AllocInfo:
+    __slots__ = ("max_parallel", "resources")
+
+    def __init__(self, max_parallel: int, resources: ComparableResources):
+        self.max_parallel = max_parallel
+        self.resources = resources
+
+
+class Preemptor:
+    def __init__(self, job_priority: int, ctx, job_namespaced_id) -> None:
+        self.current_preemptions: Dict[Tuple[str, str], Dict[str, int]] = {}
+        self.alloc_details: Dict[str, _AllocInfo] = {}
+        self.job_priority = job_priority
+        self.job_id = job_namespaced_id  # (namespace, id) tuple or None
+        self.node_remaining_resources: Optional[ComparableResources] = None
+        self.current_allocs: List[Allocation] = []
+        self.ctx = ctx
+
+    def set_node(self, node: Node) -> None:
+        remaining = node.comparable_resources()
+        reserved = node.comparable_reserved_resources()
+        if reserved is not None:
+            remaining.subtract(reserved)
+        self.node_remaining_resources = remaining
+
+    def set_candidates(self, allocs: List[Allocation]) -> None:
+        self.current_allocs = []
+        for alloc in allocs:
+            if self.job_id is not None and (alloc.namespace, alloc.job_id) == (
+                self.job_id[0],
+                self.job_id[1],
+            ):
+                continue
+            max_parallel = 0
+            if alloc.job is not None:
+                tg = alloc.job.lookup_task_group(alloc.task_group)
+                if tg is not None and tg.migrate is not None:
+                    max_parallel = tg.migrate.max_parallel
+            self.alloc_details[alloc.id] = _AllocInfo(max_parallel, alloc.comparable_resources())
+            self.current_allocs.append(alloc)
+
+    def set_preemptions(self, allocs: List[Allocation]) -> None:
+        self.current_preemptions = {}
+        for alloc in allocs:
+            key = (alloc.job_id, alloc.namespace)
+            self.current_preemptions.setdefault(key, {})
+            self.current_preemptions[key][alloc.task_group] = (
+                self.current_preemptions[key].get(alloc.task_group, 0) + 1
+            )
+
+    def _num_preemptions(self, alloc: Allocation) -> int:
+        return self.current_preemptions.get((alloc.job_id, alloc.namespace), {}).get(
+            alloc.task_group, 0
+        )
+
+    # -- task group (cpu/mem/disk) ----------------------------------------
+
+    def preempt_for_task_group(self, resource_ask: AllocatedResources) -> List[Allocation]:
+        resources_needed = resource_ask.comparable()
+
+        for alloc in self.current_allocs:
+            self.node_remaining_resources.subtract(self.alloc_details[alloc.id].resources)
+
+        allocs_by_priority = filter_and_group_preemptible_allocs(
+            self.job_priority, self.current_allocs
+        )
+
+        best_allocs: List[Allocation] = []
+        all_requirements_met = False
+        available = self.node_remaining_resources.copy()
+        resources_asked = resource_ask.comparable()
+
+        for _priority, grp_allocs in allocs_by_priority:
+            grp = list(grp_allocs)
+            while grp and not all_requirements_met:
+                best_distance = float("inf")
+                closest_index = -1
+                for index, alloc in enumerate(grp):
+                    details = self.alloc_details[alloc.id]
+                    distance = score_for_task_group(
+                        resources_needed,
+                        details.resources,
+                        details.max_parallel,
+                        self._num_preemptions(alloc),
+                    )
+                    if distance < best_distance:
+                        best_distance = distance
+                        closest_index = index
+                closest = grp.pop(closest_index)
+                closest_resources = self.alloc_details[closest.id].resources
+                available.add(closest_resources)
+                all_requirements_met, _ = available.superset(resources_asked)
+                best_allocs.append(closest)
+                resources_needed.subtract(closest_resources)
+            if all_requirements_met:
+                break
+
+        if not all_requirements_met:
+            return []
+
+        # Second pass: drop allocs whose resources are already covered.
+        resources_needed = resource_ask.comparable()
+        return self._filter_superset_basic(
+            best_allocs, self.node_remaining_resources, resources_needed
+        )
+
+    def _filter_superset_basic(
+        self,
+        best_allocs: List[Allocation],
+        node_remaining: ComparableResources,
+        ask: ComparableResources,
+    ) -> List[Allocation]:
+        best_allocs = sorted(
+            best_allocs,
+            key=lambda a: basic_resource_distance(ask, self.alloc_details[a.id].resources),
+            reverse=True,
+        )
+        available = node_remaining.copy()
+        filtered: List[Allocation] = []
+        for alloc in best_allocs:
+            filtered.append(alloc)
+            available.add(self.alloc_details[alloc.id].resources)
+            met, _ = available.superset(ask)
+            if met:
+                break
+        return filtered
+
+    # -- network -----------------------------------------------------------
+
+    def preempt_for_network(
+        self, ask: NetworkResource, net_idx: NetworkIndex
+    ) -> Optional[List[Allocation]]:
+        if not self.current_allocs:
+            return None
+
+        mbits_needed = ask.mbits
+        reserved_ports_needed = ask.reserved_ports
+        filtered_reserved_ports: Dict[str, set] = {}
+        device_to_allocs: Dict[str, List[Allocation]] = {}
+
+        for alloc in self.current_allocs:
+            if alloc.job is None:
+                continue
+            networks = self._first_network_list(alloc)
+            if not networks:
+                continue
+            net = networks[0]
+            if self.job_priority - alloc.job.priority < PRIORITY_DELTA:
+                for port in net.reserved_ports:
+                    filtered_reserved_ports.setdefault(net.device, set()).add(port.value)
+                continue
+            device_to_allocs.setdefault(net.device, []).append(alloc)
+
+        if not device_to_allocs:
+            return None
+
+        allocs_to_preempt: List[Allocation] = []
+        met = False
+        free_bandwidth = 0
+        preempted_device = ""
+
+        for device, current_allocs in device_to_allocs.items():
+            preempted_device = device
+            total_bandwidth = net_idx.avail_bandwidth.get(device, 0)
+            if total_bandwidth < mbits_needed:
+                continue
+            free_bandwidth = total_bandwidth - net_idx.used_bandwidth.get(device, 0)
+            preempted_bandwidth = 0
+            allocs_to_preempt = []
+
+            if reserved_ports_needed:
+                used_port_to_alloc: Dict[int, Allocation] = {}
+                for alloc in current_allocs:
+                    for n in self._first_network_list(alloc):
+                        for p in n.reserved_ports:
+                            used_port_to_alloc[p.value] = alloc
+                skip_device = False
+                for port in reserved_ports_needed:
+                    alloc = used_port_to_alloc.get(port.value)
+                    if alloc is not None:
+                        preempted_bandwidth += self._first_network_list(alloc)[0].mbits
+                        allocs_to_preempt.append(alloc)
+                    elif port.value in filtered_reserved_ports.get(device, set()):
+                        skip_device = True
+                        break
+                if skip_device:
+                    continue
+                current_allocs = remove_allocs(current_allocs, allocs_to_preempt)
+
+            if preempted_bandwidth + free_bandwidth >= mbits_needed:
+                met = True
+                break
+
+            for _priority, grp in filter_and_group_preemptible_allocs(
+                self.job_priority, current_allocs
+            ):
+                grp = sorted(grp, key=lambda a: self._network_distance_key(a, ask))
+                done = False
+                for alloc in grp:
+                    preempted_bandwidth += self._first_network_list(alloc)[0].mbits
+                    allocs_to_preempt.append(alloc)
+                    if preempted_bandwidth + free_bandwidth >= mbits_needed:
+                        met = True
+                        done = True
+                        break
+                if done:
+                    break
+            if met:
+                break
+
+        if not met:
+            return None
+
+        # Final superset pass on network distance.
+        def net_used(a: Allocation) -> Optional[NetworkResource]:
+            nets = self._first_network_list(a)
+            return nets[0] if nets else None
+
+        allocs_sorted = sorted(
+            allocs_to_preempt,
+            key=lambda a: network_resource_distance(net_used(a), ask),
+            reverse=True,
+        )
+        available_mbits = free_bandwidth
+        filtered: List[Allocation] = []
+        for alloc in allocs_sorted:
+            filtered.append(alloc)
+            used = net_used(alloc)
+            if used is not None:
+                available_mbits += used.mbits
+            if available_mbits > 0 and mbits_needed > 0 and available_mbits >= mbits_needed:
+                break
+        return filtered
+
+    def _network_distance_key(self, alloc: Allocation, ask: NetworkResource) -> float:
+        details = self.alloc_details[alloc.id]
+        nets = details.resources.flattened.networks
+        used = nets[0] if nets else None
+        max_parallel = details.max_parallel
+        return score_for_network(used, ask, max_parallel, self._num_preemptions(alloc))
+
+    def _first_network_list(self, alloc: Allocation) -> List[NetworkResource]:
+        details = self.alloc_details.get(alloc.id)
+        if details is not None:
+            return details.resources.flattened.networks
+        return alloc.comparable_resources().flattened.networks
+
+    # -- devices -----------------------------------------------------------
+
+    def preempt_for_device(self, ask: RequestedDevice, dev_alloc) -> Optional[List[Allocation]]:
+        from .feasible import node_device_matches
+
+        device_to_allocs: Dict[object, Tuple[List[Allocation], Dict[str, int]]] = {}
+        for alloc in self.current_allocs:
+            if alloc.allocated_resources is None:
+                continue
+            for tr in alloc.allocated_resources.tasks.values():
+                for device in tr.devices:
+                    dev_id = device.id()
+                    dev_inst = dev_alloc.devices.get(dev_id)
+                    if dev_inst is None:
+                        continue
+                    if not node_device_matches(self.ctx, dev_inst.device, ask):
+                        continue
+                    allocs, instances = device_to_allocs.setdefault(dev_id, ([], {}))
+                    allocs.append(alloc)
+                    instances[alloc.id] = instances.get(alloc.id, 0) + len(device.device_ids)
+
+        needed_count = ask.count
+        preemption_options: List[Tuple[List[Allocation], Dict[str, int]]] = []
+
+        for dev_id, (allocs, instances) in device_to_allocs.items():
+            preempted_count = 0
+            preempted_allocs: List[Allocation] = []
+            satisfied = False
+            for _priority, grp in filter_and_group_preemptible_allocs(self.job_priority, allocs):
+                for alloc in grp:
+                    dev_inst = dev_alloc.devices[dev_id]
+                    preempted_count += instances[alloc.id]
+                    preempted_allocs.append(alloc)
+                    if preempted_count + dev_inst.free_count() >= needed_count:
+                        preemption_options.append((preempted_allocs, instances))
+                        satisfied = True
+                        break
+                if satisfied:
+                    break
+
+        if preemption_options:
+            return _select_best_allocs(preemption_options, needed_count)
+        return None
+
+
+def _select_best_allocs(
+    preemption_options: List[Tuple[List[Allocation], Dict[str, int]]], needed_count: int
+) -> List[Allocation]:
+    """Pick the option with the lowest net (sum of unique) priority."""
+    best_priority = float("inf")
+    best_allocs: List[Allocation] = []
+    for allocs, instances in preemption_options:
+        priorities = set()
+        net_priority = 0
+        filtered: List[Allocation] = []
+        allocs = sorted(allocs, key=lambda a: instances[a.id], reverse=True)
+        preempted_instance_count = 0
+        for alloc in allocs:
+            if preempted_instance_count >= needed_count:
+                break
+            preempted_instance_count += instances[alloc.id]
+            filtered.append(alloc)
+            if alloc.job is not None and alloc.job.priority not in priorities:
+                priorities.add(alloc.job.priority)
+                net_priority += alloc.job.priority
+        if net_priority < best_priority:
+            best_priority = net_priority
+            best_allocs = filtered
+    return best_allocs

@@ -1,0 +1,169 @@
+"""Property-value usage tracking for distinct_property and spread.
+
+Semantics follow reference ``scheduler/propertyset.go``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..structs.structs import Allocation, Job, Node
+from .context import EvalContext
+
+
+def get_property(node: Optional[Node], prop: str) -> Tuple[str, bool]:
+    from .feasible import resolve_target
+
+    if node is None or not prop:
+        return "", False
+    val, ok = resolve_target(prop, node)
+    if not ok or not isinstance(val, str):
+        return "", False
+    return val, True
+
+
+class PropertySet:
+    def __init__(self, ctx: EvalContext, job: Optional[Job]) -> None:
+        self.ctx = ctx
+        self.job_id = job.id if job else ""
+        self.namespace = job.namespace if job else "default"
+        self.task_group = ""
+        self.target_attribute = ""
+        self.allowed_count = 0
+        self.error_building: Optional[str] = None
+        self.existing_values: Dict[str, int] = {}
+        self.proposed_values: Dict[str, int] = {}
+        self.cleared_values: Dict[str, int] = {}
+
+    # -- configuration -----------------------------------------------------
+
+    def set_job_constraint(self, constraint) -> None:
+        self._set_constraint(constraint, "")
+
+    def set_tg_constraint(self, constraint, task_group: str) -> None:
+        self._set_constraint(constraint, task_group)
+
+    def _set_constraint(self, constraint, task_group: str) -> None:
+        if constraint.rtarget:
+            try:
+                allowed = int(constraint.rtarget)
+            except ValueError:
+                self.error_building = (
+                    f"failed to convert RTarget {constraint.rtarget!r} to uint64"
+                )
+                return
+        else:
+            allowed = 1
+        self._set_target_attribute_with_count(constraint.ltarget, allowed, task_group)
+
+    def set_target_attribute(self, target_attribute: str, task_group: str) -> None:
+        self._set_target_attribute_with_count(target_attribute, 0, task_group)
+
+    def _set_target_attribute_with_count(
+        self, target_attribute: str, allowed_count: int, task_group: str
+    ) -> None:
+        if task_group:
+            self.task_group = task_group
+        self.target_attribute = target_attribute
+        self.allowed_count = allowed_count
+        self._populate_existing()
+        self.populate_proposed()
+
+    # -- population --------------------------------------------------------
+
+    def _populate_existing(self) -> None:
+        allocs = self.ctx.state.allocs_by_job(self.namespace, self.job_id, False)
+        allocs = self._filter_allocs(allocs, filter_terminal=True)
+        nodes = self._build_node_map(allocs)
+        self._populate_properties(allocs, nodes, self.existing_values)
+
+    def populate_proposed(self) -> None:
+        self.proposed_values = {}
+        self.cleared_values = {}
+
+        stopping: List[Allocation] = []
+        for updates in self.ctx.plan.node_update.values():
+            stopping.extend(updates)
+        stopping = self._filter_allocs(stopping, filter_terminal=False)
+
+        proposed: List[Allocation] = []
+        for pallocs in self.ctx.plan.node_allocation.values():
+            proposed.extend(pallocs)
+        proposed = self._filter_allocs(proposed, filter_terminal=True)
+
+        nodes = self._build_node_map(stopping + proposed)
+        self._populate_properties(stopping, nodes, self.cleared_values)
+        self._populate_properties(proposed, nodes, self.proposed_values)
+
+        # A cleared value now re-used by a proposed alloc isn't really cleared.
+        for value in list(self.proposed_values):
+            current = self.cleared_values.get(value)
+            if current is None:
+                continue
+            if current == 0:
+                del self.cleared_values[value]
+            elif current > 1:
+                self.cleared_values[value] -= 1
+
+    # -- queries -----------------------------------------------------------
+
+    def satisfies_distinct_properties(self, option: Node, tg: str) -> Tuple[bool, str]:
+        nvalue, error_msg, used_count = self.used_count(option, tg)
+        if error_msg:
+            return False, error_msg
+        if used_count < self.allowed_count:
+            return True, ""
+        return False, (
+            f"distinct_property: {self.target_attribute}={nvalue} used by {used_count} allocs"
+        )
+
+    def used_count(self, option: Node, tg: str) -> Tuple[str, str, int]:
+        if self.error_building is not None:
+            return "", self.error_building, 0
+        nvalue, ok = get_property(option, self.target_attribute)
+        if not ok:
+            return nvalue, f'missing property "{self.target_attribute}"', 0
+        combined = self.get_combined_use_map()
+        return nvalue, "", combined.get(nvalue, 0)
+
+    def get_combined_use_map(self) -> Dict[str, int]:
+        combined: Dict[str, int] = {}
+        for used_values in (self.existing_values, self.proposed_values):
+            for value, count in used_values.items():
+                combined[value] = combined.get(value, 0) + count
+        for value, cleared in self.cleared_values.items():
+            if value not in combined:
+                continue
+            combined[value] = max(combined[value] - cleared, 0)
+        return combined
+
+    # -- helpers -----------------------------------------------------------
+
+    def _filter_allocs(self, allocs: List[Allocation], filter_terminal: bool) -> List[Allocation]:
+        out = []
+        for a in allocs:
+            if filter_terminal and a.terminal_status():
+                continue
+            if self.task_group and a.task_group != self.task_group:
+                continue
+            out.append(a)
+        return out
+
+    def _build_node_map(self, allocs: List[Allocation]) -> Dict[str, Optional[Node]]:
+        nodes: Dict[str, Optional[Node]] = {}
+        for alloc in allocs:
+            if alloc.node_id in nodes:
+                continue
+            nodes[alloc.node_id] = self.ctx.state.node_by_id(alloc.node_id)
+        return nodes
+
+    def _populate_properties(
+        self,
+        allocs: List[Allocation],
+        nodes: Dict[str, Optional[Node]],
+        properties: Dict[str, int],
+    ) -> None:
+        for alloc in allocs:
+            nprop, ok = get_property(nodes.get(alloc.node_id), self.target_attribute)
+            if not ok:
+                continue
+            properties[nprop] = properties.get(nprop, 0) + 1

@@ -1,0 +1,403 @@
+"""Host-side ranking iterators.
+
+Semantics follow reference ``scheduler/rank.go`` — BinPackIterator :146,
+JobAntiAffinityIterator :456, NodeReschedulingPenaltyIterator :526,
+NodeAffinityIterator :571, ScoreNormalizationIterator :661. Each scoring
+term here corresponds to an additive score tensor in the TPU engine.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..structs.funcs import BIN_PACKING_MAX_FIT_SCORE, allocs_fit, remove_allocs, score_fit
+from ..structs.network import NetworkIndex
+from ..structs.structs import (
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Allocation,
+    Job,
+    Node,
+    TaskGroup,
+)
+from .context import EvalContext
+from .device import DeviceAllocator
+
+
+class RankedNode:
+    def __init__(self, node: Node) -> None:
+        self.node = node
+        self.final_score = 0.0
+        self.scores: List[float] = []
+        self.task_resources: Dict[str, AllocatedTaskResources] = {}
+        self.alloc_resources: Optional[AllocatedSharedResources] = None
+        self.proposed: Optional[List[Allocation]] = None
+        self.preempted_allocs: Optional[List[Allocation]] = None
+
+    def proposed_allocs(self, ctx: EvalContext) -> List[Allocation]:
+        if self.proposed is None:
+            self.proposed = ctx.proposed_allocs(self.node.id)
+        return self.proposed
+
+    def set_task_resources(self, task, resource: AllocatedTaskResources) -> None:
+        self.task_resources[task.name] = resource
+
+    def __repr__(self) -> str:
+        return f"<Node: {self.node.id} Score: {self.final_score:.3f}>"
+
+
+class FeasibleRankIterator:
+    """Upgrades a feasible iterator to a rank iterator."""
+
+    def __init__(self, ctx: EvalContext, source) -> None:
+        self.ctx = ctx
+        self.source = source
+
+    def next(self) -> Optional[RankedNode]:
+        option = self.source.next()
+        if option is None:
+            return None
+        return RankedNode(option)
+
+    def reset(self) -> None:
+        self.source.reset()
+
+
+class StaticRankIterator:
+    """A fixed list of ranked nodes (testing only)."""
+
+    def __init__(self, ctx: EvalContext, nodes: List[RankedNode]) -> None:
+        self.ctx = ctx
+        self.nodes = nodes
+        self.offset = 0
+        self.seen = 0
+
+    def next(self) -> Optional[RankedNode]:
+        n = len(self.nodes)
+        if self.offset == n or self.seen == n:
+            if self.seen != n:
+                self.offset = 0
+            else:
+                return None
+        option = self.nodes[self.offset]
+        self.offset += 1
+        self.seen += 1
+        return option
+
+    def reset(self) -> None:
+        self.seen = 0
+
+
+class BinPackIterator:
+    """Fits the task group onto each candidate, scoring with BestFit-v3.
+
+    Handles per-task cpu/mem, group+task network asks, device assignment, and
+    (when ``evict`` is set) preemption (reference rank.go:176).
+    """
+
+    def __init__(self, ctx: EvalContext, source, evict: bool, priority: int) -> None:
+        self.ctx = ctx
+        self.source = source
+        self.evict = evict
+        self.priority = priority
+        self.job_namespaced_id = None
+        self.task_group: Optional[TaskGroup] = None
+
+    def set_job(self, job: Job) -> None:
+        self.priority = job.priority
+        self.job_namespaced_id = job.namespaced_id()
+
+    def set_task_group(self, task_group: TaskGroup) -> None:
+        self.task_group = task_group
+
+    def next(self) -> Optional[RankedNode]:
+        from .preemption import Preemptor
+
+        while True:
+            option = self.source.next()
+            if option is None:
+                return None
+
+            proposed = option.proposed_allocs(self.ctx)
+
+            net_idx = NetworkIndex(deterministic=self.ctx.deterministic)
+            net_idx.set_node(option.node)
+            net_idx.add_allocs(proposed)
+
+            dev_allocator = DeviceAllocator(self.ctx, option.node)
+            dev_allocator.add_allocs(proposed)
+
+            total_device_affinity_weight = 0.0
+            sum_matching_affinities = 0.0
+
+            total = AllocatedResources(
+                shared=AllocatedSharedResources(
+                    disk_mb=self.task_group.ephemeral_disk.size_mb
+                )
+            )
+
+            allocs_to_preempt: List[Allocation] = []
+            preemptor = Preemptor(self.priority, self.ctx, self.job_namespaced_id)
+            preemptor.set_node(option.node)
+            current_preemptions = [
+                a for allocs in self.ctx.plan.node_preemptions.values() for a in allocs
+            ]
+            preemptor.set_preemptions(current_preemptions)
+
+            exhausted = False
+
+            # Task-group-level network ask
+            if self.task_group.networks:
+                ask = self.task_group.networks[0].copy()
+                offer, err = net_idx.assign_network(ask)
+                if offer is None:
+                    if not self.evict:
+                        self.ctx.metrics.exhausted_node(option.node, f"network: {err}")
+                        continue
+                    preemptor.set_candidates(proposed)
+                    net_preemptions = preemptor.preempt_for_network(ask, net_idx)
+                    if net_preemptions is None:
+                        continue
+                    allocs_to_preempt.extend(net_preemptions)
+                    proposed = remove_allocs(proposed, net_preemptions)
+                    net_idx = NetworkIndex(deterministic=self.ctx.deterministic)
+                    net_idx.set_node(option.node)
+                    net_idx.add_allocs(proposed)
+                    offer, err = net_idx.assign_network(ask)
+                    if offer is None:
+                        continue
+                net_idx.add_reserved(offer)
+                total.shared.networks = [offer]
+                option.alloc_resources = AllocatedSharedResources(
+                    networks=[offer], disk_mb=self.task_group.ephemeral_disk.size_mb
+                )
+
+            for task in self.task_group.tasks:
+                task_resources = AllocatedTaskResources(
+                    cpu_shares=task.resources.cpu, memory_mb=task.resources.memory_mb
+                )
+
+                if task.resources.networks:
+                    ask = task.resources.networks[0].copy()
+                    offer, err = net_idx.assign_network(ask)
+                    if offer is None:
+                        if not self.evict:
+                            self.ctx.metrics.exhausted_node(option.node, f"network: {err}")
+                            exhausted = True
+                            break
+                        preemptor.set_candidates(proposed)
+                        net_preemptions = preemptor.preempt_for_network(ask, net_idx)
+                        if net_preemptions is None:
+                            exhausted = True
+                            break
+                        allocs_to_preempt.extend(net_preemptions)
+                        proposed = remove_allocs(proposed, net_preemptions)
+                        net_idx = NetworkIndex(deterministic=self.ctx.deterministic)
+                        net_idx.set_node(option.node)
+                        net_idx.add_allocs(proposed)
+                        offer, err = net_idx.assign_network(ask)
+                        if offer is None:
+                            exhausted = True
+                            break
+                    net_idx.add_reserved(offer)
+                    task_resources.networks = [offer]
+
+                for req in task.resources.devices:
+                    offer, sum_affinities, err = dev_allocator.assign_device(req)
+                    if offer is None:
+                        if not self.evict:
+                            self.ctx.metrics.exhausted_node(option.node, f"devices: {err}")
+                            exhausted = True
+                            break
+                        preemptor.set_candidates(proposed)
+                        device_preemptions = preemptor.preempt_for_device(req, dev_allocator)
+                        if device_preemptions is None:
+                            exhausted = True
+                            break
+                        allocs_to_preempt.extend(device_preemptions)
+                        proposed = remove_allocs(proposed, allocs_to_preempt)
+                        dev_allocator = DeviceAllocator(self.ctx, option.node)
+                        dev_allocator.add_allocs(proposed)
+                        offer, sum_affinities, err = dev_allocator.assign_device(req)
+                        if offer is None:
+                            exhausted = True
+                            break
+                    dev_allocator.add_reserved(offer)
+                    task_resources.devices.append(offer)
+                    if req.affinities:
+                        for a in req.affinities:
+                            total_device_affinity_weight += abs(float(a.weight))
+                        sum_matching_affinities += sum_affinities
+                if exhausted:
+                    break
+
+                option.set_task_resources(task, task_resources)
+                total.tasks[task.name] = task_resources
+
+            if exhausted:
+                continue
+
+            current = proposed
+            proposed = proposed + [Allocation(allocated_resources=total)]
+
+            fit, dim, used = allocs_fit(option.node, proposed, net_idx, check_devices=False)
+            if not fit:
+                if not self.evict:
+                    self.ctx.metrics.exhausted_node(option.node, dim)
+                    continue
+                preemptor.set_candidates(current)
+                preempted_allocs = preemptor.preempt_for_task_group(total)
+                allocs_to_preempt.extend(preempted_allocs)
+                if not preempted_allocs:
+                    self.ctx.metrics.exhausted_node(option.node, dim)
+                    continue
+            if allocs_to_preempt:
+                option.preempted_allocs = allocs_to_preempt
+
+            fitness = score_fit(option.node, used)
+            normalized_fit = fitness / BIN_PACKING_MAX_FIT_SCORE
+            option.scores.append(normalized_fit)
+            self.ctx.metrics.score_node(option.node, "binpack", normalized_fit)
+
+            if total_device_affinity_weight != 0:
+                sum_matching_affinities /= total_device_affinity_weight
+                option.scores.append(sum_matching_affinities)
+                self.ctx.metrics.score_node(option.node, "devices", sum_matching_affinities)
+
+            return option
+
+    def reset(self) -> None:
+        self.source.reset()
+
+
+class JobAntiAffinityIterator:
+    """Penalizes co-placement with allocs of the same job+group."""
+
+    def __init__(self, ctx: EvalContext, source, job_id: str) -> None:
+        self.ctx = ctx
+        self.source = source
+        self.job_id = job_id
+        self.task_group = ""
+        self.desired_count = 0
+
+    def set_job(self, job: Job) -> None:
+        self.job_id = job.id
+
+    def set_task_group(self, tg: TaskGroup) -> None:
+        self.task_group = tg.name
+        self.desired_count = tg.count
+
+    def next(self) -> Optional[RankedNode]:
+        while True:
+            option = self.source.next()
+            if option is None:
+                return None
+            proposed = option.proposed_allocs(self.ctx)
+            collisions = sum(
+                1
+                for alloc in proposed
+                if alloc.job_id == self.job_id and alloc.task_group == self.task_group
+            )
+            if collisions > 0:
+                score_penalty = -1.0 * float(collisions + 1) / float(self.desired_count)
+                option.scores.append(score_penalty)
+                self.ctx.metrics.score_node(option.node, "job-anti-affinity", score_penalty)
+            else:
+                self.ctx.metrics.score_node(option.node, "job-anti-affinity", 0)
+            return option
+
+    def reset(self) -> None:
+        self.source.reset()
+
+
+class NodeReschedulingPenaltyIterator:
+    """Penalizes nodes where this alloc previously failed."""
+
+    def __init__(self, ctx: EvalContext, source) -> None:
+        self.ctx = ctx
+        self.source = source
+        self.penalty_nodes: Set[str] = set()
+
+    def set_penalty_nodes(self, penalty_nodes: Set[str]) -> None:
+        self.penalty_nodes = penalty_nodes or set()
+
+    def next(self) -> Optional[RankedNode]:
+        option = self.source.next()
+        if option is None:
+            return None
+        if option.node.id in self.penalty_nodes:
+            option.scores.append(-1.0)
+            self.ctx.metrics.score_node(option.node, "node-reschedule-penalty", -1)
+        else:
+            self.ctx.metrics.score_node(option.node, "node-reschedule-penalty", 0)
+        return option
+
+    def reset(self) -> None:
+        self.penalty_nodes = set()
+        self.source.reset()
+
+
+class NodeAffinityIterator:
+    """Weighted affinity scoring over job+group+task affinity stanzas."""
+
+    def __init__(self, ctx: EvalContext, source) -> None:
+        self.ctx = ctx
+        self.source = source
+        self.job_affinities = []
+        self.affinities = []
+
+    def set_job(self, job: Job) -> None:
+        self.job_affinities = list(job.affinities)
+
+    def set_task_group(self, tg: TaskGroup) -> None:
+        self.affinities = list(self.job_affinities)
+        self.affinities.extend(tg.affinities)
+        for task in tg.tasks:
+            self.affinities.extend(task.affinities)
+
+    def reset(self) -> None:
+        self.source.reset()
+        self.affinities = []
+
+    def has_affinities(self) -> bool:
+        return bool(self.affinities)
+
+    def next(self) -> Optional[RankedNode]:
+        from .feasible import matches_affinity
+
+        option = self.source.next()
+        if option is None:
+            return None
+        if not self.has_affinities():
+            self.ctx.metrics.score_node(option.node, "node-affinity", 0)
+            return option
+        sum_weight = sum(abs(float(a.weight)) for a in self.affinities)
+        total = 0.0
+        for affinity in self.affinities:
+            if matches_affinity(self.ctx, affinity, option.node):
+                total += float(affinity.weight)
+        # total != 0 implies sum_weight != 0; all-zero weights are a no-op.
+        if total != 0.0:
+            norm_score = total / sum_weight
+            option.scores.append(norm_score)
+            self.ctx.metrics.score_node(option.node, "node-affinity", norm_score)
+        return option
+
+
+class ScoreNormalizationIterator:
+    """Final score = mean of accumulated score terms (reference rank.go:678)."""
+
+    def __init__(self, ctx: EvalContext, source) -> None:
+        self.ctx = ctx
+        self.source = source
+
+    def reset(self) -> None:
+        self.source.reset()
+
+    def next(self) -> Optional[RankedNode]:
+        option = self.source.next()
+        if option is None or not option.scores:
+            return option
+        option.final_score = sum(option.scores) / len(option.scores)
+        self.ctx.metrics.score_node(option.node, "normalized-score", option.final_score)
+        return option

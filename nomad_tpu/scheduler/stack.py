@@ -1,0 +1,216 @@
+"""Placement stacks: the chained iterator pipelines.
+
+Semantics follow reference ``scheduler/stack.go`` and ``stack_oss.go``:
+GenericStack = random source -> quota -> FeasibilityWrapper -> distinct_hosts
+-> distinct_property -> rank -> binpack -> job-anti-affinity -> resched
+penalty -> node affinity -> spread -> score-normalize -> limit(log2 N) ->
+max-score. SystemStack = static source, no limit/max.
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..structs.structs import Job, Node, TaskGroup
+from .context import EvalContext
+from .feasible import (
+    ConstraintChecker,
+    DeviceChecker,
+    DistinctHostsIterator,
+    DistinctPropertyIterator,
+    DriverChecker,
+    FeasibilityWrapper,
+    HostVolumeChecker,
+    QuotaIterator,
+    StaticIterator,
+    new_random_iterator,
+)
+from .rank import (
+    BinPackIterator,
+    FeasibleRankIterator,
+    JobAntiAffinityIterator,
+    NodeAffinityIterator,
+    NodeReschedulingPenaltyIterator,
+    RankedNode,
+    ScoreNormalizationIterator,
+)
+from .select import LimitIterator, MaxScoreIterator
+from .spread import SpreadIterator
+from .util import task_group_constraints
+
+# Limit-iterator skip tuning (reference stack.go:14-17)
+SKIP_SCORE_THRESHOLD = 0.0
+MAX_SKIP = 3
+
+
+@dataclass
+class SelectOptions:
+    penalty_node_ids: Set[str] = field(default_factory=set)
+    preferred_nodes: List[Node] = field(default_factory=list)
+    preempt: bool = False
+
+
+class GenericStack:
+    def __init__(self, batch: bool, ctx: EvalContext) -> None:
+        self.batch = batch
+        self.ctx = ctx
+
+        self.source = new_random_iterator(ctx, [])
+        self.quota = QuotaIterator(ctx, self.source)
+        self.job_constraint = ConstraintChecker(ctx, None)
+        self.task_group_drivers = DriverChecker(ctx, None)
+        self.task_group_constraint = ConstraintChecker(ctx, None)
+        self.task_group_devices = DeviceChecker(ctx)
+        self.task_group_host_volumes = HostVolumeChecker(ctx)
+
+        jobs = [self.job_constraint]
+        tgs = [
+            self.task_group_drivers,
+            self.task_group_constraint,
+            self.task_group_host_volumes,
+            self.task_group_devices,
+        ]
+        self.wrapped_checks = FeasibilityWrapper(ctx, self.quota, jobs, tgs)
+        self.distinct_hosts_constraint = DistinctHostsIterator(ctx, self.wrapped_checks)
+        self.distinct_property_constraint = DistinctPropertyIterator(
+            ctx, self.distinct_hosts_constraint
+        )
+        rank_source = FeasibleRankIterator(ctx, self.distinct_property_constraint)
+        self.bin_pack = BinPackIterator(ctx, rank_source, False, 0)
+        self.job_anti_aff = JobAntiAffinityIterator(ctx, self.bin_pack, "")
+        self.node_rescheduling_penalty = NodeReschedulingPenaltyIterator(ctx, self.job_anti_aff)
+        self.node_affinity = NodeAffinityIterator(ctx, self.node_rescheduling_penalty)
+        self.spread = SpreadIterator(ctx, self.node_affinity)
+        self.score_norm = ScoreNormalizationIterator(ctx, self.spread)
+        self.limit = LimitIterator(ctx, self.score_norm, 2, SKIP_SCORE_THRESHOLD, MAX_SKIP)
+        self.max_score = MaxScoreIterator(ctx, self.limit)
+
+    def set_nodes(self, base_nodes: List[Node]) -> None:
+        if not self.ctx.deterministic:
+            from .util import shuffle_nodes
+
+            shuffle_nodes(base_nodes)
+        self.source.set_nodes(base_nodes)
+
+        # Candidate sampling bound: batch = power-of-two-choices, service =
+        # ceil(log2 N) with a floor of 2 (reference stack.go:74-86).
+        limit = 2
+        n = len(base_nodes)
+        if not self.batch and n > 0:
+            log_limit = int(math.ceil(math.log2(n)))
+            if log_limit > limit:
+                limit = log_limit
+        self.limit.set_limit(limit)
+
+    def set_job(self, job: Job) -> None:
+        self.job_constraint.set_constraints(job.constraints)
+        self.distinct_hosts_constraint.set_job(job)
+        self.distinct_property_constraint.set_job(job)
+        self.bin_pack.set_job(job)
+        self.job_anti_aff.set_job(job)
+        self.node_affinity.set_job(job)
+        self.spread.set_job(job)
+        self.ctx.get_eligibility().set_job(job)
+
+    def select(self, tg: TaskGroup, options: Optional[SelectOptions]) -> Optional[RankedNode]:
+        # Preferred-node pass first (sticky ephemeral disk).
+        if options is not None and options.preferred_nodes:
+            original_nodes = self.source.nodes
+            self.source.set_nodes(list(options.preferred_nodes))
+            options_new = SelectOptions(
+                penalty_node_ids=options.penalty_node_ids,
+                preferred_nodes=[],
+                preempt=options.preempt,
+            )
+            option = self.select(tg, options_new)
+            self.source.set_nodes(original_nodes)
+            if option is not None:
+                return option
+            return self.select(tg, options_new)
+
+        self.max_score.reset()
+        self.ctx.reset()
+        start = time.monotonic_ns()
+
+        tg_constr = task_group_constraints(tg)
+        self.task_group_drivers.set_drivers(tg_constr.drivers)
+        self.task_group_constraint.set_constraints(tg_constr.constraints)
+        self.task_group_devices.set_task_group(tg)
+        self.task_group_host_volumes.set_volumes(tg.volumes)
+        self.distinct_hosts_constraint.set_task_group(tg)
+        self.distinct_property_constraint.set_task_group(tg)
+        self.wrapped_checks.set_task_group(tg.name)
+        self.bin_pack.set_task_group(tg)
+        if options is not None:
+            self.bin_pack.evict = options.preempt
+        self.job_anti_aff.set_task_group(tg)
+        if options is not None:
+            self.node_rescheduling_penalty.set_penalty_nodes(options.penalty_node_ids)
+        self.node_affinity.set_task_group(tg)
+        self.spread.set_task_group(tg)
+
+        if self.node_affinity.has_affinities() or self.spread.has_spreads():
+            self.limit.set_limit(2**31 - 1)
+
+        option = self.max_score.next()
+        self.ctx.metrics.allocation_time_ns = time.monotonic_ns() - start
+        return option
+
+
+class SystemStack:
+    def __init__(self, ctx: EvalContext) -> None:
+        self.ctx = ctx
+        self.source = StaticIterator(ctx, [])
+        self.quota = QuotaIterator(ctx, self.source)
+        self.job_constraint = ConstraintChecker(ctx, None)
+        self.task_group_drivers = DriverChecker(ctx, None)
+        self.task_group_constraint = ConstraintChecker(ctx, None)
+        self.task_group_host_volumes = HostVolumeChecker(ctx)
+        self.task_group_devices = DeviceChecker(ctx)
+
+        jobs = [self.job_constraint]
+        tgs = [
+            self.task_group_drivers,
+            self.task_group_constraint,
+            self.task_group_host_volumes,
+            self.task_group_devices,
+        ]
+        self.wrapped_checks = FeasibilityWrapper(ctx, self.quota, jobs, tgs)
+        self.distinct_property_constraint = DistinctPropertyIterator(ctx, self.wrapped_checks)
+        rank_source = FeasibleRankIterator(ctx, self.distinct_property_constraint)
+
+        _, sched_config = ctx.state.scheduler_config()
+        enable_preemption = True
+        if sched_config is not None:
+            enable_preemption = sched_config.preemption_config.system_scheduler_enabled
+        self.bin_pack = BinPackIterator(ctx, rank_source, enable_preemption, 0)
+        self.score_norm = ScoreNormalizationIterator(ctx, self.bin_pack)
+
+    def set_nodes(self, base_nodes: List[Node]) -> None:
+        self.source.set_nodes(base_nodes)
+
+    def set_job(self, job: Job) -> None:
+        self.job_constraint.set_constraints(job.constraints)
+        self.distinct_property_constraint.set_job(job)
+        self.bin_pack.set_job(job)
+        self.ctx.get_eligibility().set_job(job)
+
+    def select(self, tg: TaskGroup, options: Optional[SelectOptions]) -> Optional[RankedNode]:
+        self.score_norm.reset()
+        self.ctx.reset()
+        start = time.monotonic_ns()
+
+        tg_constr = task_group_constraints(tg)
+        self.task_group_drivers.set_drivers(tg_constr.drivers)
+        self.task_group_constraint.set_constraints(tg_constr.constraints)
+        self.task_group_devices.set_task_group(tg)
+        self.task_group_host_volumes.set_volumes(tg.volumes)
+        self.wrapped_checks.set_task_group(tg.name)
+        self.distinct_property_constraint.set_task_group(tg)
+        self.bin_pack.set_task_group(tg)
+
+        option = self.score_norm.next()
+        self.ctx.metrics.allocation_time_ns = time.monotonic_ns() - start
+        return option

@@ -1,0 +1,421 @@
+"""Scheduler helpers (reference ``scheduler/util.go``)."""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..structs.structs import (
+    ALLOC_CLIENT_PENDING,
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_STOP,
+    EVAL_STATUS_FAILED,
+    JOB_TYPE_BATCH,
+    NODE_STATUS_DOWN,
+    NODE_STATUS_READY,
+    NODE_SCHED_ELIGIBLE,
+    Allocation,
+    AllocatedResources,
+    AllocatedSharedResources,
+    Constraint,
+    Job,
+    Node,
+    Plan,
+    PlanResult,
+    TaskGroup,
+)
+
+ALLOC_NOT_NEEDED = "alloc not needed due to job update"
+ALLOC_MIGRATING = "alloc is being migrated"
+ALLOC_UPDATING = "alloc is being updated due to job update"
+ALLOC_LOST = "alloc is lost since its node is down"
+ALLOC_IN_PLACE = "alloc updating in-place"
+ALLOC_NODE_TAINTED = "alloc not needed as node is tainted"
+ALLOC_RESCHEDULED = "alloc was rescheduled because it failed"
+BLOCKED_EVAL_MAX_PLAN_DESC = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENTS = "created to place remaining allocations"
+RESCHEDULING_FOLLOWUP_EVAL_DESC = "created for delayed rescheduling"
+MAX_PAST_RESCHEDULE_EVENTS = 5
+
+
+class SetStatusError(Exception):
+    def __init__(self, msg: str, eval_status: str = EVAL_STATUS_FAILED):
+        super().__init__(msg)
+        self.eval_status = eval_status
+
+
+@dataclass
+class AllocTuple:
+    name: str
+    task_group: Optional[TaskGroup]
+    alloc: Optional[Allocation]
+
+
+@dataclass
+class DiffResult:
+    place: List[AllocTuple] = field(default_factory=list)
+    update: List[AllocTuple] = field(default_factory=list)
+    migrate: List[AllocTuple] = field(default_factory=list)
+    stop: List[AllocTuple] = field(default_factory=list)
+    ignore: List[AllocTuple] = field(default_factory=list)
+    lost: List[AllocTuple] = field(default_factory=list)
+
+    def append(self, other: "DiffResult") -> None:
+        self.place.extend(other.place)
+        self.update.extend(other.update)
+        self.migrate.extend(other.migrate)
+        self.stop.extend(other.stop)
+        self.ignore.extend(other.ignore)
+        self.lost.extend(other.lost)
+
+
+def materialize_task_groups(job: Optional[Job]) -> Dict[str, TaskGroup]:
+    """Expand counts to named instances: "<job>.<tg>[i]"."""
+    out: Dict[str, TaskGroup] = {}
+    if job is None or job.stopped():
+        return out
+    for tg in job.task_groups:
+        for i in range(tg.count):
+            out[f"{job.name}.{tg.name}[{i}]"] = tg
+    return out
+
+
+def diff_allocs(
+    job: Job,
+    tainted_nodes: Dict[str, Optional[Node]],
+    required: Dict[str, TaskGroup],
+    allocs: List[Allocation],
+    terminal_allocs: Dict[str, Allocation],
+) -> DiffResult:
+    """Set-difference of desired vs existing allocs (reference util.go:70)."""
+    result = DiffResult()
+    existing = set()
+    for exist in allocs:
+        name = exist.name
+        existing.add(name)
+        tg = required.get(name)
+        if tg is None:
+            result.stop.append(AllocTuple(name, tg, exist))
+            continue
+        if not exist.terminal_status() and exist.desired_transition.should_migrate():
+            result.migrate.append(AllocTuple(name, tg, exist))
+            continue
+        if exist.node_id in tainted_nodes:
+            node = tainted_nodes[exist.node_id]
+            if exist.job is not None and exist.job.type == JOB_TYPE_BATCH and exist.ran_successfully():
+                result.ignore.append(AllocTuple(name, tg, exist))
+                continue
+            if not exist.terminal_status() and (node is None or node.terminal_status()):
+                result.lost.append(AllocTuple(name, tg, exist))
+            else:
+                result.ignore.append(AllocTuple(name, tg, exist))
+            continue
+        if exist.job is not None and job.job_modify_index != exist.job.job_modify_index:
+            result.update.append(AllocTuple(name, tg, exist))
+            continue
+        result.ignore.append(AllocTuple(name, tg, exist))
+    for name, tg in required.items():
+        if name not in existing:
+            result.place.append(AllocTuple(name, tg, terminal_allocs.get(name)))
+    return result
+
+
+def diff_system_allocs(
+    job: Job,
+    nodes: List[Node],
+    tainted_nodes: Dict[str, Optional[Node]],
+    allocs: List[Allocation],
+    terminal_allocs: Dict[str, Allocation],
+) -> DiffResult:
+    """Per-node variant for the system scheduler (reference util.go:176)."""
+    node_allocs: Dict[str, List[Allocation]] = {}
+    for alloc in allocs:
+        node_allocs.setdefault(alloc.node_id, []).append(alloc)
+    for node in nodes:
+        node_allocs.setdefault(node.id, [])
+
+    required = materialize_task_groups(job)
+    result = DiffResult()
+    for node_id, nallocs in node_allocs.items():
+        diff = diff_allocs(job, tainted_nodes, required, nallocs, terminal_allocs)
+        if node_id in tainted_nodes:
+            diff.place = []
+        else:
+            for tup in diff.place:
+                if tup.alloc is None or tup.alloc.node_id != node_id:
+                    tup.alloc = Allocation(node_id=node_id)
+        result.append(diff)
+    return result
+
+
+def ready_nodes_in_dcs(state, dcs: List[str]) -> Tuple[List[Node], Dict[str, int]]:
+    dc_map = {dc: 0 for dc in dcs}
+    out = []
+    for node in state.nodes():
+        if node.status != NODE_STATUS_READY:
+            continue
+        if node.drain:
+            continue
+        if node.scheduling_eligibility != NODE_SCHED_ELIGIBLE:
+            continue
+        if node.datacenter not in dc_map:
+            continue
+        out.append(node)
+        dc_map[node.datacenter] += 1
+    return out, dc_map
+
+
+def retry_max(max_attempts: int, cb: Callable[[], bool], reset: Optional[Callable[[], bool]] = None) -> None:
+    """Retry until cb() returns done; reset() returning True restarts attempts."""
+    attempts = 0
+    while attempts < max_attempts:
+        done = cb()
+        if done:
+            return
+        if reset is not None and reset():
+            attempts = 0
+        else:
+            attempts += 1
+    raise SetStatusError(f"maximum attempts reached ({max_attempts})")
+
+
+def progress_made(result: Optional[PlanResult]) -> bool:
+    return result is not None and (
+        bool(result.node_update)
+        or bool(result.node_allocation)
+        or result.deployment is not None
+        or bool(result.deployment_updates)
+    )
+
+
+def tainted_nodes(state, allocs: List[Allocation]) -> Dict[str, Optional[Node]]:
+    """Nodes (down/draining/missing) containing these allocs (util.go:303)."""
+    out: Dict[str, Optional[Node]] = {}
+    for alloc in allocs:
+        if alloc.node_id in out:
+            continue
+        node = state.node_by_id(alloc.node_id)
+        if node is None:
+            out[alloc.node_id] = None
+            continue
+        if node.status == NODE_STATUS_DOWN or node.drain:
+            out[alloc.node_id] = node
+    return out
+
+
+def shuffle_nodes(nodes: List[Node]) -> None:
+    n = len(nodes)
+    for i in range(n - 1, 0, -1):
+        j = random.randint(0, i)
+        nodes[i], nodes[j] = nodes[j], nodes[i]
+
+
+def networks_updated(nets_a, nets_b) -> bool:
+    if len(nets_a) != len(nets_b):
+        return True
+    for an, bn in zip(nets_a, nets_b):
+        if an.mbits != bn.mbits:
+            return True
+        if _network_port_map(an) != _network_port_map(bn):
+            return True
+    return False
+
+
+def _network_port_map(n) -> Dict[str, int]:
+    m = {p.label: p.value for p in n.reserved_ports}
+    m.update({p.label: -1 for p in n.dynamic_ports})
+    return m
+
+
+def _merged_affinities(job: Job, tg: TaskGroup):
+    out = list(job.affinities) + list(tg.affinities)
+    for task in tg.tasks:
+        out.extend(task.affinities)
+    return out
+
+
+def tasks_updated(job_a: Job, job_b: Job, task_group: str) -> bool:
+    """Whether the group requires a destructive update (reference util.go:342)."""
+    a = job_a.lookup_task_group(task_group)
+    b = job_b.lookup_task_group(task_group)
+    if len(a.tasks) != len(b.tasks):
+        return True
+    if a.ephemeral_disk != b.ephemeral_disk:
+        return True
+    if networks_updated(a.networks, b.networks):
+        return True
+    if _merged_affinities(job_a, a) != _merged_affinities(job_b, b):
+        return True
+    if list(job_a.spreads) + list(a.spreads) != list(job_b.spreads) + list(b.spreads):
+        return True
+    for at in a.tasks:
+        bt = b.lookup_task(at.name)
+        if bt is None:
+            return True
+        if at.driver != bt.driver or at.user != bt.user:
+            return True
+        if at.config != bt.config or at.env != bt.env:
+            return True
+        if at.artifacts != bt.artifacts or at.vault != bt.vault or at.templates != bt.templates:
+            return True
+        if job_a.combined_task_meta(task_group, at.name) != job_b.combined_task_meta(task_group, bt.name):
+            return True
+        if networks_updated(at.resources.networks, bt.resources.networks):
+            return True
+        ar, br = at.resources, bt.resources
+        if ar.cpu != br.cpu or ar.memory_mb != br.memory_mb or ar.devices != br.devices:
+            return True
+    return False
+
+
+def set_status(
+    logger,
+    planner,
+    eval,
+    next_eval,
+    spawned_blocked,
+    tg_metrics,
+    status: str,
+    desc: str,
+    queued_allocs,
+    deployment_id: str,
+) -> None:
+    new_eval = eval.copy()
+    new_eval.status = status
+    new_eval.status_description = desc
+    new_eval.deployment_id = deployment_id
+    new_eval.failed_tg_allocs = tg_metrics or {}
+    if next_eval is not None:
+        new_eval.next_eval = next_eval.id
+    if spawned_blocked is not None:
+        new_eval.blocked_eval = spawned_blocked.id
+    if queued_allocs is not None:
+        new_eval.queued_allocations = queued_allocs
+    planner.update_eval(new_eval)
+
+
+def evict_and_place(ctx, diff: DiffResult, allocs: List[AllocTuple], desc: str, limit: List[int]) -> bool:
+    """Stop up to limit[0] allocs and queue replacements; True if limit hit."""
+    n = len(allocs)
+    for i in range(min(n, limit[0])):
+        a = allocs[i]
+        ctx.plan.append_stopped_alloc(a.alloc, desc, "")
+        diff.place.append(a)
+    if n <= limit[0]:
+        limit[0] -= n
+        return False
+    limit[0] = 0
+    return True
+
+
+@dataclass
+class TgConstrainTuple:
+    constraints: List[Constraint]
+    drivers: set
+
+
+def task_group_constraints(tg: TaskGroup) -> TgConstrainTuple:
+    constraints = list(tg.constraints)
+    drivers = set()
+    for task in tg.tasks:
+        drivers.add(task.driver)
+        constraints.extend(task.constraints)
+    return TgConstrainTuple(constraints=constraints, drivers=drivers)
+
+
+def adjust_queued_allocations(logger, result: Optional[PlanResult], queued_allocs: Dict[str, int]) -> None:
+    if result is None:
+        return
+    for allocations in result.node_allocation.values():
+        for allocation in allocations:
+            if allocation.create_index != allocation.modify_index:
+                continue
+            if allocation.task_group in queued_allocs:
+                queued_allocs[allocation.task_group] -= 1
+
+
+def update_non_terminal_allocs_to_lost(
+    plan: Plan, tainted: Dict[str, Optional[Node]], allocs: List[Allocation]
+) -> None:
+    """Mark pending/running allocs on down nodes as lost (util.go:800)."""
+    for alloc in allocs:
+        if alloc.node_id not in tainted:
+            continue
+        node = tainted[alloc.node_id]
+        if node is not None and node.status != NODE_STATUS_DOWN:
+            continue
+        if alloc.desired_status == ALLOC_DESIRED_STOP and alloc.client_status in (
+            ALLOC_CLIENT_RUNNING,
+            ALLOC_CLIENT_PENDING,
+        ):
+            from ..structs.structs import ALLOC_CLIENT_LOST
+
+            plan.append_stopped_alloc(alloc, ALLOC_LOST, ALLOC_CLIENT_LOST)
+
+
+def desired_updates(diff: DiffResult, inplace_updates, destructive_updates):
+    from ..structs.structs import DesiredUpdates
+
+    desired: Dict[str, DesiredUpdates] = {}
+
+    def get(name: str) -> DesiredUpdates:
+        return desired.setdefault(name, DesiredUpdates())
+
+    for tup in diff.place:
+        get(tup.task_group.name).place += 1
+    for tup in diff.stop:
+        get(tup.alloc.task_group).stop += 1
+    for tup in diff.ignore:
+        get(tup.task_group.name).ignore += 1
+    for tup in diff.migrate:
+        get(tup.task_group.name).migrate += 1
+    for tup in inplace_updates:
+        get(tup.task_group.name).in_place_update += 1
+    for tup in destructive_updates:
+        get(tup.task_group.name).destructive_update += 1
+    return desired
+
+
+def inplace_update(ctx, eval, job: Job, stack, updates: List[AllocTuple]):
+    """Try to update allocs in place; returns (destructive, inplace)
+    (reference util.go:539)."""
+    ws_updates = list(updates)
+    inplace: List[AllocTuple] = []
+    destructive: List[AllocTuple] = []
+    for update in ws_updates:
+        existing = update.alloc.job
+        if existing is None or tasks_updated(job, existing, update.task_group.name):
+            destructive.append(update)
+            continue
+        if update.alloc.terminal_status():
+            inplace.append(update)
+            continue
+        node = ctx.state.node_by_id(update.alloc.node_id)
+        if node is None:
+            destructive.append(update)
+            continue
+        stack.set_nodes([node])
+        ctx.plan.append_stopped_alloc(update.alloc, ALLOC_IN_PLACE, "")
+        option = stack.select(update.task_group, None)
+        ctx.plan.pop_update(update.alloc)
+        if option is None:
+            destructive.append(update)
+            continue
+        for task, resources in option.task_resources.items():
+            networks = []
+            if update.alloc.allocated_resources is not None:
+                tr = update.alloc.allocated_resources.tasks.get(task)
+                if tr is not None:
+                    networks = tr.networks
+            resources.networks = networks
+        new_alloc = update.alloc.copy_skip_job()
+        new_alloc.eval_id = eval.id
+        new_alloc.job = None
+        new_alloc.allocated_resources = AllocatedResources(
+            tasks=option.task_resources,
+            shared=AllocatedSharedResources(disk_mb=update.task_group.ephemeral_disk.size_mb),
+        )
+        new_alloc.metrics = ctx.metrics
+        ctx.plan.append_alloc(new_alloc)
+        inplace.append(update)
+    return destructive, inplace

@@ -1,0 +1,130 @@
+"""Version parsing and constraint checking.
+
+Implements the semantics of the reference's two version engines:
+go-version (lenient, used by the ``version`` operand) and strict semver
+(``semver`` operand) — reference scheduler/feasible.go:1170-1214 and
+helper/constraints/semver/.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+_VERSION_RE = re.compile(
+    r"^v?(\d+(?:\.\d+)*)(?:-([0-9A-Za-z.-]+))?(?:\+([0-9A-Za-z.-]+))?$"
+)
+_SEMVER_RE = re.compile(
+    r"^(\d+)\.(\d+)\.(\d+)(?:-([0-9A-Za-z.-]+))?(?:\+([0-9A-Za-z.-]+))?$"
+)
+
+
+class Version:
+    __slots__ = ("segments", "prerelease", "_si")
+
+    def __init__(self, segments: List[int], prerelease: str):
+        self.segments = segments
+        self.prerelease = prerelease
+        self._si = len(segments)
+
+    @classmethod
+    def parse(cls, s: str, strict: bool = False) -> Optional["Version"]:
+        s = s.strip()
+        if strict:
+            m = _SEMVER_RE.match(s)
+            if not m:
+                return None
+            return cls([int(m.group(1)), int(m.group(2)), int(m.group(3))], m.group(4) or "")
+        m = _VERSION_RE.match(s)
+        if not m:
+            return None
+        segments = [int(x) for x in m.group(1).split(".")]
+        while len(segments) < 3:
+            segments.append(0)
+        return cls(segments, m.group(2) or "")
+
+    def _cmp_prerelease(self, other: "Version") -> int:
+        a, b = self.prerelease, other.prerelease
+        if a == b:
+            return 0
+        if a == "":
+            return 1  # release > prerelease
+        if b == "":
+            return -1
+        # dotted identifier comparison (numeric identifiers compare numerically)
+        pa, pb = a.split("."), b.split(".")
+        for xa, xb in zip(pa, pb):
+            na, nb = xa.isdigit(), xb.isdigit()
+            if na and nb:
+                if int(xa) != int(xb):
+                    return -1 if int(xa) < int(xb) else 1
+            elif na != nb:
+                return -1 if na else 1  # numeric < alphanumeric
+            elif xa != xb:
+                return -1 if xa < xb else 1
+        if len(pa) != len(pb):
+            return -1 if len(pa) < len(pb) else 1
+        return 0
+
+    def compare(self, other: "Version") -> int:
+        n = max(len(self.segments), len(other.segments))
+        for i in range(n):
+            a = self.segments[i] if i < len(self.segments) else 0
+            b = other.segments[i] if i < len(other.segments) else 0
+            if a != b:
+                return -1 if a < b else 1
+        return self._cmp_prerelease(other)
+
+
+_CONSTRAINT_RE = re.compile(r"^\s*(=|!=|>=|<=|>|<|~>)?\s*(.+?)\s*$")
+
+
+class Constraints:
+    """A parsed comma-separated constraint set (all must hold)."""
+
+    def __init__(self, parts: List[Tuple[str, Version, int]]):
+        self.parts = parts
+
+    @classmethod
+    def parse(cls, spec: str, strict: bool = False) -> Optional["Constraints"]:
+        parts: List[Tuple[str, Version, int]] = []
+        for raw in spec.split(","):
+            m = _CONSTRAINT_RE.match(raw)
+            if not m or not m.group(2):
+                return None
+            op = m.group(1) or "="
+            vstr = m.group(2)
+            # ~> keeps track of how many segments were specified
+            seg_count = len(vstr.lstrip("v").split("-")[0].split("."))
+            v = Version.parse(vstr, strict=strict)
+            if v is None:
+                return None
+            parts.append((op, v, seg_count))
+        return cls(parts) if parts else None
+
+    def check(self, v: Version) -> bool:
+        return all(self._check_one(op, target, segs, v) for op, target, segs in self.parts)
+
+    @staticmethod
+    def _check_one(op: str, target: Version, seg_count: int, v: Version) -> bool:
+        c = v.compare(target)
+        if op == "=":
+            return c == 0
+        if op == "!=":
+            return c != 0
+        if op == ">":
+            return c > 0
+        if op == "<":
+            return c < 0
+        if op == ">=":
+            return c >= 0
+        if op == "<=":
+            return c <= 0
+        if op == "~>":
+            # pessimistic: >= target and < next significant release
+            if c < 0:
+                return False
+            upper_segments = list(target.segments[: max(seg_count - 1, 1)])
+            upper_segments[-1] += 1
+            upper = Version(upper_segments, "")
+            return v.compare(upper) < 0
+        return False

@@ -1,0 +1,2 @@
+"""State store (reference nomad/state/)."""
+from .state_store import StateStore  # noqa: F401

@@ -1,0 +1,487 @@
+"""In-memory state store with Raft-index stamps.
+
+Fills the role of reference ``nomad/state/state_store.go`` (go-memdb MVCC).
+Instead of an immutable radix tree, this design keeps simple dict tables
+guarded by an RWLock with copy-on-snapshot: schedulers always work against a
+``snapshot()`` (cheap shallow copies of table dicts), so writers never
+invalidate a running evaluation — the same isolation guarantee memdb gives
+the reference. Blocking queries are exposed via a table-version condition
+variable (reference state_store.go:188 BlockingQuery).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..structs.structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_STOP,
+    EVAL_STATUS_BLOCKED,
+    JOB_STATUS_DEAD,
+    JOB_STATUS_PENDING,
+    JOB_STATUS_RUNNING,
+    Allocation,
+    Deployment,
+    Evaluation,
+    Job,
+    Node,
+    PlanResult,
+    SchedulerConfiguration,
+)
+
+
+class StateStore:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self.latest_index = 0
+
+        self.nodes_table: Dict[str, Node] = {}
+        self.jobs_table: Dict[Tuple[str, str], Job] = {}
+        self.job_versions: Dict[Tuple[str, str], List[Job]] = {}
+        self.allocs_table: Dict[str, Allocation] = {}
+        self.evals_table: Dict[str, Evaluation] = {}
+        self.deployments_table: Dict[str, Deployment] = {}
+        self.scheduler_config_entry: Optional[SchedulerConfiguration] = None
+
+        # secondary indexes
+        self._allocs_by_node: Dict[str, set] = {}
+        self._allocs_by_job: Dict[Tuple[str, str], set] = {}
+        self._allocs_by_eval: Dict[str, set] = {}
+        self._evals_by_job: Dict[Tuple[str, str], set] = {}
+        self._deployments_by_job: Dict[Tuple[str, str], set] = {}
+
+    # ------------------------------------------------------------------
+    # snapshots / blocking
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> "StateStore":
+        """Point-in-time view; shallow-copies tables (objects are treated as
+        immutable once inserted — all writers insert copies)."""
+        with self._lock:
+            snap = StateStore.__new__(StateStore)
+            snap._lock = threading.RLock()
+            snap._cond = threading.Condition(snap._lock)
+            snap.latest_index = self.latest_index
+            snap.nodes_table = dict(self.nodes_table)
+            snap.jobs_table = dict(self.jobs_table)
+            snap.job_versions = {k: list(v) for k, v in self.job_versions.items()}
+            snap.allocs_table = dict(self.allocs_table)
+            snap.evals_table = dict(self.evals_table)
+            snap.deployments_table = dict(self.deployments_table)
+            snap.scheduler_config_entry = self.scheduler_config_entry
+            snap._allocs_by_node = {k: set(v) for k, v in self._allocs_by_node.items()}
+            snap._allocs_by_job = {k: set(v) for k, v in self._allocs_by_job.items()}
+            snap._allocs_by_eval = {k: set(v) for k, v in self._allocs_by_eval.items()}
+            snap._evals_by_job = {k: set(v) for k, v in self._evals_by_job.items()}
+            snap._deployments_by_job = {k: set(v) for k, v in self._deployments_by_job.items()}
+            return snap
+
+    def snapshot_min_index(self, index: int, timeout: float = 5.0) -> "StateStore":
+        """Wait until the store has applied ``index`` then snapshot
+        (reference state_store.go:114)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self.latest_index >= index, timeout=timeout):
+                raise TimeoutError(
+                    f"timed out waiting for index {index} (at {self.latest_index})"
+                )
+            return self.snapshot()
+
+    def blocking_query(
+        self, run: Callable[["StateStore"], object], min_index: int, timeout: float = 60.0
+    ):
+        """Re-run ``run`` once the store passes ``min_index`` (long poll)."""
+        with self._cond:
+            self._cond.wait_for(lambda: self.latest_index > min_index, timeout=timeout)
+            return run(self), self.latest_index
+
+    def _bump(self, index: Optional[int] = None) -> int:
+        if index is None:
+            index = self.latest_index + 1
+        self.latest_index = max(self.latest_index, index)
+        self._cond.notify_all()
+        return index
+
+    # ------------------------------------------------------------------
+    # nodes
+    # ------------------------------------------------------------------
+
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            node = node.copy()  # snapshot isolation: the store owns its objects
+            existing = self.nodes_table.get(node.id)
+            if existing is not None:
+                node.create_index = existing.create_index
+                # Preserve operator-set fields across re-registration
+                node.drain = existing.drain
+                node.scheduling_eligibility = existing.scheduling_eligibility
+            else:
+                node.create_index = index
+            node.modify_index = index
+            if not node.computed_class:
+                node.compute_class()
+            self.nodes_table[node.id] = node
+            self._bump(index)
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            self.nodes_table.pop(node_id, None)
+            self._bump(index)
+
+    def update_node_status(self, index: int, node_id: str, status: str) -> None:
+        with self._lock:
+            node = self.nodes_table.get(node_id)
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            node = node.copy()
+            node.status = status
+            node.modify_index = index
+            self.nodes_table[node_id] = node
+            self._bump(index)
+
+    def update_node_drain(self, index: int, node_id: str, drain: bool) -> None:
+        with self._lock:
+            node = self.nodes_table.get(node_id)
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            node = node.copy()
+            node.drain = drain
+            from ..structs.structs import NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE
+
+            node.scheduling_eligibility = (
+                NODE_SCHED_INELIGIBLE if drain else NODE_SCHED_ELIGIBLE
+            )
+            node.modify_index = index
+            self.nodes_table[node_id] = node
+            self._bump(index)
+
+    def update_node_eligibility(self, index: int, node_id: str, eligibility: str) -> None:
+        with self._lock:
+            node = self.nodes_table.get(node_id)
+            if node is None:
+                raise KeyError(f"node {node_id} not found")
+            node = node.copy()
+            node.scheduling_eligibility = eligibility
+            node.modify_index = index
+            self.nodes_table[node_id] = node
+            self._bump(index)
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self.nodes_table.get(node_id)
+
+    def nodes(self) -> List[Node]:
+        return list(self.nodes_table.values())
+
+    # ------------------------------------------------------------------
+    # jobs
+    # ------------------------------------------------------------------
+
+    def upsert_job(self, index: int, job: Job) -> None:
+        with self._lock:
+            job = job.copy()  # snapshot isolation: the store owns its objects
+            key = (job.namespace, job.id)
+            existing = self.jobs_table.get(key)
+            if existing is not None:
+                job.create_index = existing.create_index
+                job.modify_index = index
+                job.job_modify_index = index
+                job.version = existing.version + 1
+            else:
+                job.create_index = index
+                job.modify_index = index
+                job.job_modify_index = index
+                job.version = 0
+            if job.status not in (JOB_STATUS_PENDING, JOB_STATUS_RUNNING, JOB_STATUS_DEAD):
+                job.status = JOB_STATUS_PENDING
+            self.jobs_table[key] = job
+            self.job_versions.setdefault(key, []).append(job)
+            # keep a bounded version history (reference keeps 6)
+            if len(self.job_versions[key]) > 6:
+                self.job_versions[key] = self.job_versions[key][-6:]
+            self._bump(index)
+
+    def delete_job(self, index: int, namespace: str, job_id: str) -> None:
+        with self._lock:
+            self.jobs_table.pop((namespace, job_id), None)
+            self.job_versions.pop((namespace, job_id), None)
+            self._bump(index)
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self.jobs_table.get((namespace, job_id))
+
+    def job_by_id_and_version(self, namespace: str, job_id: str, version: int) -> Optional[Job]:
+        for j in self.job_versions.get((namespace, job_id), []):
+            if j.version == version:
+                return j
+        return None
+
+    def jobs(self) -> List[Job]:
+        return list(self.jobs_table.values())
+
+    # ------------------------------------------------------------------
+    # evals
+    # ------------------------------------------------------------------
+
+    def upsert_evals(self, index: int, evals: List[Evaluation]) -> None:
+        with self._lock:
+            for e in evals:
+                e = e.copy()  # snapshot isolation: the store owns its objects
+                existing = self.evals_table.get(e.id)
+                if existing is not None:
+                    e.create_index = existing.create_index
+                else:
+                    e.create_index = index
+                e.modify_index = index
+                self.evals_table[e.id] = e
+                self._evals_by_job.setdefault((e.namespace, e.job_id), set()).add(e.id)
+            self._bump(index)
+
+    def delete_eval(self, index: int, eval_ids: List[str], alloc_ids: List[str]) -> None:
+        with self._lock:
+            for eid in eval_ids:
+                e = self.evals_table.pop(eid, None)
+                if e is not None:
+                    s = self._evals_by_job.get((e.namespace, e.job_id))
+                    if s is not None:
+                        s.discard(eid)
+            for aid in alloc_ids:
+                self._remove_alloc_index(aid)
+                self.allocs_table.pop(aid, None)
+            self._bump(index)
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self.evals_table.get(eval_id)
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return [
+            self.evals_table[eid]
+            for eid in self._evals_by_job.get((namespace, job_id), set())
+            if eid in self.evals_table
+        ]
+
+    def evals(self) -> List[Evaluation]:
+        return list(self.evals_table.values())
+
+    # ------------------------------------------------------------------
+    # allocs
+    # ------------------------------------------------------------------
+
+    def _index_alloc(self, alloc: Allocation) -> None:
+        self._allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
+        self._allocs_by_job.setdefault((alloc.namespace, alloc.job_id), set()).add(alloc.id)
+        self._allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+
+    def _remove_alloc_index(self, alloc_id: str) -> None:
+        alloc = self.allocs_table.get(alloc_id)
+        if alloc is None:
+            return
+        self._allocs_by_node.get(alloc.node_id, set()).discard(alloc_id)
+        self._allocs_by_job.get((alloc.namespace, alloc.job_id), set()).discard(alloc_id)
+        self._allocs_by_eval.get(alloc.eval_id, set()).discard(alloc_id)
+
+    def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
+        with self._lock:
+            self._upsert_allocs_impl(index, allocs)
+            self._bump(index)
+
+    def _upsert_allocs_impl(self, index: int, allocs: List[Allocation]) -> None:
+        for alloc in allocs:
+            # Snapshot isolation: copy the alloc, sharing the (immutable) job.
+            alloc = alloc.copy_skip_job()
+            existing = self.allocs_table.get(alloc.id)
+            if existing is not None:
+                alloc.create_index = existing.create_index
+                alloc.create_time_ns = existing.create_time_ns
+                # Client-owned fields survive server-side updates
+                if alloc.client_status == "" and existing.client_status != "":
+                    alloc.client_status = existing.client_status
+                self._remove_alloc_index(alloc.id)
+            else:
+                alloc.create_index = index
+            alloc.modify_index = index
+            if alloc.job is None and existing is not None:
+                alloc.job = existing.job
+            self.allocs_table[alloc.id] = alloc
+            self._index_alloc(alloc)
+
+    def update_allocs_from_client(self, index: int, allocs: List[Allocation]) -> None:
+        """Client status sync (reference state_store.go:1933)."""
+        with self._lock:
+            for client_alloc in allocs:
+                existing = self.allocs_table.get(client_alloc.id)
+                if existing is None:
+                    continue
+                updated = existing.copy_skip_job()
+                updated.client_status = client_alloc.client_status
+                updated.client_description = client_alloc.client_description
+                updated.task_states = dict(client_alloc.task_states)
+                updated.deployment_status = client_alloc.deployment_status
+                updated.modify_index = index
+                updated.modify_time_ns = client_alloc.modify_time_ns
+                self._remove_alloc_index(existing.id)
+                self.allocs_table[updated.id] = updated
+                self._index_alloc(updated)
+            self._bump(index)
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self.allocs_table.get(alloc_id)
+
+    def allocs(self) -> List[Allocation]:
+        return list(self.allocs_table.values())
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return [
+            self.allocs_table[aid]
+            for aid in self._allocs_by_node.get(node_id, set())
+            if aid in self.allocs_table
+        ]
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id) if a.terminal_status() == terminal]
+
+    def allocs_by_job(self, namespace: str, job_id: str, all_allocs: bool) -> List[Allocation]:
+        out = [
+            self.allocs_table[aid]
+            for aid in self._allocs_by_job.get((namespace, job_id), set())
+            if aid in self.allocs_table
+        ]
+        if not all_allocs:
+            # Exclude allocs from prior job versions that are terminal? The
+            # reference's "all" flag includes allocs of all job create indexes;
+            # for scheduling purposes all=True is used.
+            pass
+        return out
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        return [
+            self.allocs_table[aid]
+            for aid in self._allocs_by_eval.get(eval_id, set())
+            if aid in self.allocs_table
+        ]
+
+    # ------------------------------------------------------------------
+    # deployments
+    # ------------------------------------------------------------------
+
+    def upsert_deployment(self, index: int, deployment: Deployment) -> None:
+        with self._lock:
+            existing = self.deployments_table.get(deployment.id)
+            if existing is not None:
+                deployment.create_index = existing.create_index
+            else:
+                deployment.create_index = index
+            deployment.modify_index = index
+            self.deployments_table[deployment.id] = deployment
+            self._deployments_by_job.setdefault(
+                (deployment.namespace, deployment.job_id), set()
+            ).add(deployment.id)
+            self._bump(index)
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self.deployments_table.get(deployment_id)
+
+    def deployments(self) -> List[Deployment]:
+        return list(self.deployments_table.values())
+
+    def latest_deployment_by_job_id(self, namespace: str, job_id: str) -> Optional[Deployment]:
+        ids = self._deployments_by_job.get((namespace, job_id), set())
+        latest = None
+        for did in ids:
+            d = self.deployments_table.get(did)
+            if d is None:
+                continue
+            if latest is None or d.create_index > latest.create_index:
+                latest = d
+        return latest
+
+    def delete_deployment(self, index: int, deployment_ids: List[str]) -> None:
+        with self._lock:
+            for did in deployment_ids:
+                d = self.deployments_table.pop(did, None)
+                if d is not None:
+                    s = self._deployments_by_job.get((d.namespace, d.job_id))
+                    if s is not None:
+                        s.discard(did)
+            self._bump(index)
+
+    # ------------------------------------------------------------------
+    # scheduler config
+    # ------------------------------------------------------------------
+
+    def scheduler_config(self) -> Tuple[int, Optional[SchedulerConfiguration]]:
+        cfg = self.scheduler_config_entry
+        return (cfg.modify_index if cfg else 0), cfg
+
+    def scheduler_set_config(self, index: int, config: SchedulerConfiguration) -> None:
+        with self._lock:
+            config.modify_index = index
+            if self.scheduler_config_entry is None:
+                config.create_index = index
+            else:
+                config.create_index = self.scheduler_config_entry.create_index
+            self.scheduler_config_entry = config
+            self._bump(index)
+
+    # ------------------------------------------------------------------
+    # plan results (the alloc commit path — reference state_store.go:227)
+    # ------------------------------------------------------------------
+
+    def upsert_plan_results(
+        self,
+        index: int,
+        alloc_updates: List[Allocation],
+        allocs_stopped: List[Allocation],
+        allocs_preempted: List[Allocation],
+        deployment: Optional[Deployment] = None,
+        deployment_updates: Optional[List] = None,
+        eval_id: str = "",
+        preempted_eval_ids: Optional[List[str]] = None,
+    ) -> None:
+        with self._lock:
+            if deployment is not None:
+                existing = self.deployments_table.get(deployment.id)
+                if existing is not None:
+                    deployment.create_index = existing.create_index
+                else:
+                    deployment.create_index = index
+                deployment.modify_index = index
+                self.deployments_table[deployment.id] = deployment
+                self._deployments_by_job.setdefault(
+                    (deployment.namespace, deployment.job_id), set()
+                ).add(deployment.id)
+            for update in deployment_updates or []:
+                d = self.deployments_table.get(update.deployment_id)
+                if d is not None:
+                    d = d.copy()
+                    d.status = update.status
+                    d.status_description = update.status_description
+                    d.modify_index = index
+                    self.deployments_table[d.id] = d
+            self._upsert_allocs_impl(index, alloc_updates + allocs_stopped + allocs_preempted)
+            self._bump(index)
+
+    # ------------------------------------------------------------------
+    # job status summaries
+    # ------------------------------------------------------------------
+
+    def job_summary(self, namespace: str, job_id: str) -> Dict[str, Dict[str, int]]:
+        summary: Dict[str, Dict[str, int]] = {}
+        for alloc in self.allocs_by_job(namespace, job_id, True):
+            tg = summary.setdefault(
+                alloc.task_group,
+                {"queued": 0, "complete": 0, "failed": 0, "running": 0, "starting": 0, "lost": 0},
+            )
+            cs = alloc.client_status
+            if cs == ALLOC_CLIENT_FAILED:
+                tg["failed"] += 1
+            elif cs == ALLOC_CLIENT_COMPLETE:
+                tg["complete"] += 1
+            elif cs == ALLOC_CLIENT_LOST:
+                tg["lost"] += 1
+            elif cs == "running":
+                tg["running"] += 1
+            else:
+                tg["starting"] += 1
+        return summary

@@ -1,0 +1,134 @@
+"""Feasibility checker tests (mirrors reference scheduler/feasible_test.go)."""
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import (
+    ConstraintChecker,
+    DriverChecker,
+    HostVolumeChecker,
+    StaticIterator,
+    check_constraint,
+    resolve_target,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Constraint
+from nomad_tpu.structs.structs import DriverInfo, HostVolume, VolumeRequest
+
+
+def make_ctx(deterministic=True):
+    state = StateStore()
+    ev = mock.eval()
+    plan = ev.make_plan(mock.job())
+    return EvalContext(state, plan, deterministic=deterministic)
+
+
+def test_static_iterator_serves_all():
+    ctx = make_ctx()
+    nodes = [mock.node() for _ in range(3)]
+    it = StaticIterator(ctx, nodes)
+    out = []
+    while True:
+        n = it.next()
+        if n is None:
+            break
+        out.append(n)
+    assert out == nodes
+    assert ctx.metrics.nodes_evaluated == 3
+
+
+def test_static_iterator_reset_wraps():
+    ctx = make_ctx()
+    nodes = [mock.node() for _ in range(3)]
+    it = StaticIterator(ctx, nodes)
+    it.next()  # consume one
+    it.reset()
+    out = [it.next() for _ in range(3)]
+    assert None not in out
+    assert it.next() is None
+
+
+def test_driver_checker():
+    ctx = make_ctx()
+    nodes = [mock.node() for _ in range(4)]
+    nodes[1].attributes["driver.foo"] = "1"
+    nodes[2].attributes["driver.foo"] = "0"
+    nodes[3].drivers = {"foo": DriverInfo(detected=True, healthy=False)}
+    checker = DriverChecker(ctx, {"foo"})
+    assert not checker.feasible(nodes[0])
+    assert checker.feasible(nodes[1])
+    assert not checker.feasible(nodes[2])
+    assert not checker.feasible(nodes[3])
+
+
+def test_constraint_checker_ops():
+    ctx = make_ctx()
+    node = mock.node()
+    cases = [
+        (Constraint("${node.datacenter}", "dc1", "="), True),
+        (Constraint("${node.datacenter}", "dc2", "="), False),
+        (Constraint("${attr.kernel.name}", "linux", "="), True),
+        (Constraint("${attr.kernel.name}", "", "is_set"), True),
+        (Constraint("${attr.nonexistent}", "", "is_set"), False),
+        (Constraint("${attr.nonexistent}", "", "is_not_set"), True),
+        (Constraint("${meta.pci-dss}", "true", "="), True),
+        (Constraint("${attr.kernel.name}", "li.*x", "regexp"), True),
+        (Constraint("${attr.kernel.name}", "win.*", "regexp"), False),
+        (Constraint("${node.class}", "linux-medium-pci", "="), True),
+        (Constraint("${attr.nomad.version}", ">= 0.4, < 0.8", "version"), True),
+        (Constraint("${attr.nomad.version}", "> 1.0", "version"), False),
+    ]
+    for constraint, expected in cases:
+        checker = ConstraintChecker(ctx, [constraint])
+        assert checker.feasible(node) == expected, str(constraint)
+
+
+def test_check_constraint_set_contains():
+    ctx = make_ctx()
+    assert check_constraint(ctx, "set_contains", "a,b,c", "a,c", True, True)
+    assert not check_constraint(ctx, "set_contains", "a,b", "a,c", True, True)
+    assert check_constraint(ctx, "set_contains_any", "a,b", "c,b", True, True)
+    assert not check_constraint(ctx, "set_contains_any", "a,b", "c,d", True, True)
+
+
+def test_check_constraint_lexical():
+    ctx = make_ctx()
+    assert check_constraint(ctx, "<", "abc", "abd", True, True)
+    assert not check_constraint(ctx, ">", "abc", "abd", True, True)
+    assert check_constraint(ctx, ">=", "abc", "abc", True, True)
+
+
+def test_check_constraint_semver():
+    ctx = make_ctx()
+    assert check_constraint(ctx, "semver", "1.7.0-beta", ">= 1.6.0", True, True)
+    # go-version ">= 1.6.0" does not admit prereleases below the bound either;
+    # key semver-vs-version difference is strict 3-segment parsing:
+    assert not check_constraint(ctx, "semver", "1.7", ">= 1.6.0", True, True)
+    assert check_constraint(ctx, "version", "1.7", ">= 1.6.0", True, True)
+
+
+def test_resolve_target_literal_and_missing():
+    node = mock.node()
+    val, ok = resolve_target("some-literal", node)
+    assert ok and val == "some-literal"
+    val, ok = resolve_target("${attr.missing}", node)
+    assert not ok
+    val, ok = resolve_target("${node.unique.id}", node)
+    assert ok and val == node.id
+
+
+def test_host_volume_checker():
+    ctx = make_ctx()
+    checker = HostVolumeChecker(ctx)
+    node = mock.node()
+    node.host_volumes = {"shared": HostVolume(name="shared", read_only=True)}
+    # no volumes requested -> feasible
+    checker.set_volumes({})
+    assert checker.feasible(node)
+    # requested matching volume read-only -> ok
+    checker.set_volumes({"v": VolumeRequest(name="v", type="host", source="shared", read_only=True)})
+    assert checker.feasible(node)
+    # read-write request on read-only volume -> fail
+    checker.set_volumes({"v": VolumeRequest(name="v", type="host", source="shared", read_only=False)})
+    assert not checker.feasible(node)
+    # missing volume -> fail
+    checker.set_volumes({"v": VolumeRequest(name="v", type="host", source="zzz")})
+    assert not checker.feasible(node)

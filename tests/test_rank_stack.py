@@ -1,0 +1,281 @@
+"""Ranking iterators + generic stack tests (mirrors scheduler/rank_test.go,
+stack_test.go semantics)."""
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import StaticIterator
+from nomad_tpu.scheduler.rank import (
+    BinPackIterator,
+    FeasibleRankIterator,
+    JobAntiAffinityIterator,
+    RankedNode,
+    ScoreNormalizationIterator,
+    StaticRankIterator,
+)
+from nomad_tpu.scheduler.select import LimitIterator, MaxScoreIterator
+from nomad_tpu.scheduler.stack import GenericStack, SelectOptions, SystemStack
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Affinity, Constraint
+from nomad_tpu.structs.structs import Spread, SpreadTarget
+
+
+def make_ctx(state=None, job=None):
+    state = state or StateStore()
+    ev = mock.eval()
+    plan = ev.make_plan(job or mock.job())
+    return EvalContext(state, plan, deterministic=True), state, plan
+
+
+def _drain(it):
+    out = []
+    while True:
+        o = it.next()
+        if o is None:
+            return out
+        out.append(o)
+
+
+def test_binpack_prefers_packed_node():
+    """BestFit: node with existing load scores higher than an empty one."""
+    ctx, state, _plan = make_ctx()
+    n1, n2 = mock.node(), mock.node()
+    state.upsert_node(1, n1)
+    state.upsert_node(2, n2)
+    # Existing alloc on n1
+    a = mock.alloc()
+    a.node_id = n1.id
+    state.upsert_allocs(3, [a])
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []  # isolate cpu/mem scoring
+    tg.networks = []
+
+    source = StaticRankIterator(ctx, [RankedNode(n1), RankedNode(n2)])
+    bp = BinPackIterator(ctx, source, False, 0)
+    bp.set_job(job)
+    bp.set_task_group(tg)
+    out = _drain(bp)
+    assert len(out) == 2
+    by_node = {r.node.id: r for r in out}
+    assert by_node[n1.id].scores[0] > by_node[n2.id].scores[0]
+
+
+def test_binpack_exhausts_node():
+    ctx, state, _ = make_ctx()
+    n1 = mock.node()
+    n1.node_resources.cpu_shares = 400  # too small for the 500MHz ask
+    state.upsert_node(1, n1)
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.tasks[0].resources.networks = []
+    source = StaticRankIterator(ctx, [RankedNode(n1)])
+    bp = BinPackIterator(ctx, source, False, 0)
+    bp.set_job(job)
+    bp.set_task_group(tg)
+    assert _drain(bp) == []
+    assert ctx.metrics.nodes_exhausted == 1
+    assert ctx.metrics.dimension_exhausted.get("cpu") == 1
+
+
+def test_job_anti_affinity_penalty():
+    ctx, state, plan = make_ctx()
+    n1 = mock.node()
+    state.upsert_node(1, n1)
+    job = mock.job()
+    # propose 2 allocs of this job on the node via the plan
+    for _ in range(2):
+        a = mock.alloc()
+        a.node_id = n1.id
+        a.job_id = job.id
+        a.task_group = "web"
+        plan.node_allocation.setdefault(n1.id, []).append(a)
+    source = StaticRankIterator(ctx, [RankedNode(n1)])
+    it = JobAntiAffinityIterator(ctx, source, job.id)
+    it.set_job(job)
+    it.set_task_group(job.task_groups[0])  # count=10
+    out = _drain(it)
+    # penalty = -(2+1)/10
+    assert abs(out[0].scores[0] - (-0.3)) < 1e-9
+
+
+def test_score_normalization_mean():
+    ctx, _, _ = make_ctx()
+    rn = RankedNode(mock.node())
+    rn.scores = [0.8, -0.2]
+    it = ScoreNormalizationIterator(ctx, StaticRankIterator(ctx, [rn]))
+    out = _drain(it)
+    assert abs(out[0].final_score - 0.3) < 1e-9
+
+
+def test_limit_iterator_skips_low_scores():
+    ctx, _, _ = make_ctx()
+    nodes = [RankedNode(mock.node()) for _ in range(4)]
+    scores = [-1.0, -1.0, 0.5, 0.9]
+    for rn, s in zip(nodes, scores):
+        rn.final_score = s
+    limit = LimitIterator(ctx, StaticRankIterator(ctx, nodes), 2, 0.0, 3)
+    out = _drain(limit)
+    assert len(out) == 2
+    assert out[0].final_score == 0.5
+    assert out[1].final_score == 0.9
+
+
+def test_limit_iterator_falls_back_to_skipped():
+    ctx, _, _ = make_ctx()
+    nodes = [RankedNode(mock.node()) for _ in range(2)]
+    for rn in nodes:
+        rn.final_score = -1.0
+    limit = LimitIterator(ctx, StaticRankIterator(ctx, nodes), 2, 0.0, 3)
+    out = _drain(limit)
+    # All below threshold: the skipped nodes are served anyway
+    assert len(out) == 2
+
+
+def test_max_score_iterator():
+    ctx, _, _ = make_ctx()
+    nodes = [RankedNode(mock.node()) for _ in range(3)]
+    for rn, s in zip(nodes, [0.2, 0.9, 0.5]):
+        rn.final_score = s
+    it = MaxScoreIterator(ctx, StaticRankIterator(ctx, nodes))
+    out = _drain(it)
+    assert len(out) == 1
+    assert out[0].final_score == 0.9
+
+
+def test_generic_stack_selects_feasible_node():
+    ctx, state, _ = make_ctx()
+    good, bad = mock.node(), mock.node()
+    bad.attributes["kernel.name"] = "windows"
+    bad.compute_class()
+    state.upsert_node(1, good)
+    state.upsert_node(2, bad)
+    job = mock.job()  # constrained to kernel.name = linux
+    stack = GenericStack(False, ctx)
+    stack.set_job(job)
+    stack.set_nodes([good, bad])
+    option = stack.select(job.task_groups[0], SelectOptions())
+    assert option is not None
+    assert option.node.id == good.id
+    assert option.task_resources["web"].cpu_shares == 500
+
+
+def test_generic_stack_no_feasible_node():
+    ctx, state, _ = make_ctx()
+    bad = mock.node()
+    bad.attributes["kernel.name"] = "windows"
+    bad.compute_class()
+    state.upsert_node(1, bad)
+    job = mock.job()
+    stack = GenericStack(False, ctx)
+    stack.set_job(job)
+    stack.set_nodes([bad])
+    assert stack.select(job.task_groups[0], SelectOptions()) is None
+    assert ctx.metrics.nodes_filtered >= 1
+
+
+def test_generic_stack_affinity_scoring():
+    ctx, state, _ = make_ctx()
+    plain, preferred = mock.node(), mock.node()
+    preferred.attributes["rack"] = "r1"
+    preferred.compute_class()
+    state.upsert_node(1, plain)
+    state.upsert_node(2, preferred)
+    job = mock.job()
+    job.affinities = [Affinity("${attr.rack}", "r1", "=", 100)]
+    stack = GenericStack(False, ctx)
+    stack.set_job(job)
+    stack.set_nodes([plain, preferred])
+    option = stack.select(job.task_groups[0], SelectOptions())
+    assert option.node.id == preferred.id
+
+
+def test_generic_stack_spread_scoring():
+    ctx, state, plan = make_ctx()
+    n_dc1, n_dc2 = mock.node(), mock.node()
+    n_dc2.datacenter = "dc2"
+    n_dc2.compute_class()
+    state.upsert_node(1, n_dc1)
+    state.upsert_node(2, n_dc2)
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    job.spreads = [Spread("${node.datacenter}", 100,
+                          [SpreadTarget("dc1", 50), SpreadTarget("dc2", 50)])]
+    # existing alloc in dc1
+    a = mock.alloc()
+    a.node_id = n_dc1.id
+    a.job_id = job.id
+    a.task_group = "web"
+    a.job = job
+    state.upsert_allocs(3, [a])
+    stack = GenericStack(False, ctx)
+    stack.set_job(job)
+    stack.set_nodes([n_dc1, n_dc2])
+    option = stack.select(job.task_groups[0], SelectOptions())
+    assert option.node.id == n_dc2.id
+
+
+def test_system_stack_scores_all_nodes():
+    ctx, state, _ = make_ctx(job=mock.system_job())
+    nodes = [mock.node() for _ in range(3)]
+    for i, n in enumerate(nodes):
+        state.upsert_node(i + 1, n)
+    job = mock.system_job()
+    stack = SystemStack(ctx)
+    stack.set_job(job)
+    stack.set_nodes(nodes)
+    option = stack.select(job.task_groups[0], None)
+    assert option is not None
+
+
+def test_distinct_hosts_via_stack():
+    ctx, state, plan = make_ctx()
+    n1, n2 = mock.node(), mock.node()
+    state.upsert_node(1, n1)
+    state.upsert_node(2, n2)
+    job = mock.job()
+    job.constraints.append(Constraint(operand="distinct_hosts"))
+    # proposed alloc of same job on n1
+    a = mock.alloc()
+    a.node_id = n1.id
+    a.job_id = job.id
+    a.task_group = "web"
+    plan.node_allocation.setdefault(n1.id, []).append(a)
+    stack = GenericStack(False, ctx)
+    stack.set_job(job)
+    stack.set_nodes([n1, n2])
+    option = stack.select(job.task_groups[0], SelectOptions())
+    assert option is not None
+    assert option.node.id == n2.id
+
+
+def test_spread_percent_zero_steers_away():
+    """Regression: percent-0 spread target must not crash; yields -inf score."""
+    ctx, state, _ = make_ctx()
+    n_dc1, n_dc2 = mock.node(), mock.node()
+    n_dc2.datacenter = "dc2"
+    n_dc2.compute_class()
+    state.upsert_node(1, n_dc1)
+    state.upsert_node(2, n_dc2)
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    job.spreads = [Spread("${node.datacenter}", 100,
+                          [SpreadTarget("dc1", 100), SpreadTarget("dc2", 0)])]
+    stack = GenericStack(False, ctx)
+    stack.set_job(job)
+    stack.set_nodes([n_dc1, n_dc2])
+    option = stack.select(job.task_groups[0], SelectOptions())
+    assert option is not None
+    assert option.node.id == n_dc1.id
+
+
+def test_affinity_all_zero_weights_noop():
+    """Regression: all-zero affinity weights must not crash select()."""
+    ctx, state, _ = make_ctx()
+    n = mock.node()
+    state.upsert_node(1, n)
+    job = mock.job()
+    job.affinities = [Affinity("${attr.rack}", "r1", "=", 0)]
+    stack = GenericStack(False, ctx)
+    stack.set_job(job)
+    stack.set_nodes([n])
+    assert stack.select(job.task_groups[0], SelectOptions()) is not None
