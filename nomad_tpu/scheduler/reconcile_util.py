@@ -1,0 +1,360 @@
+"""Reconciler helpers: alloc sets, name indexes, placement results.
+
+Semantics follow reference ``scheduler/reconcile_util.go``.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..structs.structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_EVICT,
+    ALLOC_DESIRED_STOP,
+    Allocation,
+    Deployment,
+    Job,
+    Node,
+    TaskGroup,
+)
+
+_NAME_INDEX_RE = re.compile(r"\[(\d+)\]$")
+
+
+def alloc_name(job: str, task_group: str, idx: int) -> str:
+    return f"{job}.{task_group}[{idx}]"
+
+
+def alloc_index(name: str) -> int:
+    m = _NAME_INDEX_RE.search(name)
+    return int(m.group(1)) if m else 0
+
+
+# ---------------------------------------------------------------------------
+# placement results
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AllocStopResult:
+    alloc: Allocation
+    client_status: str = ""
+    status_description: str = ""
+
+
+@dataclass
+class AllocPlaceResult:
+    """A new allocation to place."""
+
+    name: str = ""
+    canary: bool = False
+    task_group: Optional[TaskGroup] = None
+    previous_alloc: Optional[Allocation] = None
+    reschedule: bool = False
+
+    def get_task_group(self) -> TaskGroup:
+        return self.task_group
+
+    def get_name(self) -> str:
+        return self.name
+
+    def is_canary(self) -> bool:
+        return self.canary
+
+    def get_previous_allocation(self) -> Optional[Allocation]:
+        return self.previous_alloc
+
+    def is_rescheduling(self) -> bool:
+        return self.reschedule
+
+    def stop_previous_alloc(self) -> Tuple[bool, str]:
+        return False, ""
+
+
+@dataclass
+class AllocDestructiveResult:
+    """Stop the old alloc only once its replacement placed (atomic pair)."""
+
+    place_name: str = ""
+    place_task_group: Optional[TaskGroup] = None
+    stop_alloc: Optional[Allocation] = None
+    stop_status_description: str = ""
+
+    def get_task_group(self) -> TaskGroup:
+        return self.place_task_group
+
+    def get_name(self) -> str:
+        return self.place_name
+
+    def is_canary(self) -> bool:
+        return False
+
+    def get_previous_allocation(self) -> Optional[Allocation]:
+        return self.stop_alloc
+
+    def is_rescheduling(self) -> bool:
+        return False
+
+    def stop_previous_alloc(self) -> Tuple[bool, str]:
+        return True, self.stop_status_description
+
+
+@dataclass
+class DelayedRescheduleInfo:
+    alloc_id: str
+    alloc: Allocation
+    reschedule_time_ns: int
+
+
+# ---------------------------------------------------------------------------
+# alloc sets
+# ---------------------------------------------------------------------------
+
+
+class AllocSet(Dict[str, Allocation]):
+    """A set of allocations keyed by ID with reconcile helpers."""
+
+    @classmethod
+    def from_allocs(cls, allocs: Iterable[Allocation]) -> "AllocSet":
+        s = cls()
+        for a in allocs:
+            s[a.id] = a
+        return s
+
+    def name_set(self) -> Set[str]:
+        return {a.name for a in self.values()}
+
+    def name_order(self) -> List[Allocation]:
+        return sorted(self.values(), key=lambda a: alloc_index(a.name))
+
+    def difference(self, *others: "AllocSet") -> "AllocSet":
+        out = AllocSet()
+        for k, v in self.items():
+            if any(k in other for other in others):
+                continue
+            out[k] = v
+        return out
+
+    def union(self, *others: "AllocSet") -> "AllocSet":
+        out = AllocSet(self)
+        for other in others:
+            out.update(other)
+        return out
+
+    def from_keys(self, keys: Iterable[str]) -> "AllocSet":
+        out = AllocSet()
+        for k in keys:
+            if k in self:
+                out[k] = self[k]
+        return out
+
+    def filter_by_tainted(
+        self, nodes: Dict[str, Optional[Node]]
+    ) -> Tuple["AllocSet", "AllocSet", "AllocSet"]:
+        """(untainted, migrate, lost)."""
+        untainted, migrate, lost = AllocSet(), AllocSet(), AllocSet()
+        for alloc in self.values():
+            if alloc.terminal_status():
+                untainted[alloc.id] = alloc
+                continue
+            if alloc.desired_transition.should_migrate():
+                migrate[alloc.id] = alloc
+                continue
+            if alloc.node_id not in nodes:
+                untainted[alloc.id] = alloc
+                continue
+            n = nodes[alloc.node_id]
+            if n is None or n.terminal_status():
+                lost[alloc.id] = alloc
+                continue
+            untainted[alloc.id] = alloc
+        return untainted, migrate, lost
+
+    def filter_by_rescheduleable(
+        self,
+        is_batch: bool,
+        now_ns: int,
+        eval_id: str,
+        deployment: Optional[Deployment],
+    ) -> Tuple["AllocSet", "AllocSet", List[DelayedRescheduleInfo]]:
+        """(untainted, reschedule_now, reschedule_later)."""
+        untainted, reschedule_now = AllocSet(), AllocSet()
+        reschedule_later: List[DelayedRescheduleInfo] = []
+        for alloc in self.values():
+            if alloc.next_allocation != "":
+                continue
+            is_untainted, ignore = should_filter(alloc, is_batch)
+            if is_untainted:
+                untainted[alloc.id] = alloc
+            if is_untainted or ignore:
+                continue
+            eligible_now, eligible_later, reschedule_time = update_by_reschedulable(
+                alloc, now_ns, eval_id, deployment
+            )
+            if not eligible_now:
+                untainted[alloc.id] = alloc
+                if eligible_later:
+                    reschedule_later.append(
+                        DelayedRescheduleInfo(alloc.id, alloc, reschedule_time)
+                    )
+            else:
+                reschedule_now[alloc.id] = alloc
+        return untainted, reschedule_now, reschedule_later
+
+    def filter_by_deployment(self, deployment_id: str) -> Tuple["AllocSet", "AllocSet"]:
+        match, nonmatch = AllocSet(), AllocSet()
+        for alloc in self.values():
+            if alloc.deployment_id == deployment_id:
+                match[alloc.id] = alloc
+            else:
+                nonmatch[alloc.id] = alloc
+        return match, nonmatch
+
+
+def filter_by_terminal(allocs: AllocSet) -> AllocSet:
+    out = AllocSet()
+    for aid, alloc in allocs.items():
+        if not alloc.terminal_status():
+            out[aid] = alloc
+    return out
+
+
+def should_filter(alloc: Allocation, is_batch: bool) -> Tuple[bool, bool]:
+    """(untainted, ignore) — reference reconcile_util.go shouldFilter."""
+    if is_batch:
+        if alloc.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+            if alloc.ran_successfully():
+                return True, False
+            return False, True
+        if alloc.client_status != ALLOC_CLIENT_FAILED:
+            return True, False
+        return False, False
+
+    if alloc.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+        return False, True
+    if alloc.client_status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_LOST):
+        return False, True
+    return False, False
+
+
+RESCHEDULE_WINDOW_NS = 10**9  # 1s clock-drift guard
+
+
+def update_by_reschedulable(
+    alloc: Allocation, now_ns: int, eval_id: str, d: Optional[Deployment]
+) -> Tuple[bool, bool, int]:
+    """(reschedule_now, reschedule_later, reschedule_time_ns)."""
+    if (
+        d is not None
+        and alloc.deployment_id == d.id
+        and d.active()
+        and not (alloc.desired_transition.reschedule is True)
+    ):
+        return False, False, 0
+
+    reschedule_now = alloc.desired_transition.should_force_reschedule()
+
+    reschedule_time, eligible = alloc.next_reschedule_time()
+    if eligible and (
+        alloc.followup_eval_id == eval_id or reschedule_time - now_ns <= RESCHEDULE_WINDOW_NS
+    ):
+        return True, False, reschedule_time
+    if reschedule_now:
+        return True, False, reschedule_time
+    if eligible and alloc.followup_eval_id == "":
+        return False, True, reschedule_time
+    return False, False, reschedule_time
+
+
+# ---------------------------------------------------------------------------
+# name index
+# ---------------------------------------------------------------------------
+
+
+class AllocNameIndex:
+    """Chooses allocation names (indexes) for placement/removal using a set
+    of used indexes (reference uses a bitmap; a Python set is equivalent)."""
+
+    def __init__(self, job: str, task_group: str, count: int, in_set: AllocSet) -> None:
+        self.job = job
+        self.task_group = task_group
+        self.count = count
+        self.used: Set[int] = {alloc_index(a.name) for a in in_set.values()}
+
+    def highest(self, n: int) -> Set[str]:
+        """Remove and return the highest n used names."""
+        out: Set[str] = set()
+        for idx in sorted(self.used, reverse=True):
+            if len(out) >= n:
+                break
+            self.used.discard(idx)
+            out.add(alloc_name(self.job, self.task_group, idx))
+        return out
+
+    def set_allocs(self, allocs: AllocSet) -> None:
+        for a in allocs.values():
+            self.used.add(alloc_index(a.name))
+
+    def unset_index(self, idx: int) -> None:
+        self.used.discard(idx)
+
+    def next(self, n: int) -> List[str]:
+        out: List[str] = []
+        for idx in range(self.count):
+            if len(out) == n:
+                return out
+            if idx not in self.used:
+                out.append(alloc_name(self.job, self.task_group, idx))
+                self.used.add(idx)
+        i = 0
+        while len(out) < n:
+            out.append(alloc_name(self.job, self.task_group, i))
+            self.used.add(i)
+            i += 1
+        return out
+
+    def next_canaries(self, n: int, existing: AllocSet, destructive: AllocSet) -> List[str]:
+        next_names: List[str] = []
+        existing_names = existing.name_set()
+
+        # Prefer indexes undergoing destructive updates (they'll be replaced).
+        dused = {alloc_index(a.name) for a in destructive.values()}
+        for idx in sorted(dused):
+            if idx >= self.count:
+                continue
+            name = alloc_name(self.job, self.task_group, idx)
+            if name not in existing_names:
+                next_names.append(name)
+                self.used.add(idx)
+                if len(next_names) == n:
+                    return next_names
+
+        for idx in range(self.count):
+            if idx in self.used:
+                continue
+            name = alloc_name(self.job, self.task_group, idx)
+            if name not in existing_names:
+                next_names.append(name)
+                self.used.add(idx)
+                if len(next_names) == n:
+                    return next_names
+
+        # Exhausted: extend past count to avoid overlap.
+        i = self.count
+        while len(next_names) < n:
+            next_names.append(alloc_name(self.job, self.task_group, i))
+            i += 1
+        return next_names
+
+
+def new_alloc_matrix(job: Optional[Job], allocs: List[Allocation]) -> Dict[str, AllocSet]:
+    m: Dict[str, AllocSet] = {}
+    for a in allocs:
+        m.setdefault(a.task_group, AllocSet())[a.id] = a
+    if job is not None:
+        for tg in job.task_groups:
+            m.setdefault(tg.name, AllocSet())
+    return m

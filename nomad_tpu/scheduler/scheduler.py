@@ -1,0 +1,46 @@
+"""Scheduler interfaces and factory (reference ``scheduler/scheduler.go``).
+
+State and Planner are duck-typed protocols here. State is any object with the
+StateStore read surface (nodes, allocs_by_job, node_by_id, job_by_id,
+latest_deployment_by_job_id, scheduler_config, allocs_by_node_terminal).
+Planner must provide submit_plan / update_eval / create_eval / reblock_eval.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+SCHEDULER_VERSION = 1
+
+
+class Planner:
+    """Protocol for plan submission (reference scheduler.go:97)."""
+
+    def submit_plan(self, plan):  # -> (PlanResult, Optional[State])
+        raise NotImplementedError
+
+    def update_eval(self, evaluation) -> None:
+        raise NotImplementedError
+
+    def create_eval(self, evaluation) -> None:
+        raise NotImplementedError
+
+    def reblock_eval(self, evaluation) -> None:
+        raise NotImplementedError
+
+
+def builtin_schedulers() -> Dict[str, Callable]:
+    from .generic_sched import new_batch_scheduler, new_service_scheduler
+    from .system_sched import new_system_scheduler
+
+    return {
+        "service": new_service_scheduler,
+        "batch": new_batch_scheduler,
+        "system": new_system_scheduler,
+    }
+
+
+def new_scheduler(name: str, logger, state, planner):
+    factories = builtin_schedulers()
+    if name not in factories:
+        raise ValueError(f"unknown scheduler '{name}'")
+    return factories[name](logger, state, planner)

@@ -1,0 +1,1 @@
+"""TPU placement engine: dense tensor encodings + jit'd scoring."""

@@ -1,0 +1,321 @@
+"""End-to-end scheduler tests via the Harness (mirrors generic_sched_test.go
+and system_sched_test.go core cases)."""
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_LOST,
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP,
+    EVAL_STATUS_COMPLETE,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_UPDATE,
+    NODE_STATUS_DOWN,
+    Evaluation,
+)
+
+
+def setup_harness(num_nodes=10):
+    h = Harness()
+    nodes = []
+    for _ in range(num_nodes):
+        n = mock.node()
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return h, nodes
+
+
+def register_eval(job):
+    return Evaluation(
+        priority=job.priority,
+        type=job.type,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+        namespace=job.namespace,
+    )
+
+
+def test_service_register_places_all():
+    h, _ = setup_harness(10)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.state.upsert_evals(h.next_index(), [ev])
+
+    h.process("service", ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 10
+    # all placements have resources assigned
+    for a in placed:
+        assert a.allocated_resources.tasks["web"].cpu_shares == 500
+        assert a.job_id == job.id
+    # eval marked complete
+    h.assert_eval_status(EVAL_STATUS_COMPLETE)
+    # allocs live in state now
+    out = h.state.allocs_by_job(job.namespace, job.id, True)
+    assert len(out) == 10
+    # queued allocations drained
+    assert h.evals[0].queued_allocations.get("web") == 0
+
+
+def test_service_register_annotates_metrics():
+    h, _ = setup_harness(3)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("service", ev)
+    placed = [a for allocs in h.plans[0].node_allocation.values() for a in allocs]
+    assert placed[0].metrics.nodes_evaluated > 0
+    assert placed[0].metrics.score_meta  # top-K populated
+
+
+def test_service_no_nodes_creates_blocked_eval():
+    h = Harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("service", ev)
+    # blocked eval created for failed placements
+    assert len(h.create_evals) == 1
+    assert h.create_evals[0].status == "blocked"
+    assert h.evals[0].status == EVAL_STATUS_COMPLETE
+    assert h.evals[0].blocked_eval == h.create_evals[0].id
+    assert h.evals[0].failed_tg_allocs["web"] is not None
+
+
+def test_service_count_scale_down_stops():
+    h, nodes = setup_harness(10)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("service", ev)
+    assert len(h.state.allocs_by_job(job.namespace, job.id, True)) == 10
+
+    # scale down to 3
+    job2 = job.copy()
+    job2.task_groups[0].count = 3
+    h.state.upsert_job(h.next_index(), job2)
+    ev2 = register_eval(job2)
+    h.process("service", ev2)
+
+    plan = h.plans[-1]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    assert len(stopped) == 7
+    live = [
+        a
+        for a in h.state.allocs_by_job(job.namespace, job.id, True)
+        if a.desired_status == ALLOC_DESIRED_RUN
+    ]
+    assert len(live) == 3
+    # the highest-indexed names are the ones stopped
+    live_names = sorted(a.name for a in live)
+    assert live_names == [f"{job.id}.web[{i}]" for i in range(3)]
+
+
+def test_service_job_deregister_stops_all():
+    h, _ = setup_harness(5)
+    job = mock.job()
+    job.task_groups[0].count = 5
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("service", ev)
+
+    job2 = job.copy()
+    job2.stop = True
+    h.state.upsert_job(h.next_index(), job2)
+    ev2 = register_eval(job2)
+    h.process("service", ev2)
+
+    plan = h.plans[-1]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    assert len(stopped) == 5
+
+
+def test_service_node_down_replaces_allocs():
+    h, nodes = setup_harness(3)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    # disable rescheduling to exercise the lost-replacement path directly
+    job.task_groups[0].reschedule_policy = None
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("service", ev)
+    allocs = h.state.allocs_by_job(job.namespace, job.id, True)
+    assert len(allocs) == 2
+
+    # take down the node of the first alloc; mark allocs running first
+    for a in allocs:
+        ca = a.copy_skip_job()
+        ca.client_status = ALLOC_CLIENT_RUNNING
+        h.state.update_allocs_from_client(h.next_index(), [ca])
+    down_node = allocs[0].node_id
+    h.state.update_node_status(h.next_index(), down_node, NODE_STATUS_DOWN)
+
+    ev2 = Evaluation(
+        priority=job.priority,
+        type=job.type,
+        triggered_by=EVAL_TRIGGER_NODE_UPDATE,
+        job_id=job.id,
+        node_id=down_node,
+        namespace=job.namespace,
+    )
+    h.process("service", ev2)
+
+    plan = h.plans[-1]
+    # lost alloc marked stopped+lost, replacement placed elsewhere
+    stopped = [a for allocs_ in plan.node_update.values() for a in allocs_]
+    assert any(a.client_status == ALLOC_CLIENT_LOST for a in stopped)
+    placed = [a for allocs_ in plan.node_allocation.values() for a in allocs_]
+    assert len(placed) == 1
+    assert placed[0].node_id != down_node
+
+
+def test_service_destructive_update():
+    h, _ = setup_harness(4)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("service", ev)
+
+    # change the task config -> destructive update
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+    h.state.upsert_job(h.next_index(), job2)
+    ev2 = register_eval(job2)
+    h.process("service", ev2)
+
+    plan = h.plans[-1]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(stopped) == 4
+    assert len(placed) == 4
+
+
+def test_service_inplace_update():
+    h, _ = setup_harness(4)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("service", ev)
+
+    # bump job without changing tasks -> in-place update
+    job2 = job.copy()
+    h.state.upsert_job(h.next_index(), job2)
+    ev2 = register_eval(job2)
+    h.process("service", ev2)
+
+    plan = h.plans[-1]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(stopped) == 0
+    assert len(placed) == 4  # in-place updates appended as allocations
+    # same alloc ids preserved (in-place)
+    prev_ids = {a.id for a in h.state.allocs_by_job(job.namespace, job.id, True)}
+    assert {a.id for a in placed} <= prev_ids
+
+
+def test_batch_ignores_complete_allocs():
+    h, _ = setup_harness(2)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("batch", ev)
+    allocs = h.state.allocs_by_job(job.namespace, job.id, True)
+    assert len(allocs) == 1
+
+    # complete successfully on client
+    from nomad_tpu.structs.structs import TaskState
+
+    ca = allocs[0].copy_skip_job()
+    ca.client_status = "complete"
+    ca.task_states = {"worker": TaskState(state="dead", failed=False)}
+    h.state.update_allocs_from_client(h.next_index(), [ca])
+
+    ev2 = register_eval(job)
+    h.process("batch", ev2)
+    # no new placements: batch job already ran successfully
+    assert len(h.plans) == 1 or h.plans[-1].is_noop()
+
+
+def test_system_places_one_per_node():
+    h, nodes = setup_harness(5)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = Evaluation(
+        priority=job.priority,
+        type=job.type,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+        namespace=job.namespace,
+    )
+    h.process("system", ev)
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 5
+    assert {a.node_id for a in placed} == {n.id for n in nodes}
+    h.assert_eval_status(EVAL_STATUS_COMPLETE)
+
+
+def test_system_skips_infeasible_nodes():
+    h, nodes = setup_harness(3)
+    bad = mock.node()
+    bad.attributes["kernel.name"] = "windows"
+    bad.compute_class()
+    h.state.upsert_node(h.next_index(), bad)
+    job = mock.system_job()  # constrained to linux
+    h.state.upsert_job(h.next_index(), job)
+    ev = Evaluation(
+        priority=job.priority, type=job.type,
+        triggered_by=EVAL_TRIGGER_JOB_REGISTER, job_id=job.id, namespace=job.namespace,
+    )
+    h.process("system", ev)
+    placed = [a for allocs in h.plans[0].node_allocation.values() for a in allocs]
+    assert len(placed) == 3
+    assert bad.id not in {a.node_id for a in placed}
+
+
+def test_failed_alloc_reschedule_now():
+    import time
+
+    h, nodes = setup_harness(3)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    rp = job.task_groups[0].reschedule_policy
+    rp.delay_ns = 0
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process("service", ev)
+    allocs = h.state.allocs_by_job(job.namespace, job.id, True)
+    assert len(allocs) == 1
+    failed_node = allocs[0].node_id
+
+    from nomad_tpu.structs.structs import TaskState
+
+    ca = allocs[0].copy_skip_job()
+    ca.client_status = ALLOC_CLIENT_FAILED
+    ca.task_states = {
+        "web": TaskState(state="dead", failed=True, finished_at_ns=time.time_ns())
+    }
+    ca.modify_time_ns = time.time_ns()
+    h.state.update_allocs_from_client(h.next_index(), [ca])
+
+    ev2 = Evaluation(
+        priority=job.priority, type=job.type,
+        triggered_by="alloc-failure", job_id=job.id, namespace=job.namespace,
+    )
+    h.process("service", ev2)
+    plan = h.plans[-1]
+    placed = [a for allocs_ in plan.node_allocation.values() for a in allocs_]
+    assert len(placed) == 1
+    # rescheduled alloc chains to previous and avoids the failed node
+    assert placed[0].previous_allocation == allocs[0].id
+    assert placed[0].reschedule_tracker is not None
+    assert placed[0].node_id != failed_node
