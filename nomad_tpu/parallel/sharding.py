@@ -1,0 +1,112 @@
+"""Mesh construction and sharding specs for the placement scan.
+
+The scale axes of this domain map onto a 2-D ``jax.sharding.Mesh``:
+
+  "evals" — data parallelism over independent evaluations (each eval's scan
+            is independent; the broker dequeues many at once). The analog of
+            DP in an ML workload.
+  "nodes" — model/sequence parallelism over the cluster's node axis: every
+            [N]-shaped array (capacity, masks, scores) is sharded across
+            chips, and XLA inserts the all-gather/all-reduce/argmax
+            collectives the ring-ordered selection needs. The analog of
+            TP/SP: the "long context" here is the 5K-node (and beyond)
+            cluster state.
+
+We use GSPMD via jit + NamedSharding rather than hand-written shard_map:
+the scan body is dominated by elementwise ops, cumsums and reductions over
+the node axis, all of which XLA partitions well.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+def make_mesh(n_devices: Optional[int] = None, eval_parallel: int = 1):
+    """Build a ("evals", "nodes") mesh over the available devices."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    ep = max(1, min(eval_parallel, n))
+    while n % ep != 0:
+        ep -= 1
+    grid = np.asarray(devices).reshape(ep, n // ep)
+    return Mesh(grid, ("evals", "nodes"))
+
+
+def scan_input_shardings(mesh, batched: bool):
+    """(static, carry, xs) PartitionSpecs for the placement scan.
+
+    ``batched`` adds a leading eval axis (sharded over "evals") to carry/xs.
+    Node-dim arrays shard over "nodes"; small per-TG tables replicate.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    b = ("evals",) if batched else ()
+
+    static = (
+        ns("nodes", None),        # totals [N, D]
+        ns("nodes", None),        # reserved [N, D]
+        ns(None, None),           # asks [G, D]
+        ns(None, "nodes"),        # feas [G, N]
+        ns(None, "nodes"),        # aff_score [G, N]
+        ns(None, "nodes"),        # aff_present [G, N]
+        ns(None),                 # desired_counts [G]
+        ns(None),                 # dh_job [G]
+        ns(None),                 # dh_tg [G]
+        ns(None),                 # limits [G]
+        ns(None, None, "nodes"),  # spread_vids [G, S, N]
+        ns(None, None, None),     # spread_desired [G, S, V]
+        ns(None, None),           # spread_weights [G, S]
+        ns(None, None),           # spread_has_targets [G, S]
+        ns(None, None),           # spread_active [G, S]
+        ns(None),                 # sum_spread_weights [G]
+        ns(),                     # n_real scalar
+    )
+    carry = (
+        ns(*b, "nodes", None),    # used [N, D]
+        ns(*b, None, "nodes"),    # tg_counts [G, N]
+        ns(*b, "nodes"),          # job_counts [N]
+        ns(*b, None, None, None),  # spread_counts [G, S, V]
+        ns(*b, None, None, None),  # spread_entry [G, S, V]
+        ns(*b),                   # offset
+        ns(*b, None),             # failed [G]
+    )
+    xs = (
+        ns(*b, None),             # tg_idx [P]
+        ns(*b, None, None),       # penalty_idx [P, K]
+        ns(*b, None),             # evict_node [P]
+        ns(*b, None, None),       # evict_res [P, D]
+        ns(*b, None),             # evict_tg [P]
+        ns(*b, None),             # limit_p [P]
+        ns(*b, None),             # sum_sw_p [P]
+    )
+    return static, carry, xs
+
+
+def batched_place_scan(mesh, n_pad: int):
+    """A jit'd, mesh-sharded, eval-batched placement scan.
+
+    vmaps the single-eval scan over a leading batch axis (independent evals)
+    and shards: batch over "evals", node axis over "nodes". Static (node
+    table / TG spec) arrays are shared by all evals in the batch.
+    """
+    import jax
+
+    from ..tpu.engine import _build_place_scan
+
+    place_scan = _build_place_scan()
+
+    static_s, carry_s, xs_s = scan_input_shardings(mesh, batched=True)
+
+    def run(static, carry_b, xs_b):
+        return jax.vmap(lambda c, x: place_scan(n_pad, static, c, x))(carry_b, xs_b)
+
+    return jax.jit(run, in_shardings=(static_s, carry_s, xs_s))

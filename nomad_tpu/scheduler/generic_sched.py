@@ -292,6 +292,19 @@ class GenericScheduler:
             deployment_id = self.deployment.id
 
         self.stack.set_nodes(nodes)
+        self._nodes_by_dc = by_dc
+
+        # tpu_binpack: batch the whole placement list through one device scan.
+        _, sched_config = self.state.scheduler_config()
+        if (
+            sched_config is not None
+            and sched_config.scheduler_algorithm == SCHED_ALG_TPU_BINPACK
+        ):
+            from ..tpu.integration import compute_placements_with_engine
+
+            if compute_placements_with_engine(self, destructive, place) is True:
+                return
+
         now = _time.time_ns()
 
         # Destructive before place: their resources must be discounted first.
@@ -362,15 +375,7 @@ class GenericScheduler:
                         self.plan.pop_update(prev_allocation)
 
     def select_next_option(self, tg, select_options: SelectOptions):
-        """Placement backend dispatch. ``tpu_binpack`` still resolves per-eval
-        sequencing through the engine; subclass/monkeypatch point for tests."""
-        _, sched_config = self.state.scheduler_config()
-        if sched_config is not None and sched_config.scheduler_algorithm == SCHED_ALG_TPU_BINPACK:
-            from ..tpu.integration import select_with_tpu_engine
-
-            option = select_with_tpu_engine(self, tg, select_options)
-            if option is not NotImplemented:
-                return option
+        """Host placement backend (subclass/monkeypatch point for tests)."""
         return self.stack.select(tg, select_options)
 
     def _handle_preemptions(self, option, alloc: Allocation, missing) -> None:

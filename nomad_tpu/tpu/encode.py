@@ -1,0 +1,360 @@
+"""Dense tensor encodings for the TPU placement engine.
+
+This layer has no reference analog: it converts the host object graph
+(nodes, task groups, plan state) into the arrays consumed by
+``nomad_tpu.tpu.engine``. Feasibility is computed host-side *per computed
+node class* (same memoization the reference uses in scheduler/context.go:191)
+and gathered per node into mask vectors; string-world constraints therefore
+never run on device.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..structs.structs import (
+    CONSTRAINT_DISTINCT_HOSTS,
+    CONSTRAINT_DISTINCT_PROPERTY,
+    Job,
+    Node,
+    TaskGroup,
+)
+
+# Capacity dimensions tracked on device.
+DIM_CPU, DIM_MEM, DIM_DISK, DIM_MBITS = 0, 1, 2, 3
+NUM_DIMS = 4
+
+# Max penalty nodes encoded per placement (failed node + reschedule history).
+MAX_PENALTY_NODES = 6
+
+
+@dataclass
+class NodeTable:
+    """Per-node dense state for one evaluation."""
+
+    nodes: List[Node]
+    node_index: Dict[str, int]
+    # [N, D] totals and reserved
+    totals: np.ndarray
+    reserved: np.ndarray
+    # [N, D] used by proposed allocs at eval start
+    used: np.ndarray
+    # per-node count of proposed allocs of this job / per TG
+    job_counts: np.ndarray  # [N]
+    tg_counts: np.ndarray  # [G, N]
+
+
+@dataclass
+class TGSpec:
+    """Per-task-group dense spec."""
+
+    index: int
+    name: str
+    ask: np.ndarray  # [D]
+    feasible: np.ndarray  # [N] bool
+    affinity_score: np.ndarray  # [N] float32
+    affinity_present: np.ndarray  # [N] bool
+    desired_count: int
+    distinct_hosts_job: bool
+    distinct_hosts_tg: bool
+    limit: int
+    # spread: [S, N] value ids, [S, V] desired counts, [S] weights, [S, V] initial counts
+    spread_vids: np.ndarray
+    spread_desired: np.ndarray
+    spread_weights: np.ndarray
+    spread_counts0: np.ndarray
+    spread_has_targets: np.ndarray  # [S] bool — targeted vs even-spread scoring
+    sum_spread_weights: float
+    widens: bool = False  # affinity/spread stanzas -> MaxInt32 limit
+
+
+class UnsupportedByEngine(Exception):
+    """Raised when a job uses features the device engine doesn't accelerate;
+    the caller falls back to the (semantically complete) host path."""
+
+
+def _net_ask(tg: TaskGroup) -> Tuple[int, bool]:
+    """Total mbits asked (group + tasks); flags reserved-port asks."""
+    mbits = 0
+    has_reserved_ports = False
+    for net in tg.networks:
+        mbits += net.mbits
+        if net.reserved_ports:
+            has_reserved_ports = True
+    for task in tg.tasks:
+        for net in task.resources.networks:
+            mbits += net.mbits
+            if net.reserved_ports:
+                has_reserved_ports = True
+    return mbits, has_reserved_ports
+
+
+def check_supported(job: Job, tg: TaskGroup) -> None:
+    """Gate on features the round-1 engine doesn't model on device."""
+    for task in tg.tasks:
+        if task.resources.devices:
+            raise UnsupportedByEngine("device asks")
+    _, has_reserved_ports = _net_ask(tg)
+    if has_reserved_ports:
+        raise UnsupportedByEngine("reserved port asks")
+    for c in list(job.constraints) + list(tg.constraints):
+        if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
+            raise UnsupportedByEngine("distinct_property")
+
+
+def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
+    """Encode nodes + proposed allocs into dense arrays."""
+    n = len(nodes)
+    g = len(job.task_groups)
+    node_index = {node.id: i for i, node in enumerate(nodes)}
+    tg_index = {tg.name: gi for gi, tg in enumerate(job.task_groups)}
+
+    totals = np.zeros((n, NUM_DIMS), dtype=np.float64)
+    reserved = np.zeros((n, NUM_DIMS), dtype=np.float64)
+    used = np.zeros((n, NUM_DIMS), dtype=np.float64)
+    job_counts = np.zeros(n, dtype=np.int32)
+    tg_counts = np.zeros((g, n), dtype=np.int32)
+
+    for i, node in enumerate(nodes):
+        nr = node.node_resources
+        totals[i, DIM_CPU] = nr.cpu_shares
+        totals[i, DIM_MEM] = nr.memory_mb
+        totals[i, DIM_DISK] = nr.disk_mb
+        totals[i, DIM_MBITS] = sum(net.mbits for net in nr.networks)
+        rr = node.reserved_resources
+        if rr is not None:
+            reserved[i, DIM_CPU] = rr.cpu_shares
+            reserved[i, DIM_MEM] = rr.memory_mb
+            reserved[i, DIM_DISK] = rr.disk_mb
+
+        for alloc in ctx.proposed_allocs(node.id):
+            if alloc.terminal_status():
+                continue
+            cr = alloc.comparable_resources()
+            used[i, DIM_CPU] += cr.flattened.cpu_shares
+            used[i, DIM_MEM] += cr.flattened.memory_mb
+            used[i, DIM_DISK] += cr.shared.disk_mb
+            if alloc.allocated_resources is not None:
+                for net in alloc.allocated_resources.shared.networks:
+                    used[i, DIM_MBITS] += net.mbits
+                for tr in alloc.allocated_resources.tasks.values():
+                    for net in tr.networks:
+                        used[i, DIM_MBITS] += net.mbits
+            if alloc.job_id == job.id:
+                job_counts[i] += 1
+                gi = tg_index.get(alloc.task_group)
+                if gi is not None:
+                    tg_counts[gi, i] += 1
+
+    return NodeTable(
+        nodes=nodes,
+        node_index=node_index,
+        totals=totals,
+        reserved=reserved,
+        used=used,
+        job_counts=job_counts,
+        tg_counts=tg_counts,
+    )
+
+
+def _class_feasibility(ctx, job: Job, tg: TaskGroup, nodes: List[Node]) -> np.ndarray:
+    """Per-node feasibility mask, memoized per computed class for non-escaped
+    constraints (mirrors FeasibilityWrapper semantics, feasible.go:778)."""
+    from ..scheduler.feasible import ConstraintChecker, DeviceChecker, DriverChecker, HostVolumeChecker
+    from ..scheduler.util import task_group_constraints
+    from ..structs.node_class import escaped_constraints
+
+    job_checker = ConstraintChecker(ctx, job.constraints)
+    tg_constr = task_group_constraints(tg)
+    drivers = DriverChecker(ctx, tg_constr.drivers)
+    constraints = ConstraintChecker(ctx, tg_constr.constraints)
+    volumes = HostVolumeChecker(ctx)
+    volumes.set_volumes(tg.volumes)
+    devices = DeviceChecker(ctx)
+    devices.set_task_group(tg)
+
+    escaped = bool(
+        escaped_constraints(list(job.constraints))
+        or escaped_constraints(tg_constr.constraints)
+    )
+
+    mask = np.zeros(len(nodes), dtype=bool)
+    class_cache: Dict[str, bool] = {}
+    for i, node in enumerate(nodes):
+        cls = node.computed_class
+        if not escaped and cls in class_cache:
+            mask[i] = class_cache[cls]
+            continue
+        ok = (
+            job_checker.feasible(node)
+            and drivers.feasible(node)
+            and constraints.feasible(node)
+            and volumes.feasible(node)
+            and devices.feasible(node)
+        )
+        mask[i] = ok
+        if not escaped:
+            class_cache[cls] = ok
+    return mask
+
+
+def _affinity_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node]) -> Tuple[np.ndarray, np.ndarray]:
+    from ..scheduler.feasible import matches_affinity
+
+    affinities = list(job.affinities) + list(tg.affinities)
+    for task in tg.tasks:
+        affinities.extend(task.affinities)
+
+    n = len(nodes)
+    score = np.zeros(n, dtype=np.float64)
+    present = np.zeros(n, dtype=bool)
+    if not affinities:
+        return score, present
+
+    sum_weight = sum(abs(float(a.weight)) for a in affinities)
+    for i, node in enumerate(nodes):
+        total = 0.0
+        for aff in affinities:
+            if matches_affinity(ctx, aff, node):
+                total += float(aff.weight)
+        if total != 0.0 and sum_weight != 0.0:
+            score[i] = total / sum_weight
+            present[i] = True
+    return score, present
+
+
+def _spread_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node]):
+    """Encode spreads: value-id per node per spread, desired counts, and the
+    existing+proposed usage counts (from the propertyset at eval start)."""
+    from ..scheduler.propertyset import PropertySet, get_property
+
+    spreads = list(tg.spreads) + list(job.spreads)
+    s = len(spreads)
+    n = len(nodes)
+    if s == 0:
+        return (
+            np.zeros((0, n), dtype=np.int32),
+            np.zeros((0, 1), dtype=np.float64),
+            np.zeros((0,), dtype=np.float64),
+            np.zeros((0, 1), dtype=np.float64),
+            np.zeros((0,), dtype=bool),
+            0.0,
+        )
+
+    # Build vocab per spread: values seen on nodes + declared targets.
+    vids = np.zeros((s, n), dtype=np.int32)
+    vocab_sizes = []
+    vocabs: List[Dict[str, int]] = []
+    node_values: List[List[Optional[str]]] = []
+    for si, spread in enumerate(spreads):
+        vocab: Dict[str, int] = {}
+        vals: List[Optional[str]] = []
+        for st in spread.spread_target:
+            vocab.setdefault(st.value, len(vocab))
+        for node in nodes:
+            val, ok = get_property(node, spread.attribute)
+            if not ok:
+                vals.append(None)
+                continue
+            vocab.setdefault(val, len(vocab))
+            vals.append(val)
+        vocabs.append(vocab)
+        node_values.append(vals)
+        vocab_sizes.append(max(len(vocab), 1))
+    v = max(vocab_sizes)
+
+    desired = np.full((s, v + 1), -1.0, dtype=np.float64)  # -1 = no target
+    weights = np.zeros(s, dtype=np.float64)
+    counts0 = np.zeros((s, v + 1), dtype=np.float64)
+    has_targets = np.zeros(s, dtype=bool)
+
+    total_count = tg.count
+    sum_weights = 0.0
+    for si, spread in enumerate(spreads):
+        weights[si] = spread.weight
+        sum_weights += spread.weight
+        vocab = vocabs[si]
+        # node value ids (missing property -> v, the "invalid" bucket)
+        for i in range(n):
+            val = node_values[si][i]
+            vids[si, i] = vocab[val] if val is not None else v
+        sum_desired = 0.0
+        for st in spread.spread_target:
+            d = (float(st.percent) / 100.0) * float(total_count)
+            desired[si, vocab[st.value]] = d
+            sum_desired += d
+            has_targets[si] = True
+        # implicit remainder bucket
+        if 0 < sum_desired < float(total_count):
+            remainder = float(total_count) - sum_desired
+            for val, vid in vocab.items():
+                if desired[si, vid] < 0:
+                    desired[si, vid] = remainder
+        # existing + proposed usage counts via the propertyset
+        pset = PropertySet(ctx, job)
+        pset.set_target_attribute(spread.attribute, tg.name)
+        for val, count in pset.get_combined_use_map().items():
+            if val in vocab:
+                counts0[si, vocab[val]] = count
+
+    return vids, desired, weights, counts0, has_targets, sum_weights
+
+
+def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool) -> TGSpec:
+    import math
+
+    check_supported(job, tg)
+
+    ask = np.zeros(NUM_DIMS, dtype=np.float64)
+    for task in tg.tasks:
+        ask[DIM_CPU] += task.resources.cpu
+        ask[DIM_MEM] += task.resources.memory_mb
+    ask[DIM_DISK] = tg.ephemeral_disk.size_mb
+    ask[DIM_MBITS], _ = _net_ask(tg)
+
+    feasible = _class_feasibility(ctx, job, tg, nodes)
+    affinity_score, affinity_present = _affinity_arrays(ctx, job, tg, nodes)
+    vids, desired, weights, counts0, has_targets, sum_weights = _spread_arrays(
+        ctx, job, tg, nodes
+    )
+
+    # Base candidate limit (reference stack.go:74-86). The MaxInt32 widening
+    # when affinity/spread stanzas exist is sticky across selects within one
+    # set_nodes scope — resolved per placement by the engine driver.
+    n = len(nodes)
+    limit = 2
+    if not batch and n > 0:
+        limit = max(limit, int(math.ceil(math.log2(n))) if n > 1 else 2)
+
+    has_affinity_stanzas = bool(
+        list(job.affinities) or list(tg.affinities)
+        or any(task.affinities for task in tg.tasks)
+    )
+    widens = has_affinity_stanzas or bool(list(tg.spreads) + list(job.spreads))
+
+    gi = next(i for i, g in enumerate(job.task_groups) if g.name == tg.name)
+
+    dh_job = any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in job.constraints)
+    dh_tg = any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints)
+
+    return TGSpec(
+        index=gi,
+        name=tg.name,
+        ask=ask,
+        feasible=feasible,
+        affinity_score=affinity_score,
+        affinity_present=affinity_present,
+        desired_count=tg.count,
+        distinct_hosts_job=dh_job,
+        distinct_hosts_tg=dh_tg,
+        limit=limit,
+        widens=widens,
+        spread_vids=vids,
+        spread_desired=desired,
+        spread_weights=weights,
+        spread_counts0=counts0,
+        spread_has_targets=has_targets,
+        sum_spread_weights=sum_weights,
+    )

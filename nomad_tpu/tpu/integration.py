@@ -1,16 +1,16 @@
 """Bridge between GenericScheduler and the JAX placement engine.
 
-``select_with_tpu_engine`` may return NotImplemented to fall back to the host
-iterator stack (e.g. when the task group uses features the device engine
-doesn't accelerate yet — the host path is always semantically complete).
+``compute_placements_with_engine`` returns True when the engine handled the
+eval's whole placement batch, or NotImplemented to fall back to the host
+iterator stack (the host path is always semantically complete).
 """
 from __future__ import annotations
 
 
-def select_with_tpu_engine(sched, tg, select_options):
+def compute_placements_with_engine(sched, destructive, place):
     try:
         from .engine import TpuPlacementEngine
     except ImportError:
         return NotImplemented
     engine = TpuPlacementEngine.shared()
-    return engine.select(sched, tg, select_options)
+    return engine.compute_placements(sched, destructive, place)

@@ -7,9 +7,21 @@ is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): parity tests require the CPU backend's exact
+# IEEE float64 — TPU emulated f64 (double-double) rounds differently and can
+# flip exact-tie orderings by <=2 ULP. Benchmarks run on the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A sitecustomize may re-register the hardware TPU plugin regardless of the
+# env var; override at the config level too (must happen pre-backend-init).
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # host-only install: TPU tests will fall back/skip
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
