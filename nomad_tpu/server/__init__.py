@@ -1,0 +1,8 @@
+"""Server runtime (reference nomad/): raft/FSM, broker, planner, workers."""
+from .eval_broker import EvalBroker  # noqa: F401
+from .blocked_evals import BlockedEvals  # noqa: F401
+from .fsm import NomadFSM  # noqa: F401
+from .plan_apply import Planner, PlanQueue  # noqa: F401
+from .raft import InProcRaft  # noqa: F401
+from .server import Server, ServerConfig  # noqa: F401
+from .worker import Worker  # noqa: F401

@@ -1,0 +1,204 @@
+"""Blocked-evaluations tracker: unblocks on capacity changes.
+
+Semantics follow reference ``nomad/blocked_evals.go`` — evals that failed
+placement wait keyed by computed node class (captured vs escaped), and are
+re-enqueued when new capacity (node updates, alloc stops) appears. The
+system-scheduler variant tracks per-node blocks (blocked_evals_system.go).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..structs.structs import EVAL_STATUS_PENDING, EVAL_TRIGGER_MAX_PLANS, Evaluation
+
+UNBLOCK_FAILED_INTERVAL = 60.0  # periodic retry of max-plan-failed evals
+
+
+class BlockedEvals:
+    def __init__(self, eval_broker) -> None:
+        self.eval_broker = eval_broker
+        self._lock = threading.RLock()
+        self.enabled = False
+
+        # eval id -> (eval, token) wrapper
+        self.captured: Dict[str, Evaluation] = {}
+        # evals whose constraints escaped computed classes: unblock on any change
+        self.escaped: Dict[str, Evaluation] = {}
+        # (namespace, job id) -> eval id, to dedup per job
+        self.job_blocks: Dict[Tuple[str, str], str] = {}
+        # node id -> eval ids (system scheduler per-node blocks)
+        self.system_blocks: Dict[str, Set[str]] = {}
+        # class -> eval ids interested
+        self.capacity_classes: Dict[str, Set[str]] = {}
+        # evals blocked due to max plan attempts, retried periodically
+        self.failed: Dict[str, Evaluation] = {}
+        # classes seen while disabled/after block, to catch racing capacity
+        self.unblock_indexes: Dict[str, int] = {}
+        self.stats_blocked = 0
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            prev = self.enabled
+            self.enabled = enabled
+        if prev and not enabled:
+            self.flush()
+
+    # ------------------------------------------------------------------
+
+    def block(self, evaluation: Evaluation) -> None:
+        with self._lock:
+            if not self.enabled:
+                return
+            if evaluation.id in self.captured or evaluation.id in self.escaped:
+                return
+
+            # Missed-unblock check (reference blocked_evals.go:202): if
+            # relevant capacity appeared after the eval's snapshot, don't
+            # block — re-enqueue right away.
+            if self._missed_unblock(evaluation):
+                new_eval = evaluation.copy()
+                new_eval.status = EVAL_STATUS_PENDING
+                self.eval_broker.enqueue(new_eval)
+                return
+
+            # Dedup by job: keep the latest eval per job.
+            namespaced = (evaluation.namespace, evaluation.job_id)
+            existing_id = self.job_blocks.get(namespaced)
+            if existing_id is not None:
+                existing = self.captured.get(existing_id) or self.escaped.get(existing_id)
+                if existing is not None and existing.create_index >= evaluation.create_index:
+                    return
+                self._remove(existing_id)
+            self.job_blocks[namespaced] = evaluation.id
+
+            if evaluation.triggered_by == EVAL_TRIGGER_MAX_PLANS:
+                self.failed[evaluation.id] = evaluation
+                return
+
+            if evaluation.node_id:
+                self.system_blocks.setdefault(evaluation.node_id, set()).add(evaluation.id)
+                self.captured[evaluation.id] = evaluation
+                return
+
+            if evaluation.escaped_computed_class:
+                self.escaped[evaluation.id] = evaluation
+                return
+
+            self.captured[evaluation.id] = evaluation
+            # Index interest: eligible classes and unseen classes both unblock.
+            for cls, eligible in (evaluation.class_eligibility or {}).items():
+                if eligible:
+                    self.capacity_classes.setdefault(cls, set()).add(evaluation.id)
+
+    def _missed_unblock(self, evaluation: Evaluation) -> bool:
+        if evaluation.triggered_by == EVAL_TRIGGER_MAX_PLANS:
+            return False
+        snapshot = evaluation.snapshot_index
+        elig = evaluation.class_eligibility or {}
+        for cls, index in self.unblock_indexes.items():
+            if index <= snapshot:
+                continue
+            if evaluation.escaped_computed_class:
+                return True
+            # capacity in an eligible class, or a class the eval never saw
+            if elig.get(cls, None) is not False:
+                return True
+        return False
+
+    def _remove(self, eval_id: str) -> None:
+        ev = self.captured.pop(eval_id, None) or self.escaped.pop(eval_id, None) \
+            or self.failed.pop(eval_id, None)
+        if ev is not None:
+            self.job_blocks.pop((ev.namespace, ev.job_id), None)
+        for ids in self.capacity_classes.values():
+            ids.discard(eval_id)
+        for ids in self.system_blocks.values():
+            ids.discard(eval_id)
+
+    def untrack(self, namespace: str, job_id: str) -> None:
+        """Stop tracking blocked evals for a job (e.g. on deregister)."""
+        with self._lock:
+            eval_id = self.job_blocks.get((namespace, job_id))
+            if eval_id:
+                self._remove(eval_id)
+
+    # ------------------------------------------------------------------
+
+    def unblock(self, computed_class: str, index: int) -> None:
+        """New capacity in a computed class: re-enqueue interested evals."""
+        with self._lock:
+            if not self.enabled:
+                return
+            self.unblock_indexes[computed_class] = index
+            unblock: List[Evaluation] = []
+            # escaped evals unblock on any change
+            unblock.extend(self.escaped.values())
+            self.escaped.clear()
+            # captured evals: eligible for this class, or class unseen
+            seen_ids = self.capacity_classes.pop(computed_class, set())
+            for eval_id in list(self.captured):
+                ev = self.captured[eval_id]
+                elig = ev.class_eligibility or {}
+                if eval_id in seen_ids or computed_class not in elig:
+                    unblock.append(ev)
+                    del self.captured[eval_id]
+            self._enqueue(unblock, index)
+
+    def unblock_node(self, node_id: str, index: int) -> None:
+        with self._lock:
+            if not self.enabled:
+                return
+            ids = self.system_blocks.pop(node_id, set())
+            unblock = [self.captured.pop(i) for i in ids if i in self.captured]
+            self._enqueue(unblock, index)
+
+    def unblock_failed(self) -> None:
+        """Periodic retry of plan-conflict (max-plans) blocked evals."""
+        with self._lock:
+            if not self.enabled:
+                return
+            unblock = list(self.failed.values())
+            self.failed.clear()
+            self._enqueue(unblock, 0)
+
+    def unblock_quota(self, quota: str, index: int) -> None:
+        with self._lock:
+            if not self.enabled:
+                return
+            unblock = []
+            for eval_id in list(self.captured):
+                ev = self.captured[eval_id]
+                if ev.quota_limit_reached == quota:
+                    unblock.append(ev)
+                    del self.captured[eval_id]
+            self._enqueue(unblock, index)
+
+    def _enqueue(self, evals: List[Evaluation], index: int) -> None:
+        for ev in evals:
+            self.job_blocks.pop((ev.namespace, ev.job_id), None)
+            new_eval = ev.copy()
+            new_eval.status = EVAL_STATUS_PENDING
+            new_eval.snapshot_index = index
+            self.eval_broker.enqueue(new_eval)
+
+    # ------------------------------------------------------------------
+
+    def flush(self) -> None:
+        with self._lock:
+            self.captured.clear()
+            self.escaped.clear()
+            self.job_blocks.clear()
+            self.system_blocks.clear()
+            self.capacity_classes.clear()
+            self.failed.clear()
+            self.unblock_indexes.clear()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "total_blocked": len(self.captured) + len(self.escaped),
+                "total_escaped": len(self.escaped),
+                "total_failed": len(self.failed),
+            }

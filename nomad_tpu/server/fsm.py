@@ -1,0 +1,274 @@
+"""Finite state machine: applies replicated log entries to the StateStore.
+
+Fills the role of reference ``nomad/fsm.go`` — one dispatch point so every
+server materializes identical state from the same log. Log entries are
+(type, payload) tuples of plain Python objects (the in-proc log passes them
+by reference; a wire codec slots in at the raft boundary).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from ..state import StateStore
+from ..structs.structs import (
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_PENDING,
+    Allocation,
+    Deployment,
+    Evaluation,
+    Job,
+    Node,
+    SchedulerConfiguration,
+)
+
+# Log entry types (reference fsm.go:190-252 dispatch)
+NODE_REGISTER = "node-register"
+NODE_DEREGISTER = "node-deregister"
+NODE_STATUS_UPDATE = "node-status-update"
+NODE_DRAIN_UPDATE = "node-drain-update"
+NODE_ELIGIBILITY_UPDATE = "node-eligibility-update"
+JOB_REGISTER = "job-register"
+JOB_DEREGISTER = "job-deregister"
+EVAL_UPDATE = "eval-update"
+EVAL_DELETE = "eval-delete"
+ALLOC_UPDATE = "alloc-update"
+ALLOC_CLIENT_UPDATE = "alloc-client-update"
+ALLOC_UPDATE_DESIRED_TRANSITION = "alloc-update-desired-transition"
+APPLY_PLAN_RESULTS = "apply-plan-results"
+DEPLOYMENT_STATUS_UPDATE = "deployment-status-update"
+DEPLOYMENT_PROMOTE = "deployment-promote"
+DEPLOYMENT_ALLOC_HEALTH = "deployment-alloc-health"
+DEPLOYMENT_DELETE = "deployment-delete"
+SCHEDULER_CONFIG = "scheduler-config"
+BATCH_NODE_UPDATE_DRAIN = "batch-node-update-drain"
+
+
+class NomadFSM:
+    def __init__(self, state: Optional[StateStore] = None, logger=None) -> None:
+        self.state = state or StateStore()
+        self.logger = logger or logging.getLogger("nomad_tpu.fsm")
+        # leader-only hooks, set by the server when it holds leadership
+        self.on_eval_upserted: Optional[Callable[[Evaluation], None]] = None
+        self.on_capacity_change: Optional[Callable[[str, int], None]] = None
+
+    def apply(self, index: int, entry_type: str, payload) -> object:
+        handler = _DISPATCH.get(entry_type)
+        if handler is None:
+            raise ValueError(f"unknown log entry type {entry_type!r}")
+        return handler(self, index, payload)
+
+    # -- handlers ----------------------------------------------------------
+
+    def _apply_node_register(self, index: int, node: Node):
+        self.state.upsert_node(index, node)
+        stored = self.state.node_by_id(node.id)
+        if self.on_capacity_change is not None and stored is not None and stored.ready():
+            self.on_capacity_change(stored.computed_class, index)
+
+    def _apply_node_deregister(self, index: int, node_id: str):
+        self.state.delete_node(index, node_id)
+
+    def _apply_node_status_update(self, index: int, payload):
+        node_id, status = payload
+        self.state.update_node_status(index, node_id, status)
+        node = self.state.node_by_id(node_id)
+        if self.on_capacity_change is not None and node is not None and node.ready():
+            self.on_capacity_change(node.computed_class, index)
+
+    def _apply_node_drain_update(self, index: int, payload):
+        node_id, drain = payload
+        self.state.update_node_drain(index, node_id, drain)
+
+    def _apply_node_eligibility_update(self, index: int, payload):
+        node_id, eligibility = payload
+        self.state.update_node_eligibility(index, node_id, eligibility)
+        node = self.state.node_by_id(node_id)
+        if self.on_capacity_change is not None and node is not None and node.ready():
+            self.on_capacity_change(node.computed_class, index)
+
+    def _apply_job_register(self, index: int, job: Job):
+        self.state.upsert_job(index, job)
+
+    def _apply_job_deregister(self, index: int, payload):
+        namespace, job_id, purge = payload
+        if purge:
+            self.state.delete_job(index, namespace, job_id)
+        else:
+            job = self.state.job_by_id(namespace, job_id)
+            if job is not None:
+                stopped = job.copy()
+                stopped.stop = True
+                self.state.upsert_job(index, stopped)
+
+    def _apply_eval_update(self, index: int, evals: List[Evaluation]):
+        self.state.upsert_evals(index, evals)
+        if self.on_eval_upserted is not None:
+            for ev in evals:
+                stored = self.state.eval_by_id(ev.id)
+                if stored is not None:
+                    self.on_eval_upserted(stored)
+
+    def _apply_eval_delete(self, index: int, payload):
+        eval_ids, alloc_ids = payload
+        self.state.delete_eval(index, eval_ids, alloc_ids)
+
+    def _apply_alloc_update(self, index: int, allocs: List[Allocation]):
+        self.state.upsert_allocs(index, allocs)
+
+    def _apply_alloc_client_update(self, index: int, allocs: List[Allocation]):
+        self.state.update_allocs_from_client(index, allocs)
+        # terminal client states free capacity -> unblock
+        if self.on_capacity_change is not None:
+            for alloc in allocs:
+                if alloc.client_terminal_status():
+                    stored = self.state.alloc_by_id(alloc.id)
+                    node = self.state.node_by_id(stored.node_id) if stored else None
+                    if node is not None:
+                        self.on_capacity_change(node.computed_class, index)
+
+    def _apply_alloc_update_desired_transition(self, index: int, payload):
+        transitions, evals = payload
+        for alloc_id, transition in transitions.items():
+            alloc = self.state.alloc_by_id(alloc_id)
+            if alloc is None:
+                continue
+            updated = alloc.copy_skip_job()
+            updated.desired_transition = transition
+            updated.modify_index = index
+            self.state.upsert_allocs(index, [updated])
+        if evals:
+            self._apply_eval_update(index, evals)
+
+    def _apply_plan_results(self, index: int, payload):
+        self.state.upsert_plan_results(
+            index,
+            alloc_updates=payload["alloc_updates"],
+            allocs_stopped=payload["allocs_stopped"],
+            allocs_preempted=payload.get("allocs_preempted", []),
+            deployment=payload.get("deployment"),
+            deployment_updates=payload.get("deployment_updates"),
+            eval_id=payload.get("eval_id", ""),
+        )
+        if payload.get("preemption_evals"):
+            self._apply_eval_update(index, payload["preemption_evals"])
+        # stopped allocs free capacity
+        if self.on_capacity_change is not None:
+            seen = set()
+            for alloc in payload["allocs_stopped"]:
+                node = self.state.node_by_id(alloc.node_id)
+                if node is not None and node.computed_class not in seen:
+                    seen.add(node.computed_class)
+                    self.on_capacity_change(node.computed_class, index)
+
+    def _apply_deployment_status_update(self, index: int, payload):
+        update, job, evaluation = payload
+        d = self.state.deployment_by_id(update.deployment_id)
+        if d is not None:
+            nd = d.copy()
+            nd.status = update.status
+            nd.status_description = update.status_description
+            self.state.upsert_deployment(index, nd)
+        if job is not None:
+            self.state.upsert_job(index, job)
+        if evaluation is not None:
+            self._apply_eval_update(index, [evaluation])
+
+    def _apply_deployment_promote(self, index: int, payload):
+        deployment_id, groups, evaluation = payload
+        d = self.state.deployment_by_id(deployment_id)
+        if d is not None:
+            nd = d.copy()
+            for group, dstate in nd.task_groups.items():
+                if groups is None or group in groups:
+                    dstate.promoted = True
+            nd.status_description = "Deployment is running"
+            self.state.upsert_deployment(index, nd)
+            # canaries lose canary status on promote
+            for alloc_id in [
+                a for s in (d.task_groups or {}).values() for a in s.placed_canaries
+            ]:
+                alloc = self.state.alloc_by_id(alloc_id)
+                if alloc is not None and alloc.deployment_status is not None:
+                    updated = alloc.copy_skip_job()
+                    updated.deployment_status.canary = False
+                    self.state.upsert_allocs(index, [updated])
+        if evaluation is not None:
+            self._apply_eval_update(index, [evaluation])
+
+    def _apply_deployment_alloc_health(self, index: int, payload):
+        deployment_id, healthy_ids, unhealthy_ids, timestamp_ns, dstatus, evaluation = payload
+        from ..structs.structs import AllocDeploymentStatus
+
+        stored = self.state.deployment_by_id(deployment_id)
+        # never mutate the stored object: snapshots share it
+        d = stored.copy() if stored is not None else None
+        for alloc_id, healthy in [(i, True) for i in healthy_ids] + [
+            (i, False) for i in unhealthy_ids
+        ]:
+            alloc = self.state.alloc_by_id(alloc_id)
+            if alloc is None:
+                continue
+            updated = alloc.copy_skip_job()  # deep copy: status safely mutable
+            if updated.deployment_status is None:
+                updated.deployment_status = AllocDeploymentStatus()
+            updated.deployment_status.healthy = healthy
+            updated.deployment_status.timestamp_ns = timestamp_ns
+            self.state.upsert_allocs(index, [updated])
+            if d is not None:
+                ds = d.task_groups.get(alloc.task_group)
+                if ds is not None:
+                    if healthy:
+                        ds.healthy_allocs += 1
+                    else:
+                        ds.unhealthy_allocs += 1
+        if d is not None:
+            self.state.upsert_deployment(index, d)
+        if dstatus is not None:
+            self._apply_deployment_status_update(index, (dstatus, None, None))
+        if evaluation is not None:
+            self._apply_eval_update(index, [evaluation])
+
+    def _apply_deployment_delete(self, index: int, deployment_ids: List[str]):
+        self.state.delete_deployment(index, deployment_ids)
+
+    def _apply_scheduler_config(self, index: int, config: SchedulerConfiguration):
+        self.state.scheduler_set_config(index, config)
+
+    def _apply_batch_node_drain(self, index: int, payload):
+        for node_id, drain in payload.items():
+            try:
+                self.state.update_node_drain(index, node_id, drain)
+            except KeyError:
+                pass
+
+    # -- snapshot/restore --------------------------------------------------
+
+    def snapshot(self) -> StateStore:
+        return self.state.snapshot()
+
+    def restore(self, snapshot: StateStore) -> None:
+        self.state = snapshot
+
+
+_DISPATCH: Dict[str, Callable] = {
+    NODE_REGISTER: NomadFSM._apply_node_register,
+    NODE_DEREGISTER: NomadFSM._apply_node_deregister,
+    NODE_STATUS_UPDATE: NomadFSM._apply_node_status_update,
+    NODE_DRAIN_UPDATE: NomadFSM._apply_node_drain_update,
+    NODE_ELIGIBILITY_UPDATE: NomadFSM._apply_node_eligibility_update,
+    JOB_REGISTER: NomadFSM._apply_job_register,
+    JOB_DEREGISTER: NomadFSM._apply_job_deregister,
+    EVAL_UPDATE: NomadFSM._apply_eval_update,
+    EVAL_DELETE: NomadFSM._apply_eval_delete,
+    ALLOC_UPDATE: NomadFSM._apply_alloc_update,
+    ALLOC_CLIENT_UPDATE: NomadFSM._apply_alloc_client_update,
+    ALLOC_UPDATE_DESIRED_TRANSITION: NomadFSM._apply_alloc_update_desired_transition,
+    APPLY_PLAN_RESULTS: NomadFSM._apply_plan_results,
+    DEPLOYMENT_STATUS_UPDATE: NomadFSM._apply_deployment_status_update,
+    DEPLOYMENT_PROMOTE: NomadFSM._apply_deployment_promote,
+    DEPLOYMENT_ALLOC_HEALTH: NomadFSM._apply_deployment_alloc_health,
+    DEPLOYMENT_DELETE: NomadFSM._apply_deployment_delete,
+    SCHEDULER_CONFIG: NomadFSM._apply_scheduler_config,
+    BATCH_NODE_UPDATE_DRAIN: NomadFSM._apply_batch_node_drain,
+}

@@ -1,0 +1,228 @@
+"""Plan queue and plan applier: the cluster's serialization point.
+
+Semantics follow reference ``nomad/plan_queue.go`` and ``nomad/plan_apply.go``:
+workers submit plans optimistically; the leader's single applier thread
+re-validates every touched node against current state (AllocsFit,
+plan_apply.go:628), partially commits what fits, and returns a RefreshIndex
+forcing stale workers to re-plan. The per-node feasibility fan-out the
+reference does over a goroutine pool (plan_apply_pool.go) is a vectorized
+batch here — the same capacity math the TPU engine runs, host-side.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+from ..structs.funcs import allocs_fit, remove_allocs
+from ..structs.structs import (
+    EVAL_STATUS_PENDING,
+    EVAL_TRIGGER_PREEMPTION,
+    Allocation,
+    Evaluation,
+    Plan,
+    PlanResult,
+)
+from .fsm import APPLY_PLAN_RESULTS
+
+
+class PendingPlan:
+    def __init__(self, plan: Plan) -> None:
+        self.plan = plan
+        self.future: Future = Future()
+
+
+class PlanQueue:
+    """Leader-only priority queue of submitted plans (reference plan_queue.go)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._heap: List[Tuple[int, int, PendingPlan]] = []
+        self._counter = itertools.count()
+        self.enabled = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            prev = self.enabled
+            self.enabled = enabled
+            if prev and not enabled:
+                for _, _, pending in self._heap:
+                    pending.future.set_exception(RuntimeError("plan queue disabled"))
+                self._heap.clear()
+            self._cond.notify_all()
+
+    def enqueue(self, plan: Plan) -> PendingPlan:
+        with self._lock:
+            if not self.enabled:
+                raise RuntimeError("plan queue is disabled")
+            pending = PendingPlan(plan)
+            heapq.heappush(self._heap, (-plan.priority, next(self._counter), pending))
+            self._cond.notify()
+            return pending
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        with self._cond:
+            if not self._heap:
+                self._cond.wait(timeout=timeout)
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"depth": len(self._heap)}
+
+
+class Planner:
+    """The leader's plan applier loop (reference planner.planApply)."""
+
+    def __init__(self, raft, peer: int, fsm, plan_queue: PlanQueue, logger=None) -> None:
+        self.raft = raft
+        self.peer = peer
+        self.fsm = fsm
+        self.plan_queue = plan_queue
+        self.logger = logger or logging.getLogger("nomad_tpu.planner")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="plan-apply", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.plan_queue.dequeue(timeout=0.2)
+            if pending is None:
+                continue
+            try:
+                result = self.apply_plan(pending.plan)
+                pending.future.set_result(result)
+            except Exception as e:  # noqa: BLE001 — worker gets the error
+                self.logger.exception("plan apply failed")
+                pending.future.set_exception(e)
+
+    # ------------------------------------------------------------------
+
+    def evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
+        """Re-check every touched node against current state; keep what fits
+        (reference plan_apply.go:399/:436/:628)."""
+        result = PlanResult(
+            node_update=plan.node_update,
+            node_allocation={},
+            node_preemptions={},
+            deployment=plan.deployment,
+            deployment_updates=list(plan.deployment_updates),
+        )
+        partial = False
+        for node_id, allocs in plan.node_allocation.items():
+            ok = self._evaluate_node_plan(snapshot, plan, node_id)
+            if ok:
+                result.node_allocation[node_id] = allocs
+                if node_id in plan.node_preemptions:
+                    result.node_preemptions[node_id] = plan.node_preemptions[node_id]
+            else:
+                partial = True
+        if partial:
+            # Invalid placements: cancel deployment bits if everything failed
+            if not result.node_allocation:
+                result.deployment = None
+                result.deployment_updates = []
+            result.refresh_index = self.fsm.state.latest_index
+        return result
+
+    def _evaluate_node_plan(self, snapshot, plan: Plan, node_id: str) -> bool:
+        new_allocs = plan.node_allocation.get(node_id, [])
+        node = snapshot.node_by_id(node_id)
+        if node is None:
+            return not new_allocs
+        if node.drain or not node.ready():
+            return False
+
+        existing = snapshot.allocs_by_node(node_id)
+        existing = [a for a in existing if not a.terminal_status()]
+        # Remove planned evictions, preemptions, AND prior versions of the
+        # planned allocations (in-place updates must not double count).
+        remove = list(plan.node_update.get(node_id, []))
+        remove.extend(plan.node_preemptions.get(node_id, []))
+        remove.extend(new_allocs)
+        if remove:
+            existing = remove_allocs(existing, remove)
+        proposed = existing + new_allocs
+
+        fit, reason, _util = allocs_fit(node, proposed, None, check_devices=True)
+        if not fit:
+            self.logger.debug("plan for node %s rejected: %s", node_id, reason)
+        return fit
+
+    def apply_plan(self, plan: Plan) -> PlanResult:
+        snapshot = self.fsm.state.snapshot()
+        result = self.evaluate_plan(snapshot, plan)
+        if result.is_noop():
+            return result
+
+        # Flatten + stamp, attaching the plan's job (the same struct-sharing
+        # the reference relies on in UpsertPlanResults).
+        alloc_updates: List[Allocation] = []
+        for allocs in result.node_allocation.values():
+            for alloc in allocs:
+                existing = snapshot.alloc_by_id(alloc.id)
+                alloc.create_index = existing.create_index if existing else 0
+                if alloc.job is None:
+                    alloc.job = plan.job
+                alloc_updates.append(alloc)
+        allocs_stopped: List[Allocation] = []
+        for allocs in result.node_update.values():
+            allocs_stopped.extend(allocs)
+        allocs_preempted: List[Allocation] = []
+        preemption_evals: List[Evaluation] = []
+        preempted_job_ids = set()
+        for allocs in result.node_preemptions.values():
+            for alloc in allocs:
+                allocs_preempted.append(alloc)
+                existing = snapshot.alloc_by_id(alloc.id)
+                if existing is not None:
+                    preempted_job_ids.add((existing.namespace, existing.job_id))
+        for namespace, job_id in preempted_job_ids:
+            job = snapshot.job_by_id(namespace, job_id)
+            if job is None:
+                continue
+            preemption_evals.append(
+                Evaluation(
+                    namespace=namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=EVAL_TRIGGER_PREEMPTION,
+                    job_id=job_id,
+                    status=EVAL_STATUS_PENDING,
+                )
+            )
+
+        payload = {
+            "alloc_updates": alloc_updates,
+            "allocs_stopped": allocs_stopped,
+            "allocs_preempted": allocs_preempted,
+            "deployment": result.deployment,
+            "deployment_updates": result.deployment_updates,
+            "eval_id": plan.eval_id,
+            "preemption_evals": preemption_evals,
+        }
+        index, _ = self.raft.apply(self.peer, APPLY_PLAN_RESULTS, payload)
+        result.alloc_index = index
+
+        # Stamp result allocs (the scheduler checks create==modify for "new")
+        for alloc in alloc_updates:
+            stored = self.fsm.state.alloc_by_id(alloc.id)
+            if stored is not None:
+                alloc.create_index = stored.create_index
+                alloc.modify_index = stored.modify_index
+        return result

@@ -1,0 +1,83 @@
+"""Replicated log abstraction.
+
+Fills the role of the reference's vendored hashicorp/raft + BoltDB store
+(nomad/server.go:1079 setupRaft). Two implementations:
+
+- ``InProcRaft``: an in-process log for single-server (dev) mode and for
+  multi-server tests — the leader appends entries and applies them to every
+  peer FSM synchronously, giving the same linearizable apply order real raft
+  provides (without network fault tolerance).
+- A C++ consensus core is the planned native substrate for multi-host
+  deployments (same ``apply`` contract); the control plane rides DCN, never
+  ICI.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from .fsm import NomadFSM
+
+
+class NotLeaderError(Exception):
+    pass
+
+
+class InProcRaft:
+    """Shared log; one elected leader; synchronous replication to peer FSMs."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.log: List[Tuple[int, str, object]] = []
+        self.last_index = 0
+        self.fsms: List[NomadFSM] = []
+        self.leader_idx: Optional[int] = None
+        self.leadership_observers: List[Callable[[int, bool], None]] = []
+
+    def join(self, fsm: NomadFSM) -> int:
+        """Add a server's FSM; returns its peer index. Replays the log."""
+        with self._lock:
+            for index, entry_type, payload in self.log:
+                fsm.apply(index, entry_type, payload)
+            self.fsms.append(fsm)
+            peer = len(self.fsms) - 1
+            if self.leader_idx is None:
+                self._elect(peer)
+            return peer
+
+    def _elect(self, peer: int) -> None:
+        old = self.leader_idx
+        self.leader_idx = peer
+        for observer in self.leadership_observers:
+            observer(peer, True)
+            if old is not None:
+                observer(old, False)
+
+    def transfer_leadership(self, peer: int) -> None:
+        with self._lock:
+            if peer >= len(self.fsms):
+                raise ValueError(f"unknown peer {peer}")
+            old = self.leader_idx
+            self.leader_idx = peer
+            for observer in self.leadership_observers:
+                if old is not None:
+                    observer(old, False)
+                observer(peer, True)
+
+    def is_leader(self, peer: int) -> bool:
+        return self.leader_idx == peer
+
+    def apply(self, peer: int, entry_type: str, payload) -> Tuple[int, object]:
+        """Append + replicate + apply; returns (index, leader-FSM response)."""
+        with self._lock:
+            if self.leader_idx != peer:
+                raise NotLeaderError(f"peer {peer} is not the leader")
+            self.last_index += 1
+            index = self.last_index
+            self.log.append((index, entry_type, payload))
+            response = None
+            for i, fsm in enumerate(self.fsms):
+                r = fsm.apply(index, entry_type, payload)
+                if i == peer:
+                    response = r
+            return index, response

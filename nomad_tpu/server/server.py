@@ -1,0 +1,416 @@
+"""The server: wires raft/FSM, broker, plan pipeline, workers, heartbeats.
+
+Fills the role of reference ``nomad/server.go`` + ``nomad/leader.go``: on
+gaining leadership the broker/blocked-tracker/plan-queue enable and pending
+evals restore from state (leader.go:180 establishLeadership); on losing it
+everything disables. Endpoint methods (register_*, update_*) are the
+in-process equivalents of the RPC endpoint layer; a transport front-end
+(msgpack/gRPC) binds to them at the process boundary.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..structs.structs import (
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_PENDING,
+    EVAL_TRIGGER_JOB_DEREGISTER,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_UPDATE,
+    JOB_TYPE_SERVICE,
+    NODE_STATUS_DOWN,
+    NODE_STATUS_READY,
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    SchedulerConfiguration,
+    generate_uuid,
+)
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker
+from .fsm import (
+    ALLOC_CLIENT_UPDATE,
+    EVAL_UPDATE,
+    JOB_DEREGISTER,
+    JOB_REGISTER,
+    NODE_DEREGISTER,
+    NODE_DRAIN_UPDATE,
+    NODE_ELIGIBILITY_UPDATE,
+    NODE_REGISTER,
+    NODE_STATUS_UPDATE,
+    SCHEDULER_CONFIG,
+    NomadFSM,
+)
+from .heartbeat import HeartbeatTimers
+from .plan_apply import Planner, PlanQueue
+from .raft import InProcRaft
+from .worker import Worker
+
+
+@dataclass
+class ServerConfig:
+    num_schedulers: int = 2
+    deterministic: bool = False
+    heartbeat_min_ttl: float = 10.0
+    heartbeat_max_ttl: float = 30.0
+    eval_gc_interval: float = 300.0
+    unblock_failed_interval: float = 60.0
+    scheduler_algorithm: str = "tpu_binpack"
+
+
+class Server:
+    def __init__(
+        self,
+        config: Optional[ServerConfig] = None,
+        raft: Optional[InProcRaft] = None,
+        name: str = "server-1",
+    ) -> None:
+        self.config = config or ServerConfig()
+        self.name = name
+        self.logger = logging.getLogger(f"nomad_tpu.server.{name}")
+
+        self.fsm = NomadFSM()
+        self.raft = raft or InProcRaft()
+        self.eval_broker = EvalBroker()
+        self.blocked_evals = BlockedEvals(self.eval_broker)
+        self.plan_queue = PlanQueue()
+        self.heartbeaters = HeartbeatTimers(
+            self, self.config.heartbeat_min_ttl, self.config.heartbeat_max_ttl
+        )
+        self.workers: List[Worker] = []
+        self.planner: Optional[Planner] = None
+        self._leadership = False
+        self._leader_generation = 0
+        self._leader_timers: List[threading.Timer] = []
+        self._lock = threading.RLock()
+
+        from .timetable import TimeTable
+
+        self.timetable = TimeTable()
+
+        # Join before observing: the join-time election fires observers, and
+        # start() handles the initial-leadership case explicitly.
+        self.peer = self.raft.join(self.fsm)
+        self.raft.leadership_observers.append(self._on_leadership)
+        self.planner = Planner(self.raft, self.peer, self.fsm, self.plan_queue)
+
+    # ------------------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft.is_leader(self.peer)
+
+    def raft_apply(self, entry_type: str, payload) -> Tuple[int, object]:
+        index, response = self.raft.apply(self.peer, entry_type, payload)
+        self.timetable.witness(index)
+        return index, response
+
+    def start(self) -> None:
+        for i in range(self.config.num_schedulers):
+            w = Worker(self, i)
+            self.workers.append(w)
+            w.start()
+        self.planner.start()
+        if self.is_leader and not self._leadership:
+            self._establish_leadership()
+
+    def stop(self) -> None:
+        for w in self.workers:
+            w.stop()
+        if self.planner is not None:
+            self.planner.stop()
+        self._revoke_leadership()
+
+    # -- leadership ------------------------------------------------------
+
+    def _on_leadership(self, peer: int, is_leader: bool) -> None:
+        if peer != self.peer:
+            return
+        if is_leader:
+            self._establish_leadership()
+        else:
+            self._revoke_leadership()
+
+    def _establish_leadership(self) -> None:
+        with self._lock:
+            if self._leadership:
+                return
+            self._leadership = True
+        self.logger.info("gained leadership")
+        self.plan_queue.set_enabled(True)
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.heartbeaters.set_enabled(True)
+        self.fsm.on_eval_upserted = self._handle_upserted_eval
+        self.fsm.on_capacity_change = self.blocked_evals.unblock
+        self._restore_evals()
+        self._restore_heartbeats()
+        if self.fsm.state.scheduler_config()[1] is None:
+            self.raft_apply(
+                SCHEDULER_CONFIG,
+                SchedulerConfiguration(scheduler_algorithm=self.config.scheduler_algorithm),
+            )
+        self._leader_generation += 1
+        gen = self._leader_generation
+        self._schedule_leader_task(gen, self.config.unblock_failed_interval,
+                                   self.blocked_evals.unblock_failed)
+        self._schedule_leader_task(gen, self.config.unblock_failed_interval,
+                                   self._reap_failed_evals)
+        self._schedule_leader_task(gen, self.config.eval_gc_interval, self._create_gc_evals)
+
+    def _revoke_leadership(self) -> None:
+        with self._lock:
+            if not self._leadership:
+                return
+            self._leadership = False
+        self.logger.info("lost leadership")
+        self.fsm.on_eval_upserted = None
+        self.fsm.on_capacity_change = None
+        self.plan_queue.set_enabled(False)
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.heartbeaters.set_enabled(False)
+        self._leader_generation += 1  # invalidates in-flight leader timers
+        with self._lock:
+            for t in self._leader_timers:
+                t.cancel()
+            self._leader_timers.clear()
+
+    def _restore_evals(self) -> None:
+        """Re-enqueue non-terminal evals on leadership (leader.go:295)."""
+        for ev in self.fsm.state.evals():
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def _restore_heartbeats(self) -> None:
+        for node in self.fsm.state.nodes():
+            if node.status != NODE_STATUS_DOWN:
+                self.heartbeaters.reset_heartbeat_timer(node.id)
+
+    def _schedule_leader_task(self, gen: int, interval: float, fn) -> None:
+        """Run fn every interval while this leadership generation holds."""
+
+        def tick():
+            if self._leader_generation != gen or not self._leadership:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                self.logger.exception("leader task %s failed", fn.__name__)
+            self._schedule_leader_task(gen, interval, fn)
+
+        t = threading.Timer(interval, tick)
+        t.daemon = True
+        with self._lock:
+            if self._leader_generation != gen:
+                return
+            self._leader_timers.append(t)
+            # prune fired timers
+            self._leader_timers = [x for x in self._leader_timers if x.is_alive() or x is t]
+        t.start()
+
+    def _reap_failed_evals(self) -> None:
+        """Drain the _failed queue: mark failed + create follow-ups
+        (reference leader.go:505)."""
+        from .eval_broker import FAILED_QUEUE
+
+        while True:
+            evaluation, token = self.eval_broker.dequeue([FAILED_QUEUE], timeout=0.01)
+            if evaluation is None:
+                return
+            updated = evaluation.copy()
+            updated.status = "failed"
+            updated.status_description = (
+                f"evaluation reached delivery limit ({self.eval_broker.delivery_limit})"
+            )
+            follow_up = evaluation.create_failed_follow_up_eval(60 * 10**9)
+            updated.next_eval = follow_up.id
+            updated.update_modify_time()
+            follow_up.update_modify_time()
+            self.raft_apply(EVAL_UPDATE, [updated, follow_up])
+            try:
+                self.eval_broker.ack(evaluation.id, token)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _create_gc_evals(self) -> None:
+        """Enqueue internal _core GC evals (reference leader.go:441)."""
+        from ..structs.structs import (
+            CORE_JOB_DEPLOYMENT_GC,
+            CORE_JOB_EVAL_GC,
+            CORE_JOB_JOB_GC,
+            CORE_JOB_NODE_GC,
+            JOB_TYPE_CORE,
+        )
+
+        index = self.fsm.state.latest_index
+        for core_job in (
+            CORE_JOB_EVAL_GC,
+            CORE_JOB_JOB_GC,
+            CORE_JOB_NODE_GC,
+            CORE_JOB_DEPLOYMENT_GC,
+        ):
+            ev = Evaluation(
+                namespace="-",
+                priority=200,
+                type=JOB_TYPE_CORE,
+                triggered_by="scheduled",
+                job_id=core_job,
+                status=EVAL_STATUS_PENDING,
+                snapshot_index=index,
+            )
+            self.eval_broker.enqueue(ev)
+
+    def _handle_upserted_eval(self, evaluation: Evaluation) -> None:
+        """FSM hook: route fresh evals to broker/blocked (fsm.go:641)."""
+        if evaluation.should_enqueue():
+            self.eval_broker.enqueue(evaluation)
+        elif evaluation.should_block():
+            self.blocked_evals.block(evaluation)
+
+    # ------------------------------------------------------------------
+    # Endpoint surface (in-process RPC equivalents)
+    # ------------------------------------------------------------------
+
+    def register_node(self, node: Node) -> float:
+        """Node.Register: upsert + heartbeat TTL."""
+        self.raft_apply(NODE_REGISTER, node)
+        return self.heartbeaters.reset_heartbeat_timer(node.id)
+
+    def deregister_node(self, node_id: str) -> None:
+        self.heartbeaters.clear_heartbeat_timer(node_id)
+        self.raft_apply(NODE_DEREGISTER, node_id)
+        self.create_node_evals(node_id)
+
+    def heartbeat(self, node_id: str) -> float:
+        """Node.UpdateStatus(ready) via TTL reset."""
+        node = self.fsm.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"node {node_id} not registered")
+        if node.status == NODE_STATUS_DOWN:
+            self.raft_apply(NODE_STATUS_UPDATE, (node_id, NODE_STATUS_READY))
+            self.create_node_evals(node_id)
+        return self.heartbeaters.reset_heartbeat_timer(node_id)
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        self.raft_apply(NODE_STATUS_UPDATE, (node_id, status))
+        self.create_node_evals(node_id)
+
+    def update_node_drain(self, node_id: str, drain: bool) -> None:
+        self.raft_apply(NODE_DRAIN_UPDATE, (node_id, drain))
+        if drain:
+            self.create_node_evals(node_id)
+
+    def update_node_eligibility(self, node_id: str, eligibility: str) -> None:
+        self.raft_apply(NODE_ELIGIBILITY_UPDATE, (node_id, eligibility))
+
+    def create_node_evals(self, node_id: str) -> List[str]:
+        """One eval per job with allocs on the node (node_endpoint.go)."""
+        allocs = self.fsm.state.allocs_by_node(node_id)
+        jobs = {}
+        for alloc in allocs:
+            jobs[(alloc.namespace, alloc.job_id)] = alloc
+        evals = []
+        for (namespace, job_id), alloc in jobs.items():
+            job = self.fsm.state.job_by_id(namespace, job_id)
+            ev = Evaluation(
+                namespace=namespace,
+                priority=job.priority if job else 50,
+                type=job.type if job else JOB_TYPE_SERVICE,
+                triggered_by=EVAL_TRIGGER_NODE_UPDATE,
+                job_id=job_id,
+                node_id=node_id,
+                status=EVAL_STATUS_PENDING,
+            )
+            ev.update_modify_time()
+            evals.append(ev)
+        if evals:
+            self.raft_apply(EVAL_UPDATE, evals)
+        return [e.id for e in evals]
+
+    # -- jobs ------------------------------------------------------------
+
+    def register_job(self, job: Job) -> str:
+        """Job.Register: upsert + create an eval (job_endpoint.go:73)."""
+        self.raft_apply(JOB_REGISTER, job)
+        stored = self.fsm.state.job_by_id(job.namespace, job.id)
+        if stored.is_periodic():
+            return ""  # periodic jobs spawn children at launch time
+        ev = Evaluation(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            job_modify_index=stored.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+        )
+        ev.update_modify_time()
+        self.raft_apply(EVAL_UPDATE, [ev])
+        return ev.id
+
+    def deregister_job(self, namespace: str, job_id: str, purge: bool = False) -> str:
+        job = self.fsm.state.job_by_id(namespace, job_id)
+        self.raft_apply(JOB_DEREGISTER, (namespace, job_id, purge))
+        self.blocked_evals.untrack(namespace, job_id)
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else JOB_TYPE_SERVICE,
+            triggered_by=EVAL_TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+        )
+        ev.update_modify_time()
+        self.raft_apply(EVAL_UPDATE, [ev])
+        return ev.id
+
+    # -- client sync -----------------------------------------------------
+
+    def update_allocs_from_client(self, allocs: List[Allocation]) -> None:
+        """Node.UpdateAlloc: client status sync; failed allocs trigger
+        reschedule evals via their job (node_endpoint.go)."""
+        self.raft_apply(ALLOC_CLIENT_UPDATE, allocs)
+        evals = []
+        seen = set()
+        for alloc in allocs:
+            if alloc.client_status != "failed":
+                continue
+            stored = self.fsm.state.alloc_by_id(alloc.id)
+            if stored is None or (stored.namespace, stored.job_id) in seen:
+                continue
+            seen.add((stored.namespace, stored.job_id))
+            job = self.fsm.state.job_by_id(stored.namespace, stored.job_id)
+            if job is None:
+                continue
+            ev = Evaluation(
+                namespace=stored.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by="alloc-failure",
+                job_id=job.id,
+                status=EVAL_STATUS_PENDING,
+            )
+            ev.update_modify_time()
+            evals.append(ev)
+        if evals:
+            self.raft_apply(EVAL_UPDATE, evals)
+
+    # -- introspection ---------------------------------------------------
+
+    def drain_evals(self, timeout: float = 10.0) -> bool:
+        """Wait until the broker has no ready/unacked work (test helper)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            s = self.eval_broker.stats()
+            if s["total_ready"] == 0 and s["total_unacked"] == 0 and s["total_waiting"] == 0:
+                return True
+            time.sleep(0.01)
+        return False

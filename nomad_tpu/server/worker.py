@@ -1,0 +1,123 @@
+"""Scheduling worker: dequeues evals, invokes a scheduler, submits plans.
+
+Semantics follow reference ``nomad/worker.go`` — N workers per server
+(leader and followers), each scheduling optimistically against a state
+snapshot at least as fresh as the eval (SnapshotMinIndex, worker.go:228),
+acting as the scheduler's Planner and Ack/Nacking the broker.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..scheduler.scheduler import new_scheduler
+from ..structs.structs import Evaluation, Plan, PlanResult
+from .eval_broker import NotOutstandingError, TokenMismatchError
+from .fsm import EVAL_UPDATE
+
+BUILTIN_SCHEDULERS = ["service", "batch", "system"]
+CORE_SCHEDULER = "_core"
+
+
+class Worker:
+    def __init__(self, server, worker_id: int) -> None:
+        self.server = server
+        self.id = worker_id
+        self.logger = logging.getLogger(f"nomad_tpu.worker.{worker_id}")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # set per-eval while scheduling
+        self._eval_token = ""
+        self.stats = {"evals_processed": 0, "plans_submitted": 0, "nacks": 0}
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"worker-{self.id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        schedulers = BUILTIN_SCHEDULERS + [CORE_SCHEDULER]
+        while not self._stop.is_set():
+            evaluation, token = self.server.eval_broker.dequeue(schedulers, timeout=0.25)
+            if evaluation is None:
+                continue
+            self._eval_token = token
+            try:
+                self._process(evaluation, token)
+                self.server.eval_broker.ack(evaluation.id, token)
+                self.stats["evals_processed"] += 1
+            except (NotOutstandingError, TokenMismatchError):
+                pass
+            except Exception:  # noqa: BLE001
+                self.logger.exception("eval %s failed", evaluation.id)
+                self.stats["nacks"] += 1
+                try:
+                    self.server.eval_broker.nack(evaluation.id, token)
+                except (NotOutstandingError, TokenMismatchError):
+                    pass
+
+    def _process(self, evaluation: Evaluation, token: str) -> None:
+        if evaluation.type == CORE_SCHEDULER:
+            from .core_sched import CoreScheduler
+
+            snapshot = self.server.fsm.state.snapshot_min_index(
+                max(evaluation.modify_index, evaluation.snapshot_index)
+            )
+            CoreScheduler(self.server, snapshot).process(evaluation)
+            return
+
+        wait_index = max(evaluation.modify_index, evaluation.snapshot_index)
+        snapshot = self.server.fsm.state.snapshot_min_index(wait_index)
+        sched = new_scheduler(evaluation.type, self.logger, snapshot, self)
+        if hasattr(sched, "deterministic"):
+            sched.deterministic = self.server.config.deterministic
+        sched.process(evaluation)
+
+    # -- Planner protocol ------------------------------------------------
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        plan.eval_token = self._eval_token
+        plan.snapshot_index = self.server.fsm.state.latest_index
+        self.server.eval_broker.pause_nack_timeout(plan.eval_id, self._eval_token)
+        try:
+            pending = self.server.plan_queue.enqueue(plan)
+            result: PlanResult = pending.future.result(timeout=60)
+        finally:
+            try:
+                self.server.eval_broker.resume_nack_timeout(plan.eval_id, self._eval_token)
+            except (NotOutstandingError, TokenMismatchError):
+                pass
+        self.stats["plans_submitted"] += 1
+
+        if result.refresh_index:
+            new_state = self.server.fsm.state.snapshot_min_index(result.refresh_index)
+            return result, new_state
+        return result, None
+
+    def update_eval(self, evaluation: Evaluation) -> None:
+        evaluation.update_modify_time()
+        self.server.raft_apply(EVAL_UPDATE, [evaluation])
+
+    def create_eval(self, evaluation: Evaluation) -> None:
+        evaluation.update_modify_time()
+        self.server.raft_apply(EVAL_UPDATE, [evaluation])
+
+    def reblock_eval(self, evaluation: Evaluation) -> None:
+        # Update in raft so a leader change re-blocks it, then re-insert
+        # into the in-memory tracker (reference worker.go:426).
+        token = self.server.eval_broker.outstanding(evaluation.id)
+        if token != self._eval_token:
+            raise TokenMismatchError(evaluation.id)
+        evaluation.update_modify_time()
+        self.server.raft_apply(EVAL_UPDATE, [evaluation])
+        self.server.blocked_evals.block(evaluation)

@@ -917,14 +917,14 @@ class Allocation:
         return _copy.deepcopy(self)
 
     def copy_skip_job(self) -> "Allocation":
+        """Deep copy sharing the (immutable) job. Must not mutate self —
+        concurrent snapshot readers share this object."""
         import copy as _copy
 
-        job, self.job = self.job, None
-        try:
-            c = _copy.deepcopy(self)
-        finally:
-            self.job = job
-        c.job = job
+        shallow = _copy.copy(self)
+        shallow.job = None
+        c = _copy.deepcopy(shallow)
+        c.job = self.job
         return c
 
 
