@@ -22,10 +22,14 @@ class BlockedEvals:
         self._lock = threading.RLock()
         self.enabled = False
 
-        # eval id -> (eval, token) wrapper
+        # eval id -> eval
         self.captured: Dict[str, Evaluation] = {}
         # evals whose constraints escaped computed classes: unblock on any change
         self.escaped: Dict[str, Evaluation] = {}
+        # eval id -> broker token held when the eval was blocked; a non-empty
+        # token means the eval is still outstanding in the broker and must be
+        # re-enqueued via the requeue-after-ack path (reference wrappedEval)
+        self.tokens: Dict[str, str] = {}
         # (namespace, job id) -> eval id, to dedup per job
         self.job_blocks: Dict[Tuple[str, str], str] = {}
         # node id -> eval ids (system scheduler per-node blocks)
@@ -48,49 +52,75 @@ class BlockedEvals:
     # ------------------------------------------------------------------
 
     def block(self, evaluation: Evaluation) -> None:
+        """Track a blocked eval (no broker token — use ``reblock`` when the
+        eval is still outstanding in the broker)."""
         with self._lock:
-            if not self.enabled:
-                return
-            if evaluation.id in self.captured or evaluation.id in self.escaped:
-                return
+            self._process_block(evaluation, "")
 
-            # Missed-unblock check (reference blocked_evals.go:202): if
-            # relevant capacity appeared after the eval's snapshot, don't
-            # block — re-enqueue right away.
-            if self._missed_unblock(evaluation):
-                new_eval = evaluation.copy()
-                new_eval.status = EVAL_STATUS_PENDING
-                self.eval_broker.enqueue(new_eval)
+    def reblock(self, evaluation: Evaluation, token: str) -> None:
+        """Worker reblock of a still-outstanding eval (reference
+        blocked_evals.go Reblock). On the leader the FSM eval-upsert hook has
+        usually already captured the eval with an empty token; this records
+        the delivery token on the tracked entry."""
+        with self._lock:
+            self._process_block(evaluation, token)
+
+    def _process_block(self, evaluation: Evaluation, token: str) -> None:
+        if not self.enabled:
+            return
+        if (
+            evaluation.id in self.captured
+            or evaluation.id in self.escaped
+            or evaluation.id in self.failed
+        ):
+            # Already tracked (e.g. the FSM hook captured it before the
+            # worker's reblock): record the non-empty token so the unblock
+            # path can requeue-after-ack.
+            if token:
+                self.tokens[evaluation.id] = token
+            return
+
+        # Missed-unblock check (reference blocked_evals.go:202): if
+        # relevant capacity appeared after the eval's snapshot, don't
+        # block — re-enqueue right away.
+        if self._missed_unblock(evaluation):
+            new_eval = evaluation.copy()
+            new_eval.status = EVAL_STATUS_PENDING
+            self.eval_broker.enqueue_all({new_eval.id: (new_eval, token)})
+            return
+
+        # Dedup by job: keep the latest eval per job. Token is stored only
+        # once the eval is actually tracked, so dropped evals don't leak
+        # token entries.
+        namespaced = (evaluation.namespace, evaluation.job_id)
+        existing_id = self.job_blocks.get(namespaced)
+        if existing_id is not None:
+            existing = self.captured.get(existing_id) or self.escaped.get(existing_id)
+            if existing is not None and existing.create_index >= evaluation.create_index:
                 return
+            self._remove(existing_id)
+        self.job_blocks[namespaced] = evaluation.id
+        if token:
+            self.tokens[evaluation.id] = token
 
-            # Dedup by job: keep the latest eval per job.
-            namespaced = (evaluation.namespace, evaluation.job_id)
-            existing_id = self.job_blocks.get(namespaced)
-            if existing_id is not None:
-                existing = self.captured.get(existing_id) or self.escaped.get(existing_id)
-                if existing is not None and existing.create_index >= evaluation.create_index:
-                    return
-                self._remove(existing_id)
-            self.job_blocks[namespaced] = evaluation.id
+        if evaluation.triggered_by == EVAL_TRIGGER_MAX_PLANS:
+            self.failed[evaluation.id] = evaluation
+            return
 
-            if evaluation.triggered_by == EVAL_TRIGGER_MAX_PLANS:
-                self.failed[evaluation.id] = evaluation
-                return
-
-            if evaluation.node_id:
-                self.system_blocks.setdefault(evaluation.node_id, set()).add(evaluation.id)
-                self.captured[evaluation.id] = evaluation
-                return
-
-            if evaluation.escaped_computed_class:
-                self.escaped[evaluation.id] = evaluation
-                return
-
+        if evaluation.node_id:
+            self.system_blocks.setdefault(evaluation.node_id, set()).add(evaluation.id)
             self.captured[evaluation.id] = evaluation
-            # Index interest: eligible classes and unseen classes both unblock.
-            for cls, eligible in (evaluation.class_eligibility or {}).items():
-                if eligible:
-                    self.capacity_classes.setdefault(cls, set()).add(evaluation.id)
+            return
+
+        if evaluation.escaped_computed_class:
+            self.escaped[evaluation.id] = evaluation
+            return
+
+        self.captured[evaluation.id] = evaluation
+        # Index interest: eligible classes and unseen classes both unblock.
+        for cls, eligible in (evaluation.class_eligibility or {}).items():
+            if eligible:
+                self.capacity_classes.setdefault(cls, set()).add(evaluation.id)
 
     def _missed_unblock(self, evaluation: Evaluation) -> bool:
         if evaluation.triggered_by == EVAL_TRIGGER_MAX_PLANS:
@@ -110,6 +140,7 @@ class BlockedEvals:
     def _remove(self, eval_id: str) -> None:
         ev = self.captured.pop(eval_id, None) or self.escaped.pop(eval_id, None) \
             or self.failed.pop(eval_id, None)
+        self.tokens.pop(eval_id, None)
         if ev is not None:
             self.job_blocks.pop((ev.namespace, ev.job_id), None)
         for ids in self.capacity_classes.values():
@@ -176,12 +207,16 @@ class BlockedEvals:
             self._enqueue(unblock, index)
 
     def _enqueue(self, evals: List[Evaluation], index: int) -> None:
+        batch = {}
         for ev in evals:
             self.job_blocks.pop((ev.namespace, ev.job_id), None)
+            token = self.tokens.pop(ev.id, "")
             new_eval = ev.copy()
             new_eval.status = EVAL_STATUS_PENDING
             new_eval.snapshot_index = index
-            self.eval_broker.enqueue(new_eval)
+            batch[new_eval.id] = (new_eval, token)
+        if batch:
+            self.eval_broker.enqueue_all(batch)
 
     # ------------------------------------------------------------------
 
@@ -194,6 +229,7 @@ class BlockedEvals:
             self.capacity_classes.clear()
             self.failed.clear()
             self.unblock_indexes.clear()
+            self.tokens.clear()
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -13,7 +13,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..structs.structs import EVAL_STATUS_PENDING, Evaluation, generate_uuid
+from ..structs.structs import Evaluation, generate_uuid
 
 FAILED_QUEUE = "_failed"
 
@@ -50,14 +50,6 @@ class _PendingHeap:
         if not self._heap:
             return None
         return self._heap[0][2]
-
-    def remove(self, eval_id: str) -> Optional[Evaluation]:
-        for i, (_, _, ev) in enumerate(self._heap):
-            if ev.id == eval_id:
-                item = self._heap.pop(i)
-                heapq.heapify(self._heap)
-                return item[2]
-        return None
 
     def __len__(self) -> int:
         return len(self._heap)

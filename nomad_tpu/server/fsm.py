@@ -51,11 +51,17 @@ class NomadFSM:
         # leader-only hooks, set by the server when it holds leadership
         self.on_eval_upserted: Optional[Callable[[Evaluation], None]] = None
         self.on_capacity_change: Optional[Callable[[str, int], None]] = None
+        # index<->time witnesses accumulate on every server (leader and
+        # followers) so GC cutoffs survive leader transitions
+        # (reference fsm.go witnesses inside Apply).
+        self.timetable = None
 
     def apply(self, index: int, entry_type: str, payload) -> object:
         handler = _DISPATCH.get(entry_type)
         if handler is None:
             raise ValueError(f"unknown log entry type {entry_type!r}")
+        if self.timetable is not None:
+            self.timetable.witness(index)
         return handler(self, index, payload)
 
     # -- handlers ----------------------------------------------------------
@@ -207,21 +213,35 @@ class NomadFSM:
             (i, False) for i in unhealthy_ids
         ]:
             alloc = self.state.alloc_by_id(alloc_id)
-            if alloc is None:
+            if alloc is None or alloc.deployment_id != deployment_id:
+                # A report for an alloc of another (e.g. superseded)
+                # deployment must not touch this deployment's counters.
                 continue
+            # Delta against the alloc's current health so duplicate reports
+            # don't inflate counts and a flip moves the old count over
+            # (reference state_store.go UpdateDeploymentAllocHealth deltas).
+            prev = (
+                alloc.deployment_status.healthy
+                if alloc.deployment_status is not None
+                else None
+            )
             updated = alloc.copy_skip_job()  # deep copy: status safely mutable
             if updated.deployment_status is None:
                 updated.deployment_status = AllocDeploymentStatus()
             updated.deployment_status.healthy = healthy
             updated.deployment_status.timestamp_ns = timestamp_ns
             self.state.upsert_allocs(index, [updated])
-            if d is not None:
+            if d is not None and prev is not healthy:
                 ds = d.task_groups.get(alloc.task_group)
                 if ds is not None:
                     if healthy:
                         ds.healthy_allocs += 1
+                        if prev is False:
+                            ds.unhealthy_allocs -= 1
                     else:
                         ds.unhealthy_allocs += 1
+                        if prev is True:
+                            ds.healthy_allocs -= 1
         if d is not None:
             self.state.upsert_deployment(index, d)
         if dstatus is not None:

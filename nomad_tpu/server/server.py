@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..structs.structs import (
     EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_FAILED,
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_JOB_DEREGISTER,
     EVAL_TRIGGER_JOB_REGISTER,
@@ -92,6 +93,10 @@ class Server:
         from .timetable import TimeTable
 
         self.timetable = TimeTable()
+        # The FSM witnesses every applied index (including plan results and
+        # entries replicated to followers), so GC cutoffs survive leader
+        # transitions.
+        self.fsm.timetable = self.timetable
 
         # Join before observing: the join-time election fires observers, and
         # start() handles the initial-leadership case explicitly.
@@ -106,9 +111,7 @@ class Server:
         return self.raft.is_leader(self.peer)
 
     def raft_apply(self, entry_type: str, payload) -> Tuple[int, object]:
-        index, response = self.raft.apply(self.peer, entry_type, payload)
-        self.timetable.witness(index)
-        return index, response
+        return self.raft.apply(self.peer, entry_type, payload)
 
     def start(self) -> None:
         for i in range(self.config.num_schedulers):
@@ -226,7 +229,7 @@ class Server:
             if evaluation is None:
                 return
             updated = evaluation.copy()
-            updated.status = "failed"
+            updated.status = EVAL_STATUS_FAILED
             updated.status_description = (
                 f"evaluation reached delivery limit ({self.eval_broker.delivery_limit})"
             )

@@ -120,4 +120,9 @@ class Worker:
             raise TokenMismatchError(evaluation.id)
         evaluation.update_modify_time()
         self.server.raft_apply(EVAL_UPDATE, [evaluation])
-        self.server.blocked_evals.block(evaluation)
+        # Pass the delivery token: the eval is still outstanding in the
+        # broker, so an unblock racing this worker's ack must requeue
+        # through the ack path rather than be dropped as a duplicate. The
+        # raft apply above already captured the eval via the FSM hook
+        # (empty token); reblock records the token on that entry.
+        self.server.blocked_evals.reblock(evaluation, token)

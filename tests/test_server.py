@@ -303,3 +303,100 @@ def test_block_after_missed_unblock_reenqueues():
         s.eval_broker.ack(got.id, token)
     finally:
         s.stop()
+
+
+def test_reblock_while_outstanding_requeues_after_ack():
+    """An unblock racing a worker's in-flight reblock must not drop the eval.
+
+    The worker reblocks an eval while it is still unacked in the broker; a
+    capacity change then unblocks it before the ack lands. The token carried
+    through BlockedEvals routes the re-enqueue via the broker's
+    requeue-after-ack path (reference wrappedEval + EnqueueAll semantics).
+    """
+    from nomad_tpu.server.eval_broker import EvalBroker
+    from nomad_tpu.server.blocked_evals import BlockedEvals
+    from nomad_tpu.structs.structs import EVAL_STATUS_BLOCKED as _BLK
+
+    broker = EvalBroker()
+    broker.set_enabled(True)
+    blocked = BlockedEvals(broker)
+    blocked.set_enabled(True)
+
+    ev = mock.eval()
+    ev.class_eligibility = {"c1": True}
+    broker.enqueue(ev)
+    out, token = broker.dequeue([ev.type], timeout=1.0)
+    assert out is not None and out.id == ev.id
+
+    # Leader ordering: the raft apply fires the FSM eval-upsert hook first,
+    # capturing the eval with no token...
+    reblocked = ev.copy()
+    reblocked.status = _BLK
+    blocked.block(reblocked)
+    # ...then the worker's reblock records its delivery token on the entry.
+    blocked.reblock(reblocked, token)
+    assert blocked.tokens[ev.id] == token
+
+    # Capacity change unblocks while the eval is still unacked: without the
+    # token this enqueue is silently dropped as a duplicate.
+    blocked.unblock("c1", index=100)
+    assert broker.stats()["total_ready"] == 0  # parked behind the ack
+
+    broker.ack(ev.id, token)
+    # The requeued copy is now deliverable again.
+    out2, token2 = broker.dequeue([ev.type], timeout=1.0)
+    assert out2 is not None and out2.id == ev.id
+    assert out2.snapshot_index == 100
+    broker.ack(ev.id, token2)
+
+
+def test_deployment_alloc_health_counts_are_idempotent():
+    """Duplicate health reports must not inflate deployment counters, and a
+    healthy->unhealthy flip must move the count, not double-book it."""
+    from nomad_tpu.server.fsm import DEPLOYMENT_ALLOC_HEALTH, NomadFSM
+    from nomad_tpu.structs.structs import Deployment, DeploymentState
+
+    fsm = NomadFSM()
+    node = mock.node()
+    fsm.state.upsert_node(1, node)
+    job = mock.job()
+    fsm.state.upsert_job(2, job)
+    alloc = mock.alloc()
+    alloc.namespace, alloc.job_id, alloc.job = job.namespace, job.id, job
+    alloc.node_id = node.id
+    alloc.task_group = job.task_groups[0].name
+    fsm.state.upsert_allocs(3, [alloc])
+
+    d = Deployment(
+        job_id=job.id,
+        namespace=job.namespace,
+        job_version=job.version,
+        task_groups={job.task_groups[0].name: DeploymentState(desired_total=1)},
+        status="running",
+    )
+    fsm.state.upsert_deployment(4, d)
+    alloc.deployment_id = d.id
+    fsm.state.upsert_allocs(4, [alloc])
+
+    # A report for an alloc of a different deployment must be ignored.
+    other = mock.alloc()
+    other.namespace, other.job_id, other.job = job.namespace, job.id, job
+    other.node_id, other.task_group = node.id, job.task_groups[0].name
+    other.deployment_id = "some-other-deployment"
+    fsm.state.upsert_allocs(4, [other])
+
+    def health(idx, healthy_ids, unhealthy_ids):
+        fsm.apply(idx, DEPLOYMENT_ALLOC_HEALTH,
+                  (d.id, healthy_ids, unhealthy_ids, 0, None, None))
+
+    health(5, [alloc.id], [])
+    health(6, [alloc.id], [])  # duplicate report
+    health(6, [], [other.id])  # other deployment's alloc: ignored
+    ds = fsm.state.deployment_by_id(d.id).task_groups[alloc.task_group]
+    assert ds.healthy_allocs == 1
+    assert ds.unhealthy_allocs == 0
+
+    health(7, [], [alloc.id])  # flip
+    ds = fsm.state.deployment_by_id(d.id).task_groups[alloc.task_group]
+    assert ds.healthy_allocs == 0
+    assert ds.unhealthy_allocs == 1
