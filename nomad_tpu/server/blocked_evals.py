@@ -38,8 +38,11 @@ class BlockedEvals:
         self.capacity_classes: Dict[str, Set[str]] = {}
         # evals blocked due to max plan attempts, retried periodically
         self.failed: Dict[str, Evaluation] = {}
-        # classes seen while disabled/after block, to catch racing capacity
+        # capacity witnesses, to catch events racing the block window:
+        # class -> index, node id -> index, quota -> index
         self.unblock_indexes: Dict[str, int] = {}
+        self.node_unblock_indexes: Dict[str, int] = {}
+        self.quota_unblock_indexes: Dict[str, int] = {}
         self.stats_blocked = 0
 
     def set_enabled(self, enabled: bool) -> None:
@@ -126,6 +129,17 @@ class BlockedEvals:
         if evaluation.triggered_by == EVAL_TRIGGER_MAX_PLANS:
             return False
         snapshot = evaluation.snapshot_index
+        if (
+            evaluation.node_id
+            and self.node_unblock_indexes.get(evaluation.node_id, 0) > snapshot
+        ):
+            return True
+        if (
+            evaluation.quota_limit_reached
+            and self.quota_unblock_indexes.get(evaluation.quota_limit_reached, 0)
+            > snapshot
+        ):
+            return True
         elig = evaluation.class_eligibility or {}
         for cls, index in self.unblock_indexes.items():
             if index <= snapshot:
@@ -181,6 +195,7 @@ class BlockedEvals:
         with self._lock:
             if not self.enabled:
                 return
+            self.node_unblock_indexes[node_id] = index
             ids = self.system_blocks.pop(node_id, set())
             unblock = [self.captured.pop(i) for i in ids if i in self.captured]
             self._enqueue(unblock, index)
@@ -198,6 +213,7 @@ class BlockedEvals:
         with self._lock:
             if not self.enabled:
                 return
+            self.quota_unblock_indexes[quota] = index
             unblock = []
             for eval_id in list(self.captured):
                 ev = self.captured[eval_id]
@@ -229,6 +245,8 @@ class BlockedEvals:
             self.capacity_classes.clear()
             self.failed.clear()
             self.unblock_indexes.clear()
+            self.node_unblock_indexes.clear()
+            self.quota_unblock_indexes.clear()
             self.tokens.clear()
 
     def stats(self) -> Dict[str, int]:

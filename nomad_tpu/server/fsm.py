@@ -42,6 +42,7 @@ DEPLOYMENT_ALLOC_HEALTH = "deployment-alloc-health"
 DEPLOYMENT_DELETE = "deployment-delete"
 SCHEDULER_CONFIG = "scheduler-config"
 BATCH_NODE_UPDATE_DRAIN = "batch-node-update-drain"
+JOB_STABILITY = "job-stability"
 
 
 class NomadFSM:
@@ -155,6 +156,7 @@ class NomadFSM:
             deployment=payload.get("deployment"),
             deployment_updates=payload.get("deployment_updates"),
             eval_id=payload.get("eval_id", ""),
+            timestamp_ns=payload.get("timestamp_ns", 0),
         )
         if payload.get("preemption_evals"):
             self._apply_eval_update(index, payload["preemption_evals"])
@@ -181,14 +183,14 @@ class NomadFSM:
             self._apply_eval_update(index, [evaluation])
 
     def _apply_deployment_promote(self, index: int, payload):
-        deployment_id, groups, evaluation = payload
+        deployment_id, groups, description, evaluation = payload
         d = self.state.deployment_by_id(deployment_id)
         if d is not None:
             nd = d.copy()
             for group, dstate in nd.task_groups.items():
                 if groups is None or group in groups:
                     dstate.promoted = True
-            nd.status_description = "Deployment is running"
+            nd.status_description = description
             self.state.upsert_deployment(index, nd)
             # canaries lose canary status on promote
             for alloc_id in [
@@ -204,46 +206,9 @@ class NomadFSM:
 
     def _apply_deployment_alloc_health(self, index: int, payload):
         deployment_id, healthy_ids, unhealthy_ids, timestamp_ns, dstatus, evaluation = payload
-        from ..structs.structs import AllocDeploymentStatus
-
-        stored = self.state.deployment_by_id(deployment_id)
-        # never mutate the stored object: snapshots share it
-        d = stored.copy() if stored is not None else None
-        for alloc_id, healthy in [(i, True) for i in healthy_ids] + [
-            (i, False) for i in unhealthy_ids
-        ]:
-            alloc = self.state.alloc_by_id(alloc_id)
-            if alloc is None or alloc.deployment_id != deployment_id:
-                # A report for an alloc of another (e.g. superseded)
-                # deployment must not touch this deployment's counters.
-                continue
-            # Delta against the alloc's current health so duplicate reports
-            # don't inflate counts and a flip moves the old count over
-            # (reference state_store.go UpdateDeploymentAllocHealth deltas).
-            prev = (
-                alloc.deployment_status.healthy
-                if alloc.deployment_status is not None
-                else None
-            )
-            updated = alloc.copy_skip_job()  # deep copy: status safely mutable
-            if updated.deployment_status is None:
-                updated.deployment_status = AllocDeploymentStatus()
-            updated.deployment_status.healthy = healthy
-            updated.deployment_status.timestamp_ns = timestamp_ns
-            self.state.upsert_allocs(index, [updated])
-            if d is not None and prev is not healthy:
-                ds = d.task_groups.get(alloc.task_group)
-                if ds is not None:
-                    if healthy:
-                        ds.healthy_allocs += 1
-                        if prev is False:
-                            ds.unhealthy_allocs -= 1
-                    else:
-                        ds.unhealthy_allocs += 1
-                        if prev is True:
-                            ds.healthy_allocs -= 1
-        if d is not None:
-            self.state.upsert_deployment(index, d)
+        self.state.update_deployment_alloc_health(
+            index, deployment_id, healthy_ids, unhealthy_ids, timestamp_ns
+        )
         if dstatus is not None:
             self._apply_deployment_status_update(index, (dstatus, None, None))
         if evaluation is not None:
@@ -254,6 +219,10 @@ class NomadFSM:
 
     def _apply_scheduler_config(self, index: int, config: SchedulerConfiguration):
         self.state.scheduler_set_config(index, config)
+
+    def _apply_job_stability(self, index: int, payload):
+        namespace, job_id, version, stable = payload
+        self.state.update_job_stability(index, namespace, job_id, version, stable)
 
     def _apply_batch_node_drain(self, index: int, payload):
         for node_id, drain in payload.items():
@@ -291,4 +260,5 @@ _DISPATCH: Dict[str, Callable] = {
     DEPLOYMENT_DELETE: NomadFSM._apply_deployment_delete,
     SCHEDULER_CONFIG: NomadFSM._apply_scheduler_config,
     BATCH_NODE_UPDATE_DRAIN: NomadFSM._apply_batch_node_drain,
+    JOB_STABILITY: NomadFSM._apply_job_stability,
 }

@@ -14,6 +14,7 @@ import heapq
 import itertools
 import logging
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
@@ -215,6 +216,9 @@ class Planner:
             "deployment_updates": result.deployment_updates,
             "eval_id": plan.eval_id,
             "preemption_evals": preemption_evals,
+            # stamped pre-apply so every replica arms identical deployment
+            # progress deadlines
+            "timestamp_ns": time.time_ns(),
         }
         index, _ = self.raft.apply(self.peer, APPLY_PLAN_RESULTS, payload)
         result.alloc_index = index

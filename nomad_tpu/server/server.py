@@ -98,6 +98,10 @@ class Server:
         # transitions.
         self.fsm.timetable = self.timetable
 
+        from .deploymentwatcher import DeploymentsWatcher
+
+        self.deployment_watcher = DeploymentsWatcher(self)
+
         # Join before observing: the join-time election fires observers, and
         # start() handles the initial-leadership case explicitly.
         self.peer = self.raft.join(self.fsm)
@@ -149,6 +153,7 @@ class Server:
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
         self.heartbeaters.set_enabled(True)
+        self.deployment_watcher.set_enabled(True)
         self.fsm.on_eval_upserted = self._handle_upserted_eval
         self.fsm.on_capacity_change = self.blocked_evals.unblock
         self._restore_evals()
@@ -178,6 +183,7 @@ class Server:
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
         self.heartbeaters.set_enabled(False)
+        self.deployment_watcher.set_enabled(False)
         self._leader_generation += 1  # invalidates in-flight leader timers
         with self._lock:
             for t in self._leader_timers:

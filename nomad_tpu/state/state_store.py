@@ -10,6 +10,7 @@ variable (reference state_store.go:188 BlockingQuery).
 """
 from __future__ import annotations
 
+import copy
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -309,20 +310,55 @@ class StateStore:
     def update_allocs_from_client(self, index: int, allocs: List[Allocation]) -> None:
         """Client status sync (reference state_store.go:1933)."""
         with self._lock:
+            flips_by_deployment: Dict[str, List[Tuple[Optional[bool], Allocation]]] = {}
             for client_alloc in allocs:
                 existing = self.allocs_table.get(client_alloc.id)
                 if existing is None:
                     continue
+                prev_healthy = (
+                    existing.deployment_status.healthy
+                    if existing.deployment_status is not None
+                    else None
+                )
                 updated = existing.copy_skip_job()
                 updated.client_status = client_alloc.client_status
                 updated.client_description = client_alloc.client_description
                 updated.task_states = dict(client_alloc.task_states)
-                updated.deployment_status = client_alloc.deployment_status
+                # own the status object: never share with (or mutate) the
+                # caller's payload. A sync carrying no deployment_status keeps
+                # the recorded health — erasing it would orphan the counter
+                # delta and let a re-report double-count.
+                if client_alloc.deployment_status is not None:
+                    updated.deployment_status = copy.deepcopy(
+                        client_alloc.deployment_status
+                    )
                 updated.modify_index = index
                 updated.modify_time_ns = client_alloc.modify_time_ns
+                # A terminally failed alloc in a deployment counts as
+                # unhealthy even if the client never reported health
+                # (reference state_store.go: terminal status ⇒ unhealthy).
+                if (
+                    updated.deployment_id
+                    and updated.client_status == ALLOC_CLIENT_FAILED
+                    and (
+                        updated.deployment_status is None
+                        or updated.deployment_status.healthy is None
+                    )
+                ):
+                    from ..structs.structs import AllocDeploymentStatus
+
+                    if updated.deployment_status is None:
+                        updated.deployment_status = AllocDeploymentStatus()
+                    updated.deployment_status.healthy = False
                 self._remove_alloc_index(existing.id)
                 self.allocs_table[updated.id] = updated
                 self._index_alloc(updated)
+                if updated.deployment_id:
+                    flips_by_deployment.setdefault(updated.deployment_id, []).append(
+                        (prev_healthy, updated)
+                    )
+            for deployment_id, flips in flips_by_deployment.items():
+                self._apply_health_deltas(index, deployment_id, flips)
             self._bump(index)
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
@@ -438,8 +474,20 @@ class StateStore:
         deployment_updates: Optional[List] = None,
         eval_id: str = "",
         preempted_eval_ids: Optional[List[str]] = None,
+        timestamp_ns: int = 0,
     ) -> None:
         with self._lock:
+            # Which updates are *new to their deployment*? Decided against
+            # pre-upsert state so in-place updates of already-counted allocs
+            # don't inflate placement counters (reference
+            # state_store.go updateDeploymentWithAlloc).
+            newly_deployed = []
+            for alloc in alloc_updates:
+                if not alloc.deployment_id:
+                    continue
+                existing = self.allocs_table.get(alloc.id)
+                if existing is None or existing.deployment_id != alloc.deployment_id:
+                    newly_deployed.append(alloc)
             if deployment is not None:
                 existing = self.deployments_table.get(deployment.id)
                 if existing is not None:
@@ -460,7 +508,148 @@ class StateStore:
                     d.modify_index = index
                     self.deployments_table[d.id] = d
             self._upsert_allocs_impl(index, alloc_updates + allocs_stopped + allocs_preempted)
+            by_deployment: Dict[str, List[Allocation]] = {}
+            for alloc in newly_deployed:
+                by_deployment.setdefault(alloc.deployment_id, []).append(alloc)
+            for deployment_id, group in by_deployment.items():
+                self._update_deployment_placements(index, deployment_id, group, timestamp_ns)
             self._bump(index)
+
+    def _update_deployment_placements(
+        self, index: int, deployment_id: str, allocs: List[Allocation], timestamp_ns: int
+    ) -> None:
+        """Maintain placement counters on one deployment for a batch of newly
+        placed allocs (reference state_store.go updateDeploymentWithAlloc).
+        One deployment copy per plan, not per alloc: C1M-scale plans place
+        many allocs of the same deployment. ``timestamp_ns`` is stamped by
+        the plan applier before the raft apply so replicas and log replays
+        arm identical progress deadlines."""
+        d = self.deployments_table.get(deployment_id)
+        if d is None or not d.active():
+            return
+        d = d.copy()
+        changed = False
+        for alloc in allocs:
+            ds = d.task_groups.get(alloc.task_group)
+            if ds is None:
+                continue
+            changed = True
+            ds.placed_allocs += 1
+            if alloc.deployment_status is not None and alloc.deployment_status.canary:
+                if alloc.id not in ds.placed_canaries:
+                    ds.placed_canaries.append(alloc.id)
+            if ds.progress_deadline_ns > 0 and ds.require_progress_by_ns == 0:
+                ds.require_progress_by_ns = timestamp_ns + ds.progress_deadline_ns
+        if changed:
+            d.modify_index = index
+            self.deployments_table[d.id] = d
+
+    def update_deployment_alloc_health(
+        self,
+        index: int,
+        deployment_id: str,
+        healthy_ids: List[str],
+        unhealthy_ids: List[str],
+        timestamp_ns: int,
+    ) -> None:
+        """Apply explicit health reports to allocs + deployment counters
+        (reference state_store.go UpdateDeploymentAllocHealth)."""
+        from ..structs.structs import AllocDeploymentStatus
+
+        with self._lock:
+            updates: List[Allocation] = []
+            flips: List[Tuple[Optional[bool], Allocation]] = []
+            for alloc_id, healthy in [(i, True) for i in healthy_ids] + [
+                (i, False) for i in unhealthy_ids
+            ]:
+                alloc = self.allocs_table.get(alloc_id)
+                if alloc is None or alloc.deployment_id != deployment_id:
+                    # A report for an alloc of another (e.g. superseded)
+                    # deployment must not touch this deployment's counters.
+                    continue
+                prev = (
+                    alloc.deployment_status.healthy
+                    if alloc.deployment_status is not None
+                    else None
+                )
+                updated = alloc.copy_skip_job()
+                if updated.deployment_status is None:
+                    updated.deployment_status = AllocDeploymentStatus()
+                updated.deployment_status.healthy = healthy
+                updated.deployment_status.timestamp_ns = timestamp_ns
+                updates.append(updated)
+                flips.append((prev, updated))
+            self._upsert_allocs_impl(index, updates)
+            self._apply_health_deltas(index, deployment_id, flips)
+            self._bump(index)
+
+    def _apply_health_deltas(
+        self,
+        index: int,
+        deployment_id: str,
+        flips: List[Tuple[Optional[bool], Allocation]],
+    ) -> None:
+        """Delta a batch of health flips into one deployment's counters with
+        a single deployment copy (reference state_store.go
+        updateDeploymentWithAlloc health deltas); a newly healthy alloc also
+        extends the group progress deadline."""
+        d = self.deployments_table.get(deployment_id)
+        if d is None or not d.active():
+            return
+        d = d.copy()
+        changed = False
+        for prev_healthy, alloc in flips:
+            if alloc.deployment_status is None:
+                continue
+            healthy = alloc.deployment_status.healthy
+            if healthy is None or healthy is prev_healthy:
+                continue
+            ds = d.task_groups.get(alloc.task_group)
+            if ds is None:
+                continue
+            changed = True
+            if healthy:
+                ds.healthy_allocs += 1
+                if prev_healthy is False:
+                    ds.unhealthy_allocs -= 1
+                if ds.progress_deadline_ns > 0:
+                    ts = alloc.deployment_status.timestamp_ns or 0
+                    ds.require_progress_by_ns = max(
+                        ds.require_progress_by_ns, ts + ds.progress_deadline_ns
+                    )
+            else:
+                ds.unhealthy_allocs += 1
+                if prev_healthy is True:
+                    ds.healthy_allocs -= 1
+        if changed:
+            d.modify_index = index
+            self.deployments_table[d.id] = d
+
+    def update_job_stability(
+        self, index: int, namespace: str, job_id: str, version: int, stable: bool
+    ) -> None:
+        """Flag one job version (in place, no version bump) as stable
+        (reference state_store.go UpdateJobStability)."""
+        with self._lock:
+            key = (namespace, job_id)
+            versions = self.job_versions.get(key)
+            if versions is not None:
+                # copy-on-write: snapshots share the stored Job objects
+                self.job_versions[key] = [
+                    self._with_stability(j, index, stable) if j.version == version else j
+                    for j in versions
+                ]
+            current = self.jobs_table.get(key)
+            if current is not None and current.version == version:
+                self.jobs_table[key] = self._with_stability(current, index, stable)
+            self._bump(index)
+
+    @staticmethod
+    def _with_stability(job: Job, index: int, stable: bool) -> Job:
+        j = job.copy()
+        j.stable = stable
+        j.modify_index = index
+        return j
 
     # ------------------------------------------------------------------
     # job status summaries

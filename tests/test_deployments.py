@@ -1,0 +1,309 @@
+"""Deployment watcher tests: rolling updates, canaries, auto-promote,
+auto-revert, progress deadlines — reference nomad/deploymentwatcher/
+deployments_watcher_test.go scenarios against the in-process Server."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.deploymentwatcher import (
+    DESC_FAILED_ALLOCS,
+    DESC_NEWER_JOB,
+    DESC_PROGRESS_DEADLINE,
+    DESC_SUCCESSFUL,
+)
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN,
+    DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED,
+    DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    AllocDeploymentStatus,
+    UpdateStrategy,
+)
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(num_schedulers=2, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    s.start()
+    yield s
+    s.stop()
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def deploy_job(server, count=3, canary=0, auto_revert=False, auto_promote=False):
+    """Register an updating service job; returns (job, deployment)."""
+    for _ in range(count + 2):
+        server.register_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].update = UpdateStrategy(
+        max_parallel=count,
+        canary=canary,
+        auto_revert=auto_revert,
+        auto_promote=auto_promote,
+        progress_deadline_ns=10 * 60 * 10**9,
+    )
+    job.update = job.task_groups[0].update
+    server.register_job(job)
+    wait_for(
+        lambda: server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id)
+        is not None,
+        msg="deployment created",
+    )
+    return job, server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id)
+
+
+def running_allocs(server, job):
+    return [
+        a
+        for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+        if a.desired_status == ALLOC_DESIRED_RUN
+    ]
+
+
+def report_health(server, allocs, healthy=True):
+    """Simulate the client's allochealth hook: status sync with health set."""
+    updates = []
+    for a in allocs:
+        u = a.copy_skip_job()
+        u.client_status = ALLOC_CLIENT_RUNNING
+        u.deployment_status = AllocDeploymentStatus(
+            healthy=healthy, timestamp_ns=time.time_ns(),
+            canary=(a.deployment_status.canary if a.deployment_status else False),
+        )
+        updates.append(u)
+    server.update_allocs_from_client(updates)
+
+
+def test_deployment_success_marks_job_stable(server):
+    job, d = deploy_job(server, count=3)
+    wait_for(lambda: len(running_allocs(server, job)) == 3, msg="3 placed")
+    d = server.fsm.state.deployment_by_id(d.id)
+    assert d.status == DEPLOYMENT_STATUS_RUNNING
+    assert d.task_groups["web"].placed_allocs == 3
+    assert d.task_groups["web"].require_progress_by_ns > 0
+
+    report_health(server, running_allocs(server, job))
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d.id).status
+        == DEPLOYMENT_STATUS_SUCCESSFUL,
+        msg="deployment successful",
+    )
+    assert server.fsm.state.deployment_by_id(d.id).status_description == DESC_SUCCESSFUL
+    assert server.fsm.state.job_by_id(job.namespace, job.id).stable is True
+
+
+def test_unhealthy_alloc_fails_deployment_and_auto_reverts(server):
+    # v0: healthy + stable
+    job, d0 = deploy_job(server, count=2, auto_revert=True)
+    wait_for(lambda: len(running_allocs(server, job)) == 2, msg="v0 placed")
+    report_health(server, running_allocs(server, job))
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d0.id).status
+        == DEPLOYMENT_STATUS_SUCCESSFUL,
+        msg="v0 successful",
+    )
+
+    # v1: destructive update, goes unhealthy
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].env = {"FOO": "v2"}
+    server.register_job(job2)
+    wait_for(
+        lambda: (
+            (d := server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id))
+            is not None
+            and d.id != d0.id
+            and d.task_groups["web"].placed_allocs >= 2
+        ),
+        msg="v1 deployment placing",
+    )
+    d1 = server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id)
+    fresh = [a for a in running_allocs(server, job) if a.deployment_id == d1.id]
+    report_health(server, fresh, healthy=False)
+
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d1.id).status
+        == DEPLOYMENT_STATUS_FAILED,
+        msg="v1 failed",
+    )
+    d1 = server.fsm.state.deployment_by_id(d1.id)
+    assert DESC_FAILED_ALLOCS in d1.status_description
+    assert "rolling back to job version 0" in d1.status_description
+    # rollback re-registered v0's content as a fresh version
+    wait_for(
+        lambda: server.fsm.state.job_by_id(job.namespace, job.id).version > 1,
+        msg="rolled back job upserted",
+    )
+    rolled = server.fsm.state.job_by_id(job.namespace, job.id)
+    assert rolled.task_groups[0].tasks[0].env == {"FOO": "bar"}
+
+
+def test_canary_requires_promotion(server):
+    job, d = deploy_job(server, count=3, canary=1)
+    wait_for(lambda: len(running_allocs(server, job)) == 3, msg="initial placed")
+    report_health(server, running_allocs(server, job))
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d.id).status
+        == DEPLOYMENT_STATUS_SUCCESSFUL,
+        msg="initial deploy done",
+    )
+
+    # destructive update → only canaries placed until promotion
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].env = {"FOO": "canary"}
+    server.register_job(job2)
+    wait_for(
+        lambda: (
+            (nd := server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id))
+            is not None
+            and nd.id != d.id
+            and len(nd.task_groups["web"].placed_canaries) == 1
+        ),
+        msg="canary placed",
+    )
+    d2 = server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id)
+    assert d2.requires_promotion()
+
+    canary_allocs = [
+        server.fsm.state.alloc_by_id(i) for i in d2.task_groups["web"].placed_canaries
+    ]
+    report_health(server, canary_allocs)
+    time.sleep(0.3)
+    # healthy canary alone must NOT complete the deployment
+    assert (
+        server.fsm.state.deployment_by_id(d2.id).status == DEPLOYMENT_STATUS_RUNNING
+    )
+
+    server.deployment_watcher.promote(d2.id)
+    wait_for(
+        lambda: not server.fsm.state.deployment_by_id(d2.id).requires_promotion(),
+        msg="promoted",
+    )
+    # promotion unleashes the rest of the rolling update
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d2.id).task_groups["web"].placed_allocs
+        >= 3,
+        msg="remaining allocs placed after promote",
+    )
+    fresh = [a for a in running_allocs(server, job) if a.deployment_id == d2.id]
+    report_health(server, fresh)
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d2.id).status
+        == DEPLOYMENT_STATUS_SUCCESSFUL,
+        msg="canary deployment successful",
+    )
+
+
+def test_auto_promote(server):
+    job, d = deploy_job(server, count=2, canary=1, auto_promote=True)
+    wait_for(lambda: len(running_allocs(server, job)) == 2, msg="initial placed")
+    report_health(server, running_allocs(server, job))
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d.id).status
+        == DEPLOYMENT_STATUS_SUCCESSFUL,
+        msg="initial done",
+    )
+
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].env = {"FOO": "auto"}
+    server.register_job(job2)
+    wait_for(
+        lambda: (
+            (nd := server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id))
+            is not None
+            and nd.id != d.id
+            and len(nd.task_groups["web"].placed_canaries) == 1
+        ),
+        msg="canary placed",
+    )
+    d2 = server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id)
+    canary_allocs = [
+        server.fsm.state.alloc_by_id(i) for i in d2.task_groups["web"].placed_canaries
+    ]
+    report_health(server, canary_allocs)
+    # watcher auto-promotes, scheduler finishes the rollout
+    wait_for(
+        lambda: not server.fsm.state.deployment_by_id(d2.id).requires_promotion(),
+        msg="auto-promoted",
+    )
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d2.id).task_groups["web"].placed_allocs
+        >= 2,
+        msg="rollout continues",
+    )
+    fresh = [a for a in running_allocs(server, job) if a.deployment_id == d2.id]
+    report_health(server, fresh)
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d2.id).status
+        == DEPLOYMENT_STATUS_SUCCESSFUL,
+        msg="successful",
+    )
+
+
+def test_progress_deadline_fails_deployment(server):
+    job, d = deploy_job(server, count=2)
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d.id).task_groups["web"].placed_allocs
+        == 2,
+        msg="placed",
+    )
+    # no health reports; force the clock past the deadline
+    far_future = time.time_ns() + 11 * 60 * 10**9
+    server.deployment_watcher.tick(now_ns=far_future)
+    d = server.fsm.state.deployment_by_id(d.id)
+    assert d.status == DEPLOYMENT_STATUS_FAILED
+    assert DESC_PROGRESS_DEADLINE in d.status_description
+
+
+def test_pause_blocks_auto_actions_and_fail_endpoint(server):
+    job, d = deploy_job(server, count=2)
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d.id).task_groups["web"].placed_allocs
+        == 2,
+        msg="placed",
+    )
+    server.deployment_watcher.pause(d.id, True)
+    assert server.fsm.state.deployment_by_id(d.id).status == DEPLOYMENT_STATUS_PAUSED
+    # paused deployments ignore the progress deadline
+    server.deployment_watcher.tick(now_ns=time.time_ns() + 11 * 60 * 10**9)
+    assert server.fsm.state.deployment_by_id(d.id).status == DEPLOYMENT_STATUS_PAUSED
+
+    server.deployment_watcher.pause(d.id, False)
+    assert server.fsm.state.deployment_by_id(d.id).status == DEPLOYMENT_STATUS_RUNNING
+
+    server.deployment_watcher.fail(d.id)
+    assert server.fsm.state.deployment_by_id(d.id).status == DEPLOYMENT_STATUS_FAILED
+
+
+def test_newer_job_version_cancels_deployment(server):
+    job, d = deploy_job(server, count=2)
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d.id).task_groups["web"].placed_allocs
+        == 2,
+        msg="placed",
+    )
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].env = {"FOO": "newer"}
+    server.register_job(job2)
+    wait_for(
+        lambda: server.fsm.state.deployment_by_id(d.id).status
+        == DEPLOYMENT_STATUS_CANCELLED,
+        msg="old deployment cancelled",
+    )
+    assert (
+        server.fsm.state.deployment_by_id(d.id).status_description == DESC_NEWER_JOB
+    )

@@ -400,3 +400,69 @@ def test_deployment_alloc_health_counts_are_idempotent():
     ds = fsm.state.deployment_by_id(d.id).task_groups[alloc.task_group]
     assert ds.healthy_allocs == 0
     assert ds.unhealthy_allocs == 1
+
+
+def test_client_sync_without_health_preserves_counters():
+    """A status sync carrying no deployment_status must not erase recorded
+    health — otherwise a later re-report double-counts healthy_allocs."""
+    from nomad_tpu.server.fsm import DEPLOYMENT_ALLOC_HEALTH, NomadFSM
+    from nomad_tpu.structs.structs import Deployment, DeploymentState
+
+    fsm = NomadFSM()
+    node = mock.node()
+    fsm.state.upsert_node(1, node)
+    job = mock.job()
+    fsm.state.upsert_job(2, job)
+    alloc = mock.alloc()
+    alloc.namespace, alloc.job_id, alloc.job = job.namespace, job.id, job
+    alloc.node_id = node.id
+    alloc.task_group = job.task_groups[0].name
+    d = Deployment(
+        job_id=job.id,
+        namespace=job.namespace,
+        job_version=job.version,
+        task_groups={alloc.task_group: DeploymentState(desired_total=2)},
+        status="running",
+    )
+    fsm.state.upsert_deployment(3, d)
+    alloc.deployment_id = d.id
+    fsm.state.upsert_allocs(4, [alloc])
+
+    fsm.apply(5, DEPLOYMENT_ALLOC_HEALTH, (d.id, [alloc.id], [], 0, None, None))
+    assert fsm.state.deployment_by_id(d.id).task_groups[alloc.task_group].healthy_allocs == 1
+
+    # plain client sync with no deployment_status
+    sync = alloc.copy_skip_job()
+    sync.client_status = ALLOC_CLIENT_RUNNING
+    sync.deployment_status = None
+    fsm.state.update_allocs_from_client(6, [sync])
+    stored = fsm.state.alloc_by_id(alloc.id)
+    assert stored.deployment_status is not None and stored.deployment_status.healthy is True
+
+    # duplicate health report must still be a no-op
+    fsm.apply(7, DEPLOYMENT_ALLOC_HEALTH, (d.id, [alloc.id], [], 0, None, None))
+    assert fsm.state.deployment_by_id(d.id).task_groups[alloc.task_group].healthy_allocs == 1
+
+
+def test_node_capacity_event_racing_block_is_not_lost():
+    """unblock_node firing between eval creation and block() must be caught
+    by the missed-unblock witness (system-scheduler analog of the class
+    capacity race)."""
+    from nomad_tpu.server.blocked_evals import BlockedEvals
+    from nomad_tpu.server.eval_broker import EvalBroker
+    from nomad_tpu.structs.structs import Evaluation
+
+    broker = EvalBroker()
+    broker.set_enabled(True)
+    blocked = BlockedEvals(broker)
+    blocked.set_enabled(True)
+
+    ev = Evaluation(type="system", job_id="sysjob", node_id="node-1",
+                    status=EVAL_STATUS_BLOCKED, snapshot_index=10)
+    # capacity appears on the node AFTER the eval's snapshot but BEFORE block()
+    blocked.unblock_node("node-1", 12)
+    blocked.block(ev)
+    # the eval must have been re-enqueued, not left blocked
+    assert blocked.stats()["total_blocked"] == 0
+    dequeued, token = broker.dequeue(["system"], timeout=1.0)
+    assert dequeued is not None and dequeued.job_id == "sysjob"
