@@ -43,6 +43,7 @@ DEPLOYMENT_DELETE = "deployment-delete"
 SCHEDULER_CONFIG = "scheduler-config"
 BATCH_NODE_UPDATE_DRAIN = "batch-node-update-drain"
 JOB_STABILITY = "job-stability"
+PERIODIC_LAUNCH = "periodic-launch"
 
 
 class NomadFSM:
@@ -84,8 +85,8 @@ class NomadFSM:
             self.on_capacity_change(node.computed_class, index)
 
     def _apply_node_drain_update(self, index: int, payload):
-        node_id, drain = payload
-        self.state.update_node_drain(index, node_id, drain)
+        node_id, drain, mark_eligible = payload
+        self.state.update_node_drain(index, node_id, drain, mark_eligible)
 
     def _apply_node_eligibility_update(self, index: int, payload):
         node_id, eligibility = payload
@@ -224,10 +225,14 @@ class NomadFSM:
         namespace, job_id, version, stable = payload
         self.state.update_job_stability(index, namespace, job_id, version, stable)
 
+    def _apply_periodic_launch(self, index: int, payload):
+        namespace, job_id, launch_ns = payload
+        self.state.upsert_periodic_launch(index, namespace, job_id, launch_ns)
+
     def _apply_batch_node_drain(self, index: int, payload):
-        for node_id, drain in payload.items():
+        for node_id, (drain, mark_eligible) in payload.items():
             try:
-                self.state.update_node_drain(index, node_id, drain)
+                self.state.update_node_drain(index, node_id, drain, mark_eligible)
             except KeyError:
                 pass
 
@@ -261,4 +266,5 @@ _DISPATCH: Dict[str, Callable] = {
     SCHEDULER_CONFIG: NomadFSM._apply_scheduler_config,
     BATCH_NODE_UPDATE_DRAIN: NomadFSM._apply_batch_node_drain,
     JOB_STABILITY: NomadFSM._apply_job_stability,
+    PERIODIC_LAUNCH: NomadFSM._apply_periodic_launch,
 }

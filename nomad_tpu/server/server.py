@@ -99,8 +99,12 @@ class Server:
         self.fsm.timetable = self.timetable
 
         from .deploymentwatcher import DeploymentsWatcher
+        from .drainer import NodeDrainer
+        from .periodic import PeriodicDispatch
 
         self.deployment_watcher = DeploymentsWatcher(self)
+        self.node_drainer = NodeDrainer(self)
+        self.periodic_dispatcher = PeriodicDispatch(self)
 
         # Join before observing: the join-time election fires observers, and
         # start() handles the initial-leadership case explicitly.
@@ -154,6 +158,8 @@ class Server:
         self.blocked_evals.set_enabled(True)
         self.heartbeaters.set_enabled(True)
         self.deployment_watcher.set_enabled(True)
+        self.node_drainer.set_enabled(True)
+        self.periodic_dispatcher.set_enabled(True)
         self.fsm.on_eval_upserted = self._handle_upserted_eval
         self.fsm.on_capacity_change = self.blocked_evals.unblock
         self._restore_evals()
@@ -184,6 +190,8 @@ class Server:
         self.blocked_evals.set_enabled(False)
         self.heartbeaters.set_enabled(False)
         self.deployment_watcher.set_enabled(False)
+        self.node_drainer.set_enabled(False)
+        self.periodic_dispatcher.set_enabled(False)
         self._leader_generation += 1  # invalidates in-flight leader timers
         with self._lock:
             for t in self._leader_timers:
@@ -312,8 +320,17 @@ class Server:
         self.raft_apply(NODE_STATUS_UPDATE, (node_id, status))
         self.create_node_evals(node_id)
 
-    def update_node_drain(self, node_id: str, drain: bool) -> None:
-        self.raft_apply(NODE_DRAIN_UPDATE, (node_id, drain))
+    def update_node_drain(self, node_id: str, drain) -> None:
+        """Node.UpdateDrain: ``drain`` is a DrainStrategy, True (default
+        strategy), or falsy to cancel. The force deadline is stamped here —
+        before the raft apply — so every replica agrees on it."""
+        from ..structs.structs import DrainStrategy
+
+        if drain is True:
+            drain = DrainStrategy()
+        if drain and drain.deadline_ns > 0 and drain.force_deadline_ns == 0:
+            drain.force_deadline_ns = time.time_ns() + drain.deadline_ns
+        self.raft_apply(NODE_DRAIN_UPDATE, (node_id, drain, not drain))
         if drain:
             self.create_node_evals(node_id)
 
@@ -350,8 +367,11 @@ class Server:
         """Job.Register: upsert + create an eval (job_endpoint.go:73)."""
         self.raft_apply(JOB_REGISTER, job)
         stored = self.fsm.state.job_by_id(job.namespace, job.id)
+        # track/update/untrack with the dispatcher on every registration so
+        # disabling a job's periodic stanza stops its launches (periodic.go:Add)
+        self.periodic_dispatcher.add(stored)
         if stored.is_periodic():
-            return ""  # periodic jobs spawn children at launch time
+            return ""  # children spawn at launch times
         ev = Evaluation(
             namespace=job.namespace,
             priority=job.priority,
@@ -369,6 +389,7 @@ class Server:
         job = self.fsm.state.job_by_id(namespace, job_id)
         self.raft_apply(JOB_DEREGISTER, (namespace, job_id, purge))
         self.blocked_evals.untrack(namespace, job_id)
+        self.periodic_dispatcher.remove(namespace, job_id)
         ev = Evaluation(
             namespace=namespace,
             priority=job.priority if job else 50,

@@ -45,6 +45,9 @@ class StateStore:
         self.allocs_table: Dict[str, Allocation] = {}
         self.evals_table: Dict[str, Evaluation] = {}
         self.deployments_table: Dict[str, Deployment] = {}
+        # (namespace, parent job id) -> last launch time ns (reference
+        # schema.go periodic_launch table)
+        self.periodic_launch_table: Dict[Tuple[str, str], int] = {}
         self.scheduler_config_entry: Optional[SchedulerConfiguration] = None
 
         # secondary indexes
@@ -72,6 +75,7 @@ class StateStore:
             snap.allocs_table = dict(self.allocs_table)
             snap.evals_table = dict(self.evals_table)
             snap.deployments_table = dict(self.deployments_table)
+            snap.periodic_launch_table = dict(self.periodic_launch_table)
             snap.scheduler_config_entry = self.scheduler_config_entry
             snap._allocs_by_node = {k: set(v) for k, v in self._allocs_by_node.items()}
             snap._allocs_by_job = {k: set(v) for k, v in self._allocs_by_job.items()}
@@ -142,18 +146,33 @@ class StateStore:
             self.nodes_table[node_id] = node
             self._bump(index)
 
-    def update_node_drain(self, index: int, node_id: str, drain: bool) -> None:
+    def update_node_drain(
+        self, index: int, node_id: str, drain, mark_eligible: bool = True
+    ) -> None:
+        """``drain`` is a DrainStrategy, True (default strategy), or a falsy
+        value ending the drain. A completed drain leaves the node ineligible
+        (reference nomad/drainer marks drain done without restoring
+        eligibility); pass mark_eligible=True only for operator-initiated
+        drain removal."""
         with self._lock:
             node = self.nodes_table.get(node_id)
             if node is None:
                 raise KeyError(f"node {node_id} not found")
-            node = node.copy()
-            node.drain = drain
-            from ..structs.structs import NODE_SCHED_ELIGIBLE, NODE_SCHED_INELIGIBLE
-
-            node.scheduling_eligibility = (
-                NODE_SCHED_INELIGIBLE if drain else NODE_SCHED_ELIGIBLE
+            from ..structs.structs import (
+                NODE_SCHED_ELIGIBLE,
+                NODE_SCHED_INELIGIBLE,
+                DrainStrategy,
             )
+
+            if drain is True:
+                drain = DrainStrategy()
+            node = node.copy()
+            node.drain_strategy = drain or None
+            node.drain = node.drain_strategy is not None
+            if node.drain:
+                node.scheduling_eligibility = NODE_SCHED_INELIGIBLE
+            elif mark_eligible:
+                node.scheduling_eligibility = NODE_SCHED_ELIGIBLE
             node.modify_index = index
             self.nodes_table[node_id] = node
             self._bump(index)
@@ -207,6 +226,7 @@ class StateStore:
         with self._lock:
             self.jobs_table.pop((namespace, job_id), None)
             self.job_versions.pop((namespace, job_id), None)
+            self.periodic_launch_table.pop((namespace, job_id), None)
             self._bump(index)
 
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
@@ -445,6 +465,25 @@ class StateStore:
     # ------------------------------------------------------------------
     # scheduler config
     # ------------------------------------------------------------------
+
+    def upsert_periodic_launch(
+        self, index: int, namespace: str, job_id: str, launch_ns: int
+    ) -> None:
+        with self._lock:
+            key = (namespace, job_id)
+            self.periodic_launch_table[key] = max(
+                self.periodic_launch_table.get(key, 0), launch_ns
+            )
+            self._bump(index)
+
+    def periodic_launch_by_id(self, namespace: str, job_id: str) -> int:
+        """Last recorded launch time ns, 0 if never launched."""
+        return self.periodic_launch_table.get((namespace, job_id), 0)
+
+    def delete_periodic_launch(self, index: int, namespace: str, job_id: str) -> None:
+        with self._lock:
+            self.periodic_launch_table.pop((namespace, job_id), None)
+            self._bump(index)
 
     def scheduler_config(self) -> Tuple[int, Optional[SchedulerConfiguration]]:
         cfg = self.scheduler_config_entry

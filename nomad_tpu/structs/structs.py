@@ -350,6 +350,24 @@ class HostVolume:
 
 
 @dataclass
+class DrainStrategy:
+    """How a node drain proceeds (reference structs.go DrainStrategy /
+    DrainSpec): ``deadline_ns`` is the grace duration (-1 forces an
+    immediate drain, 0 means no deadline); ``force_deadline_ns`` is the
+    wall-clock instant the drainer force-migrates everything, stamped by
+    the endpoint before the raft apply so replicas agree."""
+
+    deadline_ns: int = 60 * 60 * 10**9
+    ignore_system_jobs: bool = False
+    force_deadline_ns: int = 0
+
+    def deadline_passed(self, now_ns: int) -> bool:
+        if self.deadline_ns < 0:
+            return True
+        return self.force_deadline_ns > 0 and now_ns >= self.force_deadline_ns
+
+
+@dataclass
 class Node:
     """A client node (reference structs.go:1508)."""
 
@@ -367,6 +385,7 @@ class Node:
     status_description: str = ""
     scheduling_eligibility: str = NODE_SCHED_ELIGIBLE
     drain: bool = False
+    drain_strategy: Optional[DrainStrategy] = None
     computed_class: str = ""
     http_addr: str = ""
     create_index: int = 0

@@ -1,0 +1,370 @@
+"""Node drainer and periodic dispatcher tests (reference nomad/drainer/
+drainer_test.go + watch_jobs_test.go scenarios, nomad/periodic_test.go)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.periodic import CronExpr, next_launch_ns
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN,
+    NODE_SCHED_INELIGIBLE,
+    DrainStrategy,
+    MigrateStrategy,
+    PeriodicConfig,
+)
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(num_schedulers=2, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    s.start()
+    yield s
+    s.stop()
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def mark_running(server, job):
+    """Client sim: report every run-desired alloc as running."""
+    ups = []
+    for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True):
+        if a.desired_status == ALLOC_DESIRED_RUN and a.client_status != ALLOC_CLIENT_RUNNING:
+            u = a.copy_skip_job()
+            u.client_status = ALLOC_CLIENT_RUNNING
+            ups.append(u)
+    if ups:
+        server.update_allocs_from_client(ups)
+    return len(ups)
+
+
+# ---------------------------------------------------------------------------
+# drainer
+# ---------------------------------------------------------------------------
+
+
+def test_drain_migrates_allocs_and_completes(server):
+    nodes = [mock.node() for _ in range(3)]
+    for n in nodes:
+        server.register_node(n)
+    job = mock.job()
+    job.task_groups[0].count = 3
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+    server.register_job(job)
+    wait_for(lambda: len([
+        a for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+        if a.desired_status == ALLOC_DESIRED_RUN
+    ]) == 3, msg="3 placed")
+    mark_running(server, job)
+
+    victim = server.fsm.state.allocs_by_job(job.namespace, job.id, True)[0].node_id
+    server.update_node_drain(victim, DrainStrategy(deadline_ns=60 * 10**9))
+
+    # keep simulating the client while the drain progresses
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        mark_running(server, job)
+        node = server.fsm.state.node_by_id(victim)
+        if not node.drain:
+            break
+        time.sleep(0.05)
+    node = server.fsm.state.node_by_id(victim)
+    assert not node.drain, "drain did not complete"
+    assert node.drain_strategy is None
+    # drain completion leaves the node ineligible
+    assert node.scheduling_eligibility == NODE_SCHED_INELIGIBLE
+    live = [
+        a for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+        if a.desired_status == ALLOC_DESIRED_RUN and not a.terminal_status()
+    ]
+    assert len(live) == 3
+    assert all(a.node_id != victim for a in live)
+
+
+def test_drain_batches_respect_max_parallel():
+    """Unit: the first tick marks at most max_parallel per task group and no
+    more until replacements are healthy."""
+    s = Server(ServerConfig(num_schedulers=0, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    # no s.start(): drive the drainer by hand
+    node_a, node_b = mock.node(), mock.node()
+    s.register_node(node_a)
+    s.register_node(node_b)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=2)
+    s.fsm.state.upsert_job(10, job)
+    allocs = []
+    for i in range(4):
+        a = mock.alloc()
+        a.namespace, a.job_id, a.job = job.namespace, job.id, job
+        a.task_group = job.task_groups[0].name
+        a.node_id = node_a.id
+        a.client_status = ALLOC_CLIENT_RUNNING
+        allocs.append(a)
+    s.fsm.state.upsert_allocs(11, allocs)
+    s.update_node_drain(node_a.id, DrainStrategy(deadline_ns=3600 * 10**9))
+
+    s.node_drainer.tick()
+    marked = [
+        a for a in s.fsm.state.allocs_by_node(node_a.id)
+        if a.desired_transition.should_migrate()
+    ]
+    assert len(marked) == 2  # first batch == max_parallel
+
+    # replacements not up yet: a second tick must not widen the batch
+    s.node_drainer.tick()
+    marked = [
+        a for a in s.fsm.state.allocs_by_node(node_a.id)
+        if a.desired_transition.should_migrate()
+    ]
+    assert len(marked) == 2
+
+    # two replacements healthy on node B -> next batch of 2 unlocks
+    reps = []
+    for i in range(2):
+        r = mock.alloc()
+        r.namespace, r.job_id, r.job = job.namespace, job.id, job
+        r.task_group = job.task_groups[0].name
+        r.node_id = node_b.id
+        r.client_status = ALLOC_CLIENT_RUNNING
+        reps.append(r)
+    s.fsm.state.upsert_allocs(12, reps)
+    # the first batch stopped on the client
+    stopped = []
+    for a in marked:
+        u = a.copy_skip_job()
+        u.client_status = "complete"
+        stopped.append(u)
+    s.fsm.state.update_allocs_from_client(13, stopped)
+
+    s.node_drainer.tick()
+    fresh_marks = [
+        a for a in s.fsm.state.allocs_by_node(node_a.id)
+        if a.desired_transition.should_migrate() and not a.terminal_status()
+    ]
+    assert len(fresh_marks) == 2  # second batch unlocked
+    all_marked = [
+        a for a in s.fsm.state.allocs_by_node(node_a.id)
+        if a.desired_transition.should_migrate()
+    ]
+    assert len(all_marked) == 4
+
+
+def test_system_allocs_drain_last_and_deadline_forces():
+    s = Server(ServerConfig(num_schedulers=0, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    node = mock.node()
+    s.register_node(node)
+    svc = mock.job()
+    svc.task_groups[0].count = 1
+    s.fsm.state.upsert_job(10, svc)
+    sys_job = mock.system_job()
+    s.fsm.state.upsert_job(11, sys_job)
+
+    a_svc = mock.alloc()
+    a_svc.namespace, a_svc.job_id, a_svc.job = svc.namespace, svc.id, svc
+    a_svc.task_group = svc.task_groups[0].name
+    a_svc.node_id = node.id
+    a_svc.client_status = ALLOC_CLIENT_RUNNING
+    a_sys = mock.alloc()
+    a_sys.namespace, a_sys.job_id, a_sys.job = sys_job.namespace, sys_job.id, sys_job
+    a_sys.task_group = sys_job.task_groups[0].name
+    a_sys.node_id = node.id
+    a_sys.client_status = ALLOC_CLIENT_RUNNING
+    s.fsm.state.upsert_allocs(12, [a_svc, a_sys])
+
+    s.update_node_drain(node.id, DrainStrategy(deadline_ns=3600 * 10**9))
+    s.node_drainer.tick()
+    sys_alloc = s.fsm.state.alloc_by_id(a_sys.id)
+    assert not sys_alloc.desired_transition.should_migrate(), "system drained too early"
+    svc_alloc = s.fsm.state.alloc_by_id(a_svc.id)
+    assert svc_alloc.desired_transition.should_migrate()
+
+    # force past the deadline: the system alloc goes too
+    s.node_drainer.tick(now_ns=time.time_ns() + 2 * 3600 * 10**9)
+    sys_alloc = s.fsm.state.alloc_by_id(a_sys.id)
+    assert sys_alloc.desired_transition.should_migrate()
+
+
+def test_ignore_system_jobs_completes_with_system_left():
+    s = Server(ServerConfig(num_schedulers=0, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    node = mock.node()
+    s.register_node(node)
+    sys_job = mock.system_job()
+    s.fsm.state.upsert_job(10, sys_job)
+    a_sys = mock.alloc()
+    a_sys.namespace, a_sys.job_id, a_sys.job = sys_job.namespace, sys_job.id, sys_job
+    a_sys.task_group = sys_job.task_groups[0].name
+    a_sys.node_id = node.id
+    a_sys.client_status = ALLOC_CLIENT_RUNNING
+    s.fsm.state.upsert_allocs(11, [a_sys])
+
+    s.update_node_drain(
+        node.id, DrainStrategy(deadline_ns=3600 * 10**9, ignore_system_jobs=True)
+    )
+    s.node_drainer.tick()
+    node_after = s.fsm.state.node_by_id(node.id)
+    assert not node_after.drain, "drain should complete with only ignored system allocs"
+    sys_alloc = s.fsm.state.alloc_by_id(a_sys.id)
+    assert not sys_alloc.desired_transition.should_migrate()
+
+
+# ---------------------------------------------------------------------------
+# cron / periodic
+# ---------------------------------------------------------------------------
+
+
+def test_cron_expr_basics():
+    from datetime import datetime, timezone
+
+    utc = timezone.utc
+    e = CronExpr("*/15 * * * *")
+    nxt = e.next_after(datetime(2026, 7, 29, 10, 7, tzinfo=utc))
+    assert (nxt.hour, nxt.minute) == (10, 15)
+    e = CronExpr("0 12 * * *")
+    nxt = e.next_after(datetime(2026, 7, 29, 13, 0, tzinfo=utc))
+    assert (nxt.day, nxt.hour, nxt.minute) == (30, 12, 0)
+    # next-after is strict
+    nxt = e.next_after(datetime(2026, 7, 29, 12, 0, tzinfo=utc))
+    assert nxt.day == 30
+    # dow: 2026-08-03 is a Monday
+    e = CronExpr("30 6 * * 1")
+    nxt = e.next_after(datetime(2026, 7, 29, 0, 0, tzinfo=utc))
+    assert (nxt.month, nxt.day, nxt.hour, nxt.minute) == (8, 3, 6, 30)
+    with pytest.raises(ValueError):
+        CronExpr("* * * *")
+    with pytest.raises(ValueError):
+        CronExpr("61 * * * *")
+
+
+def test_periodic_job_launches_children(server):
+    for _ in range(2):
+        server.register_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.periodic = PeriodicConfig(enabled=True, spec="* * * * *")
+    server.register_job(job)
+
+    # registration returns no eval; the dispatcher tracks it
+    assert (job.namespace, job.id) in server.periodic_dispatcher.tracked
+    _, nxt = server.periodic_dispatcher.tracked[(job.namespace, job.id)]
+    assert nxt is not None and 0 < nxt - time.time_ns() <= 61 * 10**9
+
+    child_id = server.periodic_dispatcher.force_launch(job.namespace, job.id)
+    assert child_id is not None and child_id.startswith(f"{job.id}/periodic-")
+    wait_for(
+        lambda: len(server.fsm.state.allocs_by_job(job.namespace, child_id, True)) == 1,
+        msg="child scheduled",
+    )
+    child = server.fsm.state.job_by_id(job.namespace, child_id)
+    assert child.parent_id == job.id
+    assert not child.is_periodic()
+    assert server.fsm.state.periodic_launch_by_id(job.namespace, job.id) > 0
+
+
+def test_prohibit_overlap_skips_launch(server):
+    for _ in range(2):
+        server.register_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.periodic = PeriodicConfig(enabled=True, spec="* * * * *", prohibit_overlap=True)
+    server.register_job(job)
+
+    first = server.periodic_dispatcher.force_launch(job.namespace, job.id)
+    assert first is not None
+    wait_for(
+        lambda: len(server.fsm.state.allocs_by_job(job.namespace, first, True)) == 1,
+        msg="first child scheduled",
+    )
+    # child still live (allocs not terminal) -> overlap prohibited
+    second = server.periodic_dispatcher.force_launch(
+        job.namespace, job.id, launch_ns=time.time_ns() + 10**9
+    )
+    assert second is None
+
+
+def test_two_draining_nodes_share_max_parallel_budget():
+    """max_parallel is a per-task-group budget across ALL draining nodes,
+    not per node."""
+    s = Server(ServerConfig(num_schedulers=0, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    node_a, node_b = mock.node(), mock.node()
+    s.register_node(node_a)
+    s.register_node(node_b)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+    s.fsm.state.upsert_job(10, job)
+    allocs = []
+    for node in (node_a, node_b):
+        for _ in range(2):
+            a = mock.alloc()
+            a.namespace, a.job_id, a.job = job.namespace, job.id, job
+            a.task_group = job.task_groups[0].name
+            a.node_id = node.id
+            a.client_status = ALLOC_CLIENT_RUNNING
+            allocs.append(a)
+    s.fsm.state.upsert_allocs(11, allocs)
+    s.update_node_drain(node_a.id, DrainStrategy(deadline_ns=3600 * 10**9))
+    s.update_node_drain(node_b.id, DrainStrategy(deadline_ns=3600 * 10**9))
+
+    s.node_drainer.tick()
+    marked = [
+        a for a in s.fsm.state.allocs()
+        if a.desired_transition.should_migrate()
+    ]
+    assert len(marked) == 1  # one group budget, not one per node
+
+
+def test_overlap_releases_when_child_finishes(server):
+    for _ in range(2):
+        server.register_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.periodic = PeriodicConfig(enabled=True, spec="* * * * *", prohibit_overlap=True)
+    server.register_job(job)
+
+    first = server.periodic_dispatcher.force_launch(job.namespace, job.id)
+    wait_for(
+        lambda: len(server.fsm.state.allocs_by_job(job.namespace, first, True)) == 1,
+        msg="first child scheduled",
+    )
+    server.drain_evals()
+    # the batch-style child finishes: allocs terminal
+    ups = []
+    for a in server.fsm.state.allocs_by_job(job.namespace, first, True):
+        u = a.copy_skip_job()
+        u.client_status = "complete"
+        ups.append(u)
+    server.update_allocs_from_client(ups)
+    wait_for(
+        lambda: server.periodic_dispatcher.force_launch(
+            job.namespace, job.id, launch_ns=time.time_ns()
+        )
+        is not None,
+        msg="second launch allowed after child finished",
+    )
+
+
+def test_reregister_without_periodic_untracks(server):
+    job = mock.job()
+    job.periodic = PeriodicConfig(enabled=True, spec="* * * * *")
+    server.register_job(job)
+    assert (job.namespace, job.id) in server.periodic_dispatcher.tracked
+
+    job2 = job.copy()
+    job2.periodic = None
+    server.register_job(job2)
+    assert (job.namespace, job.id) not in server.periodic_dispatcher.tracked
