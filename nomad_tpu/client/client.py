@@ -1,0 +1,239 @@
+"""Client agent: node lifecycle + allocation execution.
+
+Fills the role of reference ``client/client.go`` (2,900 LoC): register
+(client.go:1670), heartbeat (:1433/:1700), watch allocations via blocking
+query (:1873 watchAllocations), diff + spawn/update/remove alloc runners
+(:2092 runAllocs), batched alloc status sync every 200ms (:1807 allocSync),
+and state restore on boot (:991). The server is reached through a
+``ServerProxy`` interface — in-process today, the RPC transport binds the
+same surface at the process boundary.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs.structs import (
+    ALLOC_DESIRED_RUN,
+    Allocation,
+    Node,
+)
+from .allocrunner import AllocRunner
+from .fingerprint import fingerprint_node
+from .state import MemDB, SqliteDB, StateDB
+
+ALLOC_SYNC_INTERVAL = 0.2  # client.go:90 allocSyncIntv
+
+
+@dataclass
+class ClientConfig:
+    state_dir: str = ""
+    datacenter: str = "dc1"
+    node_class: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    persist_state: bool = False
+    heartbeat_grace: float = 0.5
+
+
+class ServerProxy:
+    """The client⇆server RPC surface (the endpoints client.go dials)."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def register_node(self, node: Node) -> float:
+        return self.server.register_node(node)
+
+    def heartbeat(self, node_id: str) -> float:
+        return self.server.heartbeat(node_id)
+
+    def pull_allocs(self, node_id: str, min_index: int, timeout: float):
+        """Node.GetClientAllocs blocking query: (allocs, index)."""
+        state = self.server.fsm.state
+
+        def run(s):
+            return [self._with_job(s, a) for a in s.allocs_by_node(node_id)]
+
+        return state.blocking_query(run, min_index, timeout=timeout)
+
+    @staticmethod
+    def _with_job(state, alloc: Allocation) -> Allocation:
+        if alloc.job is None:
+            a = alloc.copy_skip_job()
+            a.job = state.job_by_id(alloc.namespace, alloc.job_id)
+            return a
+        return alloc
+
+    def update_allocs(self, allocs: List[Allocation]) -> None:
+        self.server.update_allocs_from_client(allocs)
+
+
+class Client:
+    def __init__(
+        self,
+        proxy: ServerProxy,
+        config: Optional[ClientConfig] = None,
+        node: Optional[Node] = None,
+    ) -> None:
+        self.config = config or ClientConfig()
+        self.proxy = proxy
+        if not self.config.state_dir:
+            self.config.state_dir = tempfile.mkdtemp(prefix="nomad-client-")
+        self.alloc_dir_base = os.path.join(self.config.state_dir, "allocs")
+
+        self.node = node or Node()
+        self.node.datacenter = self.config.datacenter
+        self.node.node_class = self.config.node_class
+        self.node.meta.update(self.config.meta)
+        fingerprint_node(self.node)
+
+        self.logger = logging.getLogger(f"nomad_tpu.client.{self.node.id[:8]}")
+        self.state_db: StateDB = (
+            SqliteDB(self.config.state_dir) if self.config.persist_state else MemDB()
+        )
+        self.allocrunners: Dict[str, AllocRunner] = {}
+        self._dirty: Dict[str, Allocation] = {}  # pending status syncs
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.heartbeat_ttl = 10.0
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._restore_state()
+        self.heartbeat_ttl = self.proxy.register_node(self.node)
+        for target, name in (
+            (self._heartbeat_loop, "heartbeat"),
+            (self._watch_allocations, "watchallocs"),
+            (self._alloc_sync_loop, "allocsync"),
+        ):
+            t = threading.Thread(target=target, name=f"client-{name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            runners = list(self.allocrunners.values())
+        for ar in runners:
+            ar.stop()
+        self.state_db.close()
+
+    # -- restore (client.go:991) -----------------------------------------
+
+    def _restore_state(self) -> None:
+        for alloc in self.state_db.get_all_allocations():
+            if alloc.terminal_status():
+                continue
+            ar = AllocRunner(
+                alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update
+            )
+            # re-attach live tasks where the driver supports it
+            handles = self.state_db.get_task_handles(alloc.id)
+            self.allocrunners[alloc.id] = ar
+            ar.run()
+            for task_name, handle in handles.items():
+                tr = ar.task_runners.get(task_name)
+                if tr is None:
+                    continue
+                try:
+                    tr.driver.recover_task(handle)
+                except Exception:  # noqa: BLE001
+                    self.logger.info("could not recover task %s", task_name)
+
+    # -- heartbeats (client.go:1700) -------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            interval = max(self.heartbeat_ttl / 2.0, 0.05)
+            if self._shutdown.wait(timeout=interval):
+                return
+            try:
+                self.heartbeat_ttl = self.proxy.heartbeat(self.node.id)
+            except Exception:  # noqa: BLE001
+                self.logger.warning("heartbeat failed; retrying")
+
+    # -- alloc watching (client.go:1873) ---------------------------------
+
+    def _watch_allocations(self) -> None:
+        index = 0
+        while not self._shutdown.is_set():
+            try:
+                allocs, index = self.proxy.pull_allocs(self.node.id, index, timeout=1.0)
+            except Exception:  # noqa: BLE001
+                if self._shutdown.wait(timeout=1.0):
+                    return
+                continue
+            self._run_allocs(allocs)
+
+    def _run_allocs(self, server_allocs: List[Allocation]) -> None:
+        """Diff server view vs runners (client.go:2092 runAllocs)."""
+        with self._lock:
+            existing = dict(self.allocrunners)
+        server_ids = {a.id for a in server_allocs}
+
+        for alloc in server_allocs:
+            ar = existing.get(alloc.id)
+            if ar is None:
+                if alloc.desired_status != ALLOC_DESIRED_RUN or alloc.terminal_status():
+                    continue
+                self._add_alloc(alloc)
+            elif alloc.modify_index > ar.alloc.modify_index:
+                ar.update(alloc)
+                self.state_db.put_allocation(alloc)
+
+        # server no longer knows these allocs (GC'd): destroy
+        for alloc_id, ar in existing.items():
+            if alloc_id not in server_ids:
+                ar.destroy()
+                self.state_db.delete_allocation(alloc_id)
+                with self._lock:
+                    self.allocrunners.pop(alloc_id, None)
+
+    def _add_alloc(self, alloc: Allocation) -> None:
+        ar = AllocRunner(
+            alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update
+        )
+        with self._lock:
+            self.allocrunners[alloc.id] = ar
+        self.state_db.put_allocation(alloc)
+        ar.run()
+        for name, tr in ar.task_runners.items():
+            if tr.handle is not None:
+                self.state_db.put_task_handle(alloc.id, name, tr.handle)
+        self._on_ar_update(ar)
+
+    # -- status sync (client.go:1807 allocSync) --------------------------
+
+    def _on_ar_update(self, ar: AllocRunner) -> None:
+        with self._lock:
+            self._dirty[ar.alloc.id] = ar.client_alloc()
+        for name, tr in ar.task_runners.items():
+            if tr.handle is not None:
+                self.state_db.put_task_handle(ar.alloc.id, name, tr.handle)
+
+    def _alloc_sync_loop(self) -> None:
+        while not self._shutdown.wait(timeout=ALLOC_SYNC_INTERVAL):
+            with self._lock:
+                if not self._dirty:
+                    continue
+                batch = list(self._dirty.values())
+                self._dirty.clear()
+            try:
+                self.proxy.update_allocs(batch)
+            except Exception:  # noqa: BLE001
+                with self._lock:  # retry next tick
+                    for a in batch:
+                        self._dirty.setdefault(a.id, a)
+
+    # -- introspection ---------------------------------------------------
+
+    def num_allocs(self) -> int:
+        with self._lock:
+            return len(self.allocrunners)

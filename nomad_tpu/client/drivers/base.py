@@ -1,0 +1,157 @@
+"""Task driver interface.
+
+Fills the role of reference ``plugins/drivers/driver.go:40 DriverPlugin``:
+TaskConfigSchema / Capabilities / Fingerprint / StartTask / WaitTask /
+StopTask / DestroyTask / RecoverTask / InspectTask / TaskStats / SignalTask /
+ExecTask. The reference runs drivers as go-plugin gRPC subprocesses; here
+drivers are in-process classes behind the same interface, so an
+out-of-process transport can wrap them without changing callers (the same
+boundary discipline as the scheduler's State/Planner interfaces).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# driver health (driver.go HealthState*)
+HEALTH_UNDETECTED = "undetected"
+HEALTH_UNHEALTHY = "unhealthy"
+HEALTH_HEALTHY = "healthy"
+
+
+@dataclass
+class Fingerprint:
+    health: str = HEALTH_HEALTHY
+    health_description: str = ""
+    attributes: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TaskConfig:
+    """What a driver needs to start a task (driver.go TaskConfig)."""
+
+    id: str = ""  # <alloc_id>/<task_name>
+    name: str = ""
+    alloc_id: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    config: Dict[str, Any] = field(default_factory=dict)  # driver-specific
+    task_dir: Optional[object] = None  # allocdir.TaskDir
+    stdout_path: str = ""
+    stderr_path: str = ""
+    cpu_limit: int = 0
+    memory_limit_mb: int = 0
+    user: str = ""
+
+
+@dataclass
+class ExitResult:
+    exit_code: int = 0
+    signal: int = 0
+    oom_killed: bool = False
+    err: str = ""
+
+    def successful(self) -> bool:
+        return self.exit_code == 0 and self.signal == 0 and not self.err
+
+
+@dataclass
+class TaskStatus:
+    id: str = ""
+    name: str = ""
+    state: str = "unknown"  # running | exited | unknown
+    started_at_ns: int = 0
+    completed_at_ns: int = 0
+    exit_result: Optional[ExitResult] = None
+
+
+@dataclass
+class TaskStats:
+    cpu_percent: float = 0.0
+    memory_rss_bytes: int = 0
+    timestamp_ns: int = 0
+
+
+@dataclass
+class Capabilities:
+    """driver.go Capabilities."""
+
+    send_signals: bool = False
+    exec: bool = False
+    fs_isolation: str = "none"  # none | chroot | image
+
+
+@dataclass
+class TaskHandle:
+    """Serializable handle for recovery after a client restart
+    (driver.go TaskHandle)."""
+
+    driver: str = ""
+    config: Optional[TaskConfig] = None
+    state: str = "running"
+    driver_state: Dict[str, Any] = field(default_factory=dict)  # e.g. pid
+
+
+class DriverError(Exception):
+    pass
+
+
+class Driver:
+    """Base driver (DriverPlugin). Subclasses register via ``register``."""
+
+    name = "base"
+    capabilities = Capabilities()
+
+    def fingerprint(self) -> Fingerprint:
+        """One-shot detection (the reference streams; the client polls)."""
+        return Fingerprint(
+            health=HEALTH_HEALTHY,
+            attributes={f"driver.{self.name}": "1"},
+        )
+
+    def start_task(self, cfg: TaskConfig) -> TaskHandle:
+        raise NotImplementedError
+
+    def wait_task(self, task_id: str, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        """Block until the task exits; None on timeout."""
+        raise NotImplementedError
+
+    def stop_task(self, task_id: str, timeout_s: float, signal: str = "SIGTERM") -> None:
+        raise NotImplementedError
+
+    def destroy_task(self, task_id: str, force: bool = False) -> None:
+        raise NotImplementedError
+
+    def inspect_task(self, task_id: str) -> TaskStatus:
+        raise NotImplementedError
+
+    def task_stats(self, task_id: str) -> TaskStats:
+        return TaskStats(timestamp_ns=time.time_ns())
+
+    def recover_task(self, handle: TaskHandle) -> None:
+        raise DriverError(f"driver {self.name} cannot recover tasks")
+
+    def signal_task(self, task_id: str, signal: str) -> None:
+        raise DriverError(f"driver {self.name} does not support signals")
+
+    def exec_task(self, task_id: str, cmd: List[str], timeout_s: float) -> Tuple[bytes, int]:
+        raise DriverError(f"driver {self.name} does not support exec")
+
+
+_REGISTRY: Dict[str, Callable[[], Driver]] = {}
+
+
+def register(name: str, factory: Callable[[], Driver]) -> None:
+    _REGISTRY[name] = factory
+
+
+def new_driver(name: str) -> Driver:
+    factory = _REGISTRY.get(name)
+    if factory is None:
+        raise DriverError(f"unknown driver {name!r} (have: {sorted(_REGISTRY)})")
+    return factory()
+
+
+def available_drivers() -> List[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,126 @@
+"""Scriptable mock driver for tests.
+
+Fills the role of reference ``drivers/mock/driver.go`` (928 LoC): a task
+"runs" for ``run_for`` seconds, exits with ``exit_code``, optionally errors
+on start (``start_error``), blocks for ``start_block_for``, and ignores the
+stop signal for ``kill_after`` (exercising kill-timeout escalation).
+Config keys mirror the reference's mock config stanza.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .base import (
+    Capabilities,
+    Driver,
+    DriverError,
+    ExitResult,
+    TaskConfig,
+    TaskHandle,
+    TaskStatus,
+    register,
+)
+
+
+class _MockTask:
+    def __init__(self, cfg: TaskConfig) -> None:
+        self.cfg = cfg
+        c = cfg.config
+        self.run_for = float(c.get("run_for", 0.0))
+        self.exit_code = int(c.get("exit_code", 0))
+        self.exit_signal = int(c.get("exit_signal", 0))
+        self.kill_after = float(c.get("kill_after", 0.0))
+        self.started_at = time.time_ns()
+        self.completed_at = 0
+        self.exit_result: Optional[ExitResult] = None
+        self.done = threading.Event()
+        self.kill_requested = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self) -> None:
+        deadline = time.monotonic() + self.run_for
+        while time.monotonic() < deadline:
+            if self.kill_requested.wait(timeout=0.01):
+                # honor the kill only after kill_after
+                time.sleep(self.kill_after)
+                self.exit_result = ExitResult(exit_code=0, signal=15)
+                break
+        if self.exit_result is None:
+            self.exit_result = ExitResult(exit_code=self.exit_code, signal=self.exit_signal)
+        self.completed_at = time.time_ns()
+        self.done.set()
+
+
+class MockDriver(Driver):
+    name = "mock"
+    capabilities = Capabilities(send_signals=True, exec=False, fs_isolation="none")
+
+    def __init__(self) -> None:
+        self.tasks: Dict[str, _MockTask] = {}
+
+    def start_task(self, cfg: TaskConfig) -> TaskHandle:
+        if cfg.config.get("start_error"):
+            raise DriverError(str(cfg.config["start_error"]))
+        block = float(cfg.config.get("start_block_for", 0.0))
+        if block:
+            time.sleep(block)
+        if cfg.id in self.tasks:
+            raise DriverError(f"task {cfg.id} already started")
+        self.tasks[cfg.id] = _MockTask(cfg)
+        return TaskHandle(driver=self.name, config=cfg, state="running")
+
+    def _get(self, task_id: str) -> _MockTask:
+        t = self.tasks.get(task_id)
+        if t is None:
+            raise DriverError(f"unknown task {task_id}")
+        return t
+
+    def wait_task(self, task_id: str, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        t = self._get(task_id)
+        if not t.done.wait(timeout=timeout):
+            return None
+        return t.exit_result
+
+    def stop_task(self, task_id: str, timeout_s: float, signal: str = "SIGTERM") -> None:
+        t = self._get(task_id)
+        t.kill_requested.set()
+        if not t.done.wait(timeout=timeout_s):
+            # force kill
+            t.exit_result = ExitResult(exit_code=0, signal=9)
+            t.completed_at = time.time_ns()
+            t.done.set()
+
+    def destroy_task(self, task_id: str, force: bool = False) -> None:
+        t = self.tasks.get(task_id)
+        if t is None:
+            return
+        if not t.done.is_set():
+            if not force:
+                raise DriverError(f"task {task_id} still running")
+            self.stop_task(task_id, 0.0)
+        del self.tasks[task_id]
+
+    def inspect_task(self, task_id: str) -> TaskStatus:
+        t = self._get(task_id)
+        return TaskStatus(
+            id=task_id,
+            name=t.cfg.name,
+            state="exited" if t.done.is_set() else "running",
+            started_at_ns=t.started_at,
+            completed_at_ns=t.completed_at,
+            exit_result=t.exit_result,
+        )
+
+    def signal_task(self, task_id: str, signal: str) -> None:
+        self._get(task_id)  # accept silently, like the reference mock
+
+    def recover_task(self, handle: TaskHandle) -> None:
+        # mock tasks die with the process; a recovered task is re-started
+        if handle.config is not None and handle.config.id not in self.tasks:
+            self.tasks[handle.config.id] = _MockTask(handle.config)
+
+
+register("mock", MockDriver)

@@ -1,0 +1,280 @@
+"""Task runner: one task's lifecycle on a client.
+
+Fills the role of reference ``client/allocrunner/taskrunner/`` —
+``task_runner.go:243 TaskRunner``, the prestart/poststart/exited/stop hook
+chain (task_runner_hooks.go:61), and the restart tracker
+(restarts/restarts.go). The hook set here is the subset with in-scope
+backends: validate, taskDir, env builder, dispatch payload, template-lite
+(env interpolation), artifacts (local file copy); logmon is folded into the
+drivers (stdout/stderr straight to the task log dir, reference logmon.go).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..structs.structs import RestartPolicy, Task, TaskState
+from .allocdir import TaskDir
+from .drivers.base import DriverError, ExitResult, TaskConfig, TaskHandle, new_driver
+from .taskenv import TaskEnvBuilder
+
+# task events (reference structs.go TaskEvent types)
+EV_RECEIVED = "Received"
+EV_TASK_SETUP = "Task Setup"
+EV_STARTED = "Started"
+EV_TERMINATED = "Terminated"
+EV_RESTARTING = "Restarting"
+EV_NOT_RESTARTING = "Not Restarting"
+EV_KILLING = "Killing"
+EV_KILLED = "Killed"
+EV_DRIVER_FAILURE = "Driver Failure"
+
+STATE_PENDING = "pending"
+STATE_RUNNING = "running"
+STATE_DEAD = "dead"
+
+
+class TaskEvent:
+    def __init__(self, type_: str, message: str = "") -> None:
+        self.type = type_
+        self.message = message
+        self.time_ns = time.time_ns()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"TaskEvent({self.type!r}, {self.message!r})"
+
+
+class RestartTracker:
+    """Restart policy decisions (reference restarts/restarts.go): up to
+    ``attempts`` restarts per ``interval``, then mode: "delay" waits out the
+    interval remainder, "fail" kills the task."""
+
+    def __init__(self, policy: RestartPolicy, batch: bool) -> None:
+        self.policy = policy or RestartPolicy()
+        self.batch = batch
+        self.count = 0
+        self.start_time_ns = 0
+
+    def next(self, exit_result: Optional[ExitResult], failure: bool) -> tuple:
+        """Returns (behavior, wait_s): behavior in restart|wait|kill."""
+        now = time.time_ns()
+        if self.start_time_ns == 0 or now - self.start_time_ns > self.policy.interval_ns:
+            self.count = 0
+            self.start_time_ns = now
+        # successful batch tasks don't restart; successful service tasks do
+        if exit_result is not None and exit_result.successful() and self.batch:
+            return ("kill", 0.0)
+        self.count += 1
+        delay = self._jitter(self.policy.delay_ns / 1e9)
+        if self.count <= self.policy.attempts:
+            return ("restart", delay)
+        if self.policy.mode == "fail":
+            return ("kill", 0.0)
+        # delay mode: wait out the rest of the interval, then a fresh window
+        remaining = (self.start_time_ns + self.policy.interval_ns - now) / 1e9
+        return ("wait", self._jitter(max(remaining, 0.0) + delay))
+
+    @staticmethod
+    def _jitter(base: float) -> float:
+        return base * (1.0 + random.random() * 0.25)
+
+
+class TaskRunner:
+    def __init__(
+        self,
+        alloc,
+        task: Task,
+        task_dir: TaskDir,
+        node=None,
+        on_state_change: Optional[Callable[[], None]] = None,
+        update_interval: float = 0.05,
+    ) -> None:
+        self.alloc = alloc
+        self.task = task
+        self.task_dir = task_dir
+        self.node = node
+        self.on_state_change = on_state_change
+        self.update_interval = update_interval
+        self.logger = logging.getLogger(f"nomad_tpu.taskrunner.{task.name}")
+
+        self.driver = new_driver(task.driver)
+        self.task_id = f"{alloc.id}/{task.name}"
+        self.handle: Optional[TaskHandle] = None
+        self.state = TaskState(state=STATE_PENDING)
+        self.events: List[TaskEvent] = []
+        self.kill_requested = threading.Event()
+        self.done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
+        policy = task.restart_policy or (tg.restart_policy if tg else None)
+        batch = bool(alloc.job and alloc.job.type == "batch")
+        self.restart_tracker = RestartTracker(policy, batch)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def run(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"taskrunner-{self.task.name}", daemon=True
+        )
+        self._thread.start()
+
+    def _emit(self, event: TaskEvent) -> None:
+        self.events.append(event)
+        self.state.restarts = max(0, self.restart_tracker.count - 1)
+        if self.on_state_change is not None:
+            self.on_state_change()
+
+    def _set_state(self, state: str, failed: bool = False) -> None:
+        self.state.state = state
+        if failed:
+            self.state.failed = True
+        if state == STATE_RUNNING and self.state.started_at_ns == 0:
+            self.state.started_at_ns = time.time_ns()
+        if state == STATE_DEAD:
+            self.state.finished_at_ns = time.time_ns()
+        if self.on_state_change is not None:
+            self.on_state_change()
+
+    def _run(self) -> None:
+        self._emit(TaskEvent(EV_RECEIVED))
+        try:
+            self._prestart()
+        except Exception as e:  # noqa: BLE001
+            self._emit(TaskEvent(EV_DRIVER_FAILURE, str(e)))
+            self._set_state(STATE_DEAD, failed=True)
+            self.done.set()
+            return
+
+        while not self.kill_requested.is_set():
+            try:
+                self._start_task()
+            except DriverError as e:
+                self._emit(TaskEvent(EV_DRIVER_FAILURE, str(e)))
+                behavior, wait_s = self.restart_tracker.next(None, failure=True)
+                if behavior == "kill" or not self._sleep(wait_s):
+                    self._set_state(STATE_DEAD, failed=True)
+                    break
+                self._emit(TaskEvent(EV_RESTARTING, f"in {wait_s:.1f}s"))
+                continue
+
+            self._set_state(STATE_RUNNING)
+            self._emit(TaskEvent(EV_STARTED))
+            result = self._wait_exit()
+            if result is None:  # killed
+                self._set_state(STATE_DEAD)
+                break
+            self._emit(
+                TaskEvent(
+                    EV_TERMINATED,
+                    f"exit_code={result.exit_code} signal={result.signal}",
+                )
+            )
+            behavior, wait_s = self.restart_tracker.next(result, failure=False)
+            if behavior == "kill":
+                self._set_state(STATE_DEAD, failed=not result.successful())
+                break
+            self._emit(TaskEvent(EV_RESTARTING, f"{behavior} {wait_s:.1f}s"))
+            if not self._sleep(wait_s):
+                self._set_state(STATE_DEAD)
+                break
+        else:
+            self._set_state(STATE_DEAD)
+        self.done.set()
+
+    def _sleep(self, seconds: float) -> bool:
+        """False if the kill arrived during the sleep."""
+        return not self.kill_requested.wait(timeout=seconds)
+
+    # -- hooks (task_runner_hooks.go subset) -----------------------------
+
+    def _prestart(self) -> None:
+        self._emit(TaskEvent(EV_TASK_SETUP))
+        # validate hook
+        if not self.task.driver:
+            raise ValueError("task has no driver")
+        # taskDir hook
+        self.task_dir.build()
+        # dispatch payload hook (parameterized jobs)
+        payload = self.alloc.job.payload if self.alloc.job else b""
+        if payload and self.task.dispatch_payload_file:
+            dest = os.path.join(self.task_dir.local_dir, self.task.dispatch_payload_file)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(payload)
+        # artifacts hook: local files only (go-getter's local protocol)
+        for art in self.task.artifacts or []:
+            src = art.get("source", "")
+            if src.startswith("file://"):
+                import shutil
+
+                shutil.copy(src[len("file://"):], self.task_dir.local_dir)
+
+    def _start_task(self) -> None:
+        env = (
+            TaskEnvBuilder(self.node, self.alloc, self.task)
+            .set_task_dirs(self.task_dir)
+            .build()
+        )
+        os.makedirs(self.task_dir.log_dir, exist_ok=True)
+        cfg = TaskConfig(
+            id=self.task_id,
+            name=self.task.name,
+            alloc_id=self.alloc.id,
+            env=env,
+            config=dict(self.task.config),
+            task_dir=self.task_dir,
+            stdout_path=os.path.join(
+                self.task_dir.log_dir, f"{self.task.name}.stdout.0"
+            ),
+            stderr_path=os.path.join(
+                self.task_dir.log_dir, f"{self.task.name}.stderr.0"
+            ),
+            cpu_limit=self.task.resources.cpu if self.task.resources else 0,
+            memory_limit_mb=self.task.resources.memory_mb if self.task.resources else 0,
+        )
+        # interpolate driver config values
+        builder = TaskEnvBuilder(self.node, self.alloc, self.task).set_task_dirs(self.task_dir)
+        cfg.config = {
+            k: builder.interpolate(v) if isinstance(v, str) else v
+            for k, v in cfg.config.items()
+        }
+        self.handle = self.driver.start_task(cfg)
+
+    def _wait_exit(self) -> Optional[ExitResult]:
+        while True:
+            result = self.driver.wait_task(self.task_id, timeout=self.update_interval)
+            if result is not None:
+                try:
+                    self.driver.destroy_task(self.task_id, force=True)
+                except DriverError:
+                    pass
+                return result
+            if self.kill_requested.is_set():
+                self._emit(TaskEvent(EV_KILLING))
+                kill_timeout = (self.task.kill_timeout_ns or 5 * 10**9) / 1e9
+                try:
+                    self.driver.stop_task(self.task_id, kill_timeout, self.task.kill_signal or "SIGTERM")
+                    self.driver.destroy_task(self.task_id, force=True)
+                except DriverError:
+                    pass
+                self._emit(TaskEvent(EV_KILLED))
+                return None
+
+    # -- external control ------------------------------------------------
+
+    def kill(self, timeout: float = 10.0) -> None:
+        self.kill_requested.set()
+        self.done.wait(timeout=timeout)
+
+    def restart(self) -> None:
+        """Restart in place (alloc restart CLI)."""
+        if self.handle is not None:
+            try:
+                self.driver.stop_task(self.task_id, 5.0)
+            except DriverError:
+                pass

@@ -91,7 +91,6 @@ class NodeDrainer:
         draining = [n for n in state.nodes() if n.drain and n.drain_strategy is not None]
         if not draining:
             return
-        draining_ids = {n.id for n in draining}
 
         to_migrate: List[Allocation] = []
         drain_complete: Dict[str, Tuple[None, bool]] = {}
@@ -107,9 +106,14 @@ class NodeDrainer:
             if complete:
                 drain_complete[node.id] = (None, False)  # stay ineligible
 
+        # force-marked allocs aren't in state yet this tick; the batch
+        # calculation must still see them as unavailable
+        force_marked_ids = {a.id for a in to_migrate}
         for (namespace, job_id, tg_name), group in service_pool.items():
             to_migrate.extend(
-                self._drain_batch_for_group(state, namespace, job_id, tg_name, group)
+                self._drain_batch_for_group(
+                    state, namespace, job_id, tg_name, group, force_marked_ids
+                )
             )
 
         if to_migrate:
@@ -175,10 +179,12 @@ class NodeDrainer:
         job_id: str,
         tg_name: str,
         on_node: List[Allocation],
+        force_marked_ids,
     ) -> List[Allocation]:
         """Pick the next drain batch for one task group: keep at least
         ``count - max_parallel`` healthy allocs at all times (reference
-        watch_jobs.go handleTaskGroup threshold count)."""
+        watch_jobs.go handleTaskGroup threshold count). ``force_marked_ids``
+        are allocs another node's passed deadline marked this same tick."""
         job = on_node[0].job or state.job_by_id(namespace, job_id)
         tg = job.lookup_task_group(tg_name) if job is not None else None
         if tg is None:
@@ -189,7 +195,7 @@ class NodeDrainer:
         for a in state.allocs_by_job(namespace, job_id, False):
             if a.task_group != tg_name or a.terminal_status():
                 continue
-            if a.desired_transition.should_migrate():
+            if a.desired_transition.should_migrate() or a.id in force_marked_ids:
                 continue  # scheduled to stop
             if a.client_status != ALLOC_CLIENT_RUNNING:
                 continue  # replacement still coming up

@@ -100,14 +100,25 @@ class CronExpr:
         return None
 
 
+def _tzinfo(name: str):
+    if not name or name.upper() == "UTC":
+        return timezone.utc
+    from zoneinfo import ZoneInfo
+
+    return ZoneInfo(name)
+
+
 def next_launch_ns(job: Job, after_ns: int) -> Optional[int]:
-    """Next launch time (ns) for a periodic job, strictly after ``after_ns``."""
+    """Next launch time (ns) for a periodic job, strictly after ``after_ns``.
+    The cron spec is evaluated on the wall clock of the job's configured
+    timezone (reference periodic.go Next + GetTimeZone)."""
     p = job.periodic
     if p is None or not p.enabled:
         return None
     if p.spec_type != "cron":
         raise ValueError(f"unsupported periodic spec_type {p.spec_type!r}")
-    after = datetime.fromtimestamp(after_ns / 1e9, tz=timezone.utc)
+    tz = _tzinfo(p.timezone)
+    after = datetime.fromtimestamp(after_ns / 1e9, tz=tz)
     nxt = CronExpr(p.spec).next_after(after)
     if nxt is None:
         return None
@@ -148,14 +159,29 @@ class PeriodicDispatch:
         t.start()
 
     def _restore(self) -> None:
-        """Track every periodic job, resuming from its recorded last launch
-        (reference leader.go:376 restorePeriodicDispatcher)."""
+        """Track every periodic job, resuming from its recorded last launch;
+        a launch missed while no leader was running fires immediately
+        (reference leader.go:376 restorePeriodicDispatcher force-runs
+        missed launches)."""
         state = self.server.fsm.state
         now = time.time_ns()
         for job in state.jobs():
-            if job.is_periodic() and not job.stopped():
-                last = state.periodic_launch_by_id(job.namespace, job.id)
-                self._track(job, max(last, now) if last else now)
+            if not (job.is_periodic() and not job.stopped()):
+                continue
+            last = state.periodic_launch_by_id(job.namespace, job.id)
+            if last:
+                try:
+                    missed = next_launch_ns(job, last)
+                except ValueError:
+                    continue
+                if missed is not None and missed <= now:
+                    try:
+                        self.force_launch(job.namespace, job.id, missed)
+                    except Exception:  # noqa: BLE001
+                        self.logger.exception("catch-up launch of %s failed", job.id)
+                        self._track(job, now)
+                    continue
+            self._track(job, max(last, now) if last else now)
 
     def add(self, job: Job) -> None:
         """Track (or update/untrack) a periodic job on registration
@@ -219,12 +245,7 @@ class PeriodicDispatch:
                     self._track(still_job, launch_ns)
 
     def _children(self, namespace: str, parent_id: str) -> List[Job]:
-        prefix = f"{parent_id}/periodic-"
-        return [
-            j
-            for j in self.server.fsm.state.jobs()
-            if j.namespace == namespace and j.id.startswith(prefix)
-        ]
+        return self.server.fsm.state.jobs_by_parent(namespace, parent_id)
 
     def _child_live(self, child: Job) -> bool:
         """A child is live while it has a non-terminal alloc or an eval still
@@ -273,6 +294,10 @@ class PeriodicDispatch:
             self.logger.info("skipping launch of %s: previous child live", job_id)
             return None
         child = self.derive_job(job, launch_ns)
-        self.server.raft_apply("periodic-launch", (namespace, job_id, launch_ns))
+        # register first: a failed registration must leave the slot
+        # unconsumed so the launch retries rather than silently vanishing
+        # (a dup after a crash between the two applies is caught by the
+        # overlap check / child id equality)
         self.server.register_job(child)
+        self.server.raft_apply("periodic-launch", (namespace, job_id, launch_ns))
         return child.id

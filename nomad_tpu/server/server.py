@@ -324,10 +324,14 @@ class Server:
         """Node.UpdateDrain: ``drain`` is a DrainStrategy, True (default
         strategy), or falsy to cancel. The force deadline is stamped here —
         before the raft apply — so every replica agrees on it."""
+        import copy as _copy
+
         from ..structs.structs import DrainStrategy
 
         if drain is True:
             drain = DrainStrategy()
+        elif drain:
+            drain = _copy.copy(drain)  # never mutate the caller's object
         if drain and drain.deadline_ns > 0 and drain.force_deadline_ns == 0:
             drain.force_deadline_ns = time.time_ns() + drain.deadline_ns
         self.raft_apply(NODE_DRAIN_UPDATE, (node_id, drain, not drain))

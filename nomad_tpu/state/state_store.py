@@ -56,6 +56,8 @@ class StateStore:
         self._allocs_by_eval: Dict[str, set] = {}
         self._evals_by_job: Dict[Tuple[str, str], set] = {}
         self._deployments_by_job: Dict[Tuple[str, str], set] = {}
+        # (namespace, parent job id) -> child job ids (periodic/dispatch)
+        self._jobs_by_parent: Dict[Tuple[str, str], set] = {}
 
     # ------------------------------------------------------------------
     # snapshots / blocking
@@ -82,6 +84,7 @@ class StateStore:
             snap._allocs_by_eval = {k: set(v) for k, v in self._allocs_by_eval.items()}
             snap._evals_by_job = {k: set(v) for k, v in self._evals_by_job.items()}
             snap._deployments_by_job = {k: set(v) for k, v in self._deployments_by_job.items()}
+            snap._jobs_by_parent = {k: set(v) for k, v in self._jobs_by_parent.items()}
             return snap
 
     def snapshot_min_index(self, index: int, timeout: float = 5.0) -> "StateStore":
@@ -220,13 +223,22 @@ class StateStore:
             # keep a bounded version history (reference keeps 6)
             if len(self.job_versions[key]) > 6:
                 self.job_versions[key] = self.job_versions[key][-6:]
+            if job.parent_id:
+                self._jobs_by_parent.setdefault(
+                    (job.namespace, job.parent_id), set()
+                ).add(job.id)
             self._bump(index)
 
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
-            self.jobs_table.pop((namespace, job_id), None)
+            job = self.jobs_table.pop((namespace, job_id), None)
             self.job_versions.pop((namespace, job_id), None)
             self.periodic_launch_table.pop((namespace, job_id), None)
+            if job is not None and job.parent_id:
+                children = self._jobs_by_parent.get((namespace, job.parent_id))
+                if children is not None:
+                    children.discard(job_id)
+            self._jobs_by_parent.pop((namespace, job_id), None)
             self._bump(index)
 
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
@@ -237,6 +249,15 @@ class StateStore:
             if j.version == version:
                 return j
         return None
+
+    def jobs_by_parent(self, namespace: str, parent_id: str) -> List[Job]:
+        """Child jobs of a periodic/parameterized parent (indexed)."""
+        ids = self._jobs_by_parent.get((namespace, parent_id), set())
+        return [
+            j
+            for j in (self.jobs_table.get((namespace, i)) for i in ids)
+            if j is not None
+        ]
 
     def jobs(self) -> List[Job]:
         return list(self.jobs_table.values())

@@ -338,8 +338,10 @@ class NodeReservedResources:
 
 @dataclass
 class DriverInfo:
+    name: str = ""
     detected: bool = False
     healthy: bool = False
+    health_description: str = ""
 
 
 @dataclass
@@ -542,6 +544,9 @@ class Task:
     vault: Optional[Dict[str, Any]] = None
     leader: bool = False
     kill_timeout_ns: int = 5 * 10**9
+    kill_signal: str = "SIGTERM"
+    restart_policy: Optional[RestartPolicy] = None
+    dispatch_payload_file: str = ""
 
 
 @dataclass
@@ -806,6 +811,17 @@ class Allocation:
     alloc_modify_index: int = 0
     create_time_ns: int = 0
     modify_time_ns: int = 0
+
+    def index(self) -> int:
+        """The trailing ``[N]`` of the alloc name (reference structs.go
+        AllocIndex / AllocName)."""
+        l, r = self.name.rfind("["), self.name.rfind("]")
+        if l == -1 or r == -1 or l >= r:
+            return -1
+        try:
+            return int(self.name[l + 1 : r])
+        except ValueError:
+            return -1
 
     # -- status ------------------------------------------------------------
 

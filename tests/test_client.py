@@ -1,0 +1,272 @@
+"""Client agent tests: drivers, task runner restart policy, alloc runner
+health, and the full server+client loop — reference client/client_test.go,
+allocrunner tests, drivers/mock + drivers/rawexec driver_test.go."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig, ServerProxy
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.drivers.base import TaskConfig, new_driver
+from nomad_tpu.client.taskenv import TaskEnvBuilder
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN,
+    RestartPolicy,
+    UpdateStrategy,
+)
+
+
+def wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def test_mock_driver_lifecycle():
+    d = new_driver("mock")
+    h = d.start_task(TaskConfig(id="t1", name="t", config={"run_for": 0.05, "exit_code": 3}))
+    assert h.state == "running"
+    res = d.wait_task("t1", timeout=5.0)
+    assert res.exit_code == 3
+    status = d.inspect_task("t1")
+    assert status.state == "exited"
+    d.destroy_task("t1")
+
+
+def test_raw_exec_driver_runs_real_process(tmp_path):
+    ad = AllocDir(str(tmp_path), "alloc1")
+    ad.build()
+    td = ad.new_task_dir("t")
+    td.build()
+    os.makedirs(td.log_dir, exist_ok=True)
+    d = new_driver("raw_exec")
+    cfg = TaskConfig(
+        id="t1",
+        name="t",
+        config={"command": "/bin/sh", "args": ["-c", "echo hello-$WHO"]},
+        env={"WHO": "nomad"},
+        task_dir=td,
+        stdout_path=os.path.join(td.log_dir, "t.stdout.0"),
+        stderr_path=os.path.join(td.log_dir, "t.stderr.0"),
+    )
+    d.start_task(cfg)
+    res = d.wait_task("t1", timeout=10.0)
+    assert res.exit_code == 0
+    with open(cfg.stdout_path) as f:
+        assert f.read().strip() == "hello-nomad"
+    d.destroy_task("t1")
+
+
+def test_raw_exec_stop_escalates_to_kill(tmp_path):
+    d = new_driver("raw_exec")
+    cfg = TaskConfig(
+        id="t1", name="t",
+        config={"command": "/bin/sh", "args": ["-c", "trap '' TERM; sleep 60"]},
+    )
+    d.start_task(cfg)
+    time.sleep(0.2)
+    start = time.monotonic()
+    d.stop_task("t1", timeout_s=0.5)
+    res = d.wait_task("t1", timeout=5.0)
+    assert time.monotonic() - start < 5.0
+    assert res.signal == 9  # escalated
+
+
+# ---------------------------------------------------------------------------
+# task env
+# ---------------------------------------------------------------------------
+
+
+def test_task_env_interpolation():
+    node = mock.node()
+    node.attributes["kernel.name"] = "linux"
+    alloc = mock.alloc()
+    job = mock.job()
+    alloc.job = job
+    alloc.job_id = job.id
+    alloc.task_group = job.task_groups[0].name
+    alloc.name = f"{job.id}.web[2]"
+    task = job.task_groups[0].tasks[0]
+    task.env = {"K": "${attr.kernel.name}", "NODE": "${node.datacenter}"}
+    env = TaskEnvBuilder(node, alloc, task).build()
+    assert env["K"] == "linux"
+    assert env["NODE"] == node.datacenter
+    assert env["NOMAD_ALLOC_INDEX"] == "2"
+    assert env["NOMAD_JOB_ID"] == job.id
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: server + client
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    s = Server(ServerConfig(num_schedulers=2, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    s.start()
+    c = Client(ServerProxy(s), ClientConfig(state_dir=str(tmp_path / "client")))
+    c.start()
+    yield s, c
+    c.shutdown()
+    s.stop()
+
+
+def batch_echo_job():
+    job = mock.job()
+    job.type = "batch"
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy.attempts = 0
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "echo done"]}
+    task.restart_policy = RestartPolicy(attempts=0, mode="fail")
+    return job
+
+
+def test_client_runs_real_job_end_to_end(cluster):
+    server, client = cluster
+    job = batch_echo_job()
+    server.register_job(job)
+    wait_for(
+        lambda: any(
+            a.client_status == ALLOC_CLIENT_COMPLETE
+            for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+        ),
+        msg="batch job completed via real subprocess",
+    )
+    allocs = server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+    states = allocs[0].task_states
+    assert states and all(s.successful() for s in states.values())
+
+
+def test_failing_task_reports_failed(cluster):
+    server, client = cluster
+    job = batch_echo_job()
+    job.task_groups[0].tasks[0].config = {"command": "/bin/sh", "args": ["-c", "exit 7"]}
+    server.register_job(job)
+    wait_for(
+        lambda: any(
+            a.client_status == ALLOC_CLIENT_FAILED
+            for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+        ),
+        msg="failed status synced",
+    )
+
+
+def test_service_job_health_feeds_deployment(cluster):
+    """The alloc health watcher reports healthy -> deployment succeeds with
+    no test-side simulation."""
+    server, client = cluster
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].update = UpdateStrategy(
+        max_parallel=2, min_healthy_time_ns=int(0.2e9)
+    )
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "sleep 300"]}
+    server.register_job(job)
+    wait_for(
+        lambda: (
+            (d := server.fsm.state.latest_deployment_by_job_id(job.namespace, job.id))
+            is not None
+            and d.status == "successful"
+        ),
+        timeout=20.0,
+        msg="deployment driven healthy by the client",
+    )
+    assert server.fsm.state.job_by_id(job.namespace, job.id).stable is True
+
+
+def test_stop_job_stops_allocs(cluster):
+    server, client = cluster
+    job = mock.job()
+    job.task_groups[0].count = 1
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "sleep 300"]}
+    server.register_job(job)
+    wait_for(
+        lambda: any(
+            a.client_status == ALLOC_CLIENT_RUNNING
+            for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+        ),
+        msg="running",
+    )
+    server.deregister_job(job.namespace, job.id)
+    wait_for(lambda: client.num_allocs() == 0 or all(
+        not tr.done.is_set() is False
+        for ar in client.allocrunners.values() for tr in ar.task_runners.values()
+    ), msg="runner stopped")
+    wait_for(
+        lambda: all(
+            a.client_terminal_status() or a.server_terminal_status()
+            for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+        ),
+        msg="allocs terminal after stop",
+    )
+
+
+def test_client_restart_recovers_allocs(tmp_path):
+    """Client restart: persisted state restores runners and re-attaches the
+    live process (client.go:991 restore + RecoverTask)."""
+    s = Server(ServerConfig(num_schedulers=2, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    s.start()
+    state_dir = str(tmp_path / "client")
+    c = Client(ServerProxy(s), ClientConfig(state_dir=state_dir, persist_state=True))
+    c.start()
+    try:
+        job = mock.job()
+        job.task_groups[0].count = 1
+        task = job.task_groups[0].tasks[0]
+        task.driver = "raw_exec"
+        task.config = {"command": "/bin/sh", "args": ["-c", "sleep 300"]}
+        server_job_ns, server_job_id = job.namespace, job.id
+        s.register_job(job)
+        wait_for(
+            lambda: any(
+                a.client_status == ALLOC_CLIENT_RUNNING
+                for a in s.fsm.state.allocs_by_job(server_job_ns, server_job_id, True)
+            ),
+            msg="running before restart",
+        )
+        pid = None
+        for ar in c.allocrunners.values():
+            for tr in ar.task_runners.values():
+                pid = tr.handle.driver_state.get("pid")
+        assert pid is not None
+
+        # "crash" the client without stopping tasks
+        c._shutdown.set()
+        c.state_db.close()
+
+        c2 = Client(
+            ServerProxy(s),
+            ClientConfig(state_dir=state_dir, persist_state=True),
+            node=c.node,
+        )
+        c2.start()
+        try:
+            assert c2.num_allocs() == 1
+            os.kill(pid, 0)  # original process still alive and re-attached
+        finally:
+            c2.shutdown()
+    finally:
+        c.shutdown()
+        s.stop()

@@ -368,3 +368,42 @@ def test_reregister_without_periodic_untracks(server):
     job2.periodic = None
     server.register_job(job2)
     assert (job.namespace, job.id) not in server.periodic_dispatcher.tracked
+
+
+def test_cron_respects_job_timezone():
+    from nomad_tpu.structs.structs import Job
+
+    job = mock.job()
+    job.periodic = PeriodicConfig(
+        enabled=True, spec="0 12 * * *", timezone="America/New_York"
+    )
+    # 2026-07-29 00:00 UTC; noon Eastern (EDT, UTC-4) == 16:00 UTC
+    from datetime import datetime, timezone as _tz
+
+    after_ns = int(datetime(2026, 7, 29, 0, 0, tzinfo=_tz.utc).timestamp() * 1e9)
+    nxt = next_launch_ns(job, after_ns)
+    launched = datetime.fromtimestamp(nxt / 1e9, tz=_tz.utc)
+    assert (launched.hour, launched.minute) == (16, 0)
+
+
+def test_missed_launch_fires_on_restore(server):
+    for _ in range(2):
+        server.register_node(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.periodic = PeriodicConfig(enabled=True, spec="0 3 * * *")
+    server.register_job(job)
+
+    # pretend the last launch was two days ago -> one launch was missed
+    two_days_ago = time.time_ns() - 2 * 24 * 3600 * 10**9
+    server.fsm.state.upsert_periodic_launch(
+        server.fsm.state.latest_index + 1, job.namespace, job.id, two_days_ago
+    )
+    server.periodic_dispatcher.set_enabled(False)
+    server.periodic_dispatcher.set_enabled(True)
+    wait_for(
+        lambda: len(server.fsm.state.jobs_by_parent(job.namespace, job.id)) >= 1,
+        msg="missed launch fired on restore",
+    )
+    child = server.fsm.state.jobs_by_parent(job.namespace, job.id)[0]
+    assert child.id.startswith(f"{job.id}/periodic-")
