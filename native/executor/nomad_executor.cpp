@@ -1,0 +1,186 @@
+// nomad-executor: out-of-process task executor.
+//
+// Fills the role of the reference's shared executor
+// (drivers/shared/executor/executor.go UniversalExecutor and the
+// libcontainer-based executor_linux.go:50): the driver fork-execs THIS
+// binary, which sets up isolation and supervises the real task so the task
+// survives a driver/client restart (re-attach by pid, the reference's
+// reattach config). Isolation applied before exec:
+//   - new session (setsid) => own process group for group signalling
+//   - rlimits (cpu seconds, address space, nofile) when requested
+//   - working directory, cleared/supplied environment
+//   - optional chroot (--chroot, needs privilege; skipped gracefully)
+// Status protocol: writes "<exit_code> <signal>\n" to --status-file when the
+// task exits (the driver's reaper tails it), and forwards SIGTERM/SIGINT to
+// the task group with a --kill-timeout escalation to SIGKILL.
+//
+// Build: g++ -O2 -std=c++17 nomad_executor.cpp -o nomad-executor
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/resource.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+pid_t child_pid = -1;
+double kill_timeout_s = 5.0;
+
+void forward_signal(int sig) {
+  if (child_pid <= 0) return;
+  kill(-child_pid, sig == SIGINT ? SIGTERM : sig);
+  if (sig == SIGTERM || sig == SIGINT) {
+    // escalation alarm: SIGKILL the group after the timeout
+    alarm((unsigned)(kill_timeout_s < 1 ? 1 : kill_timeout_s));
+  }
+}
+
+void on_alarm(int) {
+  if (child_pid > 0) kill(-child_pid, SIGKILL);
+}
+
+void write_status(const char* path, int exit_code, int sig) {
+  if (!path || !*path) return;
+  std::string tmp = std::string(path) + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) return;
+  fprintf(f, "%d %d\n", exit_code, sig);
+  fflush(f);
+  fsync(fileno(f));
+  fclose(f);
+  rename(tmp.c_str(), path);
+}
+
+void write_pid_file(const char* path, pid_t pid) {
+  if (!path || !*path) return;
+  FILE* f = fopen(path, "w");
+  if (!f) return;
+  fprintf(f, "%d", (int)pid);
+  fclose(f);
+}
+
+void usage() {
+  fprintf(stderr,
+          "usage: nomad-executor [--status-file F] [--pid-file F] [--stdout F]\n"
+          "  [--stderr F] [--cwd D] [--chroot D] [--kill-timeout S] [--rlimit-cpu S]\n"
+          "  [--rlimit-as BYTES] [--rlimit-nofile N] [--env K=V]... -- cmd args...\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* status_file = nullptr;
+  const char* pid_file = nullptr;
+  const char* stdout_path = nullptr;
+  const char* stderr_path = nullptr;
+  const char* cwd = nullptr;
+  const char* chroot_dir = nullptr;
+  long rlimit_cpu = 0, rlimit_as = 0, rlimit_nofile = 0;
+  std::vector<std::string> env;
+  int cmd_start = -1;
+
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&](const char* flag) -> const char* {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "%s needs a value\n", flag);
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--status-file") status_file = next("--status-file");
+    else if (a == "--pid-file") pid_file = next("--pid-file");
+    else if (a == "--stdout") stdout_path = next("--stdout");
+    else if (a == "--stderr") stderr_path = next("--stderr");
+    else if (a == "--cwd") cwd = next("--cwd");
+    else if (a == "--chroot") chroot_dir = next("--chroot");
+    else if (a == "--kill-timeout") kill_timeout_s = atof(next("--kill-timeout"));
+    else if (a == "--rlimit-cpu") rlimit_cpu = atol(next("--rlimit-cpu"));
+    else if (a == "--rlimit-as") rlimit_as = atol(next("--rlimit-as"));
+    else if (a == "--rlimit-nofile") rlimit_nofile = atol(next("--rlimit-nofile"));
+    else if (a == "--env") env.push_back(next("--env"));
+    else if (a == "--") { cmd_start = i + 1; break; }
+    else { usage(); return 2; }
+  }
+  if (cmd_start < 0 || cmd_start >= argc) {
+    usage();
+    return 2;
+  }
+
+  child_pid = fork();
+  if (child_pid < 0) {
+    perror("fork");
+    return 1;
+  }
+  if (child_pid == 0) {
+    // -- child: isolate, then exec the task --
+    setsid();
+    if (chroot_dir && *chroot_dir) {
+      if (chroot(chroot_dir) != 0 || chdir("/") != 0) {
+        // unprivileged: run unchrooted rather than fail the task
+        fprintf(stderr, "nomad-executor: chroot skipped: %s\n", strerror(errno));
+      }
+    }
+    if (cwd && chdir(cwd) != 0) {
+      fprintf(stderr, "nomad-executor: chdir(%s): %s\n", cwd, strerror(errno));
+      _exit(127);
+    }
+    auto set_rlim = [](int res, long v) {
+      if (v > 0) {
+        struct rlimit rl {(rlim_t)v, (rlim_t)v};
+        setrlimit(res, &rl);
+      }
+    };
+    set_rlim(RLIMIT_CPU, rlimit_cpu);
+    set_rlim(RLIMIT_AS, rlimit_as);
+    set_rlim(RLIMIT_NOFILE, rlimit_nofile);
+    if (stdout_path) {
+      int fd = open(stdout_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+      if (fd >= 0) { dup2(fd, 1); close(fd); }
+    }
+    if (stderr_path) {
+      int fd = open(stderr_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+      if (fd >= 0) { dup2(fd, 2); close(fd); }
+    }
+    if (!env.empty()) {
+      std::vector<char*> envp;
+      for (auto& e : env) envp.push_back(const_cast<char*>(e.c_str()));
+      envp.push_back(nullptr);
+      execvpe(argv[cmd_start], &argv[cmd_start], envp.data());
+    } else {
+      execvp(argv[cmd_start], &argv[cmd_start]);
+    }
+    fprintf(stderr, "nomad-executor: exec %s: %s\n", argv[cmd_start],
+            strerror(errno));
+    _exit(127);
+  }
+
+  // -- parent: supervise --
+  write_pid_file(pid_file, child_pid);  // task pgid, for external group kill
+  signal(SIGTERM, forward_signal);
+  signal(SIGINT, forward_signal);
+  signal(SIGALRM, on_alarm);
+
+  int status = 0;
+  while (waitpid(child_pid, &status, 0) < 0) {
+    if (errno != EINTR) {
+      write_status(status_file, 127, 0);
+      return 127;
+    }
+  }
+  int exit_code = WIFEXITED(status) ? WEXITSTATUS(status) : 0;
+  int sig = WIFSIGNALED(status) ? WTERMSIG(status) : 0;
+  // reap any stragglers in the group
+  kill(-child_pid, SIGKILL);
+  write_status(status_file, exit_code, sig);
+  return sig ? 128 + sig : exit_code;
+}

@@ -1,0 +1,334 @@
+// nomadlog: durable append-only segmented log for the replicated-log layer.
+//
+// Fills the role of the reference's vendored raft-boltdb log store
+// (nomad/server.go:1079 setupRaft wires hashicorp/raft to BoltDB). Design:
+// fixed-size segments of [u64 index][u32 len][u32 crc32c][payload] records,
+// an in-memory offset index rebuilt on open, torn-write recovery (scan stops
+// at the first record whose CRC fails and truncates the tail), and
+// prefix/suffix truncation for snapshot compaction and conflict repair.
+// Exposed as a C ABI consumed over ctypes.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC nomadlog.cpp -o libnomadlog.so
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// CRC-32C (Castagnoli), table-driven.
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+      crc_table[i] = c;
+    }
+  }
+} crc_init;
+
+uint32_t crc32c(const uint8_t* data, size_t len) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct RecordLoc {
+  int segment;       // index into Log::segments
+  uint64_t offset;   // record start offset in that segment file
+  uint32_t len;      // payload length
+};
+
+struct Segment {
+  std::string path;
+  uint64_t first_index;  // first record index (0 = empty)
+  int fd;
+  uint64_t size;
+};
+
+constexpr uint64_t kHeaderSize = 8 + 4 + 4;
+
+struct Log {
+  std::string dir;
+  uint64_t segment_bytes;
+  std::vector<Segment> segments;
+  std::map<uint64_t, RecordLoc> index;  // log index -> location
+  uint64_t first = 0, last = 0;
+  std::mutex mu;
+
+  ~Log() {
+    for (auto& s : segments)
+      if (s.fd >= 0) close(s.fd);
+  }
+};
+
+std::string segment_name(const std::string& dir, uint64_t first_index) {
+  char buf[64];
+  snprintf(buf, sizeof(buf), "%020llu.log", (unsigned long long)first_index);
+  return dir + "/" + buf;
+}
+
+// The compaction floor persists in <dir>/FIRST so records below it in a
+// still-active segment don't resurrect on reopen.
+uint64_t read_first_marker(const std::string& dir) {
+  FILE* f = fopen((dir + "/FIRST").c_str(), "r");
+  if (!f) return 0;
+  unsigned long long v = 0;
+  if (fscanf(f, "%llu", &v) != 1) v = 0;
+  fclose(f);
+  return v;
+}
+
+void write_first_marker(const std::string& dir, uint64_t v) {
+  std::string tmp = dir + "/FIRST.tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) return;
+  fprintf(f, "%llu", (unsigned long long)v);
+  fflush(f);
+  fsync(fileno(f));
+  fclose(f);
+  rename(tmp.c_str(), (dir + "/FIRST").c_str());
+}
+
+// Scan one segment, appending valid records to the in-memory index.
+// Returns the offset of the first invalid byte (for tail truncation).
+uint64_t scan_segment(Log* log, int seg_idx) {
+  Segment& seg = log->segments[seg_idx];
+  uint64_t off = 0;
+  uint8_t header[kHeaderSize];
+  std::vector<uint8_t> payload;
+  while (off + kHeaderSize <= seg.size) {
+    if (pread(seg.fd, header, kHeaderSize, off) != (ssize_t)kHeaderSize) break;
+    uint64_t idx;
+    uint32_t len, crc;
+    memcpy(&idx, header, 8);
+    memcpy(&len, header + 8, 4);
+    memcpy(&crc, header + 12, 4);
+    if (len > (1u << 30) || off + kHeaderSize + len > seg.size) break;
+    payload.resize(len);
+    if (len && pread(seg.fd, payload.data(), len, off + kHeaderSize) != (ssize_t)len)
+      break;
+    if (crc32c(payload.data(), len) != crc) break;  // torn write: stop
+    log->index[idx] = RecordLoc{seg_idx, off, len};
+    if (log->first == 0 || idx < log->first) log->first = idx;
+    if (idx > log->last) log->last = idx;
+    off += kHeaderSize + len;
+  }
+  return off;
+}
+
+int open_segment(Log* log, uint64_t first_index) {
+  Segment seg;
+  seg.path = segment_name(log->dir, first_index);
+  seg.first_index = first_index;
+  seg.fd = open(seg.path.c_str(), O_RDWR | O_CREAT, 0644);
+  if (seg.fd < 0) return -1;
+  struct stat st;
+  fstat(seg.fd, &st);
+  seg.size = (uint64_t)st.st_size;
+  log->segments.push_back(seg);
+  return (int)log->segments.size() - 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* nomadlog_open(const char* dir, uint64_t segment_bytes) {
+  Log* log = new Log();
+  log->dir = dir;
+  log->segment_bytes = segment_bytes ? segment_bytes : (64u << 20);
+  mkdir(dir, 0755);
+
+  std::vector<std::string> names;
+  if (DIR* d = opendir(dir)) {
+    while (dirent* e = readdir(d)) {
+      std::string n = e->d_name;
+      if (n.size() > 4 && n.substr(n.size() - 4) == ".log") names.push_back(n);
+    }
+    closedir(d);
+  }
+  std::sort(names.begin(), names.end());
+  for (auto& n : names) {
+    Segment seg;
+    seg.path = log->dir + "/" + n;
+    seg.first_index = strtoull(n.c_str(), nullptr, 10);
+    seg.fd = open(seg.path.c_str(), O_RDWR, 0644);
+    if (seg.fd < 0) continue;
+    struct stat st;
+    fstat(seg.fd, &st);
+    seg.size = (uint64_t)st.st_size;
+    log->segments.push_back(seg);
+  }
+  // rebuild the index; truncate a torn tail on the last segment
+  for (size_t i = 0; i < log->segments.size(); i++) {
+    uint64_t valid = scan_segment(log, (int)i);
+    if (i == log->segments.size() - 1 && valid < log->segments[i].size) {
+      if (ftruncate(log->segments[i].fd, (off_t)valid) == 0)
+        log->segments[i].size = valid;
+    }
+  }
+  // apply the persisted compaction floor
+  uint64_t floor = read_first_marker(log->dir);
+  if (floor > 0) {
+    for (auto it = log->index.begin();
+         it != log->index.end() && it->first < floor;)
+      it = log->index.erase(it);
+    log->first = log->index.empty() ? 0 : log->index.begin()->first;
+    if (log->index.empty()) log->last = 0;
+  }
+  return log;
+}
+
+uint64_t nomadlog_first_index(void* h) {
+  Log* log = (Log*)h;
+  std::lock_guard<std::mutex> g(log->mu);
+  return log->first;
+}
+
+uint64_t nomadlog_last_index(void* h) {
+  Log* log = (Log*)h;
+  std::lock_guard<std::mutex> g(log->mu);
+  return log->last;
+}
+
+int nomadlog_append(void* h, uint64_t index, const uint8_t* data, uint32_t len) {
+  Log* log = (Log*)h;
+  std::lock_guard<std::mutex> g(log->mu);
+  int seg_idx;
+  if (log->segments.empty() ||
+      log->segments.back().size + kHeaderSize + len > log->segment_bytes) {
+    seg_idx = open_segment(log, index);
+    if (seg_idx < 0) return -1;
+  } else {
+    seg_idx = (int)log->segments.size() - 1;
+  }
+  Segment& seg = log->segments[seg_idx];
+  uint8_t header[kHeaderSize];
+  uint32_t crc = crc32c(data, len);
+  memcpy(header, &index, 8);
+  memcpy(header + 8, &len, 4);
+  memcpy(header + 12, &crc, 4);
+  uint64_t off = seg.size;
+  if (pwrite(seg.fd, header, kHeaderSize, off) != (ssize_t)kHeaderSize) return -1;
+  if (len && pwrite(seg.fd, data, len, off + kHeaderSize) != (ssize_t)len) return -1;
+  seg.size += kHeaderSize + len;
+  log->index[index] = RecordLoc{seg_idx, off, len};
+  if (log->first == 0 || index < log->first) log->first = index;
+  if (index > log->last) log->last = index;
+  return 0;
+}
+
+int nomadlog_sync(void* h) {
+  Log* log = (Log*)h;
+  std::lock_guard<std::mutex> g(log->mu);
+  if (log->segments.empty()) return 0;
+  return fdatasync(log->segments.back().fd);
+}
+
+// Caller frees via nomadlog_free. Returns 0 on success, -1 if absent.
+int nomadlog_get(void* h, uint64_t index, uint8_t** out, uint32_t* out_len) {
+  Log* log = (Log*)h;
+  std::lock_guard<std::mutex> g(log->mu);
+  auto it = log->index.find(index);
+  if (it == log->index.end()) return -1;
+  const RecordLoc& loc = it->second;
+  uint8_t* buf = (uint8_t*)malloc(loc.len);
+  if (loc.len &&
+      pread(log->segments[loc.segment].fd, buf, loc.len,
+            loc.offset + kHeaderSize) != (ssize_t)loc.len) {
+    free(buf);
+    return -1;
+  }
+  *out = buf;
+  *out_len = loc.len;
+  return 0;
+}
+
+void nomadlog_free(uint8_t* p) { free(p); }
+
+// Drop entries with index < upto (snapshot compaction): deletes whole
+// segments whose records are all below the cutoff.
+int nomadlog_truncate_before(void* h, uint64_t upto) {
+  Log* log = (Log*)h;
+  std::lock_guard<std::mutex> g(log->mu);
+  std::vector<bool> keep(log->segments.size(), false);
+  for (auto& [idx, loc] : log->index)
+    if (idx >= upto) keep[loc.segment] = true;
+  std::vector<Segment> remaining;
+  std::vector<int> remap(log->segments.size(), -1);
+  for (size_t i = 0; i < log->segments.size(); i++) {
+    if (keep[i] || i == log->segments.size() - 1) {  // keep active segment
+      remap[i] = (int)remaining.size();
+      remaining.push_back(log->segments[i]);
+    } else {
+      close(log->segments[i].fd);
+      unlink(log->segments[i].path.c_str());
+    }
+  }
+  log->segments = std::move(remaining);
+  for (auto it = log->index.begin(); it != log->index.end();) {
+    if (it->first < upto) {
+      it = log->index.erase(it);
+    } else {
+      it->second.segment = remap[it->second.segment];
+      ++it;
+    }
+  }
+  log->first = log->index.empty() ? 0 : log->index.begin()->first;
+  if (log->index.empty()) log->last = 0;
+  write_first_marker(log->dir, upto);
+  return 0;
+}
+
+// Drop entries with index > from (conflict repair on raft divergence).
+// Raft only truncates a suffix of the append order, so the physical cut is
+// at the earliest removed record's position; everything after it goes.
+int nomadlog_truncate_after(void* h, uint64_t from) {
+  Log* log = (Log*)h;
+  std::lock_guard<std::mutex> g(log->mu);
+  int cut_seg = -1;
+  uint64_t cut_off = 0;
+  for (auto it = log->index.begin(); it != log->index.end();) {
+    if (it->first > from) {
+      if (cut_seg == -1 || it->second.segment < cut_seg ||
+          (it->second.segment == cut_seg && it->second.offset < cut_off)) {
+        cut_seg = it->second.segment;
+        cut_off = it->second.offset;
+      }
+      it = log->index.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  if (cut_seg >= 0) {
+    for (size_t i = cut_seg + 1; i < log->segments.size(); i++) {
+      close(log->segments[i].fd);
+      unlink(log->segments[i].path.c_str());
+    }
+    log->segments.resize(cut_seg + 1);
+    if (ftruncate(log->segments[cut_seg].fd, (off_t)cut_off) == 0)
+      log->segments[cut_seg].size = cut_off;
+  }
+  log->last = log->index.empty() ? 0 : log->index.rbegin()->first;
+  if (log->index.empty()) log->first = 0;
+  return 0;
+}
+
+void nomadlog_close(void* h) { delete (Log*)h; }
+
+}  // extern "C"

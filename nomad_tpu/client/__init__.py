@@ -8,6 +8,7 @@ from .taskrunner import TaskRunner
 
 # importing registers the built-in drivers
 from .drivers import base as _base  # noqa: F401
+from .drivers import exec_driver as _exec  # noqa: F401
 from .drivers import mock_driver as _mock  # noqa: F401
 from .drivers import raw_exec as _raw_exec  # noqa: F401
 

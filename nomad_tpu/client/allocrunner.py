@@ -56,7 +56,7 @@ class AllocRunner:
 
     # -- lifecycle -------------------------------------------------------
 
-    def run(self) -> None:
+    def run(self, recover_handles: Optional[Dict] = None) -> None:
         # prerun hooks: await previous alloc (upstream allocs hook), allocDir
         if self.prev_alloc_watcher is not None:
             self.prev_alloc_watcher()
@@ -70,6 +70,9 @@ class AllocRunner:
                 self.alloc, task, td, node=self.node, on_state_change=self._notify
             )
             self.task_runners[task.name] = tr
+            handle = (recover_handles or {}).get(task.name)
+            if handle is not None and not tr.recover(handle):
+                self.logger.info("task %s not recoverable; starting fresh", task.name)
         for tr in self.task_runners.values():
             tr.run()
         if self.alloc.deployment_id:

@@ -134,18 +134,11 @@ class Client:
             ar = AllocRunner(
                 alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update
             )
-            # re-attach live tasks where the driver supports it
+            # re-attach live tasks BEFORE the runners start, so a recovered
+            # task is waited on instead of started a second time
             handles = self.state_db.get_task_handles(alloc.id)
             self.allocrunners[alloc.id] = ar
-            ar.run()
-            for task_name, handle in handles.items():
-                tr = ar.task_runners.get(task_name)
-                if tr is None:
-                    continue
-                try:
-                    tr.driver.recover_task(handle)
-                except Exception:  # noqa: BLE001
-                    self.logger.info("could not recover task %s", task_name)
+            ar.run(recover_handles=handles)
 
     # -- heartbeats (client.go:1700) -------------------------------------
 

@@ -1,5 +1,5 @@
 """Task drivers (reference ``drivers/``): mock, raw_exec/exec."""
-from . import base, mock_driver, raw_exec  # noqa: F401  (registration side effects)
+from . import base, exec_driver, mock_driver, raw_exec  # noqa: F401  (registration side effects)
 from .base import Driver, DriverError, TaskConfig, TaskHandle, available_drivers, new_driver
 
 __all__ = [

@@ -46,10 +46,16 @@ class _MockTask:
             if self.kill_requested.wait(timeout=0.01):
                 # honor the kill only after kill_after
                 time.sleep(self.kill_after)
-                self.exit_result = ExitResult(exit_code=0, signal=15)
-                break
-        if self.exit_result is None:
-            self.exit_result = ExitResult(exit_code=self.exit_code, signal=self.exit_signal)
+                self._finish(ExitResult(exit_code=0, signal=15))
+                return
+        self._finish(ExitResult(exit_code=self.exit_code, signal=self.exit_signal))
+
+    def _finish(self, result: ExitResult) -> None:
+        # the force-kill path may have finished the task first; the first
+        # result wins and must not be overwritten
+        if self.done.is_set():
+            return
+        self.exit_result = result
         self.completed_at = time.time_ns()
         self.done.set()
 
@@ -88,10 +94,7 @@ class MockDriver(Driver):
         t = self._get(task_id)
         t.kill_requested.set()
         if not t.done.wait(timeout=timeout_s):
-            # force kill
-            t.exit_result = ExitResult(exit_code=0, signal=9)
-            t.completed_at = time.time_ns()
-            t.done.set()
+            t._finish(ExitResult(exit_code=0, signal=9))  # force kill
 
     def destroy_task(self, task_id: str, force: bool = False) -> None:
         t = self.tasks.get(task_id)

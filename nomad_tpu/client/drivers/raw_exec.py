@@ -51,6 +51,9 @@ class _ExecTask:
                 start_new_session=True,  # own process group for group-kill
             )
         except OSError as e:
+            for f in (self.stdout, self.stderr):
+                if hasattr(f, "close"):
+                    f.close()
             raise DriverError(f"failed to start {command}: {e}") from e
         self.cfg = cfg
         self.started_at = time.time_ns()
@@ -198,7 +201,3 @@ class RawExecDriver(Driver):
 
 
 register("raw_exec", RawExecDriver)
-# "exec" shares the implementation until the isolating native executor binds;
-# the reference separates them only by the libcontainer jail
-# (drivers/exec/driver.go vs drivers/rawexec/driver.go).
-register("exec", RawExecDriver)

@@ -41,7 +41,9 @@ class TaskEnvBuilder:
         self.alloc_dir = task_dir.shared_alloc_dir
         return self
 
-    def build(self) -> Dict[str, str]:
+    def _base_env(self) -> Dict[str, str]:
+        """The NOMAD_* map only — computed without touching user env, so
+        ``${env.*}`` resolution can't recurse into interpolation."""
         env: Dict[str, str] = {}
         if self.alloc_dir:
             env["NOMAD_ALLOC_DIR"] = self.alloc_dir
@@ -71,7 +73,11 @@ class TaskEnvBuilder:
             for k, v in meta.items():
                 env[f"NOMAD_META_{k}"] = v
                 env[f"NOMAD_META_{k.upper()}"] = v
-        # user-specified env wins, with interpolation
+        return env
+
+    def build(self) -> Dict[str, str]:
+        env = self._base_env()
+        # user-specified env wins, with interpolation against the base map
         if self.task is not None:
             for k, v in self.task.env.items():
                 env[k] = self.interpolate(v)
@@ -96,7 +102,7 @@ class TaskEnvBuilder:
             if ref.startswith("meta."):
                 return self.node.meta.get(ref[len("meta."):])
         if ref.startswith("env."):
-            return self.build().get(ref[len("env."):])
+            return self._base_env().get(ref[len("env."):])
         return None
 
     def interpolate(self, value: str) -> str:

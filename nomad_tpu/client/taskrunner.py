@@ -104,6 +104,7 @@ class TaskRunner:
         self.driver = new_driver(task.driver)
         self.task_id = f"{alloc.id}/{task.name}"
         self.handle: Optional[TaskHandle] = None
+        self._recovered = False
         self.state = TaskState(state=STATE_PENDING)
         self.events: List[TaskEvent] = []
         self.kill_requested = threading.Event()
@@ -152,7 +153,11 @@ class TaskRunner:
 
         while not self.kill_requested.is_set():
             try:
-                self._start_task()
+                if self._recovered:
+                    # a restart re-attached to the live task; skip the start
+                    self._recovered = False
+                else:
+                    self._start_task()
             except DriverError as e:
                 self._emit(TaskEvent(EV_DRIVER_FAILURE, str(e)))
                 behavior, wait_s = self.restart_tracker.next(None, failure=True)
@@ -266,6 +271,18 @@ class TaskRunner:
                 return None
 
     # -- external control ------------------------------------------------
+
+    def recover(self, handle: TaskHandle) -> bool:
+        """Re-attach to a live task before ``run()`` (RecoverTask,
+        plugins/drivers/driver.go:47). Returns False when the task is gone
+        — the run loop then starts it fresh."""
+        try:
+            self.driver.recover_task(handle)
+        except DriverError:
+            return False
+        self.handle = handle
+        self._recovered = True
+        return True
 
     def kill(self, timeout: float = 10.0) -> None:
         self.kill_requested.set()

@@ -1,0 +1,48 @@
+"""Native (C++) runtime substrate bindings.
+
+The reference leans on vendored native-grade infrastructure — raft-boltdb
+for the log (nomad/server.go:1079), libcontainer for task isolation
+(drivers/shared/executor/executor_linux.go:50). Here those are first-party
+C++ (``native/``), bound over ctypes; ``ensure_built`` compiles them on
+demand with the in-image toolchain and caches the artifacts.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+_build_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def ensure_built(target: str) -> str:
+    """Build (once) and return the path of a native artifact
+    (``libnomadlog.so`` or ``nomad-executor``)."""
+    path = os.path.join(BUILD_DIR, target)
+    with _build_lock:
+        sources = {
+            "libnomadlog.so": os.path.join(NATIVE_DIR, "nomadlog", "nomadlog.cpp"),
+            "nomad-executor": os.path.join(NATIVE_DIR, "executor", "nomad_executor.cpp"),
+        }
+        src = sources.get(target)
+        if src is None:
+            raise NativeBuildError(f"unknown native target {target!r}")
+        if os.path.exists(path) and os.path.getmtime(path) >= os.path.getmtime(src):
+            return path
+        proc = subprocess.run(
+            ["make", "-C", NATIVE_DIR, f"build/{target}"],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"native build of {target} failed:\n{proc.stdout}\n{proc.stderr}"
+            )
+        return path

@@ -13,6 +13,8 @@ Fills the role of the reference's vendored hashicorp/raft + BoltDB store
 """
 from __future__ import annotations
 
+import os
+import pickle
 import threading
 from typing import Callable, List, Optional, Tuple
 
@@ -24,19 +26,57 @@ class NotLeaderError(Exception):
 
 
 class InProcRaft:
-    """Shared log; one elected leader; synchronous replication to peer FSMs."""
+    """Shared log; one elected leader; synchronous replication to peer FSMs.
 
-    def __init__(self) -> None:
+    With ``data_dir`` set, every entry also lands in the C++ segmented log
+    (nomad_tpu/native/log.py over native/nomadlog — the raft-boltdb slot),
+    and a restarted process replays it back into the FSM on join. Snapshots
+    (``snapshot()``) persist the FSM state and compact the log behind it,
+    mirroring fsm.go:1059 Snapshot + log truncation.
+    """
+
+    def __init__(self, data_dir: Optional[str] = None, sync_writes: bool = False) -> None:
         self._lock = threading.RLock()
         self.log: List[Tuple[int, str, object]] = []
         self.last_index = 0
         self.fsms: List[NomadFSM] = []
         self.leader_idx: Optional[int] = None
         self.leadership_observers: List[Callable[[int, bool], None]] = []
+        self.sync_writes = sync_writes
+        self.store = None
+        self._snapshot_path = None
+        if data_dir is not None:
+            from ..native.log import NativeLog
+
+            os.makedirs(data_dir, exist_ok=True)
+            self.store = NativeLog(os.path.join(data_dir, "log"))
+            self._snapshot_path = os.path.join(data_dir, "snapshot.bin")
+            self._restore_from_store()
+
+    def _restore_from_store(self) -> None:
+        """Load the newest snapshot, then replay the durable log tail."""
+        snap_index = 0
+        if self._snapshot_path and os.path.exists(self._snapshot_path):
+            with open(self._snapshot_path, "rb") as f:
+                snap_index, self._snapshot_state = pickle.load(f)
+        else:
+            self._snapshot_state = None
+        first, last = self.store.first_index, self.store.last_index
+        for index in range(max(first, snap_index + 1), last + 1):
+            blob = self.store.get(index)
+            if blob is None:
+                continue
+            entry_type, payload = pickle.loads(blob)
+            self.log.append((index, entry_type, payload))
+        self.last_index = max(last, snap_index)
+        self._snapshot_index = snap_index
 
     def join(self, fsm: NomadFSM) -> int:
-        """Add a server's FSM; returns its peer index. Replays the log."""
+        """Add a server's FSM; returns its peer index. Restores the newest
+        snapshot (if any) then replays the log."""
         with self._lock:
+            if getattr(self, "_snapshot_state", None) is not None:
+                fsm.restore(pickle.loads(self._snapshot_state))
             for index, entry_type, payload in self.log:
                 fsm.apply(index, entry_type, payload)
             self.fsms.append(fsm)
@@ -44,6 +84,37 @@ class InProcRaft:
             if self.leader_idx is None:
                 self._elect(peer)
             return peer
+
+    def snapshot(self, peer: int) -> int:
+        """Persist the peer's FSM state; compact the durable log behind it
+        (fsm.go:1059 Snapshot / SnapshotAfter)."""
+        with self._lock:
+            if self.store is None or self._snapshot_path is None:
+                return 0
+            state = self.fsms[peer].snapshot()
+            index = self.last_index
+            state_blob = pickle.dumps(state)
+            blob = pickle.dumps((index, state_blob))
+            tmp = self._snapshot_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snapshot_path)
+            self.store.truncate_before(index + 1)
+            self.store.sync()
+            # compact the in-memory log too, and refresh the cached snapshot
+            # state future join() calls restore from
+            self._snapshot_state = state_blob
+            self.log = [e for e in self.log if e[0] > index]
+            self._snapshot_index = index
+            return index
+
+    def close(self) -> None:
+        if self.store is not None:
+            self.store.sync()
+            self.store.close()
+            self.store = None
 
     def _elect(self, peer: int) -> None:
         old = self.leader_idx
@@ -75,6 +146,10 @@ class InProcRaft:
             self.last_index += 1
             index = self.last_index
             self.log.append((index, entry_type, payload))
+            if self.store is not None:
+                self.store.append(
+                    index, pickle.dumps((entry_type, payload)), sync=self.sync_writes
+                )
             response = None
             for i, fsm in enumerate(self.fsms):
                 r = fsm.apply(index, entry_type, payload)

@@ -59,6 +59,18 @@ class StateStore:
         # (namespace, parent job id) -> child job ids (periodic/dispatch)
         self._jobs_by_parent: Dict[Tuple[str, str], set] = {}
 
+    # pickling (raft snapshot persistence): locks are recreated on load
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_lock", None)
+        d.pop("_cond", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+
     # ------------------------------------------------------------------
     # snapshots / blocking
     # ------------------------------------------------------------------
@@ -252,12 +264,13 @@ class StateStore:
 
     def jobs_by_parent(self, namespace: str, parent_id: str) -> List[Job]:
         """Child jobs of a periodic/parameterized parent (indexed)."""
-        ids = self._jobs_by_parent.get((namespace, parent_id), set())
-        return [
-            j
-            for j in (self.jobs_table.get((namespace, i)) for i in ids)
-            if j is not None
-        ]
+        with self._lock:
+            ids = list(self._jobs_by_parent.get((namespace, parent_id), ()))
+            return [
+                j
+                for j in (self.jobs_table.get((namespace, i)) for i in ids)
+                if j is not None
+            ]
 
     def jobs(self) -> List[Job]:
         return list(self.jobs_table.values())
