@@ -31,6 +31,18 @@ from .base import (
 )
 
 
+def _proc_start_ticks(pid: int) -> Optional[int]:
+    """Kernel start time of a pid (field 22 of /proc/<pid>/stat) — the
+    identity that distinguishes a live executor from a recycled pid."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+        # comm may contain spaces/parens; field 22 counts from after ')'
+        return int(stat.rsplit(")", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 class _ExecutorTask:
     def __init__(self, cfg: TaskConfig, executor_bin: str) -> None:
         command = cfg.config.get("command")
@@ -151,6 +163,7 @@ class ExecDriver(Driver):
             driver=self.name, config=cfg, state="running",
             driver_state={
                 "pid": t.pid,
+                "pid_start_ticks": _proc_start_ticks(t.pid),
                 "status_file": t.status_file,
                 "pid_file": t.pid_file,
             },
@@ -172,29 +185,33 @@ class ExecDriver(Driver):
         t = self._get(task_id)
         sig = getattr(_signal, signal, _signal.SIGTERM)
         pgid = t.task_pgid()
-        if sig in (_signal.SIGTERM, _signal.SIGINT):
-            # the executor forwards to the task group and escalates itself
-            try:
-                os.kill(t.pid, sig)
-            except ProcessLookupError:
-                pass
-        elif pgid is not None:
+        if sig not in (_signal.SIGTERM, _signal.SIGINT) and pgid is not None:
             try:
                 os.killpg(pgid, sig)
             except ProcessLookupError:
                 pass
+        # always poke the executor: it forwards SIGTERM to the task group
+        # and escalates itself, and it covers the window before the pid
+        # file lands on disk
+        try:
+            os.kill(t.pid, _signal.SIGTERM)
+        except ProcessLookupError:
+            pass
         kill_timeout = float(t.cfg.config.get("kill_timeout", 5.0))
         if not t.done.wait(timeout=max(timeout_s, kill_timeout) + 1.5):
             # last resort: SIGKILL the TASK GROUP (not just the executor —
-            # the task runs setsid'd and would otherwise be orphaned alive)
-            for target_sig, target in ((_signal.SIGKILL, pgid), (_signal.SIGKILL, None)):
+            # the task runs setsid'd and would otherwise be orphaned alive).
+            # Re-read the pid file: it may have landed since the first look.
+            pgid = t.task_pgid()
+            if pgid is not None:
                 try:
-                    if target is not None:
-                        os.killpg(target, target_sig)
-                    else:
-                        os.kill(t.pid, target_sig)
+                    os.killpg(pgid, _signal.SIGKILL)
                 except ProcessLookupError:
                     pass
+            try:
+                os.kill(t.pid, _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
             t.done.wait(timeout=5.0)
 
     def destroy_task(self, task_id: str, force: bool = False) -> None:
@@ -244,10 +261,18 @@ class ExecDriver(Driver):
 
     def recover_task(self, handle: TaskHandle) -> None:
         """Re-attach to a live executor by pid (RecoverTask)."""
-        pid = handle.driver_state.get("pid")
+        pid = (handle.driver_state or {}).get("pid")
         cfg = handle.config
         if pid is None or cfg is None:
             raise DriverError("handle missing pid")
+        expected_ticks = handle.driver_state.get("pid_start_ticks")
+        actual_ticks = _proc_start_ticks(pid)
+        if (
+            actual_ticks is not None
+            and expected_ticks is not None
+            and actual_ticks != expected_ticks
+        ):
+            raise DriverError(f"pid {pid} was recycled (start time mismatch)")
         t = _ExecutorTask.__new__(_ExecutorTask)
         t.cfg = cfg
         t.pid = pid

@@ -1,0 +1,119 @@
+"""Wire codec: msgpack with a typed-dataclass extension.
+
+Fills the role of the reference's msgpack codec over net/rpc
+(nomad/rpc.go, helper/codec): structs cross the wire as msgpack maps
+tagged with their registered type name and are rebuilt through a class
+registry — never arbitrary deserialization (no pickle on the wire), so a
+malicious peer can only produce known struct types.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Type
+
+import msgpack
+
+_TYPE_KEY = "__t"
+_REGISTRY: Dict[str, Type] = {}
+
+
+def register_struct(cls: Type) -> Type:
+    """Allow a dataclass across the wire."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _register_all_structs() -> None:
+    from ..structs import structs as s
+
+    for name in dir(s):
+        obj = getattr(s, name)
+        if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+            _REGISTRY[obj.__name__] = obj
+    # non-struct payloads that ride raft/rpc
+    from ..client.drivers.base import (
+        Capabilities,
+        ExitResult,
+        Fingerprint,
+        TaskConfig,
+        TaskHandle,
+        TaskStats,
+        TaskStatus,
+    )
+
+    for cls in (Capabilities, ExitResult, Fingerprint, TaskConfig, TaskHandle,
+                TaskStats, TaskStatus):
+        _REGISTRY[cls.__name__] = cls
+
+
+def _to_wire(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {_TYPE_KEY: type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            out[f.name] = _to_wire(getattr(obj, f.name))
+        return out
+    if isinstance(obj, dict):
+        # tuple keys (e.g. (namespace, job_id)) become tagged lists
+        enc = {}
+        tuple_keys = False
+        for k, v in obj.items():
+            if isinstance(k, tuple):
+                tuple_keys = True
+                break
+        if tuple_keys:
+            return {
+                _TYPE_KEY: "__tdict",
+                "items": [[_to_wire(list(k) if isinstance(k, tuple) else k), _to_wire(v)]
+                          for k, v in obj.items()],
+            }
+        for k, v in obj.items():
+            enc[k] = _to_wire(v)
+        return enc
+    if isinstance(obj, tuple):
+        return {_TYPE_KEY: "__tuple", "items": [_to_wire(v) for v in obj]}
+    if isinstance(obj, set):
+        return {_TYPE_KEY: "__set", "items": [_to_wire(v) for v in obj]}
+    if isinstance(obj, list):
+        return [_to_wire(v) for v in obj]
+    return obj
+
+
+def _from_wire(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        tname = obj.get(_TYPE_KEY)
+        if tname == "__tuple":
+            return tuple(_from_wire(v) for v in obj["items"])
+        if tname == "__set":
+            return set(_from_wire(v) for v in obj["items"])
+        if tname == "__tdict":
+            return {
+                tuple(_from_wire(k)) if isinstance(k, list) else _from_wire(k): _from_wire(v)
+                for k, v in obj["items"]
+            }
+        if tname is not None:
+            cls = _REGISTRY.get(tname)
+            if cls is None:
+                raise ValueError(f"unknown wire type {tname!r}")
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {
+                k: _from_wire(v)
+                for k, v in obj.items()
+                if k != _TYPE_KEY and k in field_names
+            }
+            return cls(**kwargs)
+        return {k: _from_wire(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_wire(v) for v in obj]
+    return obj
+
+
+def encode(obj: Any) -> bytes:
+    if not _REGISTRY:
+        _register_all_structs()
+    return msgpack.packb(_to_wire(obj), use_bin_type=True)
+
+
+def decode(data: bytes) -> Any:
+    if not _REGISTRY:
+        _register_all_structs()
+    return _from_wire(msgpack.unpackb(data, raw=False, strict_map_key=False))

@@ -1,0 +1,125 @@
+"""RPC endpoint surface: binds a Server to the transport.
+
+Fills the role of the reference's ``nomad/*_endpoint.go`` files — one
+registry entry per noun (server.go:236 ``endpoints`` struct), method names
+matching the reference RPC names ("Node.Register", "Job.Register",
+"Eval.Dequeue"...). ``RemoteServerProxy`` is the client-side counterpart
+the agent dials (client/rpc.go), satisfying the same interface as the
+in-process ``ServerProxy``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..structs.structs import Allocation, Job, Node
+from .transport import RPCClient, RPCServer
+
+
+def bind_server(server, rpc: RPCServer) -> None:
+    """Register every server endpoint on the transport."""
+    state = server.fsm.state
+
+    # -- Status --------------------------------------------------------
+    rpc.register("Status.ping", lambda: "pong")
+    rpc.register("Status.leader", lambda: list(rpc.leader_addr or rpc.addr))
+
+    # -- Node ----------------------------------------------------------
+    rpc.register("Node.Register", server.register_node)
+    rpc.register("Node.Deregister", server.deregister_node)
+    rpc.register("Node.Heartbeat", server.heartbeat)
+    rpc.register("Node.UpdateStatus", server.update_node_status)
+    rpc.register("Node.UpdateDrain", server.update_node_drain)
+    rpc.register("Node.UpdateEligibility", server.update_node_eligibility)
+    rpc.register("Node.UpdateAlloc", server.update_allocs_from_client)
+    rpc.register("Node.List", lambda: state.nodes())
+    rpc.register("Node.GetNode", state.node_by_id)
+
+    def get_client_allocs(node_id: str, min_index: int, timeout: float):
+        def run(s):
+            out = []
+            for a in s.allocs_by_node(node_id):
+                if a.job is None:
+                    a = a.copy_skip_job()
+                    a.job = s.job_by_id(a.namespace, a.job_id)
+                out.append(a)
+            return out
+
+        allocs, index = state.blocking_query(run, min_index, timeout=timeout)
+        return [allocs, index]
+
+    rpc.register("Node.GetClientAllocs", get_client_allocs)
+
+    # -- Job -----------------------------------------------------------
+    rpc.register("Job.Register", server.register_job)
+    rpc.register("Job.Deregister", server.deregister_job)
+    rpc.register("Job.GetJob", state.job_by_id)
+    rpc.register("Job.List", lambda: state.jobs())
+    rpc.register(
+        "Job.Allocations",
+        lambda ns, job_id: state.allocs_by_job(ns, job_id, True),
+    )
+    rpc.register("Job.Evaluations", state.evals_by_job)
+    rpc.register("Job.GetJobVersions",
+                 lambda ns, job_id: state.job_versions.get((ns, job_id), []))
+    rpc.register("Job.Summary", state.job_summary)
+
+    # -- Eval ----------------------------------------------------------
+    rpc.register("Eval.GetEval", state.eval_by_id)
+    rpc.register("Eval.List", lambda: state.evals())
+    rpc.register("Eval.Allocations", state.allocs_by_eval)
+
+    # -- Alloc ---------------------------------------------------------
+    rpc.register("Alloc.GetAlloc", state.alloc_by_id)
+    rpc.register("Alloc.List", lambda: state.allocs())
+
+    # -- Deployment ----------------------------------------------------
+    dw = server.deployment_watcher
+    rpc.register("Deployment.List", lambda: state.deployments())
+    rpc.register("Deployment.GetDeployment", state.deployment_by_id)
+    rpc.register("Deployment.Promote", dw.promote)
+    rpc.register("Deployment.Pause", dw.pause)
+    rpc.register("Deployment.Fail", dw.fail)
+    rpc.register("Deployment.SetAllocHealth", dw.set_alloc_health)
+
+    # -- Periodic ------------------------------------------------------
+    rpc.register("Periodic.Force", server.periodic_dispatcher.force_launch)
+
+    # -- Operator ------------------------------------------------------
+    def scheduler_get_config():
+        index, config = state.scheduler_config()
+        return [index, config]
+
+    rpc.register("Operator.SchedulerGetConfiguration", scheduler_get_config)
+    rpc.register(
+        "Operator.SchedulerSetConfiguration",
+        lambda config: server.raft_apply("scheduler-config", config)[0],
+    )
+
+
+class RemoteServerProxy:
+    """Client-side server connection over the wire (client/rpc.go) —
+    drop-in for the in-process ``client.ServerProxy``."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.rpc = RPCClient(host, port)
+        # a second connection so long-poll pulls don't block status syncs
+        self.rpc_blocking = RPCClient(host, port, timeout=90.0)
+
+    def register_node(self, node: Node) -> float:
+        return self.rpc.call("Node.Register", node)
+
+    def heartbeat(self, node_id: str) -> float:
+        return self.rpc.call("Node.Heartbeat", node_id)
+
+    def pull_allocs(self, node_id: str, min_index: int, timeout: float):
+        allocs, index = self.rpc_blocking.call(
+            "Node.GetClientAllocs", node_id, min_index, timeout
+        )
+        return allocs, index
+
+    def update_allocs(self, allocs: List[Allocation]) -> None:
+        self.rpc.call("Node.UpdateAlloc", allocs)
+
+    def close(self) -> None:
+        self.rpc.close()
+        self.rpc_blocking.close()

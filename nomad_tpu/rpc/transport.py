@@ -1,0 +1,192 @@
+"""TCP RPC transport: length-framed msgpack request/response.
+
+Fills the role of reference ``nomad/rpc.go`` + ``helper/pool/``: msgpack
+net/rpc over TCP with connection reuse and leader forwarding
+(rpc.go:409 ``forward`` / :493 forwardLeader). Framing is
+[u32 length][msgpack envelope]; the envelope is
+{"seq", "method", "body"} out and {"seq", "error", "body"} back. One
+server thread per connection (yamux multiplexing is unnecessary when each
+connection already pipelines request/response pairs).
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .codec import decode, encode
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 << 20
+
+
+class RPCError(Exception):
+    pass
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_read_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise RPCError(f"frame too large: {length}")
+    return _read_exact(sock, length)
+
+
+class RPCServer:
+    """Dispatches "Noun.Verb" methods to registered handlers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.logger = logging.getLogger("nomad_tpu.rpc.server")
+        self.handlers: Dict[str, Callable[..., Any]] = {}
+        # set to (host, port) of the leader for transparent forwarding
+        self.leader_addr: Optional[Tuple[str, int]] = None
+        self.is_leader: Callable[[], bool] = lambda: True
+        self._forward_pool: Optional["RPCClient"] = None
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        frame = _recv_frame(sock)
+                        req = decode(frame)
+                        resp = outer._dispatch(req)
+                        _send_frame(sock, encode(resp))
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._tcp = Server((host, port), Handler)
+        self.addr: Tuple[str, int] = self._tcp.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, method: str, fn: Callable[..., Any]) -> None:
+        self.handlers[method] = fn
+
+    def register_endpoint(self, noun: str, obj: object) -> None:
+        """Every public method of ``obj`` becomes "<noun>.<method>"
+        (the reference's endpoint struct registry, server.go:236)."""
+        for name in dir(obj):
+            if name.startswith("_"):
+                continue
+            fn = getattr(obj, name)
+            if callable(fn):
+                self.register(f"{noun}.{name}", fn)
+
+    FORWARDED = "forwarded"
+    LOCAL_ONLY = {"Status.ping", "Status.leader"}
+
+    def _dispatch(self, req: dict) -> dict:
+        seq = req.get("seq", 0)
+        method = req.get("method", "")
+        body = req.get("body", ())
+        fn = self.handlers.get(method)
+        if fn is None:
+            return {"seq": seq, "error": f"unknown method {method!r}", "body": None}
+        try:
+            # leader/region forwarding (rpc.go:409): followers proxy writes
+            if (
+                not self.is_leader()
+                and self.leader_addr is not None
+                and self.leader_addr != self.addr
+                and method not in self.LOCAL_ONLY
+                and not req.get("no_forward")
+            ):
+                result = self._forward(method, body)
+            else:
+                result = fn(*body)
+            return {"seq": seq, "error": None, "body": result}
+        except Exception as e:  # noqa: BLE001
+            return {"seq": seq, "error": f"{type(e).__name__}: {e}", "body": None}
+
+    def _forward(self, method: str, body) -> Any:
+        if self._forward_pool is None or self._forward_pool.addr != self.leader_addr:
+            if self._forward_pool is not None:
+                self._forward_pool.close()
+            self._forward_pool = RPCClient(*self.leader_addr)
+        return self._forward_pool.call(method, *body, no_forward=True)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, name="rpc-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._forward_pool is not None:
+            self._forward_pool.close()
+
+
+class RPCClient:
+    """Pooled client: one persistent connection, serialized calls
+    (helper/pool ConnPool's role for a single peer)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+        self.addr = (host, port)
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.addr, timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def call(self, method: str, *args: Any, no_forward: bool = False) -> Any:
+        with self._lock:
+            self._seq += 1
+            req = {"seq": self._seq, "method": method, "body": tuple(args)}
+            if no_forward:
+                req["no_forward"] = True
+            try:
+                sock = self._connect()
+                _send_frame(sock, encode(req))
+                resp = decode(_recv_frame(sock))
+            except (ConnectionError, OSError):
+                # one reconnect attempt (pool behavior on dead conns)
+                self._close_locked()
+                sock = self._connect()
+                _send_frame(sock, encode(req))
+                resp = decode(_recv_frame(sock))
+        if resp.get("error"):
+            raise RPCError(resp["error"])
+        return resp.get("body")
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()

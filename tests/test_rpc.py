@@ -1,0 +1,186 @@
+"""RPC layer tests: typed codec roundtrip, transport, leader forwarding,
+and a client agent running against a server over the wire — reference
+nomad/rpc_test.go + client/rpc tests."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.rpc import (
+    RPCClient,
+    RPCError,
+    RPCServer,
+    RemoteServerProxy,
+    bind_server,
+    decode,
+    encode,
+)
+from nomad_tpu.server import InProcRaft, Server, ServerConfig
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_COMPLETE,
+    Evaluation,
+    Job,
+    Node,
+    RestartPolicy,
+)
+
+
+def wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+
+def test_codec_roundtrips_structs():
+    job = mock.job()
+    out = decode(encode(job))
+    assert isinstance(out, Job)
+    assert out.id == job.id
+    assert out.task_groups[0].tasks[0].resources.cpu == \
+        job.task_groups[0].tasks[0].resources.cpu
+    assert out.task_groups[0].constraints == job.task_groups[0].constraints
+
+    node = mock.node()
+    out = decode(encode(node))
+    assert isinstance(out, Node)
+    assert out.node_resources.networks[0].cidr == node.node_resources.networks[0].cidr
+
+    alloc = mock.alloc()
+    alloc.job = job
+    out = decode(encode(alloc))
+    assert out.job.id == job.id
+
+    # containers: tuples, sets, tuple-keyed dicts
+    payload = {("ns", "job"): [1, 2], "plain": {"x": (1, "two")}}
+    out = decode(encode(payload))
+    assert out[("ns", "job")] == [1, 2]
+    assert out["plain"]["x"] == (1, "two")
+
+
+def test_codec_rejects_unknown_types():
+    with pytest.raises(ValueError):
+        decode(encode({"ok": 1}).replace(b"ok", b"__t"))  # crafted tag
+
+
+# ---------------------------------------------------------------------------
+# transport
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_call_and_error():
+    rpc = RPCServer()
+    rpc.register("Math.add", lambda a, b: a + b)
+
+    def boom():
+        raise ValueError("nope")
+
+    rpc.register("Math.boom", boom)
+    rpc.start()
+    try:
+        c = RPCClient(*rpc.addr)
+        assert c.call("Math.add", 2, 3) == 5
+        with pytest.raises(RPCError, match="nope"):
+            c.call("Math.boom")
+        with pytest.raises(RPCError, match="unknown method"):
+            c.call("Math.missing")
+        c.close()
+    finally:
+        rpc.stop()
+
+
+def test_follower_forwards_to_leader():
+    """Writes against a follower transparently reach the leader
+    (rpc.go:409 forward)."""
+    raft = InProcRaft()
+    leader = Server(ServerConfig(num_schedulers=0), raft=raft, name="s1")
+    follower = Server(ServerConfig(num_schedulers=0), raft=raft, name="s2")
+
+    rpc_leader = RPCServer()
+    bind_server(leader, rpc_leader)
+    rpc_leader.is_leader = lambda: leader.is_leader
+    rpc_leader.start()
+
+    rpc_follower = RPCServer()
+    bind_server(follower, rpc_follower)
+    rpc_follower.is_leader = lambda: follower.is_leader
+    rpc_follower.leader_addr = rpc_leader.addr
+    rpc_follower.start()
+
+    try:
+        c = RPCClient(*rpc_follower.addr)
+        node = mock.node()
+        ttl = c.call("Node.Register", node)  # forwarded to the leader
+        assert ttl > 0
+        # replicated to both FSMs
+        assert leader.fsm.state.node_by_id(node.id) is not None
+        assert follower.fsm.state.node_by_id(node.id) is not None
+        c.close()
+    finally:
+        rpc_leader.stop()
+        rpc_follower.stop()
+
+
+# ---------------------------------------------------------------------------
+# full wire: client agent against a server over TCP
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def wire_cluster(tmp_path):
+    s = Server(ServerConfig(num_schedulers=2, deterministic=True,
+                            scheduler_algorithm="binpack"))
+    s.start()
+    rpc = RPCServer()
+    bind_server(s, rpc)
+    rpc.start()
+    proxy = RemoteServerProxy(*rpc.addr)
+    c = Client(proxy, ClientConfig(state_dir=str(tmp_path / "client")))
+    c.start()
+    yield s, c, rpc
+    c.shutdown()
+    proxy.close()
+    rpc.stop()
+    s.stop()
+
+
+def test_client_over_wire_runs_job(wire_cluster):
+    server, client, rpc = wire_cluster
+    assert server.fsm.state.node_by_id(client.node.id) is not None
+
+    job = mock.job()
+    job.type = "batch"
+    job.task_groups[0].count = 1
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {"command": "/bin/sh", "args": ["-c", "echo wire"]}
+    task.restart_policy = RestartPolicy(attempts=0, mode="fail")
+
+    # submit over the wire too
+    submit = RPCClient(*rpc.addr)
+    eval_id = submit.call("Job.Register", job)
+    assert eval_id
+
+    wait_for(
+        lambda: any(
+            a.client_status == ALLOC_CLIENT_COMPLETE
+            for a in server.fsm.state.allocs_by_job(job.namespace, job.id, True)
+        ),
+        msg="job completed over the wire",
+    )
+    # read APIs over the wire
+    allocs = submit.call("Job.Allocations", job.namespace, job.id)
+    assert len(allocs) == 1 and allocs[0].client_status == ALLOC_CLIENT_COMPLETE
+    ev = submit.call("Eval.GetEval", eval_id)
+    assert isinstance(ev, Evaluation)
+    index, config = submit.call("Operator.SchedulerGetConfiguration")
+    assert config.scheduler_algorithm == "binpack"
+    submit.close()
