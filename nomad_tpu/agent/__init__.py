@@ -1,0 +1,10 @@
+"""HTTP agent: embeds a server and/or client and serves the /v1 API.
+
+Fills the role of the reference's ``command/agent`` package (agent.go:90
+NewAgent, http.go:150 registerHandlers).
+"""
+
+from .agent import Agent, AgentConfig
+from .http import HTTPServer
+
+__all__ = ["Agent", "AgentConfig", "HTTPServer"]

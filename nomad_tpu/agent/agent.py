@@ -1,0 +1,178 @@
+"""The agent: embeds a server and/or client plus the HTTP front-end.
+
+Fills the role of the reference's ``command/agent/agent.go`` (NewAgent
+:90, setupServer :560, setupClient :735): one process that can be a
+server, a client, or both (dev mode), serving /v1 over HTTP. The
+in-process wiring (client dials the embedded server directly) matches
+the reference's dev-mode agent; distributed wiring rides the RPC
+transport (nomad_tpu.rpc).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..client.client import Client, ClientConfig, ServerProxy
+from ..server.server import Server, ServerConfig
+from .http import HTTPServer, Request
+from .routes import Routes
+
+
+@dataclass
+class AgentConfig:
+    name: str = "agent-1"
+    region: str = "global"
+    datacenter: str = "dc1"
+    server_enabled: bool = True
+    client_enabled: bool = False
+    dev_mode: bool = False
+    http_bind: str = "127.0.0.1"
+    http_port: int = 0  # 0 = ephemeral; reference default 4646
+    num_schedulers: int = 2
+    scheduler_algorithm: str = "tpu_binpack"
+    acl_enabled: bool = False
+    node_class: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+
+
+class Agent:
+    def __init__(
+        self,
+        config: Optional[AgentConfig] = None,
+        server: Optional[Server] = None,
+        client: Optional[Client] = None,
+    ) -> None:
+        self.config = config or AgentConfig()
+        if self.config.dev_mode:
+            self.config.server_enabled = True
+            self.config.client_enabled = True
+
+        self.server: Optional[Server] = server
+        self.client: Optional[Client] = client
+        if self.server is None and self.config.server_enabled:
+            self.server = Server(
+                ServerConfig(
+                    num_schedulers=self.config.num_schedulers,
+                    scheduler_algorithm=self.config.scheduler_algorithm,
+                ),
+                name=self.config.name,
+            )
+        if self.client is None and self.config.client_enabled:
+            if self.server is None:
+                raise ValueError(
+                    "client-only agents need a server to dial; pass client="
+                )
+            self.client = Client(
+                ServerProxy(self.server),
+                ClientConfig(
+                    datacenter=self.config.datacenter,
+                    node_class=self.config.node_class,
+                    meta=dict(self.config.meta),
+                ),
+            )
+
+        self.http = HTTPServer(self.config.http_bind, self.config.http_port)
+        self.routes = Routes(self)
+        self.routes.register_all(self.http)
+        self.acl_resolver = None  # installed by the ACL layer when enabled
+        self._started = False
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Agent":
+        with self._lock:
+            if self._started:
+                return self
+            if self.server is not None:
+                self.server.start()
+            if self.client is not None:
+                self.client.start()
+            self.http.start()
+            self._started = True
+        return self
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if not self._started:
+                return
+            self.http.stop()
+            if self.client is not None:
+                self.client.shutdown()
+            if self.server is not None:
+                self.server.stop()
+            self._started = False
+
+    @property
+    def http_addr(self) -> str:
+        host, port = self.http.addr
+        return f"http://{host}:{port}"
+
+    # -- surface used by routes ------------------------------------------
+
+    def authorize(self, req: Request, capabilities, namespace: str) -> None:
+        """ACL choke point: every handler passes through here. A no-op
+        until ACLs are enabled (reference: aclObj checks in every
+        endpoint, e.g. job_endpoint.go:100)."""
+        if self.acl_resolver is not None:
+            self.acl_resolver.check_http(req, capabilities, namespace)
+
+    def peer_names(self) -> List[str]:
+        if self.server is None:
+            return []
+        return [f"{self.config.name}"]
+
+    def raft_servers(self) -> List[Tuple[str, str, bool]]:
+        if self.server is None:
+            return []
+        return [(self.config.name, self.http_addr, self.server.is_leader)]
+
+    def known_servers(self) -> List[str]:
+        return [self.http_addr] if self.server is not None else []
+
+    def members(self) -> List[dict]:
+        if self.server is None:
+            return []
+        return [
+            {
+                "Name": f"{self.config.name}.{self.config.region}",
+                "Addr": self.http.addr[0],
+                "Port": self.http.addr[1],
+                "Status": "alive",
+                "Tags": {
+                    "region": self.config.region,
+                    "dc": self.config.datacenter,
+                    "role": "nomad",
+                },
+            }
+        ]
+
+    def regions(self) -> List[str]:
+        return [self.config.region]
+
+    def self_info(self) -> dict:
+        stats = {}
+        if self.server is not None:
+            stats["nomad"] = {
+                "server": "true",
+                "leader": str(self.server.is_leader).lower(),
+            }
+        if self.client is not None:
+            stats["client"] = {
+                "node_id": self.client.node.id,
+                "known_servers": ",".join(self.known_servers()),
+            }
+        return {
+            "config": {
+                "Region": self.config.region,
+                "Datacenter": self.config.datacenter,
+                "NodeName": self.config.name,
+                "Server": {"Enabled": self.config.server_enabled},
+                "Client": {"Enabled": self.config.client_enabled},
+                "ACL": {"Enabled": self.config.acl_enabled},
+                "Version": {"Version": "0.10.2-tpu"},
+            },
+            "stats": stats,
+            "member": (self.members() or [{}])[0],
+        }

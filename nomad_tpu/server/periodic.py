@@ -266,15 +266,7 @@ class PeriodicDispatch:
 
     def derive_job(self, parent: Job, launch_ns: int) -> Job:
         """Child job named <parent>/periodic-<unixtime> (periodic.go deriveJob)."""
-        child = parent.copy()
-        child.id = f"{parent.id}/periodic-{launch_ns // 10**9}"
-        child.name = child.id
-        child.parent_id = parent.id
-        child.periodic = None
-        child.stable = False
-        child.version = 0
-        child.create_index = child.modify_index = child.job_modify_index = 0
-        return child
+        return parent.derive_child(f"{parent.id}/periodic-{launch_ns // 10**9}")
 
     def force_launch(
         self, namespace: str, job_id: str, launch_ns: Optional[int] = None

@@ -406,6 +406,175 @@ class Server:
         self.raft_apply(EVAL_UPDATE, [ev])
         return ev.id
 
+    def evaluate_job(self, namespace: str, job_id: str) -> str:
+        """Job.Evaluate: force a new evaluation (job_endpoint.go Evaluate)."""
+        job = self.fsm.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job {job_id!r} not found")
+        if job.is_periodic():
+            raise ValueError("can't evaluate periodic job")
+        if job.is_parameterized():
+            raise ValueError("can't evaluate parameterized job")
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            job_modify_index=job.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+        )
+        ev.update_modify_time()
+        self.raft_apply(EVAL_UPDATE, [ev])
+        return ev.id
+
+    def dispatch_job(
+        self, namespace: str, job_id: str, payload: bytes = b"", meta=None
+    ):
+        """Job.Dispatch: instantiate a parameterized job (job_endpoint.go
+        Dispatch). Returns (child_job_id, eval_id)."""
+        parent = self.fsm.state.job_by_id(namespace, job_id)
+        if parent is None:
+            raise KeyError(f"job {job_id!r} not found")
+        if not parent.is_parameterized():
+            raise ValueError(f"job {job_id!r} is not parameterized")
+        if parent.stopped():
+            raise ValueError(f"job {job_id!r} is stopped")
+        cfg = parent.parameterized
+        meta = dict(meta or {})
+        if cfg.payload == "required" and not payload:
+            raise ValueError("payload is required")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("payload is forbidden")
+        for key in cfg.meta_required:
+            if key not in meta:
+                raise ValueError(f"missing required dispatch meta {key!r}")
+        allowed = set(cfg.meta_required) | set(cfg.meta_optional)
+        for key in meta:
+            if key not in allowed:
+                raise ValueError(f"dispatch meta {key!r} not allowed")
+
+        child = parent.derive_child(
+            "{}/dispatch-{}-{}".format(parent.id, int(time.time()), generate_uuid()[:8])
+        )
+        child.parameterized = None
+        child.payload = bytes(payload)
+        child.meta = {**parent.meta, **meta}
+        eval_id = self.register_job(child)
+        return child.id, eval_id
+
+    def set_job_stability(
+        self, namespace: str, job_id: str, version: int, stable: bool
+    ) -> None:
+        """Job.Stable (job_endpoint.go Stable)."""
+        job = self.fsm.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job {job_id!r} not found")
+        versions = self.fsm.state.job_versions.get((namespace, job_id), [])
+        if not any(j.version == version for j in versions):
+            raise ValueError(f"job {job_id!r} has no version {version}")
+        self.raft_apply("job-stability", (namespace, job_id, version, stable))
+
+    def revert_job(
+        self,
+        namespace: str,
+        job_id: str,
+        version: int,
+        enforce_prior_version: Optional[int] = None,
+    ) -> str:
+        """Job.Revert: re-register a prior version (job_endpoint.go Revert)."""
+        cur = self.fsm.state.job_by_id(namespace, job_id)
+        if cur is None:
+            raise KeyError(f"job {job_id!r} not found")
+        if enforce_prior_version is not None and cur.version != enforce_prior_version:
+            raise ValueError(
+                f"current version is {cur.version}, not {enforce_prior_version}"
+            )
+        if version == cur.version:
+            raise ValueError(f"can't revert to current version {version}")
+        prior = self.fsm.state.job_by_id_and_version(namespace, job_id, version)
+        if prior is None:
+            raise KeyError(f"job {job_id!r} has no version {version}")
+        revert = prior.copy()
+        revert.stable = False
+        revert.version = 0  # upsert assigns the next version
+        return self.register_job(revert)
+
+    def plan_job(self, job: Job, diff: bool = False):
+        """Job.Plan: dry-run the scheduler against a snapshot with the
+        submitted job inserted (job_endpoint.go Plan → scheduler harness);
+        nothing raft-applies. Returns (annotations, failed_tg_allocs,
+        job_modify_index, job_diff)."""
+        from ..scheduler.scheduler import new_scheduler
+        from ..scheduler.testing import Harness
+        from ..structs.diff import job_diff
+
+        snap = self.fsm.state.snapshot()
+        index = snap.latest_index + 1
+        old_job = snap.job_by_id(job.namespace, job.id)
+        jdiff = job_diff(old_job, None if job.stop else job) if diff else None
+        if job.stop:
+            snap.delete_job(index, job.namespace, job.id)
+        else:
+            snap.upsert_job(index, job)
+        harness = Harness(snap)
+        ev = Evaluation(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            job_modify_index=index,
+            status=EVAL_STATUS_PENDING,
+            annotate_plan=True,
+        )
+        sched = new_scheduler(job.type, self.logger, snap, harness)
+        sched.process(ev)
+        annotations = harness.plans[-1].annotations if harness.plans else None
+        failed = {}
+        for e in harness.evals + [ev]:
+            if e.failed_tg_allocs:
+                failed.update(e.failed_tg_allocs)
+        return annotations, failed or None, index, jdiff
+
+    def force_gc(self) -> None:
+        """System.GarbageCollect: a forced core GC eval (system_endpoint.go)."""
+        from .core_sched import CoreScheduler
+
+        ev = Evaluation(
+            namespace="-",
+            priority=100,
+            type="_core",
+            triggered_by="force-gc",
+            job_id="force-gc",
+            status=EVAL_STATUS_PENDING,
+        )
+        CoreScheduler(self, self.fsm.state.snapshot()).process(ev)
+
+    def stop_alloc(self, alloc_id: str) -> str:
+        """Alloc.Stop: mark the alloc for migration and kick an eval
+        (alloc_endpoint.go Stop)."""
+        alloc = self.fsm.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id!r} not found")
+        job = alloc.job or self.fsm.state.job_by_id(alloc.namespace, alloc.job_id)
+        ev = Evaluation(
+            namespace=alloc.namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else JOB_TYPE_SERVICE,
+            triggered_by="alloc-stop",
+            job_id=alloc.job_id,
+            status=EVAL_STATUS_PENDING,
+        )
+        ev.update_modify_time()
+        from ..structs.structs import DesiredTransition
+
+        self.raft_apply(
+            "alloc-update-desired-transition",
+            ({alloc_id: DesiredTransition(migrate=True)}, [ev]),
+        )
+        return ev.id
+
     # -- client sync -----------------------------------------------------
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> None:

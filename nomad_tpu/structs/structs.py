@@ -654,6 +654,22 @@ class Job:
 
         return _copy.deepcopy(self)
 
+    def derive_child(self, child_id: str) -> "Job":
+        """Copy for a periodic/dispatch child: fresh indexes, runnable, not
+        stable (reference periodic.go deriveJob / job_endpoint.go Dispatch)."""
+        child = self.copy()
+        child.id = child_id
+        child.name = child_id
+        child.parent_id = self.id
+        child.periodic = None
+        child.stop = False
+        child.stable = False
+        child.version = 0
+        child.status = ""
+        child.status_description = ""
+        child.create_index = child.modify_index = child.job_modify_index = 0
+        return child
+
 
 # ---------------------------------------------------------------------------
 # Deployment
