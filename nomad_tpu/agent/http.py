@@ -127,7 +127,7 @@ class HTTPServer:
             def _handle(self):
                 parsed = urllib.parse.urlsplit(self.path)
                 path = parsed.path
-                query = urllib.parse.parse_qs(parsed.query)
+                query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 req = Request(

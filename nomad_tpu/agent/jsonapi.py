@@ -95,12 +95,18 @@ def _type_hints(cls: Type) -> Dict[str, Any]:
     return hints
 
 
+_keymap_cache: Dict[Type, Dict[str, str]] = {}
+
+
 def _key_map(cls: Type) -> Dict[str, str]:
     """Accepted JSON key (camel or snake, lowercased) -> field name."""
-    m = {}
-    for f in dataclasses.fields(cls):
-        m[f.name.lower()] = f.name
-        m[camel(f.name).lower()] = f.name
+    m = _keymap_cache.get(cls)
+    if m is None:
+        m = {}
+        for f in dataclasses.fields(cls):
+            m[f.name.lower()] = f.name
+            m[camel(f.name).lower()] = f.name
+        _keymap_cache[cls] = m
     return m
 
 

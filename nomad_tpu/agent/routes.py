@@ -286,7 +286,10 @@ class Routes:
         body = req.json() or {}
         import base64
 
-        payload = base64.b64decode(body.get("Payload") or "")
+        try:
+            payload = base64.b64decode(body.get("Payload") or "")
+        except Exception as e:
+            raise HTTPError(400, f"invalid payload encoding: {e}")
         meta = body.get("Meta") or {}
         try:
             child_id, eval_id = self.server.dispatch_job(
@@ -536,13 +539,17 @@ class Routes:
 
     def status_leader(self, req: Request):
         server = self.server
-        return f"{server.name}:{0}" if server.is_leader else "unknown"
+        if not server.is_leader:
+            return "unknown"
+        host, port = self.agent.http.addr
+        return f"{host}:{port}"
 
     def status_peers(self, req: Request):
         return [p for p in self.agent.peer_names()]
 
     def operator_scheduler_config(self, req: Request):
         if req.method == "GET":
+            self._authorize(req, "operator:read")
             index, config = self.state.scheduler_config()
             req.response_index = index
             return {"SchedulerConfig": config, "Index": index}
@@ -607,6 +614,7 @@ class Routes:
         return self.agent.regions()
 
     def validate_job(self, req: Request):
+        self._authorize(req, "read-job")
         payload = req.json()
         if not isinstance(payload, dict) or payload.get("Job") is None:
             raise HTTPError(400, "Job must be specified")
@@ -702,7 +710,7 @@ def _canonicalize_job(job: Job) -> None:
     if not job.datacenters:
         job.datacenters = ["dc1"]
     for tg in job.task_groups:
-        if tg.count <= 0 and not tg.count:
+        if tg.count == 0:
             tg.count = 1
 
 
@@ -717,6 +725,8 @@ def _validate_job(job: Job) -> List[str]:
         if tg.name in seen:
             errors.append(f"duplicate task group {tg.name!r}")
         seen.add(tg.name)
+        if tg.count < 0:
+            errors.append(f"task group {tg.name!r} has negative count")
         if not tg.tasks:
             errors.append(f"task group {tg.name!r} has no tasks")
     return errors

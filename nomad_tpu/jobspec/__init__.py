@@ -1,0 +1,13 @@
+"""HCL1 jobspec parsing (reference jobspec/ package)."""
+
+from .hcl import HCLError, HCLObject, parse as parse_hcl
+from .parse import parse_duration_ns, parse_file, parse_job
+
+__all__ = [
+    "HCLError",
+    "HCLObject",
+    "parse_hcl",
+    "parse_duration_ns",
+    "parse_file",
+    "parse_job",
+]

@@ -374,8 +374,10 @@ class Server:
         # track/update/untrack with the dispatcher on every registration so
         # disabling a job's periodic stanza stops its launches (periodic.go:Add)
         self.periodic_dispatcher.add(stored)
-        if stored.is_periodic():
-            return ""  # children spawn at launch times
+        if stored.is_periodic() or stored.is_parameterized():
+            # periodic children spawn at launch times; parameterized templates
+            # only run when dispatched (job_endpoint.go Register)
+            return ""
         ev = Evaluation(
             namespace=job.namespace,
             priority=job.priority,

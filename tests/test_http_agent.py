@@ -179,7 +179,8 @@ def test_evaluations_and_deployments_listing(agent):
 def test_status_and_agent_endpoints(agent):
     base = agent.http_addr
     leader, _ = call(base, "/v1/status/leader")
-    assert "dev1" in leader
+    host, port = agent.http.addr
+    assert leader == f"{host}:{port}"
     self_info, _ = call(base, "/v1/agent/self")
     assert self_info["config"]["Server"]["Enabled"] is True
     health, _ = call(base, "/v1/agent/health")

@@ -1,0 +1,442 @@
+"""Jobspec HCL parser tests (modeled on reference jobspec/parse_test.go and
+its test-fixtures/basic.hcl)."""
+
+import textwrap
+
+import pytest
+
+from nomad_tpu.jobspec import HCLError, parse_duration_ns, parse_hcl, parse_job
+
+BASIC = r'''
+# A full-surface jobspec, mirroring jobspec/test-fixtures/basic.hcl
+job "binstore-storagelocker" {
+  region       = "fooregion"
+  namespace    = "foonamespace"
+  type         = "batch"
+  priority     = 52
+  all_at_once  = true
+  datacenters  = ["us2", "eu1"]
+
+  meta {
+    foo = "bar"
+  }
+
+  constraint {
+    attribute = "kernel.os"
+    value     = "windows"
+  }
+
+  constraint {
+    distinct_hosts = true
+  }
+
+  affinity {
+    attribute = "${meta.team}"
+    value     = "mobile"
+    operator  = "="
+    weight    = 50
+  }
+
+  spread {
+    attribute = "${meta.rack}"
+    weight    = 100
+    target "r1" {
+      percent = 40
+    }
+    target "r2" {
+      percent = 60
+    }
+  }
+
+  update {
+    stagger            = "60s"
+    max_parallel       = 2
+    health_check       = "task_states"
+    min_healthy_time   = "10s"
+    healthy_deadline   = "10m"
+    progress_deadline  = "10m"
+    auto_revert        = true
+    auto_promote       = false
+    canary             = 1
+  }
+
+  periodic {
+    cron             = "*/5 * * *"
+    prohibit_overlap = true
+  }
+
+  group "binsl" {
+    count = 5
+
+    restart {
+      attempts = 5
+      interval = "10m"
+      delay    = "15s"
+      mode     = "delay"
+    }
+
+    reschedule {
+      attempts       = 5
+      interval       = "12h"
+      delay          = "30s"
+      delay_function = "exponential"
+      max_delay      = "120s"
+      unlimited      = false
+    }
+
+    ephemeral_disk {
+      sticky  = true
+      size    = 150
+      migrate = true
+    }
+
+    network {
+      mode = "bridge"
+      port "http" {}
+      port "admin" {
+        static = 8080
+        to     = 8081
+      }
+    }
+
+    volume "foo" {
+      type   = "host"
+      source = "/path"
+    }
+
+    meta {
+      elb_mode = "tcp"
+    }
+
+    task "binstore" {
+      driver = "docker"
+      user   = "bob"
+      leader = true
+      kill_timeout = "22s"
+      kill_signal  = "SIGQUIT"
+
+      config {
+        image = "hashicorp/binstore"
+        labels {
+          FOO = "bar"
+        }
+      }
+
+      env {
+        HELLO = "world"
+        LOREM = "ipsum"
+      }
+
+      service {
+        port = "http"
+        tags = ["foo", "bar"]
+      }
+
+      resources {
+        cpu    = 500
+        memory = 128
+
+        network {
+          mbits = 100
+          port "one" {
+            static = 1
+          }
+          port "three" {
+            static = 3
+          }
+          port "http" {}
+        }
+
+        device "nvidia/gpu" {
+          count = 10
+          constraint {
+            attribute = "${device.attr.memory}"
+            value     = "2GB"
+            operator  = ">"
+          }
+          affinity {
+            attribute = "${device.model}"
+            value     = "1080ti"
+            weight    = 50
+          }
+        }
+      }
+
+      artifact {
+        source = "http://foo.com/artifact"
+        options {
+          checksum = "md5:b8a4f3f72ecab0510a6a31e997461c5f"
+        }
+      }
+
+      template {
+        source      = "foo.tpl"
+        destination = "foo.target"
+        change_mode = "signal"
+      }
+
+      vault {
+        policies = ["foo", "bar"]
+      }
+    }
+
+    task "storagelocker" {
+      driver = "docker"
+      config {
+        image = "hashicorp/storagelocker"
+      }
+      resources {
+        cpu    = 500
+        memory = 128
+      }
+      constraint {
+        attribute = "kernel.arch"
+        value     = "amd64"
+      }
+    }
+  }
+}
+'''
+
+
+def test_parse_basic_job_level():
+    job = parse_job(BASIC)
+    assert job.id == "binstore-storagelocker"
+    assert job.name == "binstore-storagelocker"
+    assert job.region == "fooregion"
+    assert job.namespace == "foonamespace"
+    assert job.type == "batch"
+    assert job.priority == 52
+    assert job.all_at_once is True
+    assert job.datacenters == ["us2", "eu1"]
+    assert job.meta == {"foo": "bar"}
+
+    assert len(job.constraints) == 2
+    assert job.constraints[0].ltarget == "kernel.os"
+    assert job.constraints[0].rtarget == "windows"
+    assert job.constraints[0].operand == "="
+    assert job.constraints[1].operand == "distinct_hosts"
+
+    assert len(job.affinities) == 1
+    a = job.affinities[0]
+    assert (a.ltarget, a.rtarget, a.operand, a.weight) == (
+        "${meta.team}",
+        "mobile",
+        "=",
+        50,
+    )
+
+    assert len(job.spreads) == 1
+    sp = job.spreads[0]
+    assert sp.attribute == "${meta.rack}"
+    assert sp.weight == 100
+    assert [(t.value, t.percent) for t in sp.spread_target] == [("r1", 40), ("r2", 60)]
+
+    u = job.update
+    assert u.stagger_ns == 60 * 10**9
+    assert u.max_parallel == 2
+    assert u.health_check == "task_states"
+    assert u.auto_revert is True
+    assert u.canary == 1
+
+    assert job.periodic.enabled is True
+    assert job.periodic.spec == "*/5 * * *"
+    assert job.periodic.prohibit_overlap is True
+
+
+def test_parse_basic_group_and_tasks():
+    job = parse_job(BASIC)
+    assert len(job.task_groups) == 1
+    g = job.task_groups[0]
+    assert g.name == "binsl"
+    assert g.count == 5
+    assert g.restart_policy.attempts == 5
+    assert g.restart_policy.interval_ns == 10 * 60 * 10**9
+    assert g.restart_policy.mode == "delay"
+    assert g.reschedule_policy.delay_function == "exponential"
+    assert g.reschedule_policy.max_delay_ns == 120 * 10**9
+    assert g.ephemeral_disk.sticky is True
+    assert g.ephemeral_disk.migrate is True
+    assert g.ephemeral_disk.size_mb == 150
+    assert len(g.networks) == 1
+    assert g.networks[0].mode == "bridge"
+    assert [p.label for p in g.networks[0].dynamic_ports] == ["http"]
+    assert [(p.label, p.value, p.to) for p in g.networks[0].reserved_ports] == [
+        ("admin", 8080, 8081)
+    ]
+    assert g.volumes["foo"].source == "/path"
+    assert g.meta == {"elb_mode": "tcp"}
+
+    assert [t.name for t in g.tasks] == ["binstore", "storagelocker"]
+    t = g.tasks[0]
+    assert t.driver == "docker"
+    assert t.user == "bob"
+    assert t.leader is True
+    assert t.kill_timeout_ns == 22 * 10**9
+    assert t.kill_signal == "SIGQUIT"
+    assert t.config["image"] == "hashicorp/binstore"
+    assert t.config["labels"] == {"FOO": "bar"}
+    assert t.env == {"HELLO": "world", "LOREM": "ipsum"}
+    assert len(t.services) == 1
+    assert t.services[0].port_label == "http"
+    assert t.services[0].tags == ["foo", "bar"]
+    # default service name derives from job/task
+    assert "binstore" in t.services[0].name
+
+    r = t.resources
+    assert r.cpu == 500 and r.memory_mb == 128
+    assert len(r.networks) == 1
+    assert r.networks[0].mbits == 100
+    assert [(p.label, p.value) for p in r.networks[0].reserved_ports] == [
+        ("one", 1),
+        ("three", 3),
+    ]
+    assert [p.label for p in r.networks[0].dynamic_ports] == ["http"]
+    assert len(r.devices) == 1
+    d = r.devices[0]
+    assert d.name == "nvidia/gpu"
+    assert d.count == 10
+    assert d.constraints[0].operand == ">"
+    assert d.affinities[0].weight == 50
+
+    assert t.artifacts[0]["source"] == "http://foo.com/artifact"
+    assert t.artifacts[0]["options"]["checksum"].startswith("md5:")
+    assert t.templates[0]["change_mode"] == "signal"
+    assert t.templates[0]["splay"] == "5s"  # default
+    assert t.vault["policies"] == ["foo", "bar"]
+    assert t.vault["env"] is True  # default
+
+    t2 = g.tasks[1]
+    assert t2.constraints[0].ltarget == "kernel.arch"
+
+
+def test_constraint_sugar_operators():
+    src = textwrap.dedent(
+        """
+        job "x" {
+          constraint {
+            attribute = "${attr.kernel.version}"
+            version   = ">= 4.0"
+          }
+          constraint {
+            attribute = "${node.class}"
+            regexp    = "foo.*"
+          }
+          constraint {
+            attribute    = "${meta.tags}"
+            set_contains = "a,b"
+          }
+          constraint {
+            attribute = "${attr.driver.docker}"
+            operator  = "is_set"
+            is_set    = true
+          }
+          group "g" { task "t" { driver = "mock" } }
+        }
+        """
+    )
+    job = parse_job(src)
+    ops = [c.operand for c in job.constraints]
+    assert ops == ["version", "regexp", "set_contains", "is_set"]
+    assert job.constraints[0].rtarget == ">= 4.0"
+    assert job.constraints[3].rtarget == ""
+
+
+def test_bare_task_becomes_group():
+    src = 'job "j" { task "solo" { driver = "raw_exec" config { command = "true" } } }'
+    job = parse_job(src)
+    assert len(job.task_groups) == 1
+    assert job.task_groups[0].name == "solo"
+    assert job.task_groups[0].count == 1
+    assert job.task_groups[0].tasks[0].driver == "raw_exec"
+
+
+def test_parameterized_and_dispatch_payload():
+    src = textwrap.dedent(
+        """
+        job "j" {
+          type = "batch"
+          parameterized {
+            payload       = "required"
+            meta_required = ["one"]
+            meta_optional = ["two"]
+          }
+          group "g" {
+            task "t" {
+              driver = "mock"
+              dispatch_payload {
+                file = "foo.json"
+              }
+            }
+          }
+        }
+        """
+    )
+    job = parse_job(src)
+    assert job.parameterized.payload == "required"
+    assert job.parameterized.meta_required == ["one"]
+    assert job.task_groups[0].tasks[0].dispatch_payload_file == "foo.json"
+
+
+def test_heredoc_and_comments():
+    src = (
+        'job "j" {\n'
+        "  // line comment\n"
+        "  /* block\n     comment */\n"
+        '  group "g" {\n'
+        '    task "t" {\n'
+        '      driver = "raw_exec"\n'
+        "      config {\n"
+        "        command = \"bash\"\n"
+        "        script = <<-EOF\n"
+        "          echo hello\n"
+        "          echo world\n"
+        "        EOF\n"
+        "      }\n"
+        "    }\n"
+        "  }\n"
+        "}\n"
+    )
+    job = parse_job(src)
+    script = job.task_groups[0].tasks[0].config["script"]
+    assert script == "echo hello\necho world\n"
+
+
+def test_interpolation_preserved():
+    src = 'job "j" { group "g" { task "t" { driver = "mock" env { N = "${node.unique.name}" } } } }'
+    job = parse_job(src)
+    assert job.task_groups[0].tasks[0].env["N"] == "${node.unique.name}"
+
+
+def test_parse_durations():
+    assert parse_duration_ns("10s") == 10 * 10**9
+    assert parse_duration_ns("1h30m") == 5400 * 10**9
+    assert parse_duration_ns("250ms") == 250 * 10**6
+    assert parse_duration_ns("1.5h") == 5400 * 10**9
+    assert parse_duration_ns("-15s") == -15 * 10**9
+    assert parse_duration_ns(5000) == 5000
+    with pytest.raises(HCLError):
+        parse_duration_ns("10 parsecs")
+
+
+def test_errors():
+    with pytest.raises(HCLError):
+        parse_job("not a job")
+    with pytest.raises(HCLError):
+        parse_job('job "a" {} job "b" {}')
+    with pytest.raises(HCLError):
+        parse_job('job "a" { group "g" {} }')  # no tasks
+    with pytest.raises(HCLError):
+        parse_job('job "a" { group "g" { task "t" { driver = "mock" } } group "g" { task "t" { driver = "mock" } } }')
+    with pytest.raises(HCLError):
+        parse_hcl('key = "unterminated')
+
+
+def test_hcl_lists_and_objects():
+    obj = parse_hcl(
+        'nums = [1, 2, 3]\nmixed = ["a", true, 1.5]\nobj = { a = 1, b = "two" }'
+    )
+    assert obj.get("nums") == [1, 2, 3]
+    assert obj.get("mixed") == ["a", True, 1.5]
+    inner = obj.get("obj")
+    assert inner.get("a") == 1 and inner.get("b") == "two"
