@@ -1,0 +1,25 @@
+"""ACL policy engine (reference acl/ package + nomad/acl.go)."""
+
+from .acl import (
+    ACL,
+    HostVolumePolicy,
+    NamespacePolicy,
+    Policy,
+    management_acl,
+    new_acl,
+    parse_policy,
+)
+from .resolver import ACLResolver, PermissionDenied, TokenError
+
+__all__ = [
+    "ACL",
+    "ACLResolver",
+    "HostVolumePolicy",
+    "NamespacePolicy",
+    "PermissionDenied",
+    "Policy",
+    "TokenError",
+    "management_acl",
+    "new_acl",
+    "parse_policy",
+]

@@ -75,7 +75,17 @@ class Agent:
         self.http = HTTPServer(self.config.http_bind, self.config.http_port)
         self.routes = Routes(self)
         self.routes.register_all(self.http)
-        self.acl_resolver = None  # installed by the ACL layer when enabled
+        self.acl_resolver = None
+        if self.config.acl_enabled:
+            if self.server is None:
+                raise ValueError("ACLs require a server-mode agent")
+            from ..acl import ACLResolver
+
+            self.acl_resolver = ACLResolver(lambda: self.server.fsm.state)
+        from .acl_routes import ACLRoutes
+
+        self.acl_routes = ACLRoutes(self)
+        self.acl_routes.register_all(self.http)
         self._started = False
         self._lock = threading.Lock()
 

@@ -44,6 +44,11 @@ SCHEDULER_CONFIG = "scheduler-config"
 BATCH_NODE_UPDATE_DRAIN = "batch-node-update-drain"
 JOB_STABILITY = "job-stability"
 PERIODIC_LAUNCH = "periodic-launch"
+ACL_POLICY_UPSERT = "acl-policy-upsert"
+ACL_POLICY_DELETE = "acl-policy-delete"
+ACL_TOKEN_UPSERT = "acl-token-upsert"
+ACL_TOKEN_DELETE = "acl-token-delete"
+ACL_TOKEN_BOOTSTRAP = "acl-token-bootstrap"
 
 
 class NomadFSM:
@@ -238,6 +243,21 @@ class NomadFSM:
 
     # -- snapshot/restore --------------------------------------------------
 
+    def _apply_acl_policy_upsert(self, index: int, policies):
+        self.state.upsert_acl_policies(index, policies)
+
+    def _apply_acl_policy_delete(self, index: int, names):
+        self.state.delete_acl_policies(index, names)
+
+    def _apply_acl_token_upsert(self, index: int, tokens):
+        self.state.upsert_acl_tokens(index, tokens)
+
+    def _apply_acl_token_delete(self, index: int, accessors):
+        self.state.delete_acl_tokens(index, accessors)
+
+    def _apply_acl_token_bootstrap(self, index: int, token):
+        self.state.bootstrap_acl_token(index, token)
+
     def snapshot(self) -> StateStore:
         return self.state.snapshot()
 
@@ -267,4 +287,9 @@ _DISPATCH: Dict[str, Callable] = {
     BATCH_NODE_UPDATE_DRAIN: NomadFSM._apply_batch_node_drain,
     JOB_STABILITY: NomadFSM._apply_job_stability,
     PERIODIC_LAUNCH: NomadFSM._apply_periodic_launch,
+    ACL_POLICY_UPSERT: NomadFSM._apply_acl_policy_upsert,
+    ACL_POLICY_DELETE: NomadFSM._apply_acl_policy_delete,
+    ACL_TOKEN_UPSERT: NomadFSM._apply_acl_token_upsert,
+    ACL_TOKEN_DELETE: NomadFSM._apply_acl_token_delete,
+    ACL_TOKEN_BOOTSTRAP: NomadFSM._apply_acl_token_bootstrap,
 }

@@ -577,6 +577,43 @@ class Server:
         )
         return ev.id
 
+    # -- ACL (reference nomad/acl_endpoint.go) ---------------------------
+
+    def bootstrap_acl(self):
+        """One-shot creation of the initial management token
+        (acl_endpoint.go Bootstrap)."""
+        from ..structs.acl import bootstrap_token
+
+        if self.fsm.state.acl_bootstrap_index != 0:
+            raise ValueError("ACL bootstrap already done")
+        token = bootstrap_token()
+        self.raft_apply("acl-token-bootstrap", token)
+        return self.fsm.state.acl_token_by_accessor(token.accessor_id)
+
+    def upsert_acl_policies(self, policies) -> None:
+        from ..acl import parse_policy
+
+        for pol in policies:
+            errors = pol.validate()
+            if errors:
+                raise ValueError("; ".join(errors))
+            parse_policy(pol.rules)  # reject unparsable rules up front
+        self.raft_apply("acl-policy-upsert", policies)
+
+    def delete_acl_policies(self, names) -> None:
+        self.raft_apply("acl-policy-delete", list(names))
+
+    def upsert_acl_tokens(self, tokens):
+        for tok in tokens:
+            errors = tok.validate()
+            if errors:
+                raise ValueError("; ".join(errors))
+        self.raft_apply("acl-token-upsert", tokens)
+        return [self.fsm.state.acl_token_by_accessor(t.accessor_id) for t in tokens]
+
+    def delete_acl_tokens(self, accessors) -> None:
+        self.raft_apply("acl-token-delete", list(accessors))
+
     # -- client sync -----------------------------------------------------
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> None:

@@ -49,6 +49,11 @@ class StateStore:
         # schema.go periodic_launch table)
         self.periodic_launch_table: Dict[Tuple[str, str], int] = {}
         self.scheduler_config_entry: Optional[SchedulerConfiguration] = None
+        # ACL tables (reference schema.go acl_policy / acl_token)
+        self.acl_policies_table: Dict[str, "ACLPolicy"] = {}
+        self.acl_tokens_table: Dict[str, "ACLToken"] = {}  # by accessor
+        self._tokens_by_secret: Dict[str, str] = {}  # secret -> accessor
+        self.acl_bootstrap_index = 0
 
         # secondary indexes
         self._allocs_by_node: Dict[str, set] = {}
@@ -91,6 +96,10 @@ class StateStore:
             snap.deployments_table = dict(self.deployments_table)
             snap.periodic_launch_table = dict(self.periodic_launch_table)
             snap.scheduler_config_entry = self.scheduler_config_entry
+            snap.acl_policies_table = dict(self.acl_policies_table)
+            snap.acl_tokens_table = dict(self.acl_tokens_table)
+            snap._tokens_by_secret = dict(self._tokens_by_secret)
+            snap.acl_bootstrap_index = self.acl_bootstrap_index
             snap._allocs_by_node = {k: set(v) for k, v in self._allocs_by_node.items()}
             snap._allocs_by_job = {k: set(v) for k, v in self._allocs_by_job.items()}
             snap._allocs_by_eval = {k: set(v) for k, v in self._allocs_by_eval.items()}
@@ -532,6 +541,73 @@ class StateStore:
                 config.create_index = self.scheduler_config_entry.create_index
             self.scheduler_config_entry = config
             self._bump(index)
+
+    # ------------------------------------------------------------------
+    # ACL policies / tokens (reference state_store.go UpsertACLPolicies,
+    # ACLPolicyByName, UpsertACLTokens, ACLTokenBySecretID, BootstrapACLTokens)
+    # ------------------------------------------------------------------
+
+    def upsert_acl_policies(self, index: int, policies) -> None:
+        with self._lock:
+            for pol in policies:
+                existing = self.acl_policies_table.get(pol.name)
+                pol = copy.deepcopy(pol)
+                pol.modify_index = index
+                pol.create_index = existing.create_index if existing else index
+                self.acl_policies_table[pol.name] = pol
+            self._bump(index)
+
+    def delete_acl_policies(self, index: int, names) -> None:
+        with self._lock:
+            for name in names:
+                self.acl_policies_table.pop(name, None)
+            self._bump(index)
+
+    def acl_policy_by_name(self, name: str):
+        return self.acl_policies_table.get(name)
+
+    def acl_policies(self):
+        return sorted(self.acl_policies_table.values(), key=lambda p: p.name)
+
+    def upsert_acl_tokens(self, index: int, tokens) -> None:
+        with self._lock:
+            for tok in tokens:
+                existing = self.acl_tokens_table.get(tok.accessor_id)
+                tok = copy.deepcopy(tok)
+                tok.modify_index = index
+                tok.create_index = existing.create_index if existing else index
+                if existing is not None and existing.secret_id != tok.secret_id:
+                    self._tokens_by_secret.pop(existing.secret_id, None)
+                self.acl_tokens_table[tok.accessor_id] = tok
+                if tok.secret_id:
+                    self._tokens_by_secret[tok.secret_id] = tok.accessor_id
+            self._bump(index)
+
+    def delete_acl_tokens(self, index: int, accessor_ids) -> None:
+        with self._lock:
+            for accessor in accessor_ids:
+                tok = self.acl_tokens_table.pop(accessor, None)
+                if tok is not None:
+                    self._tokens_by_secret.pop(tok.secret_id, None)
+            self._bump(index)
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        return self.acl_tokens_table.get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        accessor = self._tokens_by_secret.get(secret_id)
+        return self.acl_tokens_table.get(accessor) if accessor else None
+
+    def acl_tokens(self):
+        return sorted(self.acl_tokens_table.values(), key=lambda t: t.accessor_id)
+
+    def bootstrap_acl_token(self, index: int, token) -> None:
+        """One-shot bootstrap (reference state_store.go BootstrapACLTokens)."""
+        with self._lock:
+            if self.acl_bootstrap_index != 0:
+                raise ValueError("ACL bootstrap already done")
+            self.acl_bootstrap_index = index
+        self.upsert_acl_tokens(index, [token])
 
     # ------------------------------------------------------------------
     # plan results (the alloc commit path — reference state_store.go:227)

@@ -1,0 +1,243 @@
+"""ACL engine + HTTP enforcement tests (reference acl/acl_test.go,
+acl/policy_test.go, nomad/acl_endpoint_test.go)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu.acl import (
+    PermissionDenied,
+    management_acl,
+    new_acl,
+    parse_policy,
+)
+from nomad_tpu.acl.acl import (
+    NS_CAP_DENY,
+    NS_CAP_LIST_JOBS,
+    NS_CAP_READ_JOB,
+    NS_CAP_SUBMIT_JOB,
+)
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.jobspec.hcl import HCLError
+
+
+def call(base, path, method="GET", body=None, token=None):
+    data = None
+    if body is not None:
+        data = json.dumps(body).encode()
+    headers = {"X-Nomad-Token": token} if token else {}
+    req = urllib.request.Request(base + path, data=data, method=method, headers=headers)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        payload = resp.read().decode()
+        return json.loads(payload) if payload else None
+
+
+def call_err(base, path, **kw):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        call(base, path, **kw)
+    return ei.value.code
+
+
+# ---------------------------------------------------------------------------
+# policy parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_policy_shorthands():
+    pol = parse_policy(
+        """
+        namespace "default" {
+          policy = "read"
+        }
+        namespace "ops" {
+          policy       = "write"
+          capabilities = ["sentinel-override"]
+        }
+        node     { policy = "write" }
+        agent    { policy = "read" }
+        operator { policy = "deny" }
+        """
+    )
+    assert len(pol.namespaces) == 2
+    default = pol.namespaces[0]
+    assert default.name == "default"
+    assert NS_CAP_LIST_JOBS in default.capabilities
+    assert NS_CAP_READ_JOB in default.capabilities
+    assert NS_CAP_SUBMIT_JOB not in default.capabilities
+    ops = pol.namespaces[1]
+    assert NS_CAP_SUBMIT_JOB in ops.capabilities
+    assert "sentinel-override" in ops.capabilities
+    assert pol.node == "write"
+    assert pol.agent == "read"
+    assert pol.operator == "deny"
+
+
+def test_parse_policy_errors():
+    with pytest.raises(HCLError):
+        parse_policy('namespace "x" { policy = "admin" }')
+    with pytest.raises(HCLError):
+        parse_policy('namespace "x" { capabilities = ["fly"] }')
+    with pytest.raises(HCLError):
+        parse_policy('widget "x" { policy = "read" }')
+    with pytest.raises(HCLError):
+        parse_policy('namespace "x" { }')  # grants nothing
+
+
+def test_acl_merge_deny_wins():
+    read = parse_policy('namespace "default" { policy = "read" }')
+    deny = parse_policy('namespace "default" { policy = "deny" }')
+    write = parse_policy('namespace "default" { policy = "write" }')
+    acl = new_acl([read, write])
+    assert acl.allow_namespace_operation("default", NS_CAP_SUBMIT_JOB)
+    acl = new_acl([read, deny, write])
+    assert not acl.allow_namespace_operation("default", NS_CAP_READ_JOB)
+    assert not acl.allow_namespace("default")
+
+
+def test_acl_coarse_merge_and_management():
+    a = parse_policy("node { policy = \"read\" }")
+    b = parse_policy("node { policy = \"write\" }")
+    acl = new_acl([a, b])
+    assert acl.allow_node_write() and acl.allow_node_read()
+    deny = parse_policy("node { policy = \"deny\" }")
+    acl = new_acl([a, b, deny])
+    assert not acl.allow_node_read()
+    m = management_acl()
+    assert m.allow_node_write() and m.allow_operator_write()
+    assert m.allow_namespace_operation("anything", NS_CAP_SUBMIT_JOB)
+
+
+def test_acl_namespace_glob():
+    pol = parse_policy('namespace "prod-*" { policy = "read" }')
+    acl = new_acl([pol])
+    assert acl.allow_namespace_operation("prod-web", NS_CAP_READ_JOB)
+    assert not acl.allow_namespace_operation("dev", NS_CAP_READ_JOB)
+
+
+def test_host_volume_policy():
+    pol = parse_policy('host_volume "data-*" { policy = "write" }')
+    acl = new_acl([pol])
+    assert acl.allow_host_volume_operation("data-1", "mount-readwrite")
+    assert not acl.allow_host_volume_operation("other", "mount-readonly")
+
+
+# ---------------------------------------------------------------------------
+# HTTP enforcement over a live agent
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def acl_agent():
+    a = Agent(
+        AgentConfig(
+            dev_mode=True,
+            num_schedulers=1,
+            acl_enabled=True,
+            name="acl-dev",
+        )
+    )
+    a.start()
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def root_token(acl_agent):
+    out = call(acl_agent.http_addr, "/v1/acl/bootstrap", method="POST")
+    assert out["Type"] == "management"
+    assert out["SecretID"]
+    return out["SecretID"]
+
+
+def test_anonymous_denied(acl_agent, root_token):
+    assert call_err(acl_agent.http_addr, "/v1/jobs") == 403
+
+
+def test_bootstrap_only_once(acl_agent, root_token):
+    assert call_err(acl_agent.http_addr, "/v1/acl/bootstrap", method="POST") == 400
+
+
+def test_management_token_allows(acl_agent, root_token):
+    jobs = call(acl_agent.http_addr, "/v1/jobs", token=root_token)
+    assert jobs == []
+
+
+def test_policy_token_lifecycle(acl_agent, root_token):
+    base = acl_agent.http_addr
+    # create a read-only policy
+    call(
+        base,
+        "/v1/acl/policy/readonly",
+        method="PUT",
+        body={
+            "Name": "readonly",
+            "Description": "read only",
+            "Rules": 'namespace "default" { policy = "read" }',
+        },
+        token=root_token,
+    )
+    pols = call(base, "/v1/acl/policies", token=root_token)
+    assert [p["Name"] for p in pols] == ["readonly"]
+
+    # bad rules are rejected
+    assert (
+        call_err(
+            base,
+            "/v1/acl/policy/bad",
+            method="PUT",
+            body={"Name": "bad", "Rules": 'namespace "x" { policy = "nope" }'},
+            token=root_token,
+        )
+        == 400
+    )
+
+    # mint a client token bound to the policy
+    tok = call(
+        base,
+        "/v1/acl/token",
+        method="PUT",
+        body={"Name": "ro", "Type": "client", "Policies": ["readonly"]},
+        token=root_token,
+    )
+    secret = tok["SecretID"]
+    assert secret and tok["AccessorID"]
+
+    # token can read but not write
+    assert call(base, "/v1/jobs", token=secret) == []
+    err = call_err(
+        base,
+        "/v1/jobs",
+        method="PUT",
+        body={"Job": {"ID": "x", "TaskGroups": []}},
+        token=secret,
+    )
+    assert err == 403
+
+    # node writes denied too (no node policy)
+    assert call_err(base, "/v1/system/gc", method="PUT", token=secret) in (403, 405)
+
+    # token self
+    me = call(base, "/v1/acl/token/self", token=secret)
+    assert me["AccessorID"] == tok["AccessorID"]
+
+    # management-only endpoints reject client tokens
+    assert call_err(base, "/v1/acl/tokens", token=secret) == 403
+
+    # token listing never leaks secrets
+    toks = call(base, "/v1/acl/tokens", token=root_token)
+    assert all(t["SecretID"] == "" for t in toks)
+
+    # delete the token; it stops resolving
+    call(
+        base,
+        f"/v1/acl/token/{tok['AccessorID']}",
+        method="DELETE",
+        token=root_token,
+    )
+    assert call_err(base, "/v1/jobs", token=secret) == 403
+
+
+def test_bad_token_rejected(acl_agent, root_token):
+    assert call_err(acl_agent.http_addr, "/v1/jobs", token="not-a-real-secret") == 403
