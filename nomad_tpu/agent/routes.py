@@ -10,7 +10,7 @@ Blocking queries ride the state store's ``blocking_query`` and stamp
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..structs.structs import (
     Allocation,
@@ -110,6 +110,7 @@ class Routes:
         r("/v1/agent/members", self.agent_members)
         r("/v1/regions", self.regions)
         r("/v1/validate/job", self.validate_job)
+        r("/v1/search", self.search)
 
     # -- jobs ------------------------------------------------------------
 
@@ -612,6 +613,57 @@ class Routes:
 
     def regions(self, req: Request):
         return self.agent.regions()
+
+    def search(self, req: Request):
+        """Prefix search across objects (reference nomad/search_endpoint.go;
+        truncates at 20 matches per context like truncateLimitQuery)."""
+        if req.method not in ("PUT", "POST"):
+            raise HTTPError(405, "method not allowed")
+        body = req.json() or {}
+        prefix = body.get("Prefix", "")
+        context = body.get("Context", "all") or "all"
+        ns = req.options.namespace
+        limit = 20
+        state = self.state
+        sources = {
+            "jobs": lambda: sorted(
+                j.id for j in state.jobs() if j.namespace == ns and j.id.startswith(prefix)
+            ),
+            "evals": lambda: sorted(
+                e.id for e in state.evals() if e.id.startswith(prefix)
+            ),
+            "allocs": lambda: sorted(
+                a.id for a in state.allocs() if a.id.startswith(prefix)
+            ),
+            "nodes": lambda: sorted(
+                n.id for n in state.nodes() if n.id.startswith(prefix)
+            ),
+            "deployment": lambda: sorted(
+                d.id for d in state.deployments() if d.id.startswith(prefix)
+            ),
+        }
+        if context != "all":
+            if context not in sources:
+                raise HTTPError(400, f"invalid search context {context!r}")
+            wanted = [context]
+        else:
+            wanted = list(sources)
+        cap_for = {
+            "jobs": "read-job",
+            "evals": "read-job",
+            "allocs": "read-job",
+            "deployment": "read-job",
+            "nodes": "node:read",
+        }
+        matches: Dict[str, List[str]] = {}
+        truncations: Dict[str, bool] = {}
+        for ctx in wanted:
+            self._authorize(req, cap_for[ctx])
+            ids = sources[ctx]()
+            truncations[ctx] = len(ids) > limit
+            matches[ctx] = ids[:limit]
+        req.response_index = self.state.latest_index
+        return {"Matches": matches, "Truncations": truncations, "Index": self.state.latest_index}
 
     def validate_job(self, req: Request):
         self._authorize(req, "read-job")

@@ -1,0 +1,22 @@
+"""Standalone HTTP API SDK (reference api/ package — importable without the
+rest of the framework; stdlib-only)."""
+
+from .api import (
+    APIError,
+    Client,
+    Config,
+    QueryMeta,
+    QueryOptions,
+    WriteMeta,
+    WriteOptions,
+)
+
+__all__ = [
+    "APIError",
+    "Client",
+    "Config",
+    "QueryMeta",
+    "QueryOptions",
+    "WriteMeta",
+    "WriteOptions",
+]

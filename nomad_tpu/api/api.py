@@ -1,0 +1,458 @@
+"""Typed HTTP client SDK (reference api/api.go:371 Client and the per-noun
+files api/jobs.go, api/nodes.go, api/allocations.go, api/evaluations.go,
+api/deployments.go, api/acl.go, api/operator.go, api/agent.go, api/search.go).
+
+The Go SDK is a standalone module importable without the rest of Nomad; this
+package mirrors that: it depends only on the standard library (urllib) and
+speaks the agent's Go-style wire JSON. Blocking queries work exactly like the
+reference: pass ``QueryOptions(wait_index=...)`` and the request long-polls
+until the server's index passes it, returning ``QueryMeta.last_index`` for the
+next call.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class APIError(Exception):
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(f"Unexpected response code: {code} ({message})")
+        self.code = code
+        self.message = message
+
+
+@dataclass
+class QueryOptions:
+    namespace: str = ""
+    region: str = ""
+    prefix: str = ""
+    auth_token: str = ""
+    wait_index: int = 0
+    wait_time: str = ""  # Go duration string, e.g. "5s"
+    params: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class WriteOptions:
+    namespace: str = ""
+    region: str = ""
+    auth_token: str = ""
+
+
+@dataclass
+class QueryMeta:
+    last_index: int = 0
+    known_leader: bool = False
+    request_time_ns: int = 0
+
+
+@dataclass
+class WriteMeta:
+    last_index: int = 0
+
+
+@dataclass
+class Config:
+    """Client configuration (reference api/api.go DefaultConfig)."""
+
+    address: str = "http://127.0.0.1:4646"
+    region: str = ""
+    namespace: str = ""
+    token: str = ""
+    timeout: float = 65.0
+
+
+class Client:
+    """Entry point; exposes one sub-client per API noun (api.go:371)."""
+
+    def __init__(self, config: Optional[Config] = None, **kw) -> None:
+        self.config = config or Config(**kw)
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.allocations = Allocations(self)
+        self.evaluations = Evaluations(self)
+        self.deployments = Deployments(self)
+        self.acl_policies = ACLPolicies(self)
+        self.acl_tokens = ACLTokens(self)
+        self.operator = Operator(self)
+        self.agent = AgentAPI(self)
+        self.system = System(self)
+        self.status = Status(self)
+        self.regions = Regions(self)
+        self.search = Search(self)
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _url(self, path: str, q: Optional[QueryOptions]) -> str:
+        params: Dict[str, str] = {}
+        ns = (q.namespace if q else "") or self.config.namespace
+        if ns:
+            params["namespace"] = ns
+        region = (q.region if q else "") or self.config.region
+        if region:
+            params["region"] = region
+        if q is not None:
+            if q.prefix:
+                params["prefix"] = q.prefix
+            if q.wait_index:
+                params["index"] = str(q.wait_index)
+            if q.wait_time:
+                params["wait"] = q.wait_time
+            params.update(q.params)
+        qs = urllib.parse.urlencode(params)
+        return self.config.address + path + (f"?{qs}" if qs else "")
+
+    def _do(
+        self,
+        method: str,
+        path: str,
+        body: Any = None,
+        q: Optional[QueryOptions] = None,
+    ) -> Tuple[Any, QueryMeta]:
+        url = self._url(path, q)
+        data = None
+        if body is not None:
+            data = body if isinstance(body, bytes) else json.dumps(body).encode()
+        headers = {}
+        token = (q.auth_token if q else "") or self.config.token
+        if token:
+            headers["X-Nomad-Token"] = token
+        if data is not None:
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=data, method=method, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.config.timeout) as resp:
+                payload = resp.read().decode()
+                meta = QueryMeta(
+                    last_index=int(resp.headers.get("X-Nomad-Index") or 0),
+                    known_leader=resp.headers.get("X-Nomad-KnownLeader") == "true",
+                )
+                return (json.loads(payload) if payload else None), meta
+        except urllib.error.HTTPError as e:
+            raise APIError(e.code, e.read().decode(errors="replace"))
+        except urllib.error.URLError as e:
+            raise APIError(0, str(e.reason))
+
+    def get(self, path: str, q: Optional[QueryOptions] = None):
+        return self._do("GET", path, None, q)
+
+    def put(self, path: str, body: Any = None, q: Optional[QueryOptions] = None):
+        return self._do("PUT", path, body, q)
+
+    def post(self, path: str, body: Any = None, q: Optional[QueryOptions] = None):
+        return self._do("POST", path, body, q)
+
+    def delete(self, path: str, q: Optional[QueryOptions] = None):
+        return self._do("DELETE", path, None, q)
+
+
+class _Sub:
+    def __init__(self, client: Client) -> None:
+        self.client = client
+
+
+# ---------------------------------------------------------------------------
+# Jobs (api/jobs.go)
+# ---------------------------------------------------------------------------
+
+
+class Jobs(_Sub):
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/jobs", q)
+
+    def info(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/job/{job_id}", q)
+
+    def register(self, job: Dict[str, Any], q: Optional[QueryOptions] = None):
+        return self.client.put("/v1/jobs", {"Job": job}, q)
+
+    def deregister(self, job_id: str, purge: bool = False, q: Optional[QueryOptions] = None):
+        q = q or QueryOptions()
+        if purge:
+            q.params["purge"] = "true"
+        return self.client.delete(f"/v1/job/{job_id}", q)
+
+    def parse_hcl(self, hcl: str, canonicalize: bool = True):
+        out, _ = self.client.post(
+            "/v1/jobs/parse", {"JobHCL": hcl, "Canonicalize": canonicalize}
+        )
+        return out
+
+    def validate(self, job: Dict[str, Any], q: Optional[QueryOptions] = None):
+        return self.client.put("/v1/validate/job", {"Job": job}, q)
+
+    def plan(self, job: Dict[str, Any], diff: bool = True, q: Optional[QueryOptions] = None):
+        return self.client.put(
+            f"/v1/job/{job.get('ID', '')}/plan", {"Job": job, "Diff": diff}, q
+        )
+
+    def evaluate(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.client.put(f"/v1/job/{job_id}/evaluate", {}, q)
+
+    def allocations(self, job_id: str, all_allocs: bool = False, q: Optional[QueryOptions] = None):
+        q = q or QueryOptions()
+        if all_allocs:
+            q.params["all"] = "true"
+        return self.client.get(f"/v1/job/{job_id}/allocations", q)
+
+    def evaluations(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/job/{job_id}/evaluations", q)
+
+    def deployments(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/job/{job_id}/deployments", q)
+
+    def latest_deployment(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/job/{job_id}/deployment", q)
+
+    def summary(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/job/{job_id}/summary", q)
+
+    def versions(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/job/{job_id}/versions", q)
+
+    def dispatch(
+        self,
+        job_id: str,
+        meta: Optional[Dict[str, str]] = None,
+        payload: bytes = b"",
+        q: Optional[QueryOptions] = None,
+    ):
+        import base64
+
+        body: Dict[str, Any] = {"Meta": meta or {}}
+        if payload:
+            body["Payload"] = base64.b64encode(payload).decode()
+        return self.client.put(f"/v1/job/{job_id}/dispatch", body, q)
+
+    def revert(self, job_id: str, version: int, q: Optional[QueryOptions] = None):
+        return self.client.put(
+            f"/v1/job/{job_id}/revert",
+            {"JobID": job_id, "JobVersion": version},
+            q,
+        )
+
+    def stable(self, job_id: str, version: int, stable: bool, q: Optional[QueryOptions] = None):
+        return self.client.put(
+            f"/v1/job/{job_id}/stable",
+            {"JobID": job_id, "JobVersion": version, "Stable": stable},
+            q,
+        )
+
+    def periodic_force(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.client.put(f"/v1/job/{job_id}/periodic/force", {}, q)
+
+
+# ---------------------------------------------------------------------------
+# Nodes (api/nodes.go)
+# ---------------------------------------------------------------------------
+
+
+class Nodes(_Sub):
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/nodes", q)
+
+    def info(self, node_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/node/{node_id}", q)
+
+    def allocations(self, node_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/node/{node_id}/allocations", q)
+
+    def evaluate(self, node_id: str, q: Optional[QueryOptions] = None):
+        return self.client.put(f"/v1/node/{node_id}/evaluate", {}, q)
+
+    def update_drain(
+        self,
+        node_id: str,
+        spec: Optional[Dict[str, Any]],
+        mark_eligible: bool = False,
+        q: Optional[QueryOptions] = None,
+    ):
+        return self.client.put(
+            f"/v1/node/{node_id}/drain",
+            {"DrainSpec": spec, "MarkEligible": mark_eligible},
+            q,
+        )
+
+    def toggle_eligibility(self, node_id: str, eligible: bool, q: Optional[QueryOptions] = None):
+        return self.client.put(
+            f"/v1/node/{node_id}/eligibility",
+            {"Eligibility": "eligible" if eligible else "ineligible"},
+            q,
+        )
+
+    def purge(self, node_id: str, q: Optional[QueryOptions] = None):
+        return self.client.put(f"/v1/node/{node_id}/purge", {}, q)
+
+
+# ---------------------------------------------------------------------------
+# Allocations / Evaluations / Deployments
+# ---------------------------------------------------------------------------
+
+
+class Allocations(_Sub):
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/allocations", q)
+
+    def info(self, alloc_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/allocation/{alloc_id}", q)
+
+    def stop(self, alloc_id: str, q: Optional[QueryOptions] = None):
+        return self.client.put(f"/v1/allocation/{alloc_id}/stop", {}, q)
+
+
+class Evaluations(_Sub):
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/evaluations", q)
+
+    def info(self, eval_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/evaluation/{eval_id}", q)
+
+    def allocations(self, eval_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/evaluation/{eval_id}/allocations", q)
+
+
+class Deployments(_Sub):
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/deployments", q)
+
+    def info(self, deployment_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/deployment/{deployment_id}", q)
+
+    def allocations(self, deployment_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/deployment/allocations/{deployment_id}", q)
+
+    def promote(self, deployment_id: str, groups: Optional[List[str]] = None, q=None):
+        body: Dict[str, Any] = {"DeploymentID": deployment_id}
+        if groups:
+            body["Groups"] = groups
+        else:
+            body["All"] = True
+        return self.client.put(f"/v1/deployment/promote/{deployment_id}", body, q)
+
+    def fail(self, deployment_id: str, q: Optional[QueryOptions] = None):
+        return self.client.put(f"/v1/deployment/fail/{deployment_id}", {}, q)
+
+    def pause(self, deployment_id: str, pause: bool, q: Optional[QueryOptions] = None):
+        return self.client.put(
+            f"/v1/deployment/pause/{deployment_id}",
+            {"DeploymentID": deployment_id, "Pause": pause},
+            q,
+        )
+
+
+# ---------------------------------------------------------------------------
+# ACL (api/acl.go)
+# ---------------------------------------------------------------------------
+
+
+class ACLPolicies(_Sub):
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/acl/policies", q)
+
+    def info(self, name: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/acl/policy/{name}", q)
+
+    def upsert(self, policy: Dict[str, Any], q: Optional[QueryOptions] = None):
+        return self.client.put(f"/v1/acl/policy/{policy['Name']}", policy, q)
+
+    def delete(self, name: str, q: Optional[QueryOptions] = None):
+        return self.client.delete(f"/v1/acl/policy/{name}", q)
+
+
+class ACLTokens(_Sub):
+    def bootstrap(self, q: Optional[QueryOptions] = None):
+        return self.client.put("/v1/acl/bootstrap", {}, q)
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/acl/tokens", q)
+
+    def info(self, accessor_id: str, q: Optional[QueryOptions] = None):
+        return self.client.get(f"/v1/acl/token/{accessor_id}", q)
+
+    def self(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/acl/token/self", q)
+
+    def create(self, token: Dict[str, Any], q: Optional[QueryOptions] = None):
+        return self.client.put("/v1/acl/token", token, q)
+
+    def update(self, token: Dict[str, Any], q: Optional[QueryOptions] = None):
+        return self.client.put(f"/v1/acl/token/{token['AccessorID']}", token, q)
+
+    def delete(self, accessor_id: str, q: Optional[QueryOptions] = None):
+        return self.client.delete(f"/v1/acl/token/{accessor_id}", q)
+
+
+# ---------------------------------------------------------------------------
+# Operator / Agent / System / Status / Regions / Search
+# ---------------------------------------------------------------------------
+
+
+class Operator(_Sub):
+    def scheduler_get_configuration(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/operator/scheduler/configuration", q)
+
+    def scheduler_set_configuration(self, config: Dict[str, Any], q=None):
+        return self.client.put("/v1/operator/scheduler/configuration", config, q)
+
+    def raft_get_configuration(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/operator/raft/configuration", q)
+
+
+class AgentAPI(_Sub):
+    def self(self):
+        out, _ = self.client.get("/v1/agent/self")
+        return out
+
+    def health(self):
+        out, _ = self.client.get("/v1/agent/health")
+        return out
+
+    def members(self):
+        out, _ = self.client.get("/v1/agent/members")
+        return out
+
+    def servers(self):
+        out, _ = self.client.get("/v1/agent/servers")
+        return out
+
+    def metrics(self):
+        out, _ = self.client.get("/v1/metrics")
+        return out
+
+
+class System(_Sub):
+    def garbage_collect(self):
+        return self.client.put("/v1/system/gc", {})
+
+    def reconcile_summaries(self):
+        return self.client.put("/v1/system/reconcile/summaries", {})
+
+
+class Status(_Sub):
+    def leader(self):
+        out, _ = self.client.get("/v1/status/leader")
+        return out
+
+    def peers(self):
+        out, _ = self.client.get("/v1/status/peers")
+        return out
+
+
+class Regions(_Sub):
+    def list(self):
+        out, _ = self.client.get("/v1/regions")
+        return sorted(out or [])
+
+
+class Search(_Sub):
+    def prefix_search(self, prefix: str, context: str = "all", q=None):
+        out, _ = self.client.post(
+            "/v1/search", {"Prefix": prefix, "Context": context}, q
+        )
+        return out
