@@ -1,0 +1,10 @@
+#!/usr/bin/env python3
+"""Launcher for the nomad_tpu CLI (reference: the single `nomad` binary)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from nomad_tpu.cli import main
+
+sys.exit(main())

@@ -150,6 +150,7 @@ class Agent:
                 "Addr": self.http.addr[0],
                 "Port": self.http.addr[1],
                 "Status": "alive",
+                "Leader": self.server.is_leader,
                 "Tags": {
                     "region": self.config.region,
                     "dc": self.config.datacenter,

@@ -1,0 +1,5 @@
+"""The `nomad` CLI (reference command/ package)."""
+
+from .main import main
+
+__all__ = ["main"]

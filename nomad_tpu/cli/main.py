@@ -1,0 +1,861 @@
+"""The `nomad` CLI (reference command/commands.go:57 registry + command/*.go).
+
+Usage: python -m nomad_tpu.cli <command> [sub] [flags] [args]
+
+Global flags (reference command/meta.go FlagSet): -address, -region,
+-namespace, -token — with NOMAD_ADDR / NOMAD_REGION / NOMAD_NAMESPACE /
+NOMAD_TOKEN environment fallbacks.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import signal
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import APIError, Client, Config, QueryOptions
+from .fmt import ago, columns, kv, short_id
+from .monitor import monitor_eval
+
+
+class CLIError(Exception):
+    pass
+
+
+class Ctx:
+    """Parsed global flags + lazy API client."""
+
+    def __init__(self) -> None:
+        self.address = os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+        self.region = os.environ.get("NOMAD_REGION", "")
+        self.namespace = os.environ.get("NOMAD_NAMESPACE", "")
+        self.token = os.environ.get("NOMAD_TOKEN", "")
+        self.out: Callable[[str], None] = print
+        self._client: Optional[Client] = None
+
+    @property
+    def client(self) -> Client:
+        if self._client is None:
+            self._client = Client(
+                Config(
+                    address=self.address,
+                    region=self.region,
+                    namespace=self.namespace,
+                    token=self.token,
+                )
+            )
+        return self._client
+
+
+def _split_flags(args: List[str]) -> Tuple[Dict[str, str], List[str]]:
+    """Nomad-style single-dash flags: -flag, -flag=value, -flag value."""
+    flags: Dict[str, str] = {}
+    rest: List[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("-") and len(a) > 1 and not a[1].isdigit():
+            name = a.lstrip("-")
+            if "=" in name:
+                name, _, val = name.partition("=")
+                flags[name] = val
+            elif i + 1 < len(args) and not args[i + 1].startswith("-") and _wants_value(name):
+                flags[name] = args[i + 1]
+                i += 1
+            else:
+                flags[name] = "true"
+        else:
+            rest.append(a)
+        i += 1
+    return flags, rest
+
+
+_VALUE_FLAGS = {
+    "address", "region", "namespace", "token", "job", "output", "type",
+    "deadline", "meta", "payload", "name", "policy", "rules",
+    "description", "bind", "http-port", "config", "version", "limit",
+    "per-page", "node-class", "datacenter",
+}
+
+
+def _wants_value(name: str) -> bool:
+    return name in _VALUE_FLAGS
+
+
+def _apply_global_flags(ctx: Ctx, flags: Dict[str, str]) -> None:
+    if "address" in flags:
+        ctx.address = flags["address"]
+    if "region" in flags:
+        ctx.region = flags["region"]
+    if "namespace" in flags:
+        ctx.namespace = flags["namespace"]
+    if "token" in flags:
+        ctx.token = flags["token"]
+
+
+def _truthy(flags: Dict[str, str], name: str) -> bool:
+    return flags.get(name, "").lower() in ("true", "1", "yes")
+
+
+# ---------------------------------------------------------------------------
+# agent
+# ---------------------------------------------------------------------------
+
+
+def cmd_agent(ctx: Ctx, args: List[str]) -> int:
+    flags, _ = _split_flags(args)
+    from ..agent import Agent, AgentConfig
+
+    dev = _truthy(flags, "dev")
+    cfg = AgentConfig(
+        dev_mode=dev,
+        name=flags.get("name", "agent-1"),
+        http_bind=flags.get("bind", "127.0.0.1"),
+        http_port=int(flags.get("http-port", "4646")),
+        acl_enabled=_truthy(flags, "acl-enabled"),
+    )
+    agent = Agent(cfg)
+    agent.start()
+    ctx.out(f"==> Nomad agent started! HTTP at {agent.http_addr}")
+    ctx.out("==> Nomad agent configuration:")
+    ctx.out(kv([
+        ("Client", agent.client is not None),
+        ("Server", agent.server is not None),
+        ("ACL", cfg.acl_enabled),
+        ("Region", "global"),
+    ]))
+    stop = {"flag": False}
+
+    def _sig(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        ctx.out("==> Caught signal, gracefully shutting down")
+        agent.shutdown()
+    return 0
+
+
+def cmd_agent_info(ctx: Ctx, args: List[str]) -> int:
+    info = ctx.client.agent.self()
+    ctx.out(json.dumps(info, indent=2, sort_keys=True))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# job family
+# ---------------------------------------------------------------------------
+
+
+def _read_jobfile(ctx: Ctx, path: str) -> dict:
+    if path == "-":
+        src = sys.stdin.read()
+    else:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    if path.endswith(".json"):
+        doc = json.loads(src)
+        return doc.get("Job", doc)
+    return ctx.client.jobs.parse_hcl(src, canonicalize=True)
+
+
+def cmd_job_run(ctx: Ctx, args: List[str]) -> int:
+    flags, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job run [-detach] <jobfile>")
+    job = _read_jobfile(ctx, rest[0])
+    out, _ = ctx.client.jobs.register(job)
+    eval_id = out.get("EvalID", "")
+    if not eval_id:
+        ctx.out(f'Job registration successful (no evaluation: periodic or parameterized)')
+        return 0
+    if _truthy(flags, "detach"):
+        ctx.out(f"Job registration successful")
+        ctx.out(f"Evaluation ID: {eval_id}")
+        return 0
+    return monitor_eval(ctx.client, eval_id, ctx.out, verbose=_truthy(flags, "verbose"))
+
+
+def cmd_job_plan(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job plan <jobfile>")
+    job = _read_jobfile(ctx, rest[0])
+    plan, _ = ctx.client.jobs.plan(job, diff=True)
+    diff = plan.get("Diff") or {}
+    ctx.out(f"+/- Job: \"{job.get('ID','')}\" ({diff.get('Type','None')})")
+    for tg in plan.get("Annotations", {}).get("DesiredTGUpdates", {}).items():
+        name, upd = tg
+        parts = [
+            f"{k.lower()}: {v}"
+            for k, v in sorted(upd.items())
+            if isinstance(v, int) and v
+        ]
+        ctx.out(f"    group \"{name}\": " + (", ".join(parts) or "no changes"))
+    failures = plan.get("FailedTGAllocs") or {}
+    if failures:
+        ctx.out("==> WARNING: failed to place all allocations:")
+        for tg in failures:
+            ctx.out(f"    group {tg!r}")
+    ctx.out(f"Job Modify Index: {plan.get('JobModifyIndex', 0)}")
+    return 1 if failures else 0
+
+
+def cmd_job_status(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    c = ctx.client
+    if not rest:
+        jobs, _ = c.jobs.list()
+        if not jobs:
+            ctx.out("No running jobs")
+            return 0
+        rows = [["ID", "Type", "Priority", "Status", "Submit Date"]]
+        for j in jobs:
+            rows.append([j["ID"], j["Type"], j["Priority"], j["Status"], ""])
+        ctx.out(columns(rows))
+        return 0
+    job_id = rest[0]
+    job, _ = c.jobs.info(job_id)
+    summary, _ = c.jobs.summary(job_id)
+    ctx.out(kv([
+        ("ID", job["ID"]),
+        ("Name", job["Name"]),
+        ("Submit Date", ""),
+        ("Type", job["Type"]),
+        ("Priority", job["Priority"]),
+        ("Datacenters", ",".join(job.get("Datacenters") or [])),
+        ("Namespace", job.get("Namespace", "default")),
+        ("Status", job["Status"]),
+        ("Periodic", bool(job.get("Periodic"))),
+        ("Parameterized", bool(job.get("ParameterizedJob"))),
+    ]))
+    ctx.out("\nSummary")
+    rows = [["Task Group", "Queued", "Starting", "Running", "Failed", "Complete", "Lost"]]
+    for tg, s in sorted((summary.get("Summary") or {}).items()):
+        rows.append([
+            tg, s.get("Queued", 0), s.get("Starting", 0), s.get("Running", 0),
+            s.get("Failed", 0), s.get("Complete", 0), s.get("Lost", 0),
+        ])
+    ctx.out(columns(rows))
+    allocs, _ = c.jobs.allocations(job_id)
+    if allocs:
+        ctx.out("\nAllocations")
+        rows = [["ID", "Node ID", "Task Group", "Version", "Desired", "Status", "Created"]]
+        for a in allocs:
+            rows.append([
+                short_id(a["ID"]), short_id(a.get("NodeID", "")), a.get("TaskGroup", ""),
+                a.get("JobVersion", 0), a.get("DesiredStatus", ""),
+                a.get("ClientStatus", ""), ago(a.get("CreateTime", 0)),
+            ])
+        ctx.out(columns(rows))
+    return 0
+
+
+def cmd_job_stop(ctx: Ctx, args: List[str]) -> int:
+    flags, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job stop [-purge] [-detach] <job>")
+    out, _ = ctx.client.jobs.deregister(rest[0], purge=_truthy(flags, "purge"))
+    eval_id = out.get("EvalID", "")
+    if _truthy(flags, "detach") or not eval_id:
+        ctx.out(f"Evaluation ID: {eval_id}")
+        return 0
+    return monitor_eval(ctx.client, eval_id, ctx.out)
+
+
+def cmd_job_history(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job history <job>")
+    versions, _ = ctx.client.jobs.versions(rest[0])
+    for v in versions or []:
+        ctx.out(kv([
+            ("Version", v.get("Version", 0)),
+            ("Stable", v.get("Stable", False)),
+            ("Status", v.get("Status", "")),
+        ]))
+        ctx.out("")
+    return 0
+
+
+def cmd_job_revert(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    if len(rest) < 2:
+        raise CLIError("usage: nomad job revert <job> <version>")
+    out, _ = ctx.client.jobs.revert(rest[0], int(rest[1]))
+    return monitor_eval(ctx.client, out.get("EvalID", ""), ctx.out)
+
+
+def cmd_job_dispatch(ctx: Ctx, args: List[str]) -> int:
+    flags, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job dispatch [-meta k=v] [-payload file] <job>")
+    meta = {}
+    if "meta" in flags:
+        k, _, v = flags["meta"].partition("=")
+        meta[k] = v
+    payload = b""
+    if "payload" in flags:
+        with open(flags["payload"], "rb") as f:
+            payload = f.read()
+    out, _ = ctx.client.jobs.dispatch(rest[0], meta=meta, payload=payload)
+    ctx.out(f"Dispatched Job ID: {out.get('DispatchedJobID','')}")
+    ctx.out(f"Evaluation ID: {short_id(out.get('EvalID',''))}")
+    return 0
+
+
+def cmd_job_inspect(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job inspect <job>")
+    job, _ = ctx.client.jobs.info(rest[0])
+    ctx.out(json.dumps({"Job": job}, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_job_validate(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job validate <jobfile>")
+    job = _read_jobfile(ctx, rest[0])
+    res, _ = ctx.client.jobs.validate(job)
+    errs = res.get("ValidationErrors") or []
+    if errs:
+        for e in errs:
+            ctx.out(f"  * {e}")
+        return 1
+    ctx.out("Job validation successful")
+    return 0
+
+
+def cmd_job_periodic_force(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job periodic force <job>")
+    out, _ = ctx.client.jobs.periodic_force(rest[0])
+    ctx.out(f"Evaluation ID: {out.get('EvalID','')}")
+    return 0
+
+
+def cmd_job(ctx: Ctx, args: List[str]) -> int:
+    subs = {
+        "run": cmd_job_run,
+        "plan": cmd_job_plan,
+        "status": cmd_job_status,
+        "stop": cmd_job_stop,
+        "history": cmd_job_history,
+        "revert": cmd_job_revert,
+        "dispatch": cmd_job_dispatch,
+        "inspect": cmd_job_inspect,
+        "validate": cmd_job_validate,
+        "periodic": lambda c, a: cmd_job_periodic_force(c, a[1:]) if a and a[0] == "force" else _usage(c, "job periodic force <job>"),
+    }
+    return _dispatch(ctx, args, subs, "job")
+
+
+# ---------------------------------------------------------------------------
+# node family
+# ---------------------------------------------------------------------------
+
+
+def cmd_node_status(ctx: Ctx, args: List[str]) -> int:
+    flags, rest = _split_flags(args)
+    c = ctx.client
+    if not rest:
+        nodes, _ = c.nodes.list()
+        rows = [["ID", "DC", "Name", "Class", "Drain", "Eligibility", "Status"]]
+        for n in nodes or []:
+            rows.append([
+                short_id(n["ID"]), n.get("Datacenter", ""), n.get("Name", ""),
+                n.get("NodeClass", ""), n.get("Drain", False),
+                n.get("SchedulingEligibility", ""), n.get("Status", ""),
+            ])
+        ctx.out(columns(rows))
+        return 0
+    node, _ = c.nodes.info(_resolve_node(ctx, rest[0]))
+    ctx.out(kv([
+        ("ID", node["ID"]),
+        ("Name", node.get("Name", "")),
+        ("Class", node.get("NodeClass", "")),
+        ("DC", node.get("Datacenter", "")),
+        ("Drain", node.get("Drain", False)),
+        ("Eligibility", node.get("SchedulingEligibility", "")),
+        ("Status", node.get("Status", "")),
+    ]))
+    allocs, _ = c.nodes.allocations(node["ID"])
+    if allocs:
+        ctx.out("\nAllocations")
+        rows = [["ID", "Job ID", "Task Group", "Desired", "Status"]]
+        for a in allocs:
+            rows.append([
+                short_id(a["ID"]), a.get("JobID", ""), a.get("TaskGroup", ""),
+                a.get("DesiredStatus", ""), a.get("ClientStatus", ""),
+            ])
+        ctx.out(columns(rows))
+    return 0
+
+
+def _resolve_node(ctx: Ctx, prefix: str) -> str:
+    nodes, _ = ctx.client.nodes.list()
+    matches = [n["ID"] for n in nodes or [] if n["ID"].startswith(prefix)]
+    if len(matches) == 1:
+        return matches[0]
+    if not matches:
+        raise CLIError(f"No node(s) with prefix {prefix!r} found")
+    raise CLIError(f"Prefix {prefix!r} matched multiple nodes")
+
+
+def cmd_node_drain(ctx: Ctx, args: List[str]) -> int:
+    flags, rest = _split_flags(args)
+    if not rest or not (_truthy(flags, "enable") or _truthy(flags, "disable")):
+        raise CLIError("usage: nomad node drain [-enable|-disable] [-deadline dur] <node>")
+    node_id = _resolve_node(ctx, rest[0])
+    spec = None
+    if _truthy(flags, "enable"):
+        deadline_ns = 3600 * 10**9  # DefaultDrainDeadline (1h)
+        if "deadline" in flags:
+            from ..jobspec import parse_duration_ns
+
+            deadline_ns = parse_duration_ns(flags["deadline"])
+        spec = {
+            "Deadline": deadline_ns,
+            "IgnoreSystemJobs": _truthy(flags, "ignore-system"),
+        }
+    ctx.client.nodes.update_drain(node_id, spec, mark_eligible=_truthy(flags, "disable"))
+    state = "enabled" if spec else "disabled"
+    ctx.out(f"Node \"{short_id(node_id)}\" drain strategy {state}")
+    return 0
+
+
+def cmd_node_eligibility(ctx: Ctx, args: List[str]) -> int:
+    flags, rest = _split_flags(args)
+    if not rest or not (_truthy(flags, "enable") or _truthy(flags, "disable")):
+        raise CLIError("usage: nomad node eligibility [-enable|-disable] <node>")
+    node_id = _resolve_node(ctx, rest[0])
+    eligible = _truthy(flags, "enable")
+    ctx.client.nodes.toggle_eligibility(node_id, eligible)
+    ctx.out(
+        f"Node \"{short_id(node_id)}\" scheduling eligibility set: "
+        + ("eligible for scheduling" if eligible else "ineligible for scheduling")
+    )
+    return 0
+
+
+def cmd_node(ctx: Ctx, args: List[str]) -> int:
+    return _dispatch(ctx, args, {
+        "status": cmd_node_status,
+        "drain": cmd_node_drain,
+        "eligibility": cmd_node_eligibility,
+    }, "node")
+
+
+# ---------------------------------------------------------------------------
+# alloc / eval / deployment
+# ---------------------------------------------------------------------------
+
+
+def cmd_alloc_status(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad alloc status <alloc-id>")
+    allocs, _ = ctx.client.allocations.list(QueryOptions(prefix=rest[0]))
+    matches = [a for a in allocs or [] if a["ID"].startswith(rest[0])]
+    if len(matches) != 1:
+        raise CLIError(f"prefix {rest[0]!r} matched {len(matches)} allocations")
+    alloc, _ = ctx.client.allocations.info(matches[0]["ID"])
+    ctx.out(kv([
+        ("ID", alloc["ID"]),
+        ("Eval ID", short_id(alloc.get("EvalID", ""))),
+        ("Name", alloc.get("Name", "")),
+        ("Node ID", short_id(alloc.get("NodeID", ""))),
+        ("Job ID", alloc.get("JobID", "")),
+        ("Job Version", alloc.get("JobVersion", 0)),
+        ("Client Status", alloc.get("ClientStatus", "")),
+        ("Desired Status", alloc.get("DesiredStatus", "")),
+        ("Created", ago(alloc.get("CreateTime", 0))),
+    ]))
+    states = alloc.get("TaskStates") or {}
+    for task, st in sorted(states.items()):
+        ctx.out(f"\nTask \"{task}\" is \"{st.get('State','')}\"")
+        events = st.get("Events") or []
+        if events:
+            rows = [["Time", "Type", "Description"]]
+            for e in events:
+                rows.append([ago(e.get("Time", 0)), e.get("Type", ""), e.get("DisplayMessage", e.get("Message", ""))])
+            ctx.out(columns(rows))
+    metrics = alloc.get("Metrics") or {}
+    if metrics.get("NodesEvaluated") is not None:
+        ctx.out("\nPlacement Metrics")
+        ctx.out(kv([
+            ("Nodes Evaluated", metrics.get("NodesEvaluated", 0)),
+            ("Nodes Filtered", metrics.get("NodesFiltered", 0)),
+            ("Nodes Exhausted", metrics.get("NodesExhausted", 0)),
+        ]))
+    return 0
+
+
+def cmd_eval_status(ctx: Ctx, args: List[str]) -> int:
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad eval status <eval-id>")
+    evals, _ = ctx.client.evaluations.list(QueryOptions(prefix=rest[0]))
+    matches = [e for e in evals or [] if e["ID"].startswith(rest[0])]
+    if len(matches) != 1:
+        raise CLIError(f"prefix {rest[0]!r} matched {len(matches)} evaluations")
+    ev, _ = ctx.client.evaluations.info(matches[0]["ID"])
+    ctx.out(kv([
+        ("ID", ev["ID"]),
+        ("Status", ev.get("Status", "")),
+        ("Type", ev.get("Type", "")),
+        ("TriggeredBy", ev.get("TriggeredBy", "")),
+        ("Job ID", ev.get("JobID", "")),
+        ("Priority", ev.get("Priority", 0)),
+        ("Placement Failures", bool(ev.get("FailedTGAllocs"))),
+    ]))
+    return 0
+
+
+def cmd_deployment(ctx: Ctx, args: List[str]) -> int:
+    def dlist(ctx, a):
+        deps, _ = ctx.client.deployments.list()
+        rows = [["ID", "Job ID", "Job Version", "Status", "Description"]]
+        for d in deps or []:
+            rows.append([
+                short_id(d["ID"]), d.get("JobID", ""), d.get("JobVersion", 0),
+                d.get("Status", ""), d.get("StatusDescription", ""),
+            ])
+        ctx.out(columns(rows))
+        return 0
+
+    def dstatus(ctx, a):
+        _, rest = _split_flags(a)
+        if not rest:
+            raise CLIError("usage: nomad deployment status <id>")
+        deps, _ = ctx.client.deployments.list()
+        matches = [d for d in deps or [] if d["ID"].startswith(rest[0])]
+        if len(matches) != 1:
+            raise CLIError(f"prefix matched {len(matches)} deployments")
+        d, _ = ctx.client.deployments.info(matches[0]["ID"])
+        ctx.out(kv([
+            ("ID", d["ID"]),
+            ("Job ID", d.get("JobID", "")),
+            ("Job Version", d.get("JobVersion", 0)),
+            ("Status", d.get("Status", "")),
+            ("Description", d.get("StatusDescription", "")),
+        ]))
+        rows = [["Task Group", "Desired", "Placed", "Healthy", "Unhealthy", "Promoted"]]
+        for tg, s in sorted((d.get("TaskGroups") or {}).items()):
+            rows.append([
+                tg, s.get("DesiredTotal", 0), s.get("PlacedAllocs", 0),
+                s.get("HealthyAllocs", 0), s.get("UnhealthyAllocs", 0),
+                s.get("Promoted", False),
+            ])
+        ctx.out("\nDeployed")
+        ctx.out(columns(rows))
+        return 0
+
+    def dpromote(ctx, a):
+        _, rest = _split_flags(a)
+        if not rest:
+            raise CLIError("usage: nomad deployment promote <id>")
+        out, _ = ctx.client.deployments.promote(rest[0])
+        return monitor_eval(ctx.client, out.get("EvalID", ""), ctx.out) if out.get("EvalID") else 0
+
+    def dfail(ctx, a):
+        _, rest = _split_flags(a)
+        if not rest:
+            raise CLIError("usage: nomad deployment fail <id>")
+        ctx.client.deployments.fail(rest[0])
+        ctx.out("Deployment marked as failed")
+        return 0
+
+    return _dispatch(ctx, args, {
+        "list": dlist, "status": dstatus, "promote": dpromote, "fail": dfail,
+    }, "deployment")
+
+
+# ---------------------------------------------------------------------------
+# acl family
+# ---------------------------------------------------------------------------
+
+
+def cmd_acl(ctx: Ctx, args: List[str]) -> int:
+    c = ctx.client
+
+    def bootstrap(ctx, a):
+        tok, _ = c.acl_tokens.bootstrap()
+        ctx.out(kv([
+            ("Accessor ID", tok["AccessorID"]),
+            ("Secret ID", tok["SecretID"]),
+            ("Name", tok["Name"]),
+            ("Type", tok["Type"]),
+            ("Global", tok.get("Global", False)),
+            ("Policies", "n/a"),
+        ]))
+        return 0
+
+    def policy(ctx, a):
+        if not a:
+            raise CLIError("usage: nomad acl policy <apply|list|info|delete>")
+        sub, rest_args = a[0], a[1:]
+        if sub == "apply":
+            flags, rest = _split_flags(rest_args)
+            if len(rest) < 2:
+                raise CLIError("usage: nomad acl policy apply <name> <rules-file>")
+            with open(rest[1], "r", encoding="utf-8") as f:
+                rules = f.read()
+            c.acl_policies.upsert({
+                "Name": rest[0],
+                "Description": flags.get("description", ""),
+                "Rules": rules,
+            })
+            ctx.out(f"Successfully wrote {rest[0]!r} ACL policy!")
+            return 0
+        if sub == "list":
+            pols, _ = c.acl_policies.list()
+            rows = [["Name", "Description"]]
+            for p in pols or []:
+                rows.append([p["Name"], p.get("Description", "")])
+            ctx.out(columns(rows))
+            return 0
+        if sub == "info":
+            if not rest_args:
+                raise CLIError("usage: nomad acl policy info <name>")
+            p, _ = c.acl_policies.info(rest_args[0])
+            ctx.out(kv([("Name", p["Name"]), ("Description", p.get("Description", ""))]))
+            ctx.out("Rules\n" + p.get("Rules", ""))
+            return 0
+        if sub == "delete":
+            if not rest_args:
+                raise CLIError("usage: nomad acl policy delete <name>")
+            c.acl_policies.delete(rest_args[0])
+            ctx.out(f"Successfully deleted {rest_args[0]!r} ACL policy!")
+            return 0
+        raise CLIError(f"unknown acl policy subcommand {sub!r}")
+
+    def token(ctx, a):
+        if not a:
+            raise CLIError("usage: nomad acl token <create|list|info|self|delete>")
+        sub, rest_args = a[0], a[1:]
+        if sub == "create":
+            flags, _ = _split_flags(rest_args)
+            policies = [p for p in flags.get("policy", "").split(",") if p]
+            tok, _ = c.acl_tokens.create({
+                "Name": flags.get("name", ""),
+                "Type": flags.get("type", "client"),
+                "Policies": policies,
+                "Global": _truthy(flags, "global"),
+            })
+            ctx.out(kv([
+                ("Accessor ID", tok["AccessorID"]),
+                ("Secret ID", tok["SecretID"]),
+                ("Name", tok.get("Name", "")),
+                ("Type", tok["Type"]),
+                ("Policies", ",".join(tok.get("Policies") or [])),
+            ]))
+            return 0
+        if sub == "list":
+            toks, _ = c.acl_tokens.list()
+            rows = [["Name", "Type", "Global", "Accessor ID"]]
+            for t in toks or []:
+                rows.append([t.get("Name", ""), t["Type"], t.get("Global", False), t["AccessorID"]])
+            ctx.out(columns(rows))
+            return 0
+        if sub == "self":
+            tok, _ = c.acl_tokens.self()
+            ctx.out(kv([("Accessor ID", tok["AccessorID"]), ("Name", tok.get("Name", "")), ("Type", tok["Type"])]))
+            return 0
+        if sub == "info":
+            if not rest_args:
+                raise CLIError("usage: nomad acl token info <accessor>")
+            tok, _ = c.acl_tokens.info(rest_args[0])
+            ctx.out(kv([("Accessor ID", tok["AccessorID"]), ("Name", tok.get("Name", "")), ("Type", tok["Type"])]))
+            return 0
+        if sub == "delete":
+            if not rest_args:
+                raise CLIError("usage: nomad acl token delete <accessor>")
+            c.acl_tokens.delete(rest_args[0])
+            ctx.out("Token deleted successfully")
+            return 0
+        raise CLIError(f"unknown acl token subcommand {sub!r}")
+
+    return _dispatch(ctx, args, {"bootstrap": bootstrap, "policy": policy, "token": token}, "acl")
+
+
+# ---------------------------------------------------------------------------
+# operator / system / server / misc
+# ---------------------------------------------------------------------------
+
+
+def cmd_operator(ctx: Ctx, args: List[str]) -> int:
+    def sched(ctx, a):
+        flags, rest = _split_flags(a)
+        if rest and rest[0] == "set-config":
+            body = {}
+            if "scheduler-algorithm" in flags:
+                body["SchedulerAlgorithm"] = flags["scheduler-algorithm"]
+            if "preemption-system" in flags:
+                body["PreemptionConfig"] = {"SystemSchedulerEnabled": _truthy(flags, "preemption-system")}
+            ctx.client.operator.scheduler_set_configuration(body)
+            ctx.out("Scheduler configuration updated!")
+            return 0
+        cfg, _ = ctx.client.operator.scheduler_get_configuration()
+        ctx.out(json.dumps(cfg, indent=2, sort_keys=True))
+        return 0
+
+    def raft(ctx, a):
+        _, rest = _split_flags(a)
+        raftcfg, _ = ctx.client.operator.raft_get_configuration()
+        rows = [["Node", "ID", "Address", "State", "Voter"]]
+        for s in raftcfg.get("Servers") or []:
+            rows.append([
+                s.get("Node", ""), s.get("ID", ""), s.get("Address", ""),
+                "leader" if s.get("Leader") else "follower", s.get("Voter", True),
+            ])
+        ctx.out(columns(rows))
+        return 0
+
+    return _dispatch(ctx, args, {
+        "scheduler": sched,
+        "scheduler-config": sched,
+        "raft": raft,
+    }, "operator")
+
+
+def cmd_system(ctx: Ctx, args: List[str]) -> int:
+    def gc(ctx, a):
+        ctx.client.system.garbage_collect()
+        ctx.out("System GC triggered")
+        return 0
+
+    def reconcile(ctx, a):
+        ctx.client.system.reconcile_summaries()
+        ctx.out("Summaries reconciled")
+        return 0
+
+    return _dispatch(ctx, args, {"gc": gc, "reconcile": reconcile}, "system")
+
+
+def cmd_server(ctx: Ctx, args: List[str]) -> int:
+    def members(ctx, a):
+        out = ctx.client.agent.members()
+        rows = [["Name", "Address", "Port", "Status", "Leader", "Region"]]
+        for m in out.get("Members") or []:
+            rows.append([
+                m.get("Name", ""), m.get("Addr", ""), m.get("Port", 0),
+                m.get("Status", ""), m.get("Leader", False), m.get("Region", "global"),
+            ])
+        ctx.out(columns(rows))
+        return 0
+
+    return _dispatch(ctx, args, {"members": members}, "server")
+
+
+def cmd_ui(ctx: Ctx, args: List[str]) -> int:
+    ctx.out(ctx.address + "/ui/")
+    return 0
+
+
+def cmd_version(ctx: Ctx, args: List[str]) -> int:
+    from .. import __version__
+
+    ctx.out(f"Nomad-TPU v{__version__}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# registry + entry point
+# ---------------------------------------------------------------------------
+
+
+def _usage(ctx: Ctx, text: str) -> int:
+    ctx.out(f"usage: nomad {text}")
+    return 1
+
+
+def _dispatch(ctx: Ctx, args: List[str], subs: Dict[str, Callable], family: str) -> int:
+    if not args or args[0] not in subs:
+        ctx.out(f"usage: nomad {family} <{('|'.join(subs))}>")
+        return 1
+    return subs[args[0]](ctx, args[1:])
+
+
+COMMANDS: Dict[str, Callable[[Ctx, List[str]], int]] = {
+    "agent": cmd_agent,
+    "agent-info": cmd_agent_info,
+    "job": cmd_job,
+    "node": cmd_node,
+    "alloc": lambda c, a: _dispatch(c, a, {"status": cmd_alloc_status}, "alloc"),
+    "eval": lambda c, a: _dispatch(c, a, {"status": cmd_eval_status}, "eval"),
+    "deployment": cmd_deployment,
+    "acl": cmd_acl,
+    "operator": cmd_operator,
+    "system": cmd_system,
+    "server": cmd_server,
+    "ui": cmd_ui,
+    "version": cmd_version,
+    # top-level aliases (reference keeps `nomad run` etc. working)
+    "run": cmd_job_run,
+    "plan": cmd_job_plan,
+    "status": cmd_job_status,
+    "stop": cmd_job_stop,
+    "validate": cmd_job_validate,
+    "inspect": cmd_job_inspect,
+}
+
+
+def main(argv: Optional[List[str]] = None, out: Callable[[str], None] = print) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ctx = Ctx()
+    ctx.out = out
+    # peel global flags wherever they appear
+    flags, rest = _split_flags(argv)
+    _apply_global_flags(ctx, flags)
+    # put non-global flags back for the subcommand (they were consumed;
+    # simplest correct approach: re-split per command from the raw argv
+    # minus global flag tokens)
+    cleaned: List[str] = []
+    skip = False
+    for i, a in enumerate(argv):
+        if skip:
+            skip = False
+            continue
+        name = a.lstrip("-").partition("=")[0]
+        if a.startswith("-") and name in ("address", "region", "namespace", "token"):
+            if "=" not in a and i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+                skip = True
+            continue
+        cleaned.append(a)
+    if not cleaned:
+        out("usage: nomad <command> [args]")
+        out("Commands: " + ", ".join(sorted(COMMANDS)))
+        return 1
+    cmd, args = cleaned[0], cleaned[1:]
+    fn = COMMANDS.get(cmd)
+    if fn is None:
+        out(f"unknown command {cmd!r}")
+        out("Commands: " + ", ".join(sorted(COMMANDS)))
+        return 1
+    try:
+        return fn(ctx, args)
+    except CLIError as e:
+        out(f"Error: {e}")
+        return 1
+    except APIError as e:
+        out(f"Error querying server: {e}")
+        return 1
+    except FileNotFoundError as e:
+        out(f"Error: {e}")
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

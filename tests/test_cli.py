@@ -1,0 +1,145 @@
+"""CLI tests driving a live agent through nomad_tpu.cli.main (reference
+command/*_test.go patterns: run command, assert output + exit code)."""
+
+import time
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.cli.main import main
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(dev_mode=True, num_schedulers=2, name="cli-dev"))
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def run_cli(agent, *args):
+    lines = []
+    code = main(["-address", agent.http_addr, *args], out=lines.append)
+    return code, "\n".join(lines)
+
+
+JOBFILE = """
+job "cli-job" {
+  datacenters = ["dc1"]
+  group "g" {
+    count = 2
+    task "t" {
+      driver = "mock"
+      config { run_for = "20s" }
+      resources { cpu = 100 memory = 64 }
+    }
+  }
+}
+"""
+
+
+def test_version_and_usage(agent):
+    code, out = run_cli(agent, "version")
+    assert code == 0 and "Nomad-TPU" in out
+    code, out = run_cli(agent)
+    assert code == 1 and "usage" in out
+    code, out = run_cli(agent, "frobnicate")
+    assert code == 1 and "unknown command" in out
+
+
+def test_job_run_and_status(agent, tmp_path):
+    jf = tmp_path / "job.hcl"
+    jf.write_text(JOBFILE)
+    code, out = run_cli(agent, "job", "run", str(jf))
+    assert code == 0, out
+    assert "Monitoring evaluation" in out
+    assert 'finished with status "complete"' in out
+    assert out.count("created: node") == 2
+
+    code, out = run_cli(agent, "job", "status")
+    assert code == 0 and "cli-job" in out
+
+    code, out = run_cli(agent, "job", "status", "cli-job")
+    assert code == 0
+    assert "Summary" in out and "Allocations" in out
+    assert "cli-job" in out
+
+    code, out = run_cli(agent, "status", "cli-job")  # top-level alias
+    assert code == 0 and "cli-job" in out
+
+
+def test_job_plan_and_validate(agent, tmp_path):
+    jf = tmp_path / "job2.hcl"
+    jf.write_text(JOBFILE.replace("cli-job", "cli-plan").replace("count = 2", "count = 3"))
+    code, out = run_cli(agent, "job", "validate", str(jf))
+    assert code == 0 and "validation successful" in out
+    code, out = run_cli(agent, "job", "plan", str(jf))
+    assert code == 0, out
+    assert "Job Modify Index" in out
+    # plan must not register
+    code, out = run_cli(agent, "job", "status", "cli-plan")
+    assert code == 1
+
+
+def test_node_commands(agent):
+    code, out = run_cli(agent, "node", "status")
+    assert code == 0 and "ready" in out
+    node_id = out.splitlines()[1].split()[0]
+
+    code, out = run_cli(agent, "node", "status", node_id)
+    assert code == 0 and "Allocations" in out or code == 0
+
+    code, out = run_cli(agent, "node", "eligibility", "-disable", node_id)
+    assert code == 0 and "ineligible" in out
+    code, out = run_cli(agent, "node", "eligibility", "-enable", node_id)
+    assert code == 0 and "eligible" in out
+
+
+def test_eval_and_alloc_status(agent):
+    code, out = run_cli(agent, "job", "status", "cli-job")
+    alloc_line = [l for l in out.splitlines() if l.strip() and "run" in l]
+    # find an alloc id from the allocations table
+    lines = out.split("Allocations")[-1].splitlines()
+    alloc_id = None
+    for line in lines[2:]:
+        parts = line.split()
+        if parts:
+            alloc_id = parts[0]
+            break
+    assert alloc_id
+    code, out = run_cli(agent, "alloc", "status", alloc_id)
+    assert code == 0, out
+    assert "Client Status" in out
+
+    code, out = run_cli(agent, "eval", "status", "zzzz")
+    assert code == 1
+
+
+def test_job_stop(agent):
+    code, out = run_cli(agent, "job", "stop", "-purge", "-detach", "cli-job")
+    assert code == 0, out
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        code, out = run_cli(agent, "job", "status", "cli-job")
+        if code == 1:
+            break
+        time.sleep(0.2)
+    assert code == 1
+
+
+def test_system_and_operator_and_server(agent):
+    code, out = run_cli(agent, "system", "gc")
+    assert code == 0
+    code, out = run_cli(agent, "operator", "scheduler")
+    assert code == 0 and "SchedulerConfig" in out
+    code, out = run_cli(agent, "operator", "raft")
+    assert code == 0 and "leader" in out
+    code, out = run_cli(agent, "server", "members")
+    assert code == 0 and "alive" in out
+    code, out = run_cli(agent, "ui")
+    assert code == 0 and "/ui/" in out
+
+
+def test_agent_info(agent):
+    code, out = run_cli(agent, "agent-info")
+    assert code == 0 and "Server" in out
