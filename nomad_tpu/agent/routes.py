@@ -111,6 +111,7 @@ class Routes:
         r("/v1/regions", self.regions)
         r("/v1/validate/job", self.validate_job)
         r("/v1/search", self.search)
+        r("/v1/metrics", self.metrics)
 
     # -- jobs ------------------------------------------------------------
 
@@ -613,6 +614,15 @@ class Routes:
 
     def regions(self, req: Request):
         return self.agent.regions()
+
+    def metrics(self, req: Request):
+        """Telemetry snapshot (reference http.go:189 /v1/metrics; supports
+        ?format=prometheus like the reference)."""
+        from ..utils.metrics import global_sink
+
+        if req.param("format") == "prometheus":
+            return global_sink().prometheus().encode()
+        return global_sink().summary()
 
     def search(self, req: Request):
         """Prefix search across objects (reference nomad/search_endpoint.go;

@@ -55,6 +55,10 @@ class HeartbeatTimers:
             timer.start()
         return ttl
 
+    def num_active(self) -> int:
+        with self._lock:
+            return len(self._timers)
+
     def clear_heartbeat_timer(self, node_id: str) -> None:
         with self._lock:
             old = self._timers.pop(node_id, None)

@@ -19,6 +19,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
 from ..structs.funcs import allocs_fit, remove_allocs
+from ..utils import metrics
 from ..structs.structs import (
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_PREEMPTION,
@@ -105,8 +106,11 @@ class Planner:
             pending = self.plan_queue.dequeue(timeout=0.2)
             if pending is None:
                 continue
+            metrics.set_gauge("nomad.plan.queue_depth", self.plan_queue.stats().get("depth", 0))
             try:
+                start = metrics.now()
                 result = self.apply_plan(pending.plan)
+                metrics.measure_since("nomad.plan.apply", start)
                 pending.future.set_result(result)
             except Exception as e:  # noqa: BLE001 — worker gets the error
                 self.logger.exception("plan apply failed")
@@ -167,7 +171,9 @@ class Planner:
 
     def apply_plan(self, plan: Plan) -> PlanResult:
         snapshot = self.fsm.state.snapshot()
+        start = metrics.now()
         result = self.evaluate_plan(snapshot, plan)
+        metrics.measure_since("nomad.plan.evaluate", start)
         if result.is_noop():
             return result
 

@@ -176,6 +176,29 @@ class Server:
         self._schedule_leader_task(gen, self.config.unblock_failed_interval,
                                    self._reap_failed_evals)
         self._schedule_leader_task(gen, self.config.eval_gc_interval, self._create_gc_evals)
+        self._schedule_leader_task(gen, 10.0, self._emit_stats)
+
+    def _emit_stats(self) -> None:
+        """Publish broker/blocked/plan-queue gauges (reference
+        eval_broker.go:825 EmitStats, blocked_evals.go EmitStats,
+        leader.go:603 job summary metrics)."""
+        from ..utils import metrics
+
+        bs = self.eval_broker.stats()
+        metrics.set_gauge("nomad.broker.total_ready", bs.get("total_ready", 0))
+        metrics.set_gauge("nomad.broker.total_unacked", bs.get("total_unacked", 0))
+        metrics.set_gauge("nomad.broker.total_blocked", bs.get("total_blocked", 0))
+        metrics.set_gauge(
+            "nomad.blocked_evals.total_blocked",
+            self.blocked_evals.stats().get("total_blocked", 0),
+        )
+        metrics.set_gauge(
+            "nomad.plan.queue_depth", self.plan_queue.stats().get("depth", 0)
+        )
+        metrics.set_gauge(
+            "nomad.heartbeat.active", self.heartbeaters.num_active()
+        )
+        metrics.set_gauge("nomad.state.latest_index", self.fsm.state.latest_index)
 
     def _revoke_leadership(self) -> None:
         with self._lock:

@@ -12,6 +12,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..scheduler.scheduler import new_scheduler
+from ..utils import metrics
 from ..structs.structs import Evaluation, Plan, PlanResult
 from .eval_broker import NotOutstandingError, TokenMismatchError
 from .fsm import EVAL_UPDATE
@@ -51,6 +52,7 @@ class Worker:
             evaluation, token = self.server.eval_broker.dequeue(schedulers, timeout=0.25)
             if evaluation is None:
                 continue
+            metrics.incr_counter("nomad.worker.dequeue_eval")
             self._eval_token = token
             try:
                 self._process(evaluation, token)
@@ -77,11 +79,17 @@ class Worker:
             return
 
         wait_index = max(evaluation.modify_index, evaluation.snapshot_index)
+        start = metrics.now()
         snapshot = self.server.fsm.state.snapshot_min_index(wait_index)
+        metrics.measure_since("nomad.worker.wait_for_index", start)
         sched = new_scheduler(evaluation.type, self.logger, snapshot, self)
         if hasattr(sched, "deterministic"):
             sched.deterministic = self.server.config.deterministic
+        start = metrics.now()
         sched.process(evaluation)
+        metrics.measure_since(
+            f"nomad.worker.invoke_scheduler.{evaluation.type}", start
+        )
 
     # -- Planner protocol ------------------------------------------------
 

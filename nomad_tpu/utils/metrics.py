@@ -1,0 +1,178 @@
+"""In-memory telemetry (reference: armon/go-metrics InmemSink wired in
+command/agent/command.go:937 setupTelemetry; surfaced at /v1/metrics
+http.go:189).
+
+Same model as the reference: fixed-duration aggregation intervals (default
+10s, retain 6); counters and samples aggregate {count, sum, min, max, mean};
+gauges keep the last value. Metric names are dotted strings and match the
+reference's instrumentation (e.g. ``nomad.worker.invoke_scheduler.service``,
+``nomad.plan.evaluate``, ``nomad.plan.apply``) so dashboards transfer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class _Aggregate:
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def ingest(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def summary(self, name: str, rate_interval: float) -> dict:
+        return {
+            "Name": name,
+            "Count": self.count,
+            "Sum": round(self.sum, 6),
+            "Min": round(self.min, 6) if self.count else 0,
+            "Max": round(self.max, 6) if self.count else 0,
+            "Mean": round(self.mean, 6),
+            "Rate": round(self.sum / rate_interval, 6) if rate_interval else 0,
+        }
+
+
+class _Interval:
+    def __init__(self, start: float) -> None:
+        self.start = start
+        self.counters: Dict[str, _Aggregate] = {}
+        self.samples: Dict[str, _Aggregate] = {}
+        self.gauges: Dict[str, float] = {}
+
+
+class InmemSink:
+    def __init__(self, interval: float = 10.0, retain: int = 6) -> None:
+        self.interval = interval
+        self.retain = retain
+        self._lock = threading.Lock()
+        self._intervals: List[_Interval] = [_Interval(time.time())]
+
+    def _current(self) -> _Interval:
+        now = time.time()
+        cur = self._intervals[-1]
+        if now - cur.start >= self.interval:
+            cur = _Interval(now - (now % self.interval))
+            self._intervals.append(cur)
+            if len(self._intervals) > self.retain:
+                del self._intervals[: len(self._intervals) - self.retain]
+        return cur
+
+    # -- instrumentation api ---------------------------------------------
+
+    def incr_counter(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._current().counters.setdefault(name, _Aggregate()).ingest(value)
+
+    def add_sample(self, name: str, value: float) -> None:
+        with self._lock:
+            self._current().samples.setdefault(name, _Aggregate()).ingest(value)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._current().gauges[name] = value
+
+    def measure_since(self, name: str, start: float) -> None:
+        """Record elapsed milliseconds, go-metrics MeasureSince style."""
+        self.add_sample(name, (time.monotonic() - start) * 1000.0)
+
+    # -- query api --------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Aggregated view of the most recent *complete-ish* interval,
+        matching the reference's /v1/metrics InmemSink DisplayMetrics."""
+        with self._lock:
+            cur = self._intervals[-1]
+            merged_gauges: Dict[str, float] = {}
+            for itv in self._intervals:
+                merged_gauges.update(itv.gauges)
+            return {
+                "Timestamp": time.strftime(
+                    "%Y-%m-%d %H:%M:%S +0000 UTC", time.gmtime(cur.start)
+                ),
+                "Gauges": [
+                    {"Name": k, "Value": v} for k, v in sorted(merged_gauges.items())
+                ],
+                "Counters": [
+                    cur.counters[k].summary(k, self.interval)
+                    for k in sorted(cur.counters)
+                ],
+                "Samples": [
+                    cur.samples[k].summary(k, self.interval)
+                    for k in sorted(cur.samples)
+                ],
+            }
+
+    def prometheus(self) -> str:
+        """Text exposition format (reference supports a prometheus sink)."""
+        out: List[str] = []
+
+        def esc(name: str) -> str:
+            return name.replace(".", "_").replace("-", "_")
+
+        with self._lock:
+            merged_gauges: Dict[str, float] = {}
+            for itv in self._intervals:
+                merged_gauges.update(itv.gauges)
+            cur = self._intervals[-1]
+            for k, v in sorted(merged_gauges.items()):
+                out.append(f"# TYPE {esc(k)} gauge")
+                out.append(f"{esc(k)} {v}")
+            for k in sorted(cur.counters):
+                agg = cur.counters[k]
+                out.append(f"# TYPE {esc(k)} counter")
+                out.append(f"{esc(k)} {agg.sum}")
+            for k in sorted(cur.samples):
+                agg = cur.samples[k]
+                n = esc(k)
+                out.append(f"# TYPE {n} summary")
+                out.append(f"{n}_sum {agg.sum}")
+                out.append(f"{n}_count {agg.count}")
+        return "\n".join(out) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._intervals = [_Interval(time.time())]
+
+
+#: process-global sink, like go-metrics' global Default registry
+_global = InmemSink()
+
+
+def global_sink() -> InmemSink:
+    return _global
+
+
+def incr_counter(name: str, value: float = 1.0) -> None:
+    _global.incr_counter(name, value)
+
+
+def add_sample(name: str, value: float) -> None:
+    _global.add_sample(name, value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    _global.set_gauge(name, value)
+
+
+def measure_since(name: str, start: float) -> None:
+    _global.measure_since(name, start)
+
+
+def now() -> float:
+    """Monotonic start stamp for measure_since."""
+    return time.monotonic()

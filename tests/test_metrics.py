@@ -1,0 +1,112 @@
+"""Telemetry tests (reference go-metrics InmemSink semantics + /v1/metrics)."""
+
+import time
+
+import pytest
+
+from nomad_tpu.utils.metrics import InmemSink, global_sink
+
+
+def test_counter_aggregation():
+    s = InmemSink(interval=100)
+    s.incr_counter("nomad.test.count")
+    s.incr_counter("nomad.test.count", 4)
+    out = s.summary()
+    (c,) = out["Counters"]
+    assert c["Name"] == "nomad.test.count"
+    assert c["Count"] == 2
+    assert c["Sum"] == 5
+    assert c["Min"] == 1 and c["Max"] == 4
+    assert c["Mean"] == 2.5
+
+
+def test_samples_and_gauges():
+    s = InmemSink(interval=100)
+    s.add_sample("nomad.test.latency", 10.0)
+    s.add_sample("nomad.test.latency", 30.0)
+    s.set_gauge("nomad.test.depth", 7)
+    out = s.summary()
+    (smp,) = out["Samples"]
+    assert smp["Mean"] == 20.0
+    (g,) = out["Gauges"]
+    assert g == {"Name": "nomad.test.depth", "Value": 7}
+
+
+def test_measure_since_records_ms():
+    s = InmemSink(interval=100)
+    start = time.monotonic()
+    time.sleep(0.01)
+    s.measure_since("nomad.test.elapsed", start)
+    (smp,) = s.summary()["Samples"]
+    assert smp["Max"] >= 10.0  # ms
+
+
+def test_interval_rotation_retains_gauges():
+    s = InmemSink(interval=0.05, retain=3)
+    s.set_gauge("g", 1)
+    s.incr_counter("c")
+    time.sleep(0.06)
+    s.incr_counter("c2")  # forces rotation
+    out = s.summary()
+    assert [g["Name"] for g in out["Gauges"]] == ["g"]  # gauges survive
+    assert [c["Name"] for c in out["Counters"]] == ["c2"]  # counters don't
+
+
+def test_prometheus_format():
+    s = InmemSink(interval=100)
+    s.set_gauge("nomad.broker.total_ready", 3)
+    s.incr_counter("nomad.worker.dequeue_eval", 2)
+    s.add_sample("nomad.plan.apply", 1.5)
+    text = s.prometheus()
+    assert "nomad_broker_total_ready 3" in text
+    assert "nomad_worker_dequeue_eval 2.0" in text
+    assert "nomad_plan_apply_sum 1.5" in text
+    assert "nomad_plan_apply_count 1" in text
+
+
+def test_server_emits_reference_metric_names(dev_agent_factory=None):
+    """Scheduling one job must tick the reference-named hot-path counters."""
+    from nomad_tpu import mock
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    global_sink().reset()
+    a = Agent(AgentConfig(dev_mode=True, num_schedulers=1, name="metrics-dev"))
+    a.start()
+    try:
+        job = mock.job()
+        job.id = "metrics-job"
+        job.task_groups[0].count = 1
+        task = job.task_groups[0].tasks[0]
+        task.driver = "mock"
+        task.config = {"run_for": "5s"}
+        a.server.register_job(job)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            names = {c["Name"] for c in global_sink().summary()["Counters"]}
+            snames = {c["Name"] for c in global_sink().summary()["Samples"]}
+            if "nomad.worker.dequeue_eval" in names and any(
+                n.startswith("nomad.worker.invoke_scheduler.") for n in snames
+            ):
+                break
+            time.sleep(0.1)
+        summary = global_sink().summary()
+        counters = {c["Name"] for c in summary["Counters"]}
+        samples = {c["Name"] for c in summary["Samples"]}
+        assert "nomad.worker.dequeue_eval" in counters
+        assert any(n.startswith("nomad.worker.invoke_scheduler.") for n in samples)
+        assert "nomad.plan.evaluate" in samples
+        assert "nomad.plan.apply" in samples
+        # /v1/metrics endpoint serves the summary
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(a.http_addr + "/v1/metrics", timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        assert "Counters" in doc and "Gauges" in doc
+        with urllib.request.urlopen(
+            a.http_addr + "/v1/metrics?format=prometheus", timeout=10
+        ) as r:
+            text = r.read().decode()
+        assert "nomad_worker_dequeue_eval" in text
+    finally:
+        a.shutdown()
