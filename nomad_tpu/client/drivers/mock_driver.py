@@ -24,14 +24,30 @@ from .base import (
 )
 
 
+def _seconds(v) -> float:
+    """Accept a number of seconds (possibly as a bare string) or a Go-style
+    duration ("5s"). Malformed values surface as DriverError so the task
+    runner records a driver failure instead of losing its run thread."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        pass
+    from ...jobspec.parse import HCLError, parse_duration_ns
+
+    try:
+        return parse_duration_ns(v) / 1e9
+    except HCLError as e:
+        raise DriverError(f"bad duration {v!r}: {e}") from e
+
+
 class _MockTask:
     def __init__(self, cfg: TaskConfig) -> None:
         self.cfg = cfg
         c = cfg.config
-        self.run_for = float(c.get("run_for", 0.0))
+        self.run_for = _seconds(c.get("run_for", 0.0))
         self.exit_code = int(c.get("exit_code", 0))
         self.exit_signal = int(c.get("exit_signal", 0))
-        self.kill_after = float(c.get("kill_after", 0.0))
+        self.kill_after = _seconds(c.get("kill_after", 0.0))
         self.started_at = time.time_ns()
         self.completed_at = 0
         self.exit_result: Optional[ExitResult] = None
@@ -70,7 +86,7 @@ class MockDriver(Driver):
     def start_task(self, cfg: TaskConfig) -> TaskHandle:
         if cfg.config.get("start_error"):
             raise DriverError(str(cfg.config["start_error"]))
-        block = float(cfg.config.get("start_block_for", 0.0))
+        block = _seconds(cfg.config.get("start_block_for", 0.0))
         if block:
             time.sleep(block)
         if cfg.id in self.tasks:
