@@ -9,6 +9,7 @@ transport (nomad_tpu.rpc).
 """
 from __future__ import annotations
 
+import socket
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -29,6 +30,15 @@ class AgentConfig:
     dev_mode: bool = False
     http_bind: str = "127.0.0.1"
     http_port: int = 0  # 0 = ephemeral; reference default 4646
+    rpc_bind: str = "127.0.0.1"
+    rpc_port: int = 0  # reference default 4647
+    serf_bind: str = "127.0.0.1"
+    serf_port: int = 0  # reference default 4648
+    advertise_addr: str = ""  # routable host gossiped to peers; required with 0.0.0.0 binds
+    gossip_enabled: bool = True
+    retry_join: List[str] = field(default_factory=list)  # "host:port" gossip addrs
+    retry_join_interval: float = 3.0
+    bootstrap_expect: int = 1
     num_schedulers: int = 2
     scheduler_algorithm: str = "tpu_binpack"
     acl_enabled: bool = False
@@ -86,7 +96,46 @@ class Agent:
 
         self.acl_routes = ACLRoutes(self)
         self.acl_routes.register_all(self.http)
+
+        # distributed wiring: RPC transport + gossip membership
+        # (reference agent.go:560 setupServer → nomad.NewServer → setupRPC/Serf)
+        self.rpc = None
+        self.membership = None
+        if self.server is not None:
+            from ..rpc.endpoints import bind_server
+            from ..rpc.transport import RPCServer
+            from ..server.membership import ServerMembership
+
+            self.rpc = RPCServer(
+                self.config.rpc_bind, self.config.rpc_port, region=self.config.region
+            )
+            bind_server(self.server, self.rpc)
+            self.rpc.register("Region.List", self.regions)
+            self.rpc.is_leader = lambda: self.server.is_leader
+            if self.config.gossip_enabled:
+                rpc_host = self.config.advertise_addr or self.rpc.addr[0]
+                if rpc_host in ("0.0.0.0", "::"):
+                    try:
+                        rpc_host = socket.gethostbyname(socket.gethostname())
+                    except OSError:
+                        rpc_host = "127.0.0.1"
+                self.membership = ServerMembership(
+                    name=self.config.name,
+                    region=self.config.region,
+                    datacenter=self.config.datacenter,
+                    rpc_addr=(rpc_host, self.rpc.addr[1]),
+                    bind_host=self.config.serf_bind,
+                    bind_port=self.config.serf_port,
+                    advertise_host=self.config.advertise_addr,
+                    expect=self.config.bootstrap_expect,
+                )
+                self.rpc.region_servers = lambda region: [
+                    s.rpc_addr for s in self.membership.servers_in_region(region)
+                ]
+                self.membership.on_server_change = self._on_server_change
+                self.server.raft.leadership_observers.append(self._on_raft_leadership)
         self._started = False
+        self._join_done = None
         self._lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
@@ -97,11 +146,42 @@ class Agent:
                 return self
             if self.server is not None:
                 self.server.start()
+            if self.rpc is not None:
+                self.rpc.start()
+            if self.membership is not None:
+                self.membership.start()
+                if self.server.is_leader:
+                    self.membership.set_leader(True)
+                if self.config.retry_join:
+                    self._start_retry_join()
             if self.client is not None:
                 self.client.start()
             self.http.start()
             self._started = True
         return self
+
+    @staticmethod
+    def _parse_addr(addr: str) -> Tuple[str, int]:
+        host, port = addr.rsplit(":", 1)
+        return (host, int(port))
+
+    def _start_retry_join(self) -> None:
+        """Join the gossip pool, retrying until at least one seed responds
+        (the reference's retry_join loop, command/agent/command.go
+        retryJoin). Runs in the background so startup isn't blocked by
+        seeds that boot later."""
+        seeds = [self._parse_addr(a) for a in self.config.retry_join]
+        self._join_done = threading.Event()
+
+        def loop() -> None:
+            while not self._join_done.is_set():
+                if self.membership.join(seeds) > 0:
+                    self._join_done.set()
+                    return
+                self._join_done.wait(self.config.retry_join_interval)
+
+        t = threading.Thread(target=loop, name="retry-join", daemon=True)
+        t.start()
 
     def shutdown(self) -> None:
         with self._lock:
@@ -110,9 +190,35 @@ class Agent:
             self.http.stop()
             if self.client is not None:
                 self.client.shutdown()
+            if getattr(self, "_join_done", None) is not None:
+                self._join_done.set()  # stop an unfinished retry-join loop
+            if self.membership is not None:
+                self.membership.leave()
+            if self.rpc is not None:
+                self.rpc.stop()
             if self.server is not None:
                 self.server.stop()
             self._started = False
+
+    # -- membership hooks ------------------------------------------------
+
+    def _on_raft_leadership(self, peer: int, is_leader: bool) -> None:
+        if self.server is not None and peer == self.server.peer:
+            if self.membership is not None:
+                self.membership.set_leader(is_leader)
+
+    def _on_server_change(self, meta, alive: bool) -> None:
+        """Track the local region's leader for RPC forwarding
+        (reference serf.go → leader forwarding via raft; here the leader
+        tag gossips the address)."""
+        if meta.region != self.config.region or self.rpc is None:
+            return
+        if alive and meta.is_leader:
+            self.rpc.leader_addr = meta.rpc_addr
+        elif self.rpc.leader_addr == meta.rpc_addr:
+            # the leader died, or stepped down while staying alive — either
+            # way, stop forwarding writes to it
+            self.rpc.leader_addr = None
 
     @property
     def http_addr(self) -> str:
@@ -131,6 +237,8 @@ class Agent:
     def peer_names(self) -> List[str]:
         if self.server is None:
             return []
+        if self.membership is not None:
+            return [s.name for s in self.membership.servers_in_region()]
         return [f"{self.config.name}"]
 
     def raft_servers(self) -> List[Tuple[str, str, bool]]:
@@ -139,11 +247,28 @@ class Agent:
         return [(self.config.name, self.http_addr, self.server.is_leader)]
 
     def known_servers(self) -> List[str]:
+        if self.membership is not None:
+            return [
+                f"{s.rpc_host}:{s.rpc_port}"
+                for s in self.membership.servers_in_region()
+            ]
         return [self.http_addr] if self.server is not None else []
 
     def members(self) -> List[dict]:
         if self.server is None:
             return []
+        if self.membership is not None:
+            return [
+                {
+                    "Name": m.name,
+                    "Addr": m.host,
+                    "Port": m.port,
+                    "Status": m.status,
+                    "Leader": m.tags.get("leader") == "1",
+                    "Tags": dict(m.tags),
+                }
+                for m in self.membership.members()
+            ]
         return [
             {
                 "Name": f"{self.config.name}.{self.config.region}",
@@ -160,6 +285,8 @@ class Agent:
         ]
 
     def regions(self) -> List[str]:
+        if self.membership is not None:
+            return self.membership.regions()
         return [self.config.region]
 
     def self_info(self) -> dict:

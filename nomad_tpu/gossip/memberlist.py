@@ -1,0 +1,502 @@
+"""SWIM gossip membership over UDP.
+
+Fills the role of the reference's vendored hashicorp/memberlist + serf
+(nomad/server.go:1250 setupSerf; nomad/serf.go event loop): each member
+runs a UDP listener, periodically probes a random peer (ping → ack, with
+indirect ping-req relays on timeout), and disseminates membership
+transitions (alive / suspect / dead / left) as piggybacked broadcasts on
+every protocol message. Tags ride the alive message, so metadata updates
+(e.g. a server gaining leadership) propagate the same way joins do, and a
+member that hears rumors of its own death refutes them with a higher
+incarnation number — the standard SWIM+inc protocol memberlist implements.
+
+Intentional deltas from memberlist: push-pull state sync rides UDP (server
+gossip pools are small — a handful of servers per region, never the
+thousands of client nodes, which don't gossip in the reference either:
+clients poll servers over RPC), and there is no message encryption — the
+reference's serf keyring slot is TLS on DCN, out of scope here.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+STATUS_ALIVE = "alive"
+STATUS_SUSPECT = "suspect"
+STATUS_DEAD = "dead"
+STATUS_LEFT = "left"
+
+MAX_DATAGRAM = 60000
+
+
+@dataclass
+class Member:
+    name: str
+    host: str
+    port: int
+    tags: Dict[str, str] = field(default_factory=dict)
+    incarnation: int = 0
+    status: str = STATUS_ALIVE
+    status_change: float = field(default_factory=time.monotonic)
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def to_wire(self) -> dict:
+        return {
+            "name": self.name,
+            "host": self.host,
+            "port": self.port,
+            "tags": self.tags,
+            "inc": self.incarnation,
+            "status": self.status,
+        }
+
+
+@dataclass
+class MemberlistConfig:
+    name: str = "node"
+    bind_host: str = "127.0.0.1"
+    bind_port: int = 0  # 0 = ephemeral
+    # address gossiped to peers; defaults to the bound address, which is
+    # wrong when binding 0.0.0.0 — set it explicitly for multi-host
+    advertise_host: str = ""
+    probe_interval: float = 0.3
+    probe_timeout: float = 0.15
+    indirect_checks: int = 2
+    suspicion_timeout: float = 1.2  # suspect → dead
+    push_pull_interval: float = 2.0
+    retransmit_mult: int = 3
+    dead_reclaim_time: float = 30.0  # forget dead/left members after this
+
+
+class Memberlist:
+    """One gossip participant. Thread-safe; all callbacks fire off the
+    listener/probe threads — keep them fast and non-blocking."""
+
+    def __init__(self, config: MemberlistConfig, tags: Optional[Dict[str, str]] = None):
+        self.config = config
+        self.logger = logging.getLogger(f"nomad_tpu.gossip.{config.name}")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((config.bind_host, config.bind_port))
+        bound: Tuple[str, int] = self._sock.getsockname()
+        advertise_host = config.advertise_host or bound[0]
+        if advertise_host in ("0.0.0.0", "::"):
+            # an unroutable advertise address would have every peer dialing
+            # itself; best-effort resolve the host's primary address
+            try:
+                advertise_host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                advertise_host = "127.0.0.1"
+        self.addr: Tuple[str, int] = (advertise_host, bound[1])
+
+        self._lock = threading.RLock()
+        self.incarnation = 1
+        self._local = Member(
+            name=config.name,
+            host=self.addr[0],
+            port=self.addr[1],
+            tags=dict(tags or {}),
+            incarnation=self.incarnation,
+        )
+        self.members: Dict[str, Member] = {config.name: self._local}
+        # broadcast queue: (remaining_transmits, wire_msg)
+        self._broadcasts: List[List] = []
+        self._seq = 0
+        self._acks: Dict[int, threading.Event] = {}
+        self._probe_ring: List[str] = []
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        # event hooks (serf EventMemberJoin/Leave/Failed/Update equivalents)
+        self.on_join: Optional[Callable[[Member], None]] = None
+        self.on_leave: Optional[Callable[[Member], None]] = None
+        self.on_fail: Optional[Callable[[Member], None]] = None
+        self.on_update: Optional[Callable[[Member], None]] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Memberlist":
+        for target, name in (
+            (self._listen_loop, "gossip-listen"),
+            (self._probe_loop, "gossip-probe"),
+            (self._push_pull_loop, "gossip-pushpull"),
+        ):
+            t = threading.Thread(target=target, name=f"{name}-{self.config.name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def leave(self) -> None:
+        """Graceful exit: broadcast the left intent, then stop."""
+        with self._lock:
+            self.incarnation += 1
+            self._local.incarnation = self.incarnation
+            self._local.status = STATUS_LEFT
+            msg = {"t": "leave", "name": self.config.name, "inc": self.incarnation}
+            self._queue_broadcast(msg)
+        # push the rumor out directly to a few peers; the queue alone may
+        # never flush since we stop probing immediately after
+        for m in self._gossip_targets(3):
+            self._send(m.addr, self._with_gossip({"t": "compound"}))
+        self.shutdown()
+
+    # -- public API ------------------------------------------------------
+
+    def join(self, seeds: List[Tuple[str, int]]) -> int:
+        """Push-pull sync with each seed; returns how many responded."""
+        ok = 0
+        for addr in seeds:
+            if tuple(addr) == self.addr:
+                continue
+            if self._push_pull(tuple(addr)):
+                ok += 1
+        return ok
+
+    def set_tags(self, tags: Dict[str, str]) -> None:
+        """Re-tag and re-broadcast ourselves (serf SetTags)."""
+        with self._lock:
+            self.incarnation += 1
+            self._local.incarnation = self.incarnation
+            self._local.tags = dict(tags)
+            self._queue_broadcast({"t": "alive", "member": self._local.to_wire()})
+
+    def alive_members(self) -> List[Member]:
+        with self._lock:
+            return [m for m in self.members.values() if m.status == STATUS_ALIVE]
+
+    def all_members(self) -> List[Member]:
+        with self._lock:
+            return list(self.members.values())
+
+    def local_member(self) -> Member:
+        with self._lock:
+            return self._local
+
+    def num_alive(self) -> int:
+        return len(self.alive_members())
+
+    # -- wire helpers ----------------------------------------------------
+
+    def _send(self, addr: Tuple[str, int], msg: dict) -> None:
+        try:
+            data = msgpack.packb(msg, use_bin_type=True)
+            if len(data) > MAX_DATAGRAM:
+                self.logger.warning("dropping oversized gossip msg (%d bytes)", len(data))
+                return
+            self._sock.sendto(data, addr)
+        except OSError:
+            pass
+
+    def _queue_broadcast(self, msg: dict) -> None:
+        n = max(1, self.config.retransmit_mult * max(1, len(self.members)).bit_length())
+        with self._lock:
+            self._broadcasts.append([n, msg])
+
+    def _with_gossip(self, msg: dict) -> dict:
+        """Piggyback queued broadcasts onto an outgoing message."""
+        with self._lock:
+            gossip = []
+            keep = []
+            for entry in self._broadcasts:
+                gossip.append(entry[1])
+                entry[0] -= 1
+                if entry[0] > 0:
+                    keep.append(entry)
+            self._broadcasts = keep
+        if gossip:
+            msg = dict(msg)
+            msg["g"] = gossip
+        return msg
+
+    def _gossip_targets(self, k: int) -> List[Member]:
+        with self._lock:
+            others = [
+                m for m in self.members.values()
+                if m.name != self.config.name and m.status in (STATUS_ALIVE, STATUS_SUSPECT)
+            ]
+        random.shuffle(others)
+        return others[:k]
+
+    # -- listener --------------------------------------------------------
+
+    def _listen_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                data, src = self._sock.recvfrom(65536)
+            except OSError:
+                return
+            try:
+                msg = msgpack.unpackb(data, raw=False)
+                self._handle(msg, src)
+            except Exception:  # noqa: BLE001 — a bad datagram must not kill the loop
+                self.logger.exception("bad gossip datagram from %s", src)
+
+    def _handle(self, msg: dict, src: Tuple[str, int]) -> None:
+        for rumor in msg.get("g", ()):
+            self._handle(rumor, src)
+        t = msg.get("t")
+        if t == "ping":
+            self._send(src, self._with_gossip({"t": "ack", "seq": msg["seq"]}))
+        elif t == "ack":
+            ev = self._acks.get(msg.get("seq"))
+            if ev is not None:
+                ev.set()
+        elif t == "ping-req":
+            # probe the target on behalf of the requester and relay the ack
+            target = tuple(msg["target"])
+            seq = msg["seq"]
+
+            def relay():
+                if self._ping(target):
+                    self._send(src, {"t": "ack", "seq": seq})
+
+            threading.Thread(target=relay, daemon=True).start()
+        elif t == "alive":
+            self._on_alive_msg(msg["member"])
+        elif t == "suspect":
+            self._on_suspect_msg(msg["name"], msg["inc"])
+        elif t == "dead":
+            self._on_dead_msg(msg["name"], msg["inc"], STATUS_DEAD)
+        elif t == "leave":
+            self._on_dead_msg(msg["name"], msg["inc"], STATUS_LEFT)
+        elif t == "push-pull":
+            self._merge_remote_state(msg.get("members", []))
+            self._send(src, {
+                "t": "push-pull-ack",
+                "seq": msg.get("seq"),
+                "members": [m.to_wire() for m in self.all_members()],
+            })
+        elif t == "push-pull-ack":
+            self._merge_remote_state(msg.get("members", []))
+            ev = self._acks.get(msg.get("seq"))
+            if ev is not None:
+                ev.set()
+        elif t == "compound":
+            pass  # pure gossip carrier
+
+    # -- state merging ---------------------------------------------------
+
+    def _on_alive_msg(self, wire: dict) -> None:
+        name = wire["name"]
+        inc = wire["inc"]
+        with self._lock:
+            if name == self.config.name:
+                # someone has stale info about us (wrong status, or a stale
+                # address from before a restart); refute with higher inc
+                if inc >= self.incarnation and (
+                    wire.get("status") != STATUS_ALIVE
+                    or (wire["host"], wire["port"]) != (self._local.host, self._local.port)
+                ):
+                    self._refute(inc)
+                return
+            cur = self.members.get(name)
+            if cur is None:
+                m = Member(
+                    name=name, host=wire["host"], port=wire["port"],
+                    tags=dict(wire.get("tags") or {}), incarnation=inc,
+                )
+                self.members[name] = m
+                self._probe_ring.append(name)
+                self._queue_broadcast({"t": "alive", "member": m.to_wire()})
+                hook, arg = self.on_join, m
+            elif inc > cur.incarnation or (
+                inc == cur.incarnation and cur.status != STATUS_ALIVE
+            ):
+                was_dead = cur.status in (STATUS_DEAD, STATUS_LEFT, STATUS_SUSPECT)
+                tags_changed = dict(wire.get("tags") or {}) != cur.tags
+                if inc == cur.incarnation and cur.status == STATUS_DEAD:
+                    # an equal-inc alive can't beat a dead rumor (SWIM rule);
+                    # the member itself will refute with a higher inc
+                    return
+                cur.incarnation = inc
+                cur.host, cur.port = wire["host"], wire["port"]
+                cur.tags = dict(wire.get("tags") or {})
+                cur.status = STATUS_ALIVE
+                cur.status_change = time.monotonic()
+                self._queue_broadcast({"t": "alive", "member": cur.to_wire()})
+                hook = self.on_join if was_dead else (self.on_update if tags_changed else None)
+                arg = cur
+            else:
+                return
+        if hook is not None:
+            try:
+                hook(arg)
+            except Exception:  # noqa: BLE001
+                self.logger.exception("membership hook failed")
+
+    def _on_suspect_msg(self, name: str, inc: int) -> None:
+        with self._lock:
+            if name == self.config.name:
+                if inc >= self.incarnation:
+                    self._refute(inc)
+                return
+            cur = self.members.get(name)
+            if cur is None or inc < cur.incarnation or cur.status != STATUS_ALIVE:
+                return
+            cur.status = STATUS_SUSPECT
+            cur.status_change = time.monotonic()
+            self._queue_broadcast({"t": "suspect", "name": name, "inc": inc})
+
+    def _on_dead_msg(self, name: str, inc: int, status: str) -> None:
+        with self._lock:
+            if name == self.config.name:
+                # refute dead AND left rumors: a restarted instance must be
+                # able to rejoin even after its predecessor left gracefully
+                if inc >= self.incarnation:
+                    self._refute(inc)
+                return
+            cur = self.members.get(name)
+            if cur is None or inc < cur.incarnation:
+                return
+            if cur.status in (STATUS_DEAD, STATUS_LEFT):
+                return
+            cur.status = status
+            cur.incarnation = inc
+            cur.status_change = time.monotonic()
+            self._queue_broadcast(
+                {"t": "dead" if status == STATUS_DEAD else "leave", "name": name, "inc": inc}
+            )
+            hook = self.on_leave if status == STATUS_LEFT else self.on_fail
+        if hook is not None:
+            try:
+                hook(cur)
+            except Exception:  # noqa: BLE001
+                self.logger.exception("membership hook failed")
+
+    def _refute(self, rumor_inc: int = 0) -> None:
+        """Rumors of our demise: outbid the rumor's incarnation and
+        re-broadcast alive. Caller holds the lock. Jumping past rumor_inc
+        matters after a restart, when our own counter reset to 1 but the
+        cluster remembers a higher one."""
+        self.incarnation = max(self.incarnation, rumor_inc) + 1
+        self._local.incarnation = self.incarnation
+        self._local.status = STATUS_ALIVE
+        self._queue_broadcast({"t": "alive", "member": self._local.to_wire()})
+
+    def _merge_remote_state(self, wires: List[dict]) -> None:
+        for wire in wires:
+            status = wire.get("status", STATUS_ALIVE)
+            if status == STATUS_ALIVE:
+                self._on_alive_msg(wire)
+            elif status == STATUS_SUSPECT:
+                self._on_suspect_msg(wire["name"], wire["inc"])
+            else:
+                self._on_dead_msg(wire["name"], wire["inc"], status)
+
+    # -- probing ---------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _ping(self, addr: Tuple[str, int], timeout: Optional[float] = None) -> bool:
+        seq = self._next_seq()
+        ev = threading.Event()
+        self._acks[seq] = ev
+        try:
+            self._send(addr, self._with_gossip({"t": "ping", "seq": seq}))
+            return ev.wait(timeout or self.config.probe_timeout)
+        finally:
+            self._acks.pop(seq, None)
+
+    def _push_pull(self, addr: Tuple[str, int]) -> bool:
+        seq = self._next_seq()
+        ev = threading.Event()
+        self._acks[seq] = ev
+        try:
+            self._send(addr, {
+                "t": "push-pull",
+                "seq": seq,
+                "members": [m.to_wire() for m in self.all_members()],
+            })
+            return ev.wait(self.config.probe_timeout * 4)
+        finally:
+            self._acks.pop(seq, None)
+
+    def _probe_loop(self) -> None:
+        while not self._shutdown.wait(self.config.probe_interval):
+            target = self._next_probe_target()
+            if target is not None:
+                self._probe(target)
+            self._expire_suspects()
+            self._reap_dead()
+
+    def _next_probe_target(self) -> Optional[Member]:
+        with self._lock:
+            if not self._probe_ring:
+                self._probe_ring = [
+                    n for n, m in self.members.items()
+                    if n != self.config.name and m.status in (STATUS_ALIVE, STATUS_SUSPECT)
+                ]
+                random.shuffle(self._probe_ring)
+            while self._probe_ring:
+                name = self._probe_ring.pop()
+                m = self.members.get(name)
+                if m is not None and m.status in (STATUS_ALIVE, STATUS_SUSPECT):
+                    return m
+        return None
+
+    def _probe(self, member: Member) -> None:
+        if self._ping(member.addr):
+            return
+        # indirect probes through k other members (SWIM ping-req)
+        seq = self._next_seq()
+        ev = threading.Event()
+        self._acks[seq] = ev
+        try:
+            relays = [m for m in self._gossip_targets(self.config.indirect_checks)
+                      if m.name != member.name]
+            for relay in relays:
+                self._send(relay.addr, {
+                    "t": "ping-req", "seq": seq, "target": list(member.addr),
+                })
+            if relays and ev.wait(self.config.probe_timeout * 3):
+                return
+        finally:
+            self._acks.pop(seq, None)
+        self._on_suspect_msg(member.name, member.incarnation)
+
+    def _expire_suspects(self) -> None:
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for m in self.members.values():
+                if m.status == STATUS_SUSPECT and (
+                    now - m.status_change > self.config.suspicion_timeout
+                ):
+                    expired.append((m.name, m.incarnation))
+        for name, inc in expired:
+            self._on_dead_msg(name, inc, STATUS_DEAD)
+
+    def _reap_dead(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for name in list(self.members):
+                m = self.members[name]
+                if m.status in (STATUS_DEAD, STATUS_LEFT) and (
+                    now - m.status_change > self.config.dead_reclaim_time
+                ):
+                    del self.members[name]
+
+    def _push_pull_loop(self) -> None:
+        while not self._shutdown.wait(self.config.push_pull_interval):
+            targets = self._gossip_targets(1)
+            if targets:
+                self._push_pull(targets[0].addr)

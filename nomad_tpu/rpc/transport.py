@@ -11,6 +11,7 @@ connection already pipelines request/response pairs).
 from __future__ import annotations
 
 import logging
+import random
 import socket
 import socketserver
 import struct
@@ -51,13 +52,19 @@ def _recv_frame(sock: socket.socket) -> bytes:
 class RPCServer:
     """Dispatches "Noun.Verb" methods to registered handlers."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, region: str = "global") -> None:
         self.logger = logging.getLogger("nomad_tpu.rpc.server")
         self.handlers: Dict[str, Callable[..., Any]] = {}
         # set to (host, port) of the leader for transparent forwarding
         self.leader_addr: Optional[Tuple[str, int]] = None
         self.is_leader: Callable[[], bool] = lambda: True
         self._forward_pool: Optional["RPCClient"] = None
+        # cross-region federation (rpc.go:502 forwardRegion): resolves a
+        # region name to that region's server RPC addrs, fed by gossip
+        self.region = region
+        self.region_servers: Optional[Callable[[str], list]] = None
+        self._region_pools: Dict[Tuple[str, int], "RPCClient"] = {}
+        self._region_pools_lock = threading.Lock()
 
         outer = self
 
@@ -106,8 +113,14 @@ class RPCServer:
         if fn is None:
             return {"seq": seq, "error": f"unknown method {method!r}", "body": None}
         try:
-            # leader/region forwarding (rpc.go:409): followers proxy writes
-            if (
+            # region forwarding (rpc.go:502 forwardRegion): a request naming
+            # another region hops to any server there, which then applies
+            # its own leader forwarding
+            req_region = req.get("region")
+            if req_region and req_region != self.region:
+                result = self._forward_region(req_region, method, body)
+            # leader forwarding (rpc.go:409): followers proxy writes
+            elif (
                 not self.is_leader()
                 and self.leader_addr is not None
                 and self.leader_addr != self.addr
@@ -128,6 +141,18 @@ class RPCServer:
             self._forward_pool = RPCClient(*self.leader_addr)
         return self._forward_pool.call(method, *body, no_forward=True)
 
+    def _forward_region(self, region: str, method: str, body) -> Any:
+        servers = self.region_servers(region) if self.region_servers else []
+        if not servers:
+            raise RPCError(f"no path to region {region!r}")
+        addr = tuple(random.choice(servers))
+        with self._region_pools_lock:
+            pool = self._region_pools.get(addr)
+            if pool is None:
+                pool = self._region_pools[addr] = RPCClient(*addr)
+        # keep the region tag: the remote sees its own region and serves it
+        return pool.call(method, *body, region=region)
+
     def start(self) -> None:
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, name="rpc-server", daemon=True
@@ -139,6 +164,11 @@ class RPCServer:
         self._tcp.server_close()
         if self._forward_pool is not None:
             self._forward_pool.close()
+        with self._region_pools_lock:
+            pools = list(self._region_pools.values())
+            self._region_pools.clear()
+        for pool in pools:
+            pool.close()
 
 
 class RPCClient:
@@ -159,12 +189,20 @@ class RPCClient:
             self._sock = s
         return self._sock
 
-    def call(self, method: str, *args: Any, no_forward: bool = False) -> Any:
+    def call(
+        self,
+        method: str,
+        *args: Any,
+        no_forward: bool = False,
+        region: Optional[str] = None,
+    ) -> Any:
         with self._lock:
             self._seq += 1
             req = {"seq": self._seq, "method": method, "body": tuple(args)}
             if no_forward:
                 req["no_forward"] = True
+            if region:
+                req["region"] = region
             try:
                 sock = self._connect()
                 _send_frame(sock, encode(req))
