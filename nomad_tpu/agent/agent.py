@@ -9,7 +9,6 @@ transport (nomad_tpu.rpc).
 """
 from __future__ import annotations
 
-import socket
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -44,6 +43,12 @@ class AgentConfig:
     acl_enabled: bool = False
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
+    # multi-process consensus: real raft over the RPC transport instead of
+    # the in-proc shared log. Requires gossip; with bootstrap_expect > 1
+    # the raft holds elections only once that many servers are known
+    # (reference server.go bootstrap_expect semantics).
+    wire_raft: bool = False
+    data_dir: str = ""  # durable raft log + snapshots (and client state)
 
 
 class Agent:
@@ -60,12 +65,39 @@ class Agent:
 
         self.server: Optional[Server] = server
         self.client: Optional[Client] = client
+        self.wire_raft = None
+        # the RPC listener binds before the server exists: wire raft needs
+        # its address to register handlers, and peers need it to dial us
+        self.rpc = None
+        if self.config.server_enabled or self.server is not None:
+            from ..rpc.transport import RPCServer
+
+            self.rpc = RPCServer(
+                self.config.rpc_bind, self.config.rpc_port, region=self.config.region
+            )
         if self.server is None and self.config.server_enabled:
+            raft = None
+            if self.config.wire_raft:
+                from ..server.wire_raft import WireRaft, WireRaftConfig
+
+                data_dir = self.config.data_dir or None
+                self.wire_raft = WireRaft(
+                    self.rpc,
+                    peers={},  # filled from gossip before election starts
+                    # raft ids match gossip member names ("<name>.<region>")
+                    # so serf→raft reconciliation is a straight map
+                    config=WireRaftConfig(
+                        node_id=f"{self.config.name}.{self.config.region}"
+                    ),
+                    data_dir=data_dir,
+                )
+                raft = self.wire_raft
             self.server = Server(
                 ServerConfig(
                     num_schedulers=self.config.num_schedulers,
                     scheduler_algorithm=self.config.scheduler_algorithm,
                 ),
+                raft=raft,
                 name=self.config.name,
             )
         if self.client is None and self.config.client_enabled:
@@ -97,28 +129,22 @@ class Agent:
         self.acl_routes = ACLRoutes(self)
         self.acl_routes.register_all(self.http)
 
-        # distributed wiring: RPC transport + gossip membership
+        # distributed wiring: RPC endpoints + gossip membership
         # (reference agent.go:560 setupServer → nomad.NewServer → setupRPC/Serf)
-        self.rpc = None
         self.membership = None
         if self.server is not None:
             from ..rpc.endpoints import bind_server
-            from ..rpc.transport import RPCServer
             from ..server.membership import ServerMembership
 
-            self.rpc = RPCServer(
-                self.config.rpc_bind, self.config.rpc_port, region=self.config.region
-            )
             bind_server(self.server, self.rpc)
             self.rpc.register("Region.List", self.regions)
             self.rpc.is_leader = lambda: self.server.is_leader
             if self.config.gossip_enabled:
-                rpc_host = self.config.advertise_addr or self.rpc.addr[0]
-                if rpc_host in ("0.0.0.0", "::"):
-                    try:
-                        rpc_host = socket.gethostbyname(socket.gethostname())
-                    except OSError:
-                        rpc_host = "127.0.0.1"
+                from ..gossip.memberlist import resolve_advertise_host
+
+                rpc_host = resolve_advertise_host(
+                    self.config.advertise_addr or self.rpc.addr[0]
+                )
                 self.membership = ServerMembership(
                     name=self.config.name,
                     region=self.config.region,
@@ -136,6 +162,8 @@ class Agent:
                 self.server.raft.leadership_observers.append(self._on_raft_leadership)
         self._started = False
         self._join_done = None
+        self._raft_started = False
+        self._raft_boot_lock = threading.Lock()
         self._lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
@@ -144,21 +172,47 @@ class Agent:
         with self._lock:
             if self._started:
                 return self
-            if self.server is not None:
-                self.server.start()
             if self.rpc is not None:
                 self.rpc.start()
+            if self.server is not None:
+                self.server.start()
             if self.membership is not None:
                 self.membership.start()
                 if self.server.is_leader:
                     self.membership.set_leader(True)
                 if self.config.retry_join:
                     self._start_retry_join()
+            self._maybe_bootstrap_raft()
             if self.client is not None:
                 self.client.start()
             self.http.start()
             self._started = True
         return self
+
+    def _maybe_bootstrap_raft(self) -> None:
+        if self.wire_raft is None:
+            return
+        with self._raft_boot_lock:
+            self._maybe_bootstrap_raft_locked()
+
+    def _maybe_bootstrap_raft_locked(self) -> None:
+        """Start wire-raft elections once bootstrap_expect servers are
+        known via gossip (reference: serf handler bootstraps the raft peer
+        set at expect, nomad/serf.go nodeJoin → maybeBootstrap). Caller
+        holds _raft_boot_lock."""
+        if self._raft_started:
+            return
+        if self.membership is None:
+            self.wire_raft.start()  # no gossip: solo (dev) raft
+            self._raft_started = True
+            return
+        known = self.membership.servers_in_region()
+        if len(known) < self.config.bootstrap_expect:
+            return
+        for meta in known:
+            self.wire_raft.add_peer(meta.name, meta.rpc_addr)
+        self.wire_raft.start()
+        self._raft_started = True
 
     @staticmethod
     def _parse_addr(addr: str) -> Tuple[str, int]:
@@ -198,6 +252,8 @@ class Agent:
                 self.rpc.stop()
             if self.server is not None:
                 self.server.stop()
+            if self.wire_raft is not None:
+                self.wire_raft.close()
             self._started = False
 
     # -- membership hooks ------------------------------------------------
@@ -219,6 +275,18 @@ class Agent:
             # the leader died, or stepped down while staying alive — either
             # way, stop forwarding writes to it
             self.rpc.leader_addr = None
+        # serf → raft peer reconciliation (leader.go:859/:952). The boot
+        # lock serializes against an in-flight bootstrap so a server whose
+        # join races it still lands in the peer set.
+        if self.wire_raft is not None:
+            if alive:
+                with self._raft_boot_lock:
+                    if self._raft_started:
+                        self.wire_raft.add_peer(meta.name, meta.rpc_addr)
+                    else:
+                        self._maybe_bootstrap_raft_locked()
+            else:
+                self.wire_raft.remove_peer(meta.name)
 
     @property
     def http_addr(self) -> str:
@@ -244,6 +312,11 @@ class Agent:
     def raft_servers(self) -> List[Tuple[str, str, bool]]:
         if self.server is None:
             return []
+        if self.membership is not None:
+            return [
+                (s.name, f"{s.rpc_host}:{s.rpc_port}", s.is_leader)
+                for s in self.membership.servers_in_region()
+            ]
         return [(self.config.name, self.http_addr, self.server.is_leader)]
 
     def known_servers(self) -> List[str]:

@@ -36,6 +36,17 @@ STATUS_LEFT = "left"
 MAX_DATAGRAM = 60000
 
 
+def resolve_advertise_host(host: str) -> str:
+    """An unroutable advertise address (0.0.0.0/::) would have every peer
+    dialing itself; best-effort resolve the host's primary address."""
+    if host in ("0.0.0.0", "::"):
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+    return host
+
+
 @dataclass
 class Member:
     name: str
@@ -88,14 +99,7 @@ class Memberlist:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((config.bind_host, config.bind_port))
         bound: Tuple[str, int] = self._sock.getsockname()
-        advertise_host = config.advertise_host or bound[0]
-        if advertise_host in ("0.0.0.0", "::"):
-            # an unroutable advertise address would have every peer dialing
-            # itself; best-effort resolve the host's primary address
-            try:
-                advertise_host = socket.gethostbyname(socket.gethostname())
-            except OSError:
-                advertise_host = "127.0.0.1"
+        advertise_host = resolve_advertise_host(config.advertise_host or bound[0])
         self.addr: Tuple[str, int] = (advertise_host, bound[1])
 
         self._lock = threading.RLock()

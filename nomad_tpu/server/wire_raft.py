@@ -1,0 +1,631 @@
+"""Raft consensus over the RPC transport — one node per server process.
+
+Fills the role of the reference's vendored hashicorp/raft
+(nomad/server.go:1079 setupRaft, nomad/raft_rpc.go RaftLayer): leader
+election with randomized timeouts, term/vote persistence, log replication
+with quorum commit, conflict rollback via next_index backtracking, and
+snapshot install for followers whose needed entries were compacted. The
+durable log rides the C++ segmented store (native/nomadlog — the
+raft-boltdb slot); term/vote metadata sits beside it.
+
+Interface-compatible with ``InProcRaft`` as the ``Server`` consumes it
+(join / apply / is_leader / snapshot / leadership_observers / close), so a
+server runs unchanged on either: in-proc for dev mode and tests, wire raft
+for real multi-process clusters. ``apply`` blocks until the entry commits
+on a quorum and is applied to the local FSM — the same linearizable
+contract ``raftApply`` gives the reference (nomad/rpc.go raftApply).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..rpc.transport import RPCClient, RPCError, RPCServer
+from .fsm import NomadFSM
+from .raft import NotLeaderError
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+@dataclass
+class WireRaftConfig:
+    node_id: str = "node-1"
+    election_timeout_min: float = 0.5
+    election_timeout_max: float = 1.0
+    heartbeat_interval: float = 0.1
+    rpc_timeout: float = 1.0
+    apply_timeout: float = 10.0
+    sync_writes: bool = False
+
+
+class WireRaft:
+    """A raft participant. ``peers`` maps node_id → RPC address of the
+    other servers; the full cluster is peers + self (static bootstrap,
+    the reference's ``bootstrap_expect`` pattern)."""
+
+    def __init__(
+        self,
+        rpc: RPCServer,
+        peers: Optional[Dict[str, Tuple[str, int]]] = None,
+        config: Optional[WireRaftConfig] = None,
+        data_dir: Optional[str] = None,
+    ) -> None:
+        self.config = config or WireRaftConfig()
+        self.node_id = self.config.node_id
+        self.logger = logging.getLogger(f"nomad_tpu.raft.{self.node_id}")
+        self.rpc = rpc
+        self.peers: Dict[str, Tuple[str, int]] = dict(peers or {})
+        self._clients: Dict[str, RPCClient] = {}
+
+        self._lock = threading.RLock()
+        self._commit_cv = threading.Condition(self._lock)
+        self._repl_cv = threading.Condition(self._lock)
+
+        # persistent state
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        # log entries as (index, term, entry_type, payload); index-contiguous,
+        # starting after the snapshot boundary
+        self.log: List[Tuple[int, int, str, object]] = []
+        self._snapshot_index = 0
+        self._snapshot_term = 0
+        self._snapshot_state: Optional[bytes] = None
+
+        # volatile state
+        self.state = FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+        self._apply_results: Dict[int, object] = {}
+
+        self.fsm: Optional[NomadFSM] = None
+        self.leadership_observers: List[Callable[[int, bool], None]] = []
+        self._was_leader = False
+
+        self.store = None
+        self._meta_path = None
+        self._snapshot_path = None
+        if data_dir is not None:
+            from ..native.log import NativeLog
+
+            os.makedirs(data_dir, exist_ok=True)
+            self.store = NativeLog(os.path.join(data_dir, "log"))
+            self._meta_path = os.path.join(data_dir, "raft_meta.json")
+            self._snapshot_path = os.path.join(data_dir, "snapshot.bin")
+            self._load_persistent()
+
+        self._shutdown = threading.Event()
+        self._started = False
+        self._last_contact = time.monotonic()
+        self._election_deadline = self._random_deadline()
+        self._threads: List[threading.Thread] = []
+
+        rpc.register("Raft.RequestVote", self._handle_request_vote)
+        rpc.register("Raft.AppendEntries", self._handle_append_entries)
+        rpc.register("Raft.InstallSnapshot", self._handle_install_snapshot)
+
+    # -- InProcRaft-compatible surface -----------------------------------
+
+    def join(self, fsm: NomadFSM) -> int:
+        """Attach the local FSM (exactly one per process); restores the
+        snapshot + replays committed log. Returns peer handle 0."""
+        with self._lock:
+            if self.fsm is not None:
+                raise ValueError("wire raft hosts exactly one FSM")
+            self.fsm = fsm
+            if self._snapshot_state is not None:
+                fsm.restore(pickle.loads(self._snapshot_state))
+                self.last_applied = self._snapshot_index
+            # committed entries re-apply on restart via commit advancement;
+            # a lone node (no peers) self-commits everything it has
+            if not self.peers:
+                self.commit_index = self._last_index()
+                self._apply_committed_locked()
+        return 0
+
+    def is_leader(self, peer: int = 0) -> bool:
+        return self.state == LEADER
+
+    def apply(self, peer: int, entry_type: str, payload) -> Tuple[int, object]:
+        """Leader-only: append, replicate to quorum, apply, return
+        (index, local FSM response)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(
+                    f"{self.node_id} is not the leader (leader={self.leader_id})"
+                )
+            index = self._last_index() + 1
+            term = self.current_term
+            self._append_locked(index, term, entry_type, payload)
+            self.match_index[self.node_id] = index
+            self._repl_cv.notify_all()
+            if not self.peers:
+                self._advance_commit_locked()
+            deadline = time.monotonic() + self.config.apply_timeout
+            while self.commit_index < index or self.last_applied < index:
+                if self.state != LEADER or self.current_term != term:
+                    raise NotLeaderError("lost leadership during apply")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"apply of index {index} timed out")
+                self._commit_cv.wait(remaining)
+            return index, self._apply_results.pop(index, None)
+
+    def snapshot(self, peer: int = 0) -> int:
+        """Snapshot the FSM and compact the log (fsm.go:1059)."""
+        with self._lock:
+            if self.fsm is None:
+                return 0
+            index = self.last_applied
+            if index == 0:
+                return 0
+            term = self._term_at(index)
+            state_blob = pickle.dumps(self.fsm.snapshot())
+            self._snapshot_state = state_blob
+            self._snapshot_term = term
+            self.log = [e for e in self.log if e[0] > index]
+            self._snapshot_index = index
+            if self._snapshot_path is not None:
+                tmp = self._snapshot_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(pickle.dumps((index, term, state_blob)))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._snapshot_path)
+            if self.store is not None:
+                self.store.truncate_before(index + 1)
+                self.store.sync()
+            return index
+
+    def close(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            self._repl_cv.notify_all()
+            self._commit_cv.notify_all()
+        for c in self._clients.values():
+            c.close()
+        if self.store is not None:
+            self.store.sync()
+            self.store.close()
+            self.store = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "WireRaft":
+        self._started = True
+        t = threading.Thread(
+            target=self._election_loop, name=f"raft-election-{self.node_id}", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        for peer_id in list(self.peers):
+            self._start_replicator(peer_id)
+        if not self.peers:
+            # single-node cluster: immediate self-election
+            with self._lock:
+                self._become_leader_locked(self.current_term + 1)
+        return self
+
+    def _start_replicator(self, peer_id: str) -> None:
+        t = threading.Thread(
+            target=self._replicator, args=(peer_id,),
+            name=f"raft-repl-{self.node_id}-{peer_id}", daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+
+    def add_peer(self, peer_id: str, addr: Tuple[str, int]) -> None:
+        """Gossip-driven peer reconciliation (reference leader.go:859
+        addRaftPeer — serf member join → raft configuration). A known peer
+        gossiping a NEW address (restart with an ephemeral port) gets its
+        connection retargeted."""
+        addr = tuple(addr)
+        stale_client = None
+        with self._lock:
+            if peer_id == self.node_id:
+                return
+            existing = self.peers.get(peer_id)
+            if existing == addr:
+                return
+            self.peers[peer_id] = addr
+            if existing is not None:
+                # address change: drop the stale connection; the live
+                # replicator thread picks up the new address next round
+                stale_client = self._clients.pop(peer_id, None)
+                new_peer = False
+            else:
+                new_peer = True
+            if self.state == LEADER:
+                self.next_index[peer_id] = self._last_index() + 1
+                self.match_index.setdefault(peer_id, 0)
+            started = self._started
+        if stale_client is not None:
+            stale_client.close()
+        if started and new_peer:
+            self._start_replicator(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        """leader.go:952 removeRaftPeer."""
+        with self._lock:
+            self.peers.pop(peer_id, None)
+            self.next_index.pop(peer_id, None)
+            self.match_index.pop(peer_id, None)
+            client = self._clients.pop(peer_id, None)
+        if client is not None:
+            client.close()
+
+    # -- persistence -----------------------------------------------------
+
+    def _load_persistent(self) -> None:
+        if self._meta_path and os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            self.current_term = meta.get("term", 0)
+            self.voted_for = meta.get("voted_for")
+        if self._snapshot_path and os.path.exists(self._snapshot_path):
+            with open(self._snapshot_path, "rb") as f:
+                self._snapshot_index, self._snapshot_term, self._snapshot_state = (
+                    pickle.load(f)
+                )
+        if self.store is not None:
+            first, last = self.store.first_index, self.store.last_index
+            for index in range(max(first, self._snapshot_index + 1), last + 1):
+                blob = self.store.get(index)
+                if blob is None:
+                    continue
+                term, entry_type, payload = pickle.loads(blob)
+                self.log.append((index, term, entry_type, payload))
+
+    def _persist_meta_locked(self) -> None:
+        if self._meta_path is None:
+            return
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.current_term, "voted_for": self.voted_for}, f)
+        os.replace(tmp, self._meta_path)
+
+    def _append_locked(self, index: int, term: int, entry_type: str, payload) -> None:
+        self.log.append((index, term, entry_type, payload))
+        if self.store is not None:
+            self.store.append(
+                index,
+                pickle.dumps((term, entry_type, payload)),
+                sync=self.config.sync_writes,
+            )
+
+    # -- log helpers (hold lock) -----------------------------------------
+
+    def _last_index(self) -> int:
+        return self.log[-1][0] if self.log else self._snapshot_index
+
+    def _last_term(self) -> int:
+        return self.log[-1][1] if self.log else self._snapshot_term
+
+    def _term_at(self, index: int) -> int:
+        if index == self._snapshot_index:
+            return self._snapshot_term
+        if index == 0:
+            return 0
+        pos = index - self._snapshot_index - 1
+        if 0 <= pos < len(self.log):
+            return self.log[pos][1]
+        return -1  # unknown (compacted or beyond tail)
+
+    def _entries_from(self, index: int, limit: int = 512):
+        pos = index - self._snapshot_index - 1
+        if pos < 0:
+            return None  # compacted — needs snapshot
+        return self.log[pos:pos + limit]
+
+    # -- roles -----------------------------------------------------------
+
+    def _random_deadline(self) -> float:
+        return time.monotonic() + random.uniform(
+            self.config.election_timeout_min, self.config.election_timeout_max
+        )
+
+    def _notify_leadership(self, gained: bool) -> None:
+        for observer in list(self.leadership_observers):
+            try:
+                observer(0, gained)
+            except Exception:  # noqa: BLE001
+                self.logger.exception("leadership observer failed")
+
+    def _step_down_locked(self, term: int) -> None:
+        was_leader = self.state == LEADER
+        self.state = FOLLOWER
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist_meta_locked()
+        self._election_deadline = self._random_deadline()
+        if was_leader:
+            self._was_leader = False
+            self._commit_cv.notify_all()
+            threading.Thread(
+                target=self._notify_leadership, args=(False,), daemon=True
+            ).start()
+
+    def _become_leader_locked(self, term: int) -> None:
+        self.state = LEADER
+        self.current_term = term
+        self.leader_id = self.node_id
+        last = self._last_index()
+        for peer_id in self.peers:
+            self.next_index[peer_id] = last + 1
+            self.match_index[peer_id] = 0
+        self.match_index[self.node_id] = last
+        self._persist_meta_locked()
+        self._was_leader = True
+        # a no-op barrier entry lets the new leader commit entries from
+        # prior terms (raft §5.4.2 — only current-term entries count
+        # toward commit)
+        self._append_locked(last + 1, term, "_raft-barrier", None)
+        self.match_index[self.node_id] = last + 1
+        self._repl_cv.notify_all()
+        if not self.peers:
+            self._advance_commit_locked()
+        threading.Thread(target=self._notify_leadership, args=(True,), daemon=True).start()
+
+    # -- election --------------------------------------------------------
+
+    def _election_loop(self) -> None:
+        while not self._shutdown.wait(0.02):
+            with self._lock:
+                if self.state == LEADER:
+                    continue
+                if time.monotonic() < self._election_deadline:
+                    continue
+                # start an election
+                self.state = CANDIDATE
+                self.current_term += 1
+                term = self.current_term
+                self.voted_for = self.node_id
+                self._persist_meta_locked()
+                self._election_deadline = self._random_deadline()
+                last_index = self._last_index()
+                last_term = self._last_term()
+            votes = 1
+            needed = (len(self.peers) + 1) // 2 + 1
+            for peer_id in list(self.peers):
+                if self._shutdown.is_set():
+                    return
+                try:
+                    r_term, granted = self._client(peer_id).call(
+                        "Raft.RequestVote", term, self.node_id, last_index, last_term,
+                        no_forward=True,
+                    )
+                except (RPCError, OSError, ConnectionError):
+                    continue
+                with self._lock:
+                    if r_term > self.current_term:
+                        self._step_down_locked(r_term)
+                        break
+                if granted:
+                    votes += 1
+            with self._lock:
+                if self.state == CANDIDATE and self.current_term == term and votes >= needed:
+                    self._become_leader_locked(term)
+
+    def _handle_request_vote(self, term, candidate_id, last_log_index, last_log_term):
+        with self._lock:
+            if term < self.current_term:
+                return [self.current_term, False]
+            if term > self.current_term:
+                self._step_down_locked(term)
+            up_to_date = (last_log_term, last_log_index) >= (
+                self._last_term(), self._last_index()
+            )
+            if up_to_date and self.voted_for in (None, candidate_id):
+                self.voted_for = candidate_id
+                self._persist_meta_locked()
+                self._election_deadline = self._random_deadline()
+                return [self.current_term, True]
+            return [self.current_term, False]
+
+    # -- replication (leader side) ---------------------------------------
+
+    def _client(self, peer_id: str) -> RPCClient:
+        c = self._clients.get(peer_id)
+        if c is None:
+            host, port = self.peers[peer_id]
+            c = self._clients[peer_id] = RPCClient(
+                host, port, timeout=self.config.rpc_timeout
+            )
+        return c
+
+    def _replicator(self, peer_id: str) -> None:
+        """Per-peer loop: push entries whenever we lead and the peer lags;
+        otherwise heartbeat on the interval."""
+        while not self._shutdown.is_set():
+            with self._lock:
+                self._repl_cv.wait(self.config.heartbeat_interval)
+                if peer_id not in self.peers:
+                    return  # removed via remove_peer
+                if self.state != LEADER:
+                    continue
+                term = self.current_term
+            try:
+                self._replicate_once(peer_id, term)
+            except (RPCError, OSError, ConnectionError):
+                continue
+            except Exception:  # noqa: BLE001
+                self.logger.exception("replication to %s failed", peer_id)
+
+    def _replicate_once(self, peer_id: str, term: int) -> None:
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
+                return
+            next_idx = self.next_index.get(peer_id, self._last_index() + 1)
+            prev_index = next_idx - 1
+            prev_term = self._term_at(prev_index)
+            entries = self._entries_from(next_idx)
+            commit = self.commit_index
+            if entries is None or prev_term < 0:
+                # peer needs entries we compacted — install snapshot
+                snap_index = self._snapshot_index
+                snap_term = self._snapshot_term
+                snap_state = self._snapshot_state
+                send_snapshot = True
+            else:
+                send_snapshot = False
+                wire_entries = [list(e) for e in entries]
+        if send_snapshot:
+            if snap_state is None:
+                return
+            r_term = self._client(peer_id).call(
+                "Raft.InstallSnapshot", term, self.node_id,
+                snap_index, snap_term, snap_state, no_forward=True,
+            )
+            with self._lock:
+                if r_term > self.current_term:
+                    self._step_down_locked(r_term)
+                    return
+                self.next_index[peer_id] = snap_index + 1
+                self.match_index[peer_id] = max(
+                    self.match_index.get(peer_id, 0), snap_index
+                )
+                self._advance_commit_locked()
+            return
+        r_term, success, match = self._client(peer_id).call(
+            "Raft.AppendEntries", term, self.node_id,
+            prev_index, prev_term, wire_entries, commit, no_forward=True,
+        )
+        with self._lock:
+            if r_term > self.current_term:
+                self._step_down_locked(r_term)
+                return
+            if self.state != LEADER or self.current_term != term:
+                return
+            if success:
+                self.match_index[peer_id] = max(
+                    self.match_index.get(peer_id, 0), match
+                )
+                self.next_index[peer_id] = self.match_index[peer_id] + 1
+                self._advance_commit_locked()
+                if self.next_index[peer_id] <= self._last_index():
+                    self._repl_cv.notify_all()  # more to send
+            else:
+                # consistency check failed: back up (peer reports its last
+                # index as a hint to skip large gaps)
+                self.next_index[peer_id] = max(1, min(next_idx - 1, match + 1))
+                self._repl_cv.notify_all()
+
+    def _advance_commit_locked(self) -> None:
+        """Commit = highest index replicated on a quorum, current term only."""
+        cluster = len(self.peers) + 1
+        needed = cluster // 2 + 1
+        for index in range(self._last_index(), self.commit_index, -1):
+            if self._term_at(index) != self.current_term:
+                break
+            count = sum(
+                1 for m in self.match_index.values() if m >= index
+            )
+            if count >= needed:
+                self.commit_index = index
+                break
+        self._apply_committed_locked()
+
+    def _apply_committed_locked(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self._entries_from(self.last_applied, 1)
+            if not entry:
+                break
+            index, term, entry_type, payload = entry[0]
+            if entry_type != "_raft-barrier" and self.fsm is not None:
+                try:
+                    result = self.fsm.apply(index, entry_type, payload)
+                except Exception as e:  # noqa: BLE001
+                    self.logger.exception("FSM apply failed at %d", index)
+                    result = e
+                if self.state == LEADER:
+                    self._apply_results[index] = result
+        self._commit_cv.notify_all()
+
+    # -- follower side ---------------------------------------------------
+
+    def _handle_append_entries(
+        self, term, leader_id, prev_index, prev_term, entries, leader_commit
+    ):
+        with self._lock:
+            if term < self.current_term:
+                return [self.current_term, False, self._last_index()]
+            if term > self.current_term or self.state != FOLLOWER:
+                self._step_down_locked(term)
+            self.leader_id = leader_id
+            self._election_deadline = self._random_deadline()
+            # consistency check
+            if prev_index > 0 and self._term_at(prev_index) != prev_term:
+                return [self.current_term, False, min(self._last_index(), prev_index - 1)]
+            for e in entries:
+                index, e_term, entry_type, payload = e
+                existing = self._term_at(index)
+                if existing == e_term:
+                    continue  # already have it
+                if existing != -1 or index <= self._last_index():
+                    # conflict: truncate from here
+                    pos = index - self._snapshot_index - 1
+                    self.log = self.log[:max(pos, 0)]
+                    if self.store is not None:
+                        self.store.truncate_after(index)
+                if index == self._last_index() + 1:
+                    self._append_locked(index, e_term, entry_type, payload)
+                else:
+                    # gap (shouldn't happen): reject so the leader backs up
+                    return [self.current_term, False, self._last_index()]
+            if leader_commit > self.commit_index:
+                self.commit_index = min(leader_commit, self._last_index())
+                self._apply_committed_locked()
+            return [self.current_term, True, self._last_index()]
+
+    def _handle_install_snapshot(self, term, leader_id, last_index, last_term, state_blob):
+        with self._lock:
+            if term < self.current_term:
+                return self.current_term
+            self._step_down_locked(term)
+            self.leader_id = leader_id
+            self._election_deadline = self._random_deadline()
+            if last_index <= self._snapshot_index:
+                return self.current_term
+            self._snapshot_index = last_index
+            self._snapshot_term = last_term
+            self._snapshot_state = state_blob
+            self.log = [e for e in self.log if e[0] > last_index]
+            if self._snapshot_path is not None:
+                tmp = self._snapshot_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(pickle.dumps((last_index, last_term, state_blob)))
+                os.replace(tmp, self._snapshot_path)
+            if self.store is not None:
+                self.store.truncate_before(last_index + 1)
+            if self.fsm is not None:
+                self.fsm.restore(pickle.loads(state_blob))
+            self.last_applied = last_index
+            self.commit_index = max(self.commit_index, last_index)
+            return self.current_term
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "term": self.current_term,
+                "leader_id": self.leader_id,
+                "last_index": self._last_index(),
+                "commit_index": self.commit_index,
+                "applied_index": self.last_applied,
+                "num_peers": len(self.peers),
+            }

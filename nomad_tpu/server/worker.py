@@ -30,6 +30,7 @@ class Worker:
         self._thread: Optional[threading.Thread] = None
         # set per-eval while scheduling
         self._eval_token = ""
+        self._snapshot_index = 0
         self.stats = {"evals_processed": 0, "plans_submitted": 0, "nacks": 0}
 
     def start(self) -> None:
@@ -82,6 +83,7 @@ class Worker:
         start = metrics.now()
         snapshot = self.server.fsm.state.snapshot_min_index(wait_index)
         metrics.measure_since("nomad.worker.wait_for_index", start)
+        self._snapshot_index = snapshot.latest_index
         sched = new_scheduler(evaluation.type, self.logger, snapshot, self)
         if hasattr(sched, "deterministic"):
             sched.deterministic = self.server.config.deterministic
@@ -95,7 +97,10 @@ class Worker:
 
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         plan.eval_token = self._eval_token
-        plan.snapshot_index = self.server.fsm.state.latest_index
+        # stamp the snapshot the scheduler actually saw (worker.go:277), not
+        # the newest index — the plan applier uses this to decide how much
+        # optimistic re-validation the plan needs
+        plan.snapshot_index = self._snapshot_index
         self.server.eval_broker.pause_nack_timeout(plan.eval_id, self._eval_token)
         try:
             pending = self.server.plan_queue.enqueue(plan)
@@ -109,6 +114,7 @@ class Worker:
 
         if result.refresh_index:
             new_state = self.server.fsm.state.snapshot_min_index(result.refresh_index)
+            self._snapshot_index = new_state.latest_index
             return result, new_state
         return result, None
 
@@ -117,6 +123,12 @@ class Worker:
         self.server.raft_apply(EVAL_UPDATE, [evaluation])
 
     def create_eval(self, evaluation: Evaluation) -> None:
+        # Stamp the worker's snapshot index (worker.go:385): the blocked-
+        # evals tracker compares it against per-class unblock indexes, and
+        # without it every new blocked eval looks like it "missed" an old
+        # unblock and is re-enqueued forever.
+        if not evaluation.snapshot_index:
+            evaluation.snapshot_index = self._snapshot_index
         evaluation.update_modify_time()
         self.server.raft_apply(EVAL_UPDATE, [evaluation])
 
