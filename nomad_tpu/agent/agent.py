@@ -263,12 +263,13 @@ class Agent:
             if self.membership is not None:
                 self.membership.set_leader(is_leader)
 
-    def _on_server_change(self, meta, alive: bool) -> None:
+    def _on_server_change(self, meta, status: str) -> None:
         """Track the local region's leader for RPC forwarding
         (reference serf.go → leader forwarding via raft; here the leader
         tag gossips the address)."""
         if meta.region != self.config.region or self.rpc is None:
             return
+        alive = status == "alive"
         if alive and meta.is_leader:
             self.rpc.leader_addr = meta.rpc_addr
         elif self.rpc.leader_addr == meta.rpc_addr:
@@ -277,7 +278,11 @@ class Agent:
             self.rpc.leader_addr = None
         # serf → raft peer reconciliation (leader.go:859/:952). The boot
         # lock serializes against an in-flight bootstrap so a server whose
-        # join races it still lands in the peer set.
+        # join races it still lands in the peer set. Only a graceful LEAVE
+        # shrinks the voter set — removing peers on failure suspicion would
+        # let a partitioned minority elect itself (split-brain); a failed
+        # peer stays a voter and simply doesn't ack (reference: serf
+        # Leave/Reap remove peers, failures don't).
         if self.wire_raft is not None:
             if alive:
                 with self._raft_boot_lock:
@@ -285,7 +290,7 @@ class Agent:
                         self.wire_raft.add_peer(meta.name, meta.rpc_addr)
                     else:
                         self._maybe_bootstrap_raft_locked()
-            else:
+            elif status == "left":
                 self.wire_raft.remove_peer(meta.name)
 
     @property
@@ -317,7 +322,10 @@ class Agent:
                 (s.name, f"{s.rpc_host}:{s.rpc_port}", s.is_leader)
                 for s in self.membership.servers_in_region()
             ]
-        return [(self.config.name, self.http_addr, self.server.is_leader)]
+        addr = (
+            "{}:{}".format(*self.rpc.addr) if self.rpc is not None else self.http_addr
+        )
+        return [(self.config.name, addr, self.server.is_leader)]
 
     def known_servers(self) -> List[str]:
         if self.membership is not None:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import shutil
+from dataclasses import dataclass
 from typing import Dict, List
 
 SHARED_ALLOC_DIR = "alloc"
@@ -19,14 +20,30 @@ TASK_SECRETS = "secrets"
 TASK_TMP = "tmp"
 
 
+@dataclass
 class TaskDir:
-    def __init__(self, alloc_dir: str, task_name: str) -> None:
-        self.dir = os.path.join(alloc_dir, task_name)
-        self.shared_alloc_dir = os.path.join(alloc_dir, SHARED_ALLOC_DIR)
-        self.local_dir = os.path.join(self.dir, TASK_LOCAL)
-        self.secrets_dir = os.path.join(self.dir, TASK_SECRETS)
-        self.tmp_dir = os.path.join(self.dir, TASK_TMP)
-        self.log_dir = os.path.join(self.shared_alloc_dir, "logs")
+    """Plain path bundle so it serializes across the driver-plugin
+    boundary (the reference's driver.proto carries dir paths as strings)."""
+
+    dir: str = ""
+    shared_alloc_dir: str = ""
+    local_dir: str = ""
+    secrets_dir: str = ""
+    tmp_dir: str = ""
+    log_dir: str = ""
+
+    @classmethod
+    def create(cls, alloc_dir: str, task_name: str) -> "TaskDir":
+        d = os.path.join(alloc_dir, task_name)
+        shared = os.path.join(alloc_dir, SHARED_ALLOC_DIR)
+        return cls(
+            dir=d,
+            shared_alloc_dir=shared,
+            local_dir=os.path.join(d, TASK_LOCAL),
+            secrets_dir=os.path.join(d, TASK_SECRETS),
+            tmp_dir=os.path.join(d, TASK_TMP),
+            log_dir=os.path.join(shared, "logs"),
+        )
 
     def build(self) -> None:
         for d in (self.dir, self.local_dir, self.tmp_dir):
@@ -46,7 +63,7 @@ class AllocDir:
         self.task_dirs: Dict[str, TaskDir] = {}
 
     def new_task_dir(self, task_name: str) -> TaskDir:
-        td = TaskDir(self.alloc_dir, task_name)
+        td = TaskDir.create(self.alloc_dir, task_name)
         self.task_dirs[task_name] = td
         return td
 

@@ -38,6 +38,12 @@ class ClientConfig:
     meta: Dict[str, str] = field(default_factory=dict)
     persist_state: bool = False
     heartbeat_grace: float = 0.5
+    # external plugins (reference client config plugin_dir + plugin stanzas):
+    # plugin_dir is scanned for nomad-driver-*/nomad-device-* executables;
+    # external_drivers forces built-in drivers out-of-process (the
+    # reference's go-plugin default), name → plugin config stanza
+    plugin_dir: str = ""
+    external_drivers: Dict[str, dict] = field(default_factory=dict)
 
 
 class ServerProxy:
@@ -86,6 +92,19 @@ class Client:
             self.config.state_dir = tempfile.mkdtemp(prefix="nomad-client-")
         self.alloc_dir_base = os.path.join(self.config.state_dir, "allocs")
 
+        # external plugins register into the driver registry BEFORE
+        # fingerprinting so discovered drivers land in node attributes
+        # (reference: plugin managers run before fingerprint merge)
+        self.plugin_catalog = None
+        if self.config.plugin_dir:
+            from ..plugins.catalog import Catalog
+
+            self.plugin_catalog = Catalog(self.config.plugin_dir).discover()
+        for drv_name, drv_config in self.config.external_drivers.items():
+            from ..plugins.catalog import register_external_driver
+
+            register_external_driver(drv_name, drv_config)
+
         self.node = node or Node()
         self.node.datacenter = self.config.datacenter
         self.node.node_class = self.config.node_class
@@ -124,6 +143,15 @@ class Client:
         for ar in runners:
             ar.stop()
         self.state_db.close()
+        if self.plugin_catalog is not None:
+            self.plugin_catalog.close()
+        # stop the subprocess drivers this client forced out-of-process
+        # and reinstate the in-process factories they displaced
+        if self.config.external_drivers:
+            from ..plugins.catalog import close_external_driver
+
+            for drv_name in self.config.external_drivers:
+                close_external_driver(drv_name)
 
     # -- restore (client.go:991) -----------------------------------------
 

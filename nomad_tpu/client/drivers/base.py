@@ -142,8 +142,20 @@ class Driver:
 _REGISTRY: Dict[str, Callable[[], Driver]] = {}
 
 
-def register(name: str, factory: Callable[[], Driver]) -> None:
+def register(name: str, factory: Callable[[], Driver]) -> Optional[Callable[[], Driver]]:
+    """Register a driver factory; returns the factory it replaced (if any)
+    so plugin catalogs can restore it on shutdown."""
+    prior = _REGISTRY.get(name)
     _REGISTRY[name] = factory
+    return prior
+
+
+def restore(name: str, factory: Optional[Callable[[], Driver]]) -> None:
+    """Undo a register(): reinstate the prior factory or drop the name."""
+    if factory is None:
+        _REGISTRY.pop(name, None)
+    else:
+        _REGISTRY[name] = factory
 
 
 def new_driver(name: str) -> Driver:

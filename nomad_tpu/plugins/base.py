@@ -1,0 +1,62 @@
+"""Base plugin protocol (reference ``plugins/base/proto/base.proto``).
+
+Every plugin — driver or device — answers ``PluginInfo``, exposes a config
+schema, and accepts ``SetConfig`` before use. The schema is a plain
+declarative dict (the hclspec slot, plugins/shared/hclspec): attribute name
+→ {"type": ..., "required": ..., "default": ...}; agents validate plugin
+stanzas against it without importing the plugin.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+API_VERSION = "v0.1.0"
+PLUGIN_TYPE_DRIVER = "driver"
+PLUGIN_TYPE_DEVICE = "device"
+
+# stdout handshake line the subprocess prints once its socket is live
+# (go-plugin's "CORE-PROTOCOL-VERSION|APP-PROTOCOL-VERSION|NETWORK|ADDR|PROTOCOL")
+HANDSHAKE_PREFIX = "NOMAD-TPU-PLUGIN|1|"
+
+
+@dataclass
+class PluginInfo:
+    type: str = PLUGIN_TYPE_DRIVER
+    name: str = ""
+    plugin_version: str = "0.1.0"
+    plugin_api_versions: tuple = (API_VERSION,)
+
+
+class BasePlugin:
+    """Implemented by every plugin object served over the socket."""
+
+    def plugin_info(self) -> PluginInfo:
+        raise NotImplementedError
+
+    def config_schema(self) -> Dict[str, Any]:
+        return {}
+
+    def set_config(self, config: Dict[str, Any]) -> None:
+        self.config = dict(config)
+
+
+def validate_config(schema: Dict[str, Any], config: Dict[str, Any]) -> list:
+    """Schema-check a plugin config stanza; returns error strings."""
+    errors = []
+    types = {"string": str, "int": int, "bool": bool, "float": (int, float),
+             "list": list, "map": dict}
+    for key, spec in schema.items():
+        if spec.get("required") and key not in config:
+            errors.append(f"missing required plugin config {key!r}")
+        if key in config and "type" in spec:
+            want = types.get(spec["type"])
+            if want is not None and not isinstance(config[key], want):
+                errors.append(
+                    f"plugin config {key!r} must be {spec['type']}, "
+                    f"got {type(config[key]).__name__}"
+                )
+    for key in config:
+        if key not in schema:
+            errors.append(f"unknown plugin config {key!r}")
+    return errors

@@ -1,0 +1,41 @@
+"""Plugin subprocess entrypoint.
+
+``python -m nomad_tpu.plugins.launch driver <name>`` serves a built-in
+driver out-of-process; ``... device <module>:<attr>`` serves a device
+plugin factory. External plugin executables are free to call
+``transport.serve_main`` themselves — this module is the built-in shim
+(the role of the reference's plugin main() + go-plugin Serve).
+"""
+from __future__ import annotations
+
+import importlib
+import sys
+
+
+def main(argv) -> int:
+    if len(argv) < 2:
+        print("usage: launch driver <name> | device <module>:<attr>", file=sys.stderr)
+        return 2
+    kind, target = argv[0], argv[1]
+    if kind == "driver":
+        from ..client.drivers import new_driver  # package import registers built-ins
+        from .driver_plugin import DriverPluginShim
+        from .transport import serve_main
+
+        serve_main(DriverPluginShim(new_driver(target)))
+    elif kind == "device":
+        module_name, _, attr = target.partition(":")
+        module = importlib.import_module(module_name)
+        factory = getattr(module, attr or "plugin")
+        from .device import DevicePluginShim
+        from .transport import serve_main
+
+        serve_main(DevicePluginShim(factory()))
+    else:
+        print(f"unknown plugin kind {kind!r}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,246 @@
+"""Plugin transport: msgpack framing over unix-domain sockets.
+
+Fills the role of go-plugin's gRPC-over-unix-socket channel (reference
+``vendor/github.com/hashicorp/go-plugin``): the parent spawns the plugin
+subprocess, reads a one-line handshake from its stdout naming the socket,
+then issues method calls with the same length-framed msgpack envelope the
+server RPC uses (rpc/codec, rpc/transport framing). Calls can block
+server-side (``wait_task``), so the client keeps a small pool of
+connections instead of serializing on one.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, List, Optional
+
+from ..rpc.codec import decode, encode
+from ..rpc.transport import _recv_frame, _send_frame
+from .base import HANDSHAKE_PREFIX
+
+logger = logging.getLogger("nomad_tpu.plugins.transport")
+
+
+class PluginError(Exception):
+    pass
+
+
+class PluginServer:
+    """Runs inside the plugin subprocess: serves a plugin object's public
+    methods over a unix socket."""
+
+    def __init__(self, obj: object, socket_path: str) -> None:
+        self.obj = obj
+        self.socket_path = socket_path
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                sock = self.request
+                try:
+                    while True:
+                        req = decode(_recv_frame(sock))
+                        _send_frame(sock, encode(outer._dispatch(req)))
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+        self._srv = Server(socket_path, Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    def _dispatch(self, req: dict) -> dict:
+        seq = req.get("seq", 0)
+        method = req.get("method", "")
+        fn = getattr(self.obj, method, None)
+        if fn is None or method.startswith("_") or not callable(fn):
+            return {"seq": seq, "error": f"unknown plugin method {method!r}", "body": None}
+        try:
+            return {"seq": seq, "error": None, "body": fn(*req.get("body", ()))}
+        except Exception as e:  # noqa: BLE001 — errors cross the boundary as strings
+            return {"seq": seq, "error": f"{type(e).__name__}: {e}", "body": None}
+
+    def serve_forever(self) -> None:
+        """Handshake on stdout, then serve until the parent disappears."""
+        print(f"{HANDSHAKE_PREFIX}{self.socket_path}", flush=True)
+        self._srv.serve_forever()
+
+    def start_background(self) -> None:
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+
+class PluginClient:
+    """Parent-side connection to one plugin process (or socket)."""
+
+    def __init__(self, socket_path: str, process: Optional[subprocess.Popen] = None,
+                 max_conns: int = 8) -> None:
+        self.socket_path = socket_path
+        self.process = process
+        self.max_conns = max_conns
+        self._lock = threading.Lock()
+        self._free: List[socket.socket] = []
+        self._live = 0
+        self._seq = 0
+        self._closed = False
+
+    # -- connection pool -------------------------------------------------
+
+    def _acquire(self) -> socket.socket:
+        with self._lock:
+            if self._closed:
+                raise PluginError("plugin client closed")
+            if self._free:
+                return self._free.pop()
+            self._live += 1
+        try:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(self.socket_path)
+            return s
+        except OSError as e:
+            with self._lock:
+                self._live -= 1
+            raise PluginError(f"plugin unreachable at {self.socket_path}: {e}") from e
+
+    def _release(self, sock: socket.socket, broken: bool) -> None:
+        with self._lock:
+            if broken or self._closed or len(self._free) >= self.max_conns:
+                self._live -= 1
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            else:
+                self._free.append(sock)
+
+    def call(self, method: str, *args: Any, timeout: Optional[float] = None) -> Any:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        sock = self._acquire()
+        broken = False
+        try:
+            sock.settimeout(timeout)
+            _send_frame(sock, encode({"seq": seq, "method": method, "body": tuple(args)}))
+            resp = decode(_recv_frame(sock))
+        except (ConnectionError, OSError, socket.timeout) as e:
+            broken = True
+            raise PluginError(f"plugin call {method} failed: {e}") from e
+        finally:
+            self._release(sock, broken)
+        if resp.get("error"):
+            raise PluginError(resp["error"])
+        return resp.get("body")
+
+    def alive(self) -> bool:
+        return self.process is None or self.process.poll() is None
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            socks, self._free = self._free, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self.process is not None and self.process.poll() is None:
+            if self.process.stdin is not None:
+                try:
+                    self.process.stdin.close()  # EOF: graceful exit signal
+                except OSError:
+                    pass
+            self.process.terminate()
+            try:
+                self.process.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                self.process.kill()
+                self.process.wait(timeout=3)
+
+
+def spawn_plugin(argv: List[str], handshake_timeout: float = 10.0,
+                 env: Optional[dict] = None) -> PluginClient:
+    """Launch a plugin subprocess and wait for its stdout handshake
+    (go-plugin client.Start)."""
+    proc = subprocess.Popen(
+        argv,
+        stdin=subprocess.PIPE,  # held open; EOF tells the plugin to exit
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=env,
+        text=True,
+    )
+    # A pump thread owns stdout: select() on a buffered text stream can
+    # miss lines already pulled into the reader's buffer, and after the
+    # handshake the pump keeps draining so a chatty plugin never blocks on
+    # a full pipe.
+    import queue as _queue
+
+    lines: "_queue.Queue[str]" = _queue.Queue()
+
+    def _pump() -> None:
+        try:
+            for out_line in proc.stdout:
+                lines.put(out_line)
+        except (ValueError, OSError):
+            pass
+
+    threading.Thread(target=_pump, daemon=True).start()
+
+    deadline = time.monotonic() + handshake_timeout
+    while True:
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise PluginError(f"plugin handshake timed out: {argv}")
+        try:
+            line = lines.get(timeout=0.1).strip()
+        except _queue.Empty:
+            if proc.poll() is not None:
+                raise PluginError(
+                    f"plugin exited ({proc.returncode}) before handshake: {argv}"
+                )
+            continue
+        if line.startswith(HANDSHAKE_PREFIX):
+            break
+    socket_path = line[len(HANDSHAKE_PREFIX):]
+    return PluginClient(socket_path, process=proc)
+
+
+def serve_main(obj: object, socket_path: Optional[str] = None) -> None:
+    """Plugin-side entrypoint: serve ``obj`` and exit when orphaned."""
+    import tempfile
+
+    if socket_path is None:
+        socket_path = os.path.join(
+            tempfile.mkdtemp(prefix="nomad-plugin-"), "plugin.sock"
+        )
+    server = PluginServer(obj, socket_path)
+
+    # exit when the parent dies (go-plugin kills via stdin close)
+    def watch_parent():
+        try:
+            sys.stdin.read()
+        except Exception:  # noqa: BLE001
+            pass
+        os._exit(0)
+
+    threading.Thread(target=watch_parent, daemon=True).start()
+    server.serve_forever()

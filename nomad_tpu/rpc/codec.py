@@ -45,6 +45,24 @@ def _register_all_structs() -> None:
                 TaskStats, TaskStatus):
         _REGISTRY[cls.__name__] = cls
 
+    from ..plugins.base import PluginInfo
+    from ..plugins.device import (
+        ContainerReservation,
+        DetectedDevice,
+        DeviceGroup,
+        DeviceSpec,
+        DeviceStats,
+        Mount,
+    )
+
+    for cls in (PluginInfo, ContainerReservation, DetectedDevice, DeviceGroup,
+                DeviceSpec, DeviceStats, Mount):
+        _REGISTRY[cls.__name__] = cls
+
+    from ..client.allocdir import TaskDir
+
+    _REGISTRY[TaskDir.__name__] = TaskDir
+
 
 def _to_wire(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):

@@ -97,9 +97,12 @@ class ServerMembership:
         self.memberlist.on_update = self._on_change
         self.memberlist.on_leave = self._on_gone
         self.memberlist.on_fail = self._on_gone
-        # fires (meta, alive) whenever the server set changes — the
-        # reference's reconcileCh consumer (leader.go:836 reconcileMember)
-        self.on_server_change: Optional[Callable[[ServerMeta, bool], None]] = None
+        # fires (meta, status) whenever the server set changes, status one
+        # of "alive" | "failed" | "left" — the reference's reconcileCh
+        # consumer (leader.go:836 reconcileMember). The distinction
+        # matters: only a graceful leave may shrink the raft peer set;
+        # removing voters on failure suspicion invites split-brain.
+        self.on_server_change: Optional[Callable[[ServerMeta, str], None]] = None
         self._ingest(self.memberlist.local_member())
 
     # -- lifecycle -------------------------------------------------------
@@ -170,13 +173,16 @@ class ServerMembership:
     def _on_change(self, member: Member) -> None:
         meta = self._ingest(member)
         if meta is not None and self.on_server_change is not None:
-            self.on_server_change(meta, True)
+            self.on_server_change(meta, "alive")
 
     def _on_gone(self, member: Member) -> None:
+        from ..gossip.memberlist import STATUS_LEFT
+
         meta = _parse_server(member)
         if meta is None:
             return
         with self._lock:
             self.peers.get(meta.region, {}).pop(meta.name, None)
         if self.on_server_change is not None:
-            self.on_server_change(meta, False)
+            status = "left" if member.status == STATUS_LEFT else "failed"
+            self.on_server_change(meta, status)

@@ -604,12 +604,18 @@ class WireRaft:
             self._snapshot_state = state_blob
             self.log = [e for e in self.log if e[0] > last_index]
             if self._snapshot_path is not None:
+                # fsync before replace: the log truncation below discards
+                # the entries this snapshot supersedes, so the snapshot
+                # must be durable first or a crash loses committed state
                 tmp = self._snapshot_path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(pickle.dumps((last_index, last_term, state_blob)))
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, self._snapshot_path)
             if self.store is not None:
                 self.store.truncate_before(last_index + 1)
+                self.store.sync()
             if self.fsm is not None:
                 self.fsm.restore(pickle.loads(state_blob))
             self.last_applied = last_index

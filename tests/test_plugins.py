@@ -1,0 +1,212 @@
+"""Plugin system tests: out-of-process drivers/devices over unix sockets.
+
+Covers the go-plugin slot (reference plugins/base, plugins/drivers,
+plugins/device, helper/pluginutils): subprocess handshake, full driver
+lifecycle across the process boundary, concurrent blocking calls, shared
+instances, config schemas, catalog discovery, and crash handling.
+"""
+import os
+import stat
+import sys
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.client.drivers.base import DriverError, TaskConfig, new_driver
+from nomad_tpu.plugins.base import PLUGIN_TYPE_DEVICE, PLUGIN_TYPE_DRIVER, validate_config
+from nomad_tpu.plugins.catalog import (
+    Catalog,
+    launch_builtin_driver,
+    register_external_driver,
+    shutdown_external_instances,
+)
+from nomad_tpu.plugins.transport import PluginError, spawn_plugin
+
+
+@pytest.fixture
+def ext_mock():
+    drv = launch_builtin_driver("mock")
+    yield drv
+    drv.close()
+
+
+class TestExternalDriver:
+    def test_handshake_and_info(self, ext_mock):
+        info = ext_mock.plugin_info()
+        assert info.type == PLUGIN_TYPE_DRIVER
+        assert info.name == "mock"
+        assert ext_mock.capabilities.send_signals is True
+
+    def test_full_task_lifecycle_across_process(self, ext_mock):
+        cfg = TaskConfig(id="t1", name="web",
+                         config={"run_for": "200ms", "exit_code": 3})
+        handle = ext_mock.start_task(cfg)
+        assert handle.driver == "mock" and handle.state == "running"
+        status = ext_mock.inspect_task("t1")
+        assert status.state in ("running", "exited")
+        res = ext_mock.wait_task("t1", timeout=5.0)
+        assert res is not None and res.exit_code == 3
+        assert ext_mock.inspect_task("t1").state == "exited"
+        ext_mock.destroy_task("t1")
+        with pytest.raises(DriverError):
+            ext_mock.inspect_task("t1")
+
+    def test_concurrent_wait_and_stop(self, ext_mock):
+        """wait_task blocks in the plugin while stop_task lands on another
+        pooled connection — the go-plugin concurrency property."""
+        ext_mock.start_task(TaskConfig(id="t2", name="w",
+                                       config={"run_for": "30s"}))
+        results = {}
+
+        def waiter():
+            results["res"] = ext_mock.wait_task("t2", timeout=10.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        ext_mock.stop_task("t2", timeout_s=2.0)
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert results["res"] is not None and results["res"].signal == 15
+
+    def test_driver_error_crosses_boundary(self, ext_mock):
+        with pytest.raises(DriverError, match="boom"):
+            ext_mock.start_task(TaskConfig(id="t3", name="w",
+                                           config={"start_error": "boom"}))
+
+    def test_plugin_crash_surfaces_as_driver_error(self):
+        drv = launch_builtin_driver("mock")
+        drv.client.process.kill()
+        drv.client.process.wait(timeout=5)
+        with pytest.raises(DriverError):
+            drv.start_task(TaskConfig(id="t4", name="w", config={}))
+        drv.close()
+
+    def test_registered_external_driver_is_shared(self):
+        register_external_driver("mock")
+        try:
+            a = new_driver("mock")
+            b = new_driver("mock")
+            assert a is b, "one subprocess instance shared across tasks"
+            a.start_task(TaskConfig(id="s1", name="w", config={"run_for": 0}))
+            assert b.wait_task("s1", timeout=5.0) is not None
+        finally:
+            shutdown_external_instances()
+            # restore the in-process registration for other tests
+            from nomad_tpu.client.drivers.mock_driver import MockDriver, register
+            register("mock", MockDriver)
+
+
+class TestDevicePlugin:
+    @pytest.fixture
+    def ext_device(self):
+        from nomad_tpu.plugins.catalog import _plugin_env
+        from nomad_tpu.plugins.device import ExternalDevicePlugin
+
+        client = spawn_plugin(
+            [sys.executable, "-m", "nomad_tpu.plugins.launch",
+             "device", "nomad_tpu.plugins.mock_device:plugin"],
+            env=_plugin_env(),
+        )
+        dev = ExternalDevicePlugin("mock-device", client)
+        yield dev
+        dev.close()
+
+    def test_fingerprint_reserve_stats(self, ext_device):
+        info = ext_device.client.call("plugin_info", timeout=5.0)
+        assert info.type == PLUGIN_TYPE_DEVICE
+        groups = ext_device.fingerprint()
+        assert len(groups) == 1
+        g = groups[0]
+        assert (g.vendor, g.type, g.name) == ("nomad", "gpu", "mock")
+        assert [d.id for d in g.devices] == ["mock-0", "mock-1"]
+        res = ext_device.reserve(["mock-1"])
+        assert res.envs == {"MOCK_VISIBLE_DEVICES": "mock-1"}
+        stats = ext_device.stats()
+        assert set(stats.instance_stats) == {"mock-0", "mock-1"}
+
+    def test_reserve_unknown_device_errors(self, ext_device):
+        with pytest.raises(PluginError, match="unknown device"):
+            ext_device.reserve(["nope-9"])
+
+    def test_set_config_changes_fingerprint(self, ext_device):
+        ext_device.client.call("set_config", {"model": "tpu", "count": 4}, timeout=5.0)
+        groups = ext_device.fingerprint()
+        assert len(groups[0].devices) == 4
+        assert groups[0].name == "tpu"
+
+
+class TestCatalog:
+    def test_discovery_launches_executables(self, tmp_path):
+        script = tmp_path / "nomad-driver-extmock"
+        script.write_text(
+            "#!/bin/sh\nexec {} -m nomad_tpu.plugins.launch driver mock\n".format(sys.executable)
+        )
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        (tmp_path / "ignored.txt").write_text("not a plugin")
+        cat = Catalog(str(tmp_path)).discover()
+        try:
+            assert list(cat.drivers) == ["mock"]
+            drv = cat.drivers["mock"]
+            drv.start_task(TaskConfig(id="c1", name="w", config={"run_for": 0}))
+            assert drv.wait_task("c1", timeout=5.0) is not None
+        finally:
+            cat.close()
+            from nomad_tpu.client.drivers.mock_driver import MockDriver, register
+            register("mock", MockDriver)
+
+
+class TestConfigSchema:
+    def test_validate_config(self):
+        schema = {"endpoint": {"type": "string", "required": True},
+                  "gc": {"type": "bool"}}
+        assert validate_config(schema, {"endpoint": "unix:///x"}) == []
+        errs = validate_config(schema, {"gc": "yes"})
+        assert any("missing required" in e for e in errs)
+        assert any("must be bool" in e for e in errs)
+        assert any("unknown plugin config" in e
+                   for e in validate_config(schema, {"endpoint": "x", "zz": 1}))
+
+
+class TestClientWithExternalDriver:
+    def test_alloc_runs_through_subprocess_driver(self):
+        """Full client path — alloc runner → task runner → driver — with
+        the driver out-of-process (the reference's production topology)."""
+        import time as _time
+
+        from nomad_tpu import mock
+        from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_schedulers=1, heartbeat_min_ttl=60,
+                                     heartbeat_max_ttl=60))
+        server.start()
+        client = Client(
+            ServerProxy(server),
+            ClientConfig(external_drivers={"mock": {}}),
+        )
+        try:
+            client.start()
+            job = mock.job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].driver = "mock"
+            job.task_groups[0].tasks[0].config = {"run_for": "30s"}
+            server.register_job(job)
+            deadline = _time.monotonic() + 30
+            while _time.monotonic() < deadline:
+                allocs = server.fsm.state.allocs_by_job("default", job.id, True)
+                if allocs and allocs[0].client_status == "running":
+                    break
+                _time.sleep(0.2)
+            else:
+                raise AssertionError("alloc never reached running via external driver")
+            drv = new_driver("mock")
+            from nomad_tpu.plugins.driver_plugin import ExternalDriver
+            assert isinstance(drv, ExternalDriver), "driver is subprocess-backed"
+        finally:
+            client.shutdown()
+            server.stop()
+            shutdown_external_instances()
+            from nomad_tpu.client.drivers.mock_driver import MockDriver, register
+            register("mock", MockDriver)
