@@ -36,11 +36,15 @@ class AllocRunner:
         node=None,
         on_update: Optional[Callable[["AllocRunner"], None]] = None,
         prev_alloc_watcher: Optional[Callable[[], None]] = None,
+        device_manager=None,
+        driver_factory=None,
     ) -> None:
         self.alloc = alloc
         self.node = node
         self.on_update = on_update
         self.prev_alloc_watcher = prev_alloc_watcher
+        self.device_manager = device_manager
+        self.driver_factory = driver_factory
         self.logger = logging.getLogger(f"nomad_tpu.allocrunner.{alloc.id[:8]}")
 
         self.alloc_dir = AllocDir(base_dir, alloc.id)
@@ -67,7 +71,9 @@ class AllocRunner:
         for task in self.task_group.tasks:
             td = self.alloc_dir.new_task_dir(task.name)
             tr = TaskRunner(
-                self.alloc, task, td, node=self.node, on_state_change=self._notify
+                self.alloc, task, td, node=self.node, on_state_change=self._notify,
+                device_manager=self.device_manager,
+                driver_factory=self.driver_factory,
             )
             self.task_runners[task.name] = tr
             handle = (recover_handles or {}).get(task.name)

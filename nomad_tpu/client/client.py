@@ -44,6 +44,10 @@ class ClientConfig:
     # reference's go-plugin default), name → plugin config stanza
     plugin_dir: str = ""
     external_drivers: Dict[str, dict] = field(default_factory=dict)
+    # built-in device plugins to run, name → config stanza (e.g.
+    # {"tpu": {}} or {"mock-device": {"count": 4}}); external device
+    # plugins arrive via plugin_dir discovery
+    device_plugins: Dict[str, dict] = field(default_factory=dict)
 
 
 class ServerProxy:
@@ -100,16 +104,34 @@ class Client:
             from ..plugins.catalog import Catalog
 
             self.plugin_catalog = Catalog(self.config.plugin_dir).discover()
-        for drv_name, drv_config in self.config.external_drivers.items():
-            from ..plugins.catalog import register_external_driver
+        # subprocess drivers this client owns (NOT process-global: two
+        # clients in one process must not share or kill each other's
+        # plugin subprocesses)
+        self._external_driver_instances: Dict[str, object] = {}
+        self._external_lock = threading.Lock()
 
-            register_external_driver(drv_name, drv_config)
+        # device plugins: built-ins by name plus any discovered externally
+        self.device_manager = None
+        device_plugins = []
+        for dev_name, dev_config in self.config.device_plugins.items():
+            from .devicemanager import builtin_device_plugin
+
+            device_plugins.append(builtin_device_plugin(dev_name, dev_config))
+        if self.plugin_catalog is not None:
+            device_plugins.extend(self.plugin_catalog.devices.values())
+        if device_plugins:
+            from .devicemanager import DeviceManager
+
+            self.device_manager = DeviceManager(device_plugins)
 
         self.node = node or Node()
         self.node.datacenter = self.config.datacenter
         self.node.node_class = self.config.node_class
         self.node.meta.update(self.config.meta)
         fingerprint_node(self.node)
+        if self.device_manager is not None:
+            self.device_manager.fingerprint_node(self.node)
+            self.node.compute_class()
 
         self.logger = logging.getLogger(f"nomad_tpu.client.{self.node.id[:8]}")
         self.state_db: StateDB = (
@@ -126,6 +148,18 @@ class Client:
 
     def start(self) -> None:
         self._restore_state()
+        if self.device_manager is not None:
+            # periodic re-fingerprint; device changes re-register the node
+            # so the scheduler sees fresh capacity
+            def _devices_changed(devices):
+                from .devicemanager import DeviceManager as _DM
+
+                _DM.apply_to_node(self.node, devices)
+                self.node.compute_class()
+                self.proxy.register_node(self.node)
+
+            self.device_manager.on_devices_changed = _devices_changed
+            self.device_manager.start()
         self.heartbeat_ttl = self.proxy.register_node(self.node)
         for target, name in (
             (self._heartbeat_loop, "heartbeat"),
@@ -143,15 +177,47 @@ class Client:
         for ar in runners:
             ar.stop()
         self.state_db.close()
+        if self.device_manager is not None:
+            self.device_manager.stop()
         if self.plugin_catalog is not None:
             self.plugin_catalog.close()
-        # stop the subprocess drivers this client forced out-of-process
-        # and reinstate the in-process factories they displaced
-        if self.config.external_drivers:
-            from ..plugins.catalog import close_external_driver
+        # stop the subprocess drivers this client owns
+        with self._external_lock:
+            instances = list(self._external_driver_instances.values())
+            self._external_driver_instances.clear()
+        for inst in instances:
+            try:
+                inst.close()
+            except Exception:  # noqa: BLE001
+                pass
 
-            for drv_name in self.config.external_drivers:
-                close_external_driver(drv_name)
+    def resolve_driver(self, name: str):
+        """Driver factory for this client's task runners: external_drivers
+        names get a client-owned subprocess plugin instance (respawned if
+        dead); everything else resolves through the shared registry."""
+        if name not in self.config.external_drivers:
+            from .drivers.base import new_driver
+
+            return new_driver(name)
+        from ..plugins.base import validate_config
+        from ..plugins.catalog import launch_builtin_driver
+        from .drivers.base import DriverError
+
+        with self._external_lock:
+            inst = self._external_driver_instances.get(name)
+            if inst is not None and inst.client.alive():
+                return inst
+            inst = launch_builtin_driver(name)
+            drv_config = self.config.external_drivers.get(name)
+            if drv_config:
+                schema = inst.config_schema()
+                errors = validate_config(schema, drv_config) if schema else []
+                if errors:
+                    inst.close()
+                    raise DriverError("; ".join(errors))
+                inst.set_config(drv_config)
+            self._external_driver_instances[name] = inst
+            return inst
 
     # -- restore (client.go:991) -----------------------------------------
 
@@ -160,7 +226,8 @@ class Client:
             if alloc.terminal_status():
                 continue
             ar = AllocRunner(
-                alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update
+                alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update,
+                device_manager=self.device_manager, driver_factory=self.resolve_driver,
             )
             # re-attach live tasks BEFORE the runners start, so a recovered
             # task is waited on instead of started a second time
@@ -219,7 +286,8 @@ class Client:
 
     def _add_alloc(self, alloc: Allocation) -> None:
         ar = AllocRunner(
-            alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update
+            alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update,
+            device_manager=self.device_manager, driver_factory=self.resolve_driver,
         )
         with self._lock:
             self.allocrunners[alloc.id] = ar

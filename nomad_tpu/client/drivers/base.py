@@ -43,6 +43,10 @@ class TaskConfig:
     cpu_limit: int = 0
     memory_limit_mb: int = 0
     user: str = ""
+    # device reservations (plugins/device ContainerReservation): isolating
+    # drivers (docker/exec) honor these; unisolated drivers see the env only
+    mounts: List[Any] = field(default_factory=list)
+    devices: List[Any] = field(default_factory=list)
 
 
 @dataclass

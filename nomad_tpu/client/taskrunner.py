@@ -92,16 +92,20 @@ class TaskRunner:
         node=None,
         on_state_change: Optional[Callable[[], None]] = None,
         update_interval: float = 0.05,
+        device_manager=None,
+        driver_factory=None,
     ) -> None:
         self.alloc = alloc
         self.task = task
         self.task_dir = task_dir
         self.node = node
         self.on_state_change = on_state_change
+        self.device_manager = device_manager
+        self.driver_factory = driver_factory or new_driver
         self.update_interval = update_interval
         self.logger = logging.getLogger(f"nomad_tpu.taskrunner.{task.name}")
 
-        self.driver = new_driver(task.driver)
+        self.driver = self.driver_factory(task.driver)
         self.task_id = f"{alloc.id}/{task.name}"
         self.handle: Optional[TaskHandle] = None
         self._recovered = False
@@ -219,12 +223,32 @@ class TaskRunner:
 
                 shutil.copy(src[len("file://"):], self.task_dir.local_dir)
 
+    def _device_reservation(self):
+        """Device hook (task_runner_hooks.go device hook): reserve the
+        alloc's assigned device instances, yielding env/mounts/devices.
+        Failures surface as DriverError so the run loop's restart policy
+        handles them like any other start failure."""
+        if self.device_manager is None or self.alloc.allocated_resources is None:
+            return None
+        task_res = self.alloc.allocated_resources.tasks.get(self.task.name)
+        if task_res is None or not task_res.devices:
+            return None
+        try:
+            return self.device_manager.reserve(task_res.devices)
+        except DriverError:
+            raise
+        except Exception as e:  # noqa: BLE001 — reservation errors are varied
+            raise DriverError(f"device reservation failed: {e}") from e
+
     def _start_task(self) -> None:
         env = (
             TaskEnvBuilder(self.node, self.alloc, self.task)
             .set_task_dirs(self.task_dir)
             .build()
         )
+        reservation = self._device_reservation()
+        if reservation is not None:
+            env.update(reservation.envs)
         os.makedirs(self.task_dir.log_dir, exist_ok=True)
         cfg = TaskConfig(
             id=self.task_id,
@@ -241,6 +265,8 @@ class TaskRunner:
             ),
             cpu_limit=self.task.resources.cpu if self.task.resources else 0,
             memory_limit_mb=self.task.resources.memory_mb if self.task.resources else 0,
+            mounts=list(reservation.mounts) if reservation else [],
+            devices=list(reservation.devices) if reservation else [],
         )
         # interpolate driver config values
         builder = TaskEnvBuilder(self.node, self.alloc, self.task).set_task_dirs(self.task_dir)

@@ -123,7 +123,9 @@ class Catalog:
                 continue
             try:
                 plugin = launch_external(path)
-            except PluginError as e:
+            except (PluginError, OSError) as e:
+                # one malformed executable (bad shebang, wrong arch) must
+                # not take the node agent down
                 logger.warning("failed to launch plugin %s: %s", path, e)
                 continue
             if isinstance(plugin, ExternalDriver):

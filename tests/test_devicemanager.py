@@ -1,0 +1,138 @@
+"""Device manager tests: plugin fingerprint → node devices → scheduler
+assignment → task reservation env.
+
+Covers reference ``client/devicemanager`` + ``devices/gpu/nvidia`` (here:
+the TPU plugin) wired through the whole stack, the way nvidia devices flow
+fingerprint → NodeResources.Devices → DeviceChecker/deviceAllocator →
+Reserve → NVIDIA_VISIBLE_DEVICES.
+"""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+from nomad_tpu.client.devicemanager import (
+    DeviceManager,
+    DeviceReservationError,
+    builtin_device_plugin,
+)
+from nomad_tpu.plugins.mock_device import MockDevicePlugin
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs.structs import AllocatedDeviceResource, RequestedDevice
+
+
+class TestDeviceManager:
+    def test_fingerprint_merges_into_node(self):
+        dm = DeviceManager([MockDevicePlugin(count=3)])
+        node = mock.node()
+        node.node_resources.devices = []
+        dm.fingerprint_node(node)
+        devs = node.node_resources.devices
+        assert len(devs) == 1
+        assert (devs[0].vendor, devs[0].type, devs[0].name) == ("nomad", "gpu", "mock")
+        assert len(devs[0].instances) == 3
+        assert node.attributes["device.nomad.gpu.mock.count"] == "3"
+        assert node.attributes["device.nomad.gpu.mock.memory_mib"] == "4096"
+
+    def test_reserve_routes_to_owning_plugin(self):
+        dm = DeviceManager([MockDevicePlugin(count=2)])
+        dm.fingerprint()
+        res = dm.reserve([
+            AllocatedDeviceResource(vendor="nomad", type="gpu", name="mock",
+                                    device_ids=["mock-0", "mock-1"])
+        ])
+        assert res.envs == {"MOCK_VISIBLE_DEVICES": "mock-0,mock-1"}
+
+    def test_reserve_unknown_group_raises(self):
+        dm = DeviceManager([MockDevicePlugin()])
+        dm.fingerprint()
+        with pytest.raises(DeviceReservationError):
+            dm.reserve([AllocatedDeviceResource(vendor="x", type="y", name="z",
+                                                device_ids=["a"])])
+
+    def test_sick_plugin_does_not_kill_fingerprint(self):
+        class Sick(MockDevicePlugin):
+            def fingerprint(self):
+                raise RuntimeError("nvml exploded")
+
+        dm = DeviceManager([Sick(), MockDevicePlugin(model="ok")])
+        groups = dm.fingerprint()
+        assert [g.name for g in groups] == ["ok"]
+
+    def test_builtin_factory(self):
+        p = builtin_device_plugin("mock-device", {"count": 5})
+        assert len(p.fingerprint()[0].devices) == 5
+        with pytest.raises(ValueError):
+            builtin_device_plugin("nope")
+
+
+class TestTPUDevicePlugin:
+    def test_fingerprint_and_reserve(self):
+        """On this host JAX sees at least one device (CPU fallback or real
+        TPU); the plugin must expose them and reserve with env vars."""
+        p = builtin_device_plugin("tpu")
+        groups = p.fingerprint()
+        assert groups, "expected at least one jax device group"
+        g = groups[0]
+        assert g.vendor == "google" and g.devices
+        ids = [d.id for d in g.devices]
+        res = p.reserve(ids[:1])
+        assert res.envs["TPU_VISIBLE_CHIPS"] == ids[0]
+        with pytest.raises(ValueError):
+            p.reserve(["not-a-chip"])
+
+
+class TestEndToEndDeviceScheduling:
+    def test_task_gets_device_env(self, tmp_path):
+        """Job asks for a device → scheduler assigns instances →
+        task runner reserves → task process sees the reservation env."""
+        server = Server(ServerConfig(num_schedulers=1, heartbeat_min_ttl=60,
+                                     heartbeat_max_ttl=60))
+        server.start()
+        client = Client(
+            ServerProxy(server),
+            ClientConfig(device_plugins={"mock-device": {"count": 2}}),
+        )
+        try:
+            client.start()
+            # the registered node advertises the mock devices
+            stored = server.fsm.state.node_by_id(client.node.id)
+            assert stored.node_resources.devices, "devices registered"
+
+            job = mock.job()
+            job.task_groups[0].count = 1
+            task = job.task_groups[0].tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": ["-c", "env > $NOMAD_TASK_DIR/envdump; sleep 30"],
+            }
+            task.resources.devices = [RequestedDevice(name="gpu/mock", count=2)]
+            server.register_job(job)
+
+            deadline = time.monotonic() + 30
+            alloc = None
+            while time.monotonic() < deadline:
+                allocs = server.fsm.state.allocs_by_job("default", job.id, True)
+                if allocs and allocs[0].client_status == "running":
+                    alloc = allocs[0]
+                    break
+                time.sleep(0.2)
+            assert alloc is not None, "alloc never ran"
+            # scheduler recorded the instance assignment
+            task_res = alloc.allocated_resources.tasks[task.name]
+            assert task_res.devices and sorted(task_res.devices[0].device_ids) == \
+                ["mock-0", "mock-1"]
+            # the task's environment carries the reservation
+            dump = os.path.join(client.alloc_dir_base, alloc.id, task.name,
+                                "local", "envdump")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not os.path.exists(dump):
+                time.sleep(0.1)
+            env_text = open(dump).read()
+            assert "MOCK_VISIBLE_DEVICES=mock-0,mock-1" in env_text
+        finally:
+            client.shutdown()
+            server.stop()

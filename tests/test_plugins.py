@@ -201,12 +201,13 @@ class TestClientWithExternalDriver:
                 _time.sleep(0.2)
             else:
                 raise AssertionError("alloc never reached running via external driver")
-            drv = new_driver("mock")
             from nomad_tpu.plugins.driver_plugin import ExternalDriver
-            assert isinstance(drv, ExternalDriver), "driver is subprocess-backed"
+            drv = client.resolve_driver("mock")
+            assert isinstance(drv, ExternalDriver), "client-owned subprocess driver"
+            # the global registry is untouched: another client in this
+            # process still gets the in-process driver
+            from nomad_tpu.client.drivers.mock_driver import MockDriver
+            assert isinstance(new_driver("mock"), MockDriver)
         finally:
             client.shutdown()
             server.stop()
-            shutdown_external_instances()
-            from nomad_tpu.client.drivers.mock_driver import MockDriver, register
-            register("mock", MockDriver)
