@@ -143,12 +143,32 @@ int main(int argc, char** argv) {
     set_rlim(RLIMIT_CPU, rlimit_cpu);
     set_rlim(RLIMIT_AS, rlimit_as);
     set_rlim(RLIMIT_NOFILE, rlimit_nofile);
+    // Output paths may be logmon FIFOs: a plain open(O_WRONLY) on a FIFO
+    // with no reader blocks forever, wedging the task before exec. Retry
+    // non-blocking (ENXIO = no reader yet) with a deadline, then restore
+    // blocking semantics for the task's own writes.
+    auto open_output = [](const char* path) -> int {
+      struct stat st;
+      bool fifo = stat(path, &st) == 0 && S_ISFIFO(st.st_mode);
+      if (!fifo) return open(path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+      for (int i = 0; i < 500; i++) {  // ~10s at 20ms
+        int fd = open(path, O_WRONLY | O_NONBLOCK);
+        if (fd >= 0) {
+          int flags = fcntl(fd, F_GETFL);
+          fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+          return fd;
+        }
+        if (errno != ENXIO) return -1;
+        usleep(20 * 1000);
+      }
+      return -1;
+    };
     if (stdout_path) {
-      int fd = open(stdout_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+      int fd = open_output(stdout_path);
       if (fd >= 0) { dup2(fd, 1); close(fd); }
     }
     if (stderr_path) {
-      int fd = open(stderr_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+      int fd = open_output(stderr_path);
       if (fd >= 0) { dup2(fd, 2); close(fd); }
     }
     if (!env.empty()) {

@@ -125,9 +125,12 @@ class Agent:
 
             self.acl_resolver = ACLResolver(lambda: self.server.fsm.state)
         from .acl_routes import ACLRoutes
+        from .fs_routes import FSRoutes
 
         self.acl_routes = ACLRoutes(self)
         self.acl_routes.register_all(self.http)
+        self.fs_routes = FSRoutes(self)
+        self.fs_routes.register_all(self.http)
 
         # distributed wiring: RPC endpoints + gossip membership
         # (reference agent.go:560 setupServer → nomad.NewServer → setupRPC/Serf)
@@ -183,9 +186,17 @@ class Agent:
                 if self.config.retry_join:
                     self._start_retry_join()
             self._maybe_bootstrap_raft()
-            if self.client is not None:
-                self.client.start()
+            # HTTP before the client: the node registration advertises this
+            # agent's HTTP address for cross-node fs/logs proxying
             self.http.start()
+            if self.client is not None:
+                from ..gossip.memberlist import resolve_advertise_host
+
+                http_host = resolve_advertise_host(
+                    self.config.advertise_addr or self.http.addr[0]
+                )
+                self.client.node.http_addr = f"{http_host}:{self.http.addr[1]}"
+                self.client.start()
             self._started = True
         return self
 

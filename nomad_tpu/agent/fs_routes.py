@@ -1,0 +1,207 @@
+"""Alloc filesystem + logs HTTP endpoints.
+
+Fills the role of reference ``client/fs_endpoint.go`` (FileSystem.List/
+Stat/Stream/Logs) + ``command/agent/fs_endpoint.go`` (/v1/client/fs/*)
++ the server→client proxy (``nomad/client_fs_endpoint.go``): an agent
+serves requests for allocs on its own client directly from the alloc dir;
+for remote allocs a server-mode agent forwards the HTTP request to the
+owning node's advertised HTTP address (the reference proxies over
+streaming RPC — same hop, this transport is HTTP).
+"""
+from __future__ import annotations
+
+import os
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from .http import HTTPError, HTTPServer, Request
+from .routes import _tail
+
+
+class FSRoutes:
+    def __init__(self, agent) -> None:
+        self.agent = agent
+
+    def register_all(self, mux: HTTPServer) -> None:
+        mux.register("/v1/client/fs/ls/", self.ls)
+        mux.register("/v1/client/fs/stat/", self.stat)
+        mux.register("/v1/client/fs/cat/", self.cat)
+        mux.register("/v1/client/fs/readat/", self.readat)
+        mux.register("/v1/client/fs/logs/", self.logs)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _authorize(self, req: Request, alloc_id: str, capability: str) -> None:
+        """Enforce namespace fs/logs capabilities (reference
+        fs_endpoint.go:~40 aclObj.AllowNsOp(ns, readFS/readLogs))."""
+        namespace = "default"
+        server = self.agent.server
+        if server is not None:
+            alloc = server.fsm.state.alloc_by_id(alloc_id)
+            if alloc is not None:
+                namespace = alloc.namespace
+        elif self.agent.client is not None:
+            ar = self.agent.client.allocrunners.get(alloc_id)
+            if ar is not None:
+                namespace = ar.alloc.namespace
+        self.agent.authorize(req, (capability,), namespace)
+
+    def _alloc_root(self, alloc_id: str) -> Optional[str]:
+        """The alloc's directory if it lives on this agent's client."""
+        client = self.agent.client
+        if client is None:
+            return None
+        root = os.path.join(client.alloc_dir_base, alloc_id)
+        return root if os.path.isdir(root) else None
+
+    def _safe_path(self, root: str, rel: str) -> str:
+        """Resolve ``rel`` inside ``root``; reject escapes
+        (fs_endpoint.go uses filepath.Clean + prefix check)."""
+        candidate = os.path.realpath(os.path.join(root, rel.lstrip("/")))
+        real_root = os.path.realpath(root)
+        if candidate != real_root and not candidate.startswith(real_root + os.sep):
+            raise HTTPError(403, "path escapes allocation directory")
+        return candidate
+
+    def _proxy(self, req: Request, alloc_id: str) -> bytes:
+        """Forward to the node that owns the alloc (client_fs_endpoint.go
+        server→client hop)."""
+        server = self.agent.server
+        if server is None:
+            raise HTTPError(404, f"alloc {alloc_id} not on this node")
+        alloc = server.fsm.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise HTTPError(404, f"unknown allocation {alloc_id}")
+        node = server.fsm.state.node_by_id(alloc.node_id)
+        if node is None or not node.http_addr:
+            raise HTTPError(
+                404, f"node for alloc {alloc_id} has no reachable HTTP address"
+            )
+        if node.http_addr == "{}:{}".format(*self.agent.http.addr):
+            raise HTTPError(404, f"alloc {alloc_id} directory not found")
+        query = urllib.parse.urlencode(
+            {k: v[0] for k, v in req.query.items()}, safe="/"
+        )
+        url = f"http://{node.http_addr}{req.path}"
+        if query:
+            url += f"?{query}"
+        preq = urllib.request.Request(url)
+        token = req.options.auth_token
+        if token:
+            preq.add_header("X-Nomad-Token", token)
+        try:
+            with urllib.request.urlopen(preq, timeout=30) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            raise HTTPError(e.code, e.read().decode(errors="replace"))
+        except OSError as e:
+            raise HTTPError(502, f"proxy to {node.http_addr} failed: {e}")
+        return data
+
+    # -- handlers --------------------------------------------------------
+
+    def ls(self, req: Request):
+        alloc_id = _tail(req, "/v1/client/fs/ls/")
+        self._authorize(req, alloc_id, "read-fs")
+        root = self._alloc_root(alloc_id)
+        if root is None:
+            import json
+
+            return json.loads(self._proxy(req, alloc_id) or b"[]")
+        path = self._safe_path(root, req.param("path", "/"))
+        if not os.path.exists(path):
+            raise HTTPError(404, f"path {req.param('path', '/')} not found")
+        entries = []
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            entries.append({
+                "Name": name,
+                "IsDir": os.path.isdir(full),
+                "Size": st.st_size,
+                "FileMode": oct(st.st_mode & 0o777),
+                "ModTime": int(st.st_mtime),
+            })
+        return entries
+
+    def stat(self, req: Request):
+        alloc_id = _tail(req, "/v1/client/fs/stat/")
+        self._authorize(req, alloc_id, "read-fs")
+        root = self._alloc_root(alloc_id)
+        if root is None:
+            import json
+
+            return json.loads(self._proxy(req, alloc_id) or b"{}")
+        path = self._safe_path(root, req.param("path", "/"))
+        if not os.path.exists(path):
+            raise HTTPError(404, f"path {req.param('path', '/')} not found")
+        st = os.stat(path)
+        return {
+            "Name": os.path.basename(path) or "/",
+            "IsDir": os.path.isdir(path),
+            "Size": st.st_size,
+            "FileMode": oct(st.st_mode & 0o777),
+            "ModTime": int(st.st_mtime),
+        }
+
+    def cat(self, req: Request) -> bytes:
+        alloc_id = _tail(req, "/v1/client/fs/cat/")
+        self._authorize(req, alloc_id, "read-fs")
+        root = self._alloc_root(alloc_id)
+        if root is None:
+            return self._proxy(req, alloc_id)
+        path = self._safe_path(root, req.param("path", "/"))
+        if not os.path.isfile(path):
+            raise HTTPError(404, f"file {req.param('path', '/')} not found")
+        with open(path, "rb") as f:
+            return f.read()
+
+    def readat(self, req: Request) -> bytes:
+        alloc_id = _tail(req, "/v1/client/fs/readat/")
+        self._authorize(req, alloc_id, "read-fs")
+        root = self._alloc_root(alloc_id)
+        if root is None:
+            return self._proxy(req, alloc_id)
+        path = self._safe_path(root, req.param("path", "/"))
+        if not os.path.isfile(path):
+            raise HTTPError(404, f"file {req.param('path', '/')} not found")
+        try:
+            offset = int(req.param("offset", "0"))
+            limit = int(req.param("limit", str(1 << 20)))
+        except ValueError:
+            raise HTTPError(400, "offset/limit must be integers")
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(max(0, limit))
+
+    def logs(self, req: Request) -> bytes:
+        """Non-follow log read across the rotated sequence
+        (fs_endpoint.go logs; follow/framing is the CLI's tail loop)."""
+        alloc_id = _tail(req, "/v1/client/fs/logs/")
+        self._authorize(req, alloc_id, "read-logs")
+        root = self._alloc_root(alloc_id)
+        if root is None:
+            return self._proxy(req, alloc_id)
+        task = req.param("task", "")
+        if not task:
+            raise HTTPError(400, "task parameter required")
+        kind = req.param("type", "stdout")
+        if kind not in ("stdout", "stderr"):
+            raise HTTPError(400, "type must be stdout or stderr")
+        try:
+            offset = int(req.param("offset", "0"))
+        except ValueError:
+            raise HTTPError(400, "offset must be an integer")
+        origin = req.param("origin", "start")
+        from ..client.logmon import read_logs
+
+        log_dir = os.path.join(root, "alloc", "logs")
+        data, next_offset = read_logs(
+            log_dir, task, kind, offset=offset, origin=origin
+        )
+        req.response_index = next_offset
+        return data

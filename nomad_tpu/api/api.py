@@ -76,6 +76,7 @@ class Client:
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
+        self.alloc_fs = AllocFS(self)
         self.evaluations = Evaluations(self)
         self.deployments = Deployments(self)
         self.acl_policies = ACLPolicies(self)
@@ -114,6 +115,7 @@ class Client:
         path: str,
         body: Any = None,
         q: Optional[QueryOptions] = None,
+        raw: bool = False,
     ) -> Tuple[Any, QueryMeta]:
         url = self._url(path, q)
         data = None
@@ -128,12 +130,15 @@ class Client:
         req = urllib.request.Request(url, data=data, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.config.timeout) as resp:
-                payload = resp.read().decode()
+                payload = resp.read()
                 meta = QueryMeta(
                     last_index=int(resp.headers.get("X-Nomad-Index") or 0),
                     known_leader=resp.headers.get("X-Nomad-KnownLeader") == "true",
                 )
-                return (json.loads(payload) if payload else None), meta
+                if raw:
+                    return payload, meta
+                text = payload.decode()
+                return (json.loads(text) if text else None), meta
         except urllib.error.HTTPError as e:
             raise APIError(e.code, e.read().decode(errors="replace"))
         except urllib.error.URLError as e:
@@ -141,6 +146,11 @@ class Client:
 
     def get(self, path: str, q: Optional[QueryOptions] = None):
         return self._do("GET", path, None, q)
+
+    def get_raw(self, path: str, q: Optional[QueryOptions] = None) -> bytes:
+        """GET returning raw bytes (fs cat/readat/logs endpoints)."""
+        payload, _ = self._do("GET", path, None, q, raw=True)
+        return payload
 
     def put(self, path: str, body: Any = None, q: Optional[QueryOptions] = None):
         return self._do("PUT", path, body, q)
@@ -304,6 +314,41 @@ class Allocations(_Sub):
 
     def stop(self, alloc_id: str, q: Optional[QueryOptions] = None):
         return self.client.put(f"/v1/allocation/{alloc_id}/stop", {}, q)
+
+
+class AllocFS(_Sub):
+    """Alloc filesystem/log access (api/fs.go AllocFS)."""
+
+    def ls(self, alloc_id: str, path: str = "/", q: Optional[QueryOptions] = None):
+        q = q or QueryOptions()
+        q.params["path"] = path
+        return self.client.get(f"/v1/client/fs/ls/{alloc_id}", q)
+
+    def stat(self, alloc_id: str, path: str, q: Optional[QueryOptions] = None):
+        q = q or QueryOptions()
+        q.params["path"] = path
+        return self.client.get(f"/v1/client/fs/stat/{alloc_id}", q)
+
+    def cat(self, alloc_id: str, path: str, q: Optional[QueryOptions] = None) -> bytes:
+        q = q or QueryOptions()
+        q.params["path"] = path
+        return self.client.get_raw(f"/v1/client/fs/cat/{alloc_id}", q)
+
+    def read_at(self, alloc_id: str, path: str, offset: int, limit: int,
+                q: Optional[QueryOptions] = None) -> bytes:
+        q = q or QueryOptions()
+        q.params.update({"path": path, "offset": str(offset), "limit": str(limit)})
+        return self.client.get_raw(f"/v1/client/fs/readat/{alloc_id}", q)
+
+    def logs(self, alloc_id: str, task: str, log_type: str = "stdout",
+             offset: int = 0, origin: str = "start",
+             q: Optional[QueryOptions] = None) -> bytes:
+        q = q or QueryOptions()
+        q.params.update({
+            "task": task, "type": log_type,
+            "offset": str(offset), "origin": origin,
+        })
+        return self.client.get_raw(f"/v1/client/fs/logs/{alloc_id}", q)
 
 
 class Evaluations(_Sub):

@@ -78,7 +78,7 @@ _VALUE_FLAGS = {
     "address", "region", "namespace", "token", "job", "output", "type",
     "deadline", "meta", "payload", "name", "policy", "rules",
     "description", "bind", "http-port", "config", "version", "limit",
-    "per-page", "node-class", "datacenter",
+    "per-page", "node-class", "datacenter", "task",
 }
 
 
@@ -462,6 +462,57 @@ def cmd_node(ctx: Ctx, args: List[str]) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _find_alloc(ctx: Ctx, prefix: str) -> dict:
+    allocs, _ = ctx.client.allocations.list(QueryOptions(prefix=prefix))
+    matches = [a for a in allocs or [] if a["ID"].startswith(prefix)]
+    if len(matches) != 1:
+        raise CLIError(f"prefix {prefix!r} matched {len(matches)} allocations")
+    return matches[0]
+
+
+def cmd_alloc_logs(ctx: Ctx, args: List[str]) -> int:
+    """nomad alloc logs [-stderr] [-task <name>] <alloc-id>
+    (reference command/alloc_logs.go)."""
+    flags, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad alloc logs [-stderr] [-task <name>] <alloc-id>")
+    match = _find_alloc(ctx, rest[0])
+    task = flags.get("task") or (rest[1] if len(rest) > 1 else "")
+    if not task:
+        alloc, _ = ctx.client.allocations.info(match["ID"])
+        tasks = sorted((alloc.get("TaskStates") or {}).keys())
+        if len(tasks) != 1:
+            raise CLIError(
+                "allocation has multiple tasks, pass -task (have: %s)" % ", ".join(tasks)
+            )
+        task = tasks[0]
+    log_type = "stderr" if "stderr" in flags else "stdout"
+    data = ctx.client.alloc_fs.logs(match["ID"], task, log_type)
+    ctx.out(data.decode(errors="replace").rstrip("\n"))
+    return 0
+
+
+def cmd_alloc_fs(ctx: Ctx, args: List[str]) -> int:
+    """nomad alloc fs <alloc-id> [path] (reference command/alloc_fs.go):
+    directory → listing, file → contents."""
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad alloc fs <alloc-id> [path]")
+    match = _find_alloc(ctx, rest[0])
+    path = rest[1] if len(rest) > 1 else "/"
+    stat, _ = ctx.client.alloc_fs.stat(match["ID"], path)
+    if stat.get("IsDir"):
+        entries, _ = ctx.client.alloc_fs.ls(match["ID"], path)
+        rows = [["Mode", "Size", "Name"]]
+        for e in entries or []:
+            name = e["Name"] + ("/" if e["IsDir"] else "")
+            rows.append([e.get("FileMode", ""), str(e.get("Size", 0)), name])
+        ctx.out(columns(rows))
+    else:
+        ctx.out(ctx.client.alloc_fs.cat(match["ID"], path).decode(errors="replace").rstrip("\n"))
+    return 0
+
+
 def cmd_alloc_status(ctx: Ctx, args: List[str]) -> int:
     _, rest = _split_flags(args)
     if not rest:
@@ -793,7 +844,11 @@ COMMANDS: Dict[str, Callable[[Ctx, List[str]], int]] = {
     "agent-info": cmd_agent_info,
     "job": cmd_job,
     "node": cmd_node,
-    "alloc": lambda c, a: _dispatch(c, a, {"status": cmd_alloc_status}, "alloc"),
+    "alloc": lambda c, a: _dispatch(
+        c, a,
+        {"status": cmd_alloc_status, "logs": cmd_alloc_logs, "fs": cmd_alloc_fs},
+        "alloc",
+    ),
     "eval": lambda c, a: _dispatch(c, a, {"status": cmd_eval_status}, "eval"),
     "deployment": cmd_deployment,
     "acl": cmd_acl,

@@ -101,11 +101,48 @@ class DriverError(Exception):
     pass
 
 
+def open_task_output(path: str, timeout: float = 10.0):
+    """Open a task output path for append. Logmon paths are FIFOs: wait
+    for the reader with a deadline instead of blocking forever (a dead
+    logmon must fail the start, not hang the task runner), then clear
+    O_NONBLOCK so the task's own writes block normally."""
+    import errno
+    import fcntl
+    import os
+    import stat as stat_mod
+
+    try:
+        is_fifo = stat_mod.S_ISFIFO(os.stat(path).st_mode)
+    except OSError:
+        is_fifo = False
+    if not is_fifo:
+        return open(path, "ab")
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            fd = os.open(path, os.O_WRONLY | os.O_NONBLOCK)
+            break
+        except OSError as e:
+            if e.errno != errno.ENXIO:
+                raise DriverError(f"cannot open task output {path}: {e}") from e
+            if time.monotonic() > deadline:
+                raise DriverError(
+                    f"no log collector reading {path} after {timeout}s"
+                ) from e
+            time.sleep(0.02)
+    flags = fcntl.fcntl(fd, fcntl.F_GETFL)
+    fcntl.fcntl(fd, fcntl.F_SETFL, flags & ~os.O_NONBLOCK)
+    return os.fdopen(fd, "ab")
+
+
 class Driver:
     """Base driver (DriverPlugin). Subclasses register via ``register``."""
 
     name = "base"
     capabilities = Capabilities()
+    # drivers that redirect task stdout/stderr into the provided paths get
+    # logmon FIFOs + rotation; purely synthetic drivers (mock) skip it
+    produces_logs = False
 
     def fingerprint(self) -> Fingerprint:
         """One-shot detection (the reference streams; the client polls)."""

@@ -133,6 +133,7 @@ class _ExecutorTask:
 class ExecDriver(Driver):
     name = "exec"
     capabilities = Capabilities(send_signals=True, exec=False, fs_isolation="chroot")
+    produces_logs = True
 
     def __init__(self) -> None:
         self.tasks: Dict[str, _ExecutorTask] = {}

@@ -24,6 +24,7 @@ from .base import (
     TaskHandle,
     TaskStats,
     TaskStatus,
+    open_task_output,
     register,
 )
 
@@ -37,8 +38,8 @@ class _ExecTask:
             raise DriverError("raw_exec requires config.command")
         args = [str(a) for a in cfg.config.get("args", [])]
         cwd = cfg.task_dir.dir if cfg.task_dir is not None else None
-        self.stdout = open(cfg.stdout_path, "ab") if cfg.stdout_path else subprocess.DEVNULL
-        self.stderr = open(cfg.stderr_path, "ab") if cfg.stderr_path else subprocess.DEVNULL
+        self.stdout = open_task_output(cfg.stdout_path) if cfg.stdout_path else subprocess.DEVNULL
+        self.stderr = open_task_output(cfg.stderr_path) if cfg.stderr_path else subprocess.DEVNULL
         env = dict(os.environ)
         env.update(cfg.env)
         try:
@@ -84,6 +85,7 @@ class _ExecTask:
 class RawExecDriver(Driver):
     name = "raw_exec"
     capabilities = Capabilities(send_signals=True, exec=True, fs_isolation="none")
+    produces_logs = True
 
     def __init__(self) -> None:
         self.tasks: Dict[str, _ExecTask] = {}

@@ -240,6 +240,37 @@ class TaskRunner:
         except Exception as e:  # noqa: BLE001 — reservation errors are varied
             raise DriverError(f"device reservation failed: {e}") from e
 
+    def _setup_logmon(self):
+        """Logmon hook (task_runner_hooks.go logmon hook): rotated capture
+        through FIFOs, detached so it survives client restarts. Returns
+        (stdout_path, stderr_path)."""
+        log_dir = self.task_dir.log_dir
+        plain = (
+            os.path.join(log_dir, f"{self.task.name}.stdout.0"),
+            os.path.join(log_dir, f"{self.task.name}.stderr.0"),
+        )
+        if not getattr(self.driver, "produces_logs", False):
+            return plain
+        from .logmon import spawn_logmon
+
+        lc = self.task.log_config
+        try:
+            stdout_fifo, stderr_fifo, self._logmon = spawn_logmon(
+                log_dir, self.task.name,
+                max_files=lc.max_files,
+                max_bytes=lc.max_file_size_mb << 20,
+            )
+            return stdout_fifo, stderr_fifo
+        except OSError as e:
+            self.logger.warning("logmon unavailable, writing plain files: %s", e)
+            return plain
+
+    def _kill_logmon(self) -> None:
+        lm = getattr(self, "_logmon", None)
+        if lm is not None and lm.poll() is None:
+            lm.terminate()
+        self._logmon = None
+
     def _start_task(self) -> None:
         env = (
             TaskEnvBuilder(self.node, self.alloc, self.task)
@@ -250,6 +281,7 @@ class TaskRunner:
         if reservation is not None:
             env.update(reservation.envs)
         os.makedirs(self.task_dir.log_dir, exist_ok=True)
+        stdout_path, stderr_path = self._setup_logmon()
         cfg = TaskConfig(
             id=self.task_id,
             name=self.task.name,
@@ -257,12 +289,8 @@ class TaskRunner:
             env=env,
             config=dict(self.task.config),
             task_dir=self.task_dir,
-            stdout_path=os.path.join(
-                self.task_dir.log_dir, f"{self.task.name}.stdout.0"
-            ),
-            stderr_path=os.path.join(
-                self.task_dir.log_dir, f"{self.task.name}.stderr.0"
-            ),
+            stdout_path=stdout_path,
+            stderr_path=stderr_path,
             cpu_limit=self.task.resources.cpu if self.task.resources else 0,
             memory_limit_mb=self.task.resources.memory_mb if self.task.resources else 0,
             mounts=list(reservation.mounts) if reservation else [],
@@ -274,7 +302,13 @@ class TaskRunner:
             k: builder.interpolate(v) if isinstance(v, str) else v
             for k, v in cfg.config.items()
         }
-        self.handle = self.driver.start_task(cfg)
+        try:
+            self.handle = self.driver.start_task(cfg)
+        except Exception:
+            # a logmon blocked on its never-opened FIFOs must not outlive
+            # the failed start
+            self._kill_logmon()
+            raise
 
     def _wait_exit(self) -> Optional[ExitResult]:
         while True:

@@ -420,8 +420,13 @@ def _parse_task(name: str, o: HCLObject) -> Task:
     if dp is not None:
         t.dispatch_payload_file = _str(dp.get("file", ""))
     if "logs" in o:
+        from ..structs.structs import LogConfig
+
         logs = _plain(o.get("logs"))
-        t.config.setdefault("logs", logs)
+        t.log_config = LogConfig(
+            max_files=int(logs.get("max_files", 10)),
+            max_file_size_mb=int(logs.get("max_file_size", 10)),
+        )
     return t
 
 

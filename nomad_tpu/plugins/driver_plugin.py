@@ -47,6 +47,9 @@ class DriverPluginShim(BasePlugin):
     def capabilities(self) -> Capabilities:
         return self.driver.capabilities
 
+    def produces_logs(self) -> bool:
+        return bool(getattr(self.driver, "produces_logs", False))
+
     def fingerprint(self) -> Fingerprint:
         return self.driver.fingerprint()
 
@@ -90,6 +93,11 @@ class ExternalDriver(Driver):
             self.capabilities = client.call("capabilities", timeout=10.0)
         except PluginError:
             self.capabilities = Capabilities()
+        try:
+            self.produces_logs = client.call("produces_logs", timeout=10.0)
+        except PluginError:
+            # older plugin without the method: don't clobber capabilities
+            self.produces_logs = False
 
     def _call(self, method: str, *args, timeout: Optional[float] = None):
         try:

@@ -528,6 +528,15 @@ class Service:
 
 
 @dataclass
+class LogConfig:
+    """Per-task log rotation policy (reference structs.go LogConfig:
+    MaxFiles × MaxFileSizeMB, defaults 10 × 10)."""
+
+    max_files: int = 10
+    max_file_size_mb: int = 10
+
+
+@dataclass
 class Task:
     name: str = ""
     driver: str = ""
@@ -547,6 +556,7 @@ class Task:
     kill_signal: str = "SIGTERM"
     restart_policy: Optional[RestartPolicy] = None
     dispatch_payload_file: str = ""
+    log_config: LogConfig = field(default_factory=LogConfig)
 
 
 @dataclass

@@ -1,0 +1,215 @@
+"""Logmon + alloc FS API tests.
+
+Covers reference ``client/logmon`` (rotated capture surviving restarts),
+``client/fs_endpoint.go`` + ``command/agent/fs_endpoint.go`` (ls/stat/cat/
+readat/logs over HTTP), the server→client proxy hop, and the alloc
+logs/fs CLI.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu.client.logmon import RotatingWriter, read_logs, spawn_logmon
+
+
+def wait_until(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestRotatingWriter:
+    def test_rotation_and_pruning(self, tmp_path):
+        w = RotatingWriter(str(tmp_path), "t.stdout", max_files=3, max_bytes=10)
+        for i in range(10):
+            w.write(b"0123456789")  # exactly one file each
+        w.close()
+        names = sorted(os.listdir(tmp_path))
+        # newest index 9; only 3 files kept
+        assert names == ["t.stdout.7", "t.stdout.8", "t.stdout.9"]
+
+    def test_resumes_at_newest_index(self, tmp_path):
+        w = RotatingWriter(str(tmp_path), "t.stdout", max_files=5, max_bytes=100)
+        w.write(b"first")
+        w.close()
+        w2 = RotatingWriter(str(tmp_path), "t.stdout", max_files=5, max_bytes=100)
+        w2.write(b"|second")
+        w2.close()
+        assert open(tmp_path / "t.stdout.0", "rb").read() == b"first|second"
+
+    def test_read_logs_spans_rotated_files(self, tmp_path):
+        w = RotatingWriter(str(tmp_path), "t.stdout", max_files=10, max_bytes=4)
+        w.write(b"abcdefghij")
+        w.close()
+        data, next_off = read_logs(str(tmp_path), "t", "stdout")
+        assert data == b"abcdefghij" and next_off == 10
+        data, _ = read_logs(str(tmp_path), "t", "stdout", offset=6)
+        assert data == b"ghij"
+        data, _ = read_logs(str(tmp_path), "t", "stdout", offset=3, origin="end")
+        assert data == b"hij"
+
+
+class TestLogmonProcess:
+    def test_capture_through_fifos(self, tmp_path):
+        log_dir = str(tmp_path)
+        out_fifo, err_fifo, proc = spawn_logmon(log_dir, "web", max_files=2,
+                                                max_bytes=1 << 20)
+        with open(out_fifo, "wb") as out, open(err_fifo, "wb") as err:
+            out.write(b"hello stdout\n")
+            err.write(b"hello stderr\n")
+        proc.wait(timeout=10)
+        wait_until(lambda: os.path.exists(os.path.join(log_dir, "web.stdout.0")))
+        assert open(os.path.join(log_dir, "web.stdout.0"), "rb").read() == b"hello stdout\n"
+        assert open(os.path.join(log_dir, "web.stderr.0"), "rb").read() == b"hello stderr\n"
+        # fifos removed after exit
+        assert not os.path.exists(out_fifo)
+
+
+@pytest.fixture(scope="class")
+def dev_agent():
+    from nomad_tpu import mock
+    from nomad_tpu.agent.agent import Agent, AgentConfig
+
+    agent = Agent(AgentConfig(name="fs-dev", dev_mode=True, gossip_enabled=False))
+    agent.start()
+    job = mock.job()
+    job.task_groups[0].count = 1
+    task = job.task_groups[0].tasks[0]
+    task.driver = "raw_exec"
+    task.config = {
+        "command": "/bin/sh",
+        "args": ["-c", "echo line-out; echo line-err >&2; "
+                       "echo data > $NOMAD_TASK_DIR/file.txt; sleep 60"],
+    }
+    agent.server.register_job(job)
+
+    def running():
+        allocs = agent.server.fsm.state.allocs_by_job("default", job.id, True)
+        return allocs and allocs[0].client_status == "running"
+
+    wait_until(running, timeout=30, msg="alloc running")
+    alloc = agent.server.fsm.state.allocs_by_job("default", job.id, True)[0]
+    yield agent, alloc, task.name
+    agent.shutdown()
+
+
+def _get(agent, path, raw=False):
+    with urllib.request.urlopen(agent.http_addr + path) as r:
+        data = r.read()
+    return data if raw else json.loads(data)
+
+
+class TestFSEndpoints:
+    def test_logs_capture_rotated(self, dev_agent):
+        agent, alloc, task = dev_agent
+        wait_until(
+            lambda: b"line-out" in _get(
+                agent, f"/v1/client/fs/logs/{alloc.id}?task={task}&type=stdout",
+                raw=True),
+            msg="stdout captured",
+        )
+        err = _get(agent, f"/v1/client/fs/logs/{alloc.id}?task={task}&type=stderr",
+                   raw=True)
+        assert b"line-err" in err
+
+    def test_ls_stat_cat_readat(self, dev_agent):
+        agent, alloc, task = dev_agent
+        wait_until(
+            lambda: any(e["Name"] == "file.txt" for e in _get(
+                agent, f"/v1/client/fs/ls/{alloc.id}?path=/{task}/local")),
+            msg="task file visible",
+        )
+        st = _get(agent, f"/v1/client/fs/stat/{alloc.id}?path=/{task}/local/file.txt")
+        assert not st["IsDir"] and st["Size"] == 5
+        data = _get(agent, f"/v1/client/fs/cat/{alloc.id}?path=/{task}/local/file.txt",
+                    raw=True)
+        assert data == b"data\n"
+        part = _get(
+            agent,
+            f"/v1/client/fs/readat/{alloc.id}?path=/{task}/local/file.txt&offset=1&limit=2",
+            raw=True)
+        assert part == b"at"
+
+    def test_path_escape_rejected(self, dev_agent):
+        agent, alloc, _ = dev_agent
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(agent, f"/v1/client/fs/cat/{alloc.id}?path=../../../etc/passwd")
+        assert e.value.code == 403
+
+    def test_unknown_alloc_404(self, dev_agent):
+        agent, _, _ = dev_agent
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(agent, "/v1/client/fs/ls/00000000-dead-beef-0000-000000000000")
+        assert e.value.code == 404
+
+    def test_cli_alloc_logs_and_fs(self, dev_agent):
+        from nomad_tpu.cli.main import main as run_cli
+
+        agent, alloc, task = dev_agent
+        out = []
+        code = run_cli(["-address", agent.http_addr, "alloc", "logs",
+                        alloc.id[:8]], out.append)
+        assert code == 0 and any("line-out" in line for line in out)
+        out2 = []
+        code = run_cli(["-address", agent.http_addr, "alloc", "fs",
+                        alloc.id[:8], f"/{task}/local"], out2.append)
+        assert code == 0 and any("file.txt" in line for line in out2)
+        out3 = []
+        code = run_cli(["-address", agent.http_addr, "alloc", "fs",
+                        alloc.id[:8], f"/{task}/local/file.txt"], out3.append)
+        assert code == 0 and any("data" in line for line in out3)
+
+
+class TestCrossNodeProxy:
+    def test_server_agent_proxies_to_client_agent(self):
+        """Server-only agent proxies fs requests to the node's agent
+        (client_fs_endpoint.go hop)."""
+        from nomad_tpu import mock
+        from nomad_tpu.agent.agent import Agent, AgentConfig
+        from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_schedulers=1, heartbeat_min_ttl=60,
+                                     heartbeat_max_ttl=60), name="srv")
+        server_agent = Agent(
+            AgentConfig(name="srv", gossip_enabled=False), server=server
+        )
+        client = Client(ServerProxy(server), ClientConfig())
+        client_agent = Agent(
+            AgentConfig(name="cli", server_enabled=False, gossip_enabled=False),
+            server=None, client=client,
+        )
+        try:
+            server_agent.start()
+            client_agent.start()
+            job = mock.job()
+            job.task_groups[0].count = 1
+            task = job.task_groups[0].tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": "/bin/sh",
+                           "args": ["-c", "echo remote-log; sleep 60"]}
+            server.register_job(job)
+
+            def running():
+                allocs = server.fsm.state.allocs_by_job("default", job.id, True)
+                return allocs and allocs[0].client_status == "running"
+
+            wait_until(running, timeout=30, msg="alloc running")
+            alloc = server.fsm.state.allocs_by_job("default", job.id, True)[0]
+            # ask the SERVER agent, which must hop to the client agent
+            wait_until(
+                lambda: b"remote-log" in _get(
+                    server_agent,
+                    f"/v1/client/fs/logs/{alloc.id}?task={task.name}&type=stdout",
+                    raw=True),
+                msg="proxied logs",
+            )
+        finally:
+            client_agent.shutdown()
+            server_agent.shutdown()
