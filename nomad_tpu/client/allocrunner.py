@@ -64,6 +64,12 @@ class AllocRunner:
         # prerun hooks: await previous alloc (upstream allocs hook), allocDir
         if self.prev_alloc_watcher is not None:
             self.prev_alloc_watcher()
+            # the wait can outlive the alloc: a GC/stop that landed while
+            # blocked must win, or we'd start tasks nothing tracks anymore
+            if self._destroyed.is_set() or self.alloc.terminal_status() or (
+                self.alloc.desired_status != ALLOC_DESIRED_RUN
+            ):
+                return
         self.alloc_dir.build()
         if self.task_group is None:
             self.logger.error("alloc %s has no task group in job", self.alloc.id)

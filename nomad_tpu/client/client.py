@@ -38,6 +38,7 @@ class ClientConfig:
     meta: Dict[str, str] = field(default_factory=dict)
     persist_state: bool = False
     heartbeat_grace: float = 0.5
+    token: str = ""  # ACL token for server + cross-node fs calls
     # external plugins (reference client config plugin_dir + plugin stanzas):
     # plugin_dir is scanned for nomad-driver-*/nomad-device-* executables;
     # external_drivers forces built-in drivers out-of-process (the
@@ -81,6 +82,19 @@ class ServerProxy:
 
     def update_allocs(self, allocs: List[Allocation]) -> None:
         self.server.update_allocs_from_client(allocs)
+
+    def alloc_info(self, alloc_id: str) -> Optional[dict]:
+        """Status + owning-node HTTP address of any alloc (the allocwatcher's
+        view of Alloc.GetAlloc + Node.GetNode)."""
+        state = self.server.fsm.state
+        alloc = state.alloc_by_id(alloc_id)
+        if alloc is None:
+            return None
+        node = state.node_by_id(alloc.node_id)
+        return {
+            "client_status": alloc.client_status,
+            "node_http_addr": node.http_addr if node is not None else "",
+        }
 
 
 class Client:
@@ -228,6 +242,8 @@ class Client:
             ar = AllocRunner(
                 alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update,
                 device_manager=self.device_manager, driver_factory=self.resolve_driver,
+                # a restart mid-wait must resume the await+migrate, not skip it
+                prev_alloc_watcher=self._make_prev_watcher(alloc),
             )
             # re-attach live tasks BEFORE the runners start, so a recovered
             # task is waited on instead of started a second time
@@ -284,19 +300,49 @@ class Client:
                 with self._lock:
                     self.allocrunners.pop(alloc_id, None)
 
+    def _make_prev_watcher(self, alloc: Allocation):
+        """Upstream-alloc hook: replacements await their predecessor and
+        migrate sticky ephemeral disk (client/allocwatcher)."""
+        if not alloc.previous_allocation:
+            return None
+        from .allocwatcher import PrevAllocWatcher
+
+        return PrevAllocWatcher(
+            alloc,
+            alloc.previous_allocation,
+            local_runner_lookup=lambda aid: self.allocrunners.get(aid),
+            alloc_dir_base=self.alloc_dir_base,
+            remote_alloc_info=getattr(self.proxy, "alloc_info", None),
+            auth_token=self.config.token,
+        ).wait_and_migrate
+
     def _add_alloc(self, alloc: Allocation) -> None:
+        watcher = self._make_prev_watcher(alloc)
         ar = AllocRunner(
             alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update,
             device_manager=self.device_manager, driver_factory=self.resolve_driver,
+            prev_alloc_watcher=watcher,
         )
         with self._lock:
             self.allocrunners[alloc.id] = ar
         self.state_db.put_allocation(alloc)
-        ar.run()
-        for name, tr in ar.task_runners.items():
-            if tr.handle is not None:
-                self.state_db.put_task_handle(alloc.id, name, tr.handle)
-        self._on_ar_update(ar)
+
+        def run_runner() -> None:
+            ar.run()
+            for name, tr in ar.task_runners.items():
+                if tr.handle is not None:
+                    self.state_db.put_task_handle(alloc.id, name, tr.handle)
+            self._on_ar_update(ar)
+
+        if watcher is not None:
+            # the prev-alloc wait can block for minutes; it must not stall
+            # the watchallocations loop (alloc_runner.go Run is a goroutine)
+            t = threading.Thread(
+                target=run_runner, name=f"allocrun-{alloc.id[:8]}", daemon=True
+            )
+            t.start()
+        else:
+            run_runner()
 
     # -- status sync (client.go:1807 allocSync) --------------------------
 

@@ -120,6 +120,16 @@ class RemoteServerProxy:
     def update_allocs(self, allocs: List[Allocation]) -> None:
         self.rpc.call("Node.UpdateAlloc", allocs)
 
+    def alloc_info(self, alloc_id: str):
+        alloc = self.rpc.call("Alloc.GetAlloc", alloc_id)
+        if alloc is None:
+            return None
+        node = self.rpc.call("Node.GetNode", alloc.node_id)
+        return {
+            "client_status": alloc.client_status,
+            "node_http_addr": node.http_addr if node is not None else "",
+        }
+
     def close(self) -> None:
         self.rpc.close()
         self.rpc_blocking.close()

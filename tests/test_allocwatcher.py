@@ -1,0 +1,193 @@
+"""Alloc watcher/migrator tests (reference client/allocwatcher):
+await-previous-alloc, local sticky move, remote fetch over the FS API,
+and the end-to-end reschedule → data-follows-alloc path.
+"""
+import os
+import time
+
+import pytest
+
+from nomad_tpu.client.allocwatcher import PrevAllocWatcher
+
+
+class FakeRunner:
+    def __init__(self, status="running"):
+        self.status = status
+
+    def client_status(self):
+        return self.status
+
+
+class FakeAlloc:
+    def __init__(self, alloc_id, job=None, task_group="tg"):
+        self.id = alloc_id
+        self.job = job
+        self.task_group = task_group
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestWaitTerminal:
+    def test_waits_for_local_runner_terminal(self):
+        runner = FakeRunner("running")
+        w = PrevAllocWatcher(
+            FakeAlloc("new"), "prev",
+            local_runner_lookup=lambda a: runner,
+            alloc_dir_base="/nonexistent",
+            poll_interval=0.01, timeout=5.0,
+        )
+        import threading
+
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (w._wait_terminal(), done.set()))
+        t.start()
+        time.sleep(0.15)
+        assert not done.is_set(), "must still be waiting on a running prev alloc"
+        runner.status = "complete"
+        t.join(timeout=2.0)
+        assert done.is_set()
+
+    def test_unknown_prev_alloc_does_not_block(self):
+        w = PrevAllocWatcher(
+            FakeAlloc("new"), "prev",
+            local_runner_lookup=lambda a: None,
+            alloc_dir_base="/nonexistent",
+            remote_alloc_info=lambda a: None,  # GC'd
+            poll_interval=0.01, timeout=5.0,
+        )
+        start = time.monotonic()
+        w._wait_terminal()
+        assert time.monotonic() - start < 1.0
+
+    def test_remote_status_polled(self):
+        statuses = iter(["running", "running", "complete"])
+        w = PrevAllocWatcher(
+            FakeAlloc("new"), "prev",
+            local_runner_lookup=lambda a: None,
+            alloc_dir_base="/nonexistent",
+            remote_alloc_info=lambda a: {"client_status": next(statuses)},
+            poll_interval=0.01, timeout=5.0,
+        )
+        w._wait_terminal()  # returns once the iterator yields terminal
+
+
+class TestLocalMigration:
+    def test_move_and_copy(self, tmp_path):
+        src = tmp_path / "prev" / "alloc" / "data"
+        src.mkdir(parents=True)
+        (src / "state.db").write_text("precious")
+        dest = tmp_path / "new" / "alloc" / "data"
+
+        PrevAllocWatcher._migrate_local(str(src), str(dest), move=True)
+        assert (dest / "state.db").read_text() == "precious"
+        assert os.path.isdir(src) and not os.listdir(src), "moved, dir recreated"
+
+        # copy mode keeps the source
+        (src / "again.txt").write_text("x")
+        dest2 = tmp_path / "new2" / "alloc" / "data"
+        PrevAllocWatcher._migrate_local(str(src), str(dest2), move=False)
+        assert (dest2 / "again.txt").read_text() == "x"
+        assert (src / "again.txt").exists()
+
+
+class TestEndToEndMigration:
+    def test_data_follows_rescheduled_alloc(self):
+        """Job with sticky+migrate ephemeral disk: alloc writes to
+        $NOMAD_ALLOC_DIR/data, gets stopped (migrate transition), and the
+        replacement alloc finds the data in ITS alloc dir
+        (generic_sched.go:630 findPreferredNode + allocwatcher migrate)."""
+        from nomad_tpu import mock
+        from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_schedulers=1, heartbeat_min_ttl=60,
+                                     heartbeat_max_ttl=60))
+        server.start()
+        client = Client(ServerProxy(server), ClientConfig())
+        try:
+            client.start()
+            job = mock.job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].ephemeral_disk.sticky = True
+            job.task_groups[0].ephemeral_disk.migrate = True
+            task = job.task_groups[0].tasks[0]
+            task.driver = "raw_exec"
+            task.config = {
+                "command": "/bin/sh",
+                "args": ["-c",
+                         "echo payload-42 > $NOMAD_ALLOC_DIR/data/keep.txt; sleep 300"],
+            }
+            server.register_job(job)
+
+            def first_running():
+                allocs = server.fsm.state.allocs_by_job("default", job.id, True)
+                return [a for a in allocs if a.client_status == "running"]
+
+            wait_until(lambda: first_running(), msg="first alloc running")
+            old = first_running()[0]
+            marker = os.path.join(client.alloc_dir_base, old.id,
+                                  "alloc", "data", "keep.txt")
+            wait_until(lambda: os.path.exists(marker), msg="task wrote data")
+
+            server.stop_alloc(old.id)
+
+            def replacement():
+                allocs = server.fsm.state.allocs_by_job("default", job.id, True)
+                return [a for a in allocs
+                        if a.id != old.id and a.client_status == "running"]
+
+            wait_until(lambda: replacement(), timeout=60, msg="replacement alloc")
+            new = replacement()[0]
+            assert new.previous_allocation == old.id
+            migrated = os.path.join(client.alloc_dir_base, new.id,
+                                    "alloc", "data", "keep.txt")
+            wait_until(lambda: os.path.exists(migrated), msg="data migrated")
+            assert open(migrated).read().strip() == "payload-42"
+        finally:
+            client.shutdown()
+            server.stop()
+
+
+class TestRemoteMigration:
+    def test_fetch_tree_over_fs_api(self, tmp_path):
+        """Remote prev alloc: the watcher walks ls/cat on the owning
+        node's agent (remotePrevAlloc semantics)."""
+        from nomad_tpu.agent.agent import Agent, AgentConfig
+        from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_schedulers=0))
+        client = Client(ServerProxy(server), ClientConfig())
+        agent = Agent(AgentConfig(name="remote", gossip_enabled=False),
+                      server=server, client=client)
+        try:
+            agent.start()
+            # fabricate a terminal prev alloc's data dir on the remote node
+            prev_id = "11111111-2222-3333-4444-555555555555"
+            data = os.path.join(client.alloc_dir_base, prev_id, "alloc", "data")
+            os.makedirs(os.path.join(data, "sub"))
+            open(os.path.join(data, "top.txt"), "w").write("T")
+            open(os.path.join(data, "sub", "nested.txt"), "w").write("N")
+
+            http_addr = "{}:{}".format(*agent.http.addr)
+            w = PrevAllocWatcher(
+                FakeAlloc("new"), prev_id,
+                local_runner_lookup=lambda a: None,
+                alloc_dir_base=str(tmp_path),
+                remote_alloc_info=lambda a: {
+                    "client_status": "complete", "node_http_addr": http_addr,
+                },
+            )
+            dest = os.path.join(str(tmp_path), "new", "alloc", "data")
+            w._migrate_remote(http_addr, dest)
+            assert open(os.path.join(dest, "top.txt")).read() == "T"
+            assert open(os.path.join(dest, "sub", "nested.txt")).read() == "N"
+        finally:
+            agent.shutdown()
