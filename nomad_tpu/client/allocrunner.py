@@ -38,6 +38,8 @@ class AllocRunner:
         prev_alloc_watcher: Optional[Callable[[], None]] = None,
         device_manager=None,
         driver_factory=None,
+        consul=None,
+        vault_fn=None,
     ) -> None:
         self.alloc = alloc
         self.node = node
@@ -45,12 +47,15 @@ class AllocRunner:
         self.prev_alloc_watcher = prev_alloc_watcher
         self.device_manager = device_manager
         self.driver_factory = driver_factory
+        self.consul = consul
+        self.vault_fn = vault_fn
         self.logger = logging.getLogger(f"nomad_tpu.allocrunner.{alloc.id[:8]}")
 
         self.alloc_dir = AllocDir(base_dir, alloc.id)
         self.task_runners: Dict[str, TaskRunner] = {}
         self.deployment_status: Optional[AllocDeploymentStatus] = None
         self._destroyed = threading.Event()
+        self._aborted = False  # stopped/GC'd before tasks ever started
         self._lock = threading.Lock()
         self._waiters = 0
 
@@ -65,10 +70,15 @@ class AllocRunner:
         if self.prev_alloc_watcher is not None:
             self.prev_alloc_watcher()
             # the wait can outlive the alloc: a GC/stop that landed while
-            # blocked must win, or we'd start tasks nothing tracks anymore
+            # blocked must win, or we'd start tasks nothing tracks anymore.
+            # Mark the abort so client_status() reports terminal — a
+            # forever-"pending" stopped alloc would block ITS replacement's
+            # watcher for the full timeout.
             if self._destroyed.is_set() or self.alloc.terminal_status() or (
                 self.alloc.desired_status != ALLOC_DESIRED_RUN
             ):
+                self._aborted = True
+                self._notify()
                 return
         self.alloc_dir.build()
         if self.task_group is None:
@@ -80,6 +90,8 @@ class AllocRunner:
                 self.alloc, task, td, node=self.node, on_state_change=self._notify,
                 device_manager=self.device_manager,
                 driver_factory=self.driver_factory,
+                consul=self.consul,
+                vault_fn=self.vault_fn,
             )
             self.task_runners[task.name] = tr
             handle = (recover_handles or {}).get(task.name)
@@ -106,7 +118,7 @@ class AllocRunner:
     def client_status(self) -> str:
         states = list(self.task_states().values())
         if not states:
-            return ALLOC_CLIENT_PENDING
+            return ALLOC_CLIENT_COMPLETE if self._aborted else ALLOC_CLIENT_PENDING
         if any(s.state == STATE_DEAD and s.failed for s in states):
             return ALLOC_CLIENT_FAILED
         if all(s.state == STATE_DEAD for s in states):

@@ -39,6 +39,9 @@ class ClientConfig:
     persist_state: bool = False
     heartbeat_grace: float = 0.5
     token: str = ""  # ACL token for server + cross-node fs calls
+    # Consul agent address for task service registration (command/agent/
+    # consul ServiceClient); empty = disabled
+    consul: Optional[object] = None  # integrations.consul.ConsulConfig
     # external plugins (reference client config plugin_dir + plugin stanzas):
     # plugin_dir is scanned for nomad-driver-*/nomad-device-* executables;
     # external_drivers forces built-in drivers out-of-process (the
@@ -96,6 +99,10 @@ class ServerProxy:
             "node_http_addr": node.http_addr if node is not None else "",
         }
 
+    def derive_vault_token(self, alloc_id: str, task_name: str) -> str:
+        """Node.DeriveVaultToken (node_endpoint.go)."""
+        return self.server.derive_vault_token(alloc_id, [task_name])[task_name]
+
 
 class Client:
     def __init__(
@@ -137,6 +144,13 @@ class Client:
             from .devicemanager import DeviceManager
 
             self.device_manager = DeviceManager(device_plugins)
+
+        # Consul service client (command/agent/consul)
+        self.consul = None
+        if self.config.consul is not None and getattr(self.config.consul, "address", ""):
+            from ..integrations.consul import ConsulClient
+
+            self.consul = ConsulClient(self.config.consul)
 
         self.node = node or Node()
         self.node.datacenter = self.config.datacenter
@@ -239,17 +253,27 @@ class Client:
         for alloc in self.state_db.get_all_allocations():
             if alloc.terminal_status():
                 continue
+            # a restart mid-wait must resume the await+migrate, not skip it
+            watcher = self._make_prev_watcher(alloc)
             ar = AllocRunner(
                 alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update,
                 device_manager=self.device_manager, driver_factory=self.resolve_driver,
-                # a restart mid-wait must resume the await+migrate, not skip it
-                prev_alloc_watcher=self._make_prev_watcher(alloc),
+                consul=self.consul, vault_fn=self._vault_fn(),
+                prev_alloc_watcher=watcher,
             )
             # re-attach live tasks BEFORE the runners start, so a recovered
             # task is waited on instead of started a second time
             handles = self.state_db.get_task_handles(alloc.id)
             self.allocrunners[alloc.id] = ar
-            ar.run(recover_handles=handles)
+            if watcher is not None:
+                # never block startup on a prev-alloc wait: registration
+                # and heartbeats must begin or the server marks us down
+                threading.Thread(
+                    target=ar.run, kwargs={"recover_handles": handles},
+                    name=f"allocrestore-{alloc.id[:8]}", daemon=True,
+                ).start()
+            else:
+                ar.run(recover_handles=handles)
 
     # -- heartbeats (client.go:1700) -------------------------------------
 
@@ -300,6 +324,10 @@ class Client:
                 with self._lock:
                     self.allocrunners.pop(alloc_id, None)
 
+    def _vault_fn(self):
+        fn = getattr(self.proxy, "derive_vault_token", None)
+        return fn
+
     def _make_prev_watcher(self, alloc: Allocation):
         """Upstream-alloc hook: replacements await their predecessor and
         migrate sticky ephemeral disk (client/allocwatcher)."""
@@ -321,6 +349,7 @@ class Client:
         ar = AllocRunner(
             alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update,
             device_manager=self.device_manager, driver_factory=self.resolve_driver,
+            consul=self.consul, vault_fn=self._vault_fn(),
             prev_alloc_watcher=watcher,
         )
         with self._lock:

@@ -94,6 +94,8 @@ class TaskRunner:
         update_interval: float = 0.05,
         device_manager=None,
         driver_factory=None,
+        consul=None,
+        vault_fn=None,
     ) -> None:
         self.alloc = alloc
         self.task = task
@@ -102,6 +104,10 @@ class TaskRunner:
         self.on_state_change = on_state_change
         self.device_manager = device_manager
         self.driver_factory = driver_factory or new_driver
+        self.consul = consul
+        self.vault_fn = vault_fn
+        self._vault_token = ""
+        self._consul_ids = []
         self.update_interval = update_interval
         self.logger = logging.getLogger(f"nomad_tpu.taskrunner.{task.name}")
 
@@ -173,7 +179,9 @@ class TaskRunner:
 
             self._set_state(STATE_RUNNING)
             self._emit(TaskEvent(EV_STARTED))
+            self._register_services()
             result = self._wait_exit()
+            self._deregister_services()
             if result is None:  # killed
                 self._set_state(STATE_DEAD)
                 break
@@ -222,6 +230,52 @@ class TaskRunner:
                 import shutil
 
                 shutil.copy(src[len("file://"):], self.task_dir.local_dir)
+        # vault hook (task_runner_hooks.go vault hook): derive the task's
+        # token and drop it in the secrets dir. Derivation goes over RPC,
+        # so transient failures (leader election, blip) retry with backoff
+        # (vault_hook.go deriveVaultToken retry loop) until the kill.
+        if self.task.vault and self.vault_fn is not None:
+            backoff = 0.5
+            while True:
+                try:
+                    self._vault_token = self.vault_fn(self.alloc.id, self.task.name)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    if self.kill_requested.is_set() or backoff > 16:
+                        raise
+                    self.logger.warning(
+                        "vault token derivation failed (retrying in %.1fs): %s",
+                        backoff, e,
+                    )
+                    if self.kill_requested.wait(backoff):
+                        raise
+                    backoff *= 2
+            token_path = os.path.join(self.task_dir.secrets_dir, "vault_token")
+            with open(token_path, "w") as f:
+                f.write(self._vault_token)
+            os.chmod(token_path, 0o600)
+
+    def _register_services(self) -> None:
+        """Consul services hook (task_runner_hooks.go services hook)."""
+        if self.consul is None or not self.task.services:
+            return
+        try:
+            address = self.node.attributes.get("unique.network.ip-address", "") \
+                if self.node is not None else ""
+            self._consul_ids = self.consul.register_task_services(
+                self.alloc, self.task, address=address
+            )
+        except Exception as e:  # noqa: BLE001 — consul outage isn't fatal
+            self.logger.warning("consul registration failed: %s", e)
+
+    def _deregister_services(self) -> None:
+        if self.consul is None or not self._consul_ids:
+            return
+        try:
+            self.consul.deregister_ids(self._consul_ids)
+        except Exception as e:  # noqa: BLE001
+            self.logger.warning("consul deregistration failed: %s", e)
+        self._consul_ids = []
 
     def _device_reservation(self):
         """Device hook (task_runner_hooks.go device hook): reserve the
@@ -280,6 +334,8 @@ class TaskRunner:
         reservation = self._device_reservation()
         if reservation is not None:
             env.update(reservation.envs)
+        if self._vault_token and (self.task.vault or {}).get("env", True):
+            env["VAULT_TOKEN"] = self._vault_token
         os.makedirs(self.task_dir.log_dir, exist_ok=True)
         stdout_path, stderr_path = self._setup_logmon()
         cfg = TaskConfig(

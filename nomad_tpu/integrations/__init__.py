@@ -1,0 +1,3 @@
+"""External system integrations: Vault (secrets) and Consul (service
+registry), talked to over their HTTP APIs with in-tree mock servers for
+tests (reference nomad/vault.go, command/agent/consul/)."""

@@ -1,0 +1,214 @@
+"""Consul integration: task service/check registration + agent
+self-registration.
+
+Fills the role of reference ``command/agent/consul/`` (ServiceClient):
+tasks' ``service`` stanzas register into Consul's agent API when the task
+starts and deregister when it stops, with Nomad-style service IDs
+(``_nomad-task-<alloc>-<task>-<service>``); server/client agents
+self-register as the ``nomad``/``nomad-client`` services. Transport is
+Consul's HTTP agent API; ``MockConsulServer`` stands in for tests.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("nomad_tpu.consul")
+
+
+@dataclass
+class ConsulConfig:
+    address: str = ""  # e.g. http://127.0.0.1:8500
+    token: str = ""
+    auto_advertise: bool = True  # self-register the agent
+
+
+class ConsulError(Exception):
+    pass
+
+
+def task_service_id(alloc_id: str, task: str, service: str) -> str:
+    """command/agent/consul/client.go makeTaskServiceID shape."""
+    return f"_nomad-task-{alloc_id}-{task}-{service}"
+
+
+class ConsulClient:
+    def __init__(self, config: ConsulConfig) -> None:
+        self.config = config
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.config.address)
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None):
+        req = urllib.request.Request(
+            self.config.address + path,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"X-Consul-Token": self.config.token} if self.config.token else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else None
+        except urllib.error.HTTPError as e:
+            raise ConsulError(f"consul {path}: {e.code} {e.read().decode(errors='replace')}")
+        except OSError as e:
+            raise ConsulError(f"consul unreachable at {self.config.address}: {e}")
+
+    # -- agent service API ----------------------------------------------
+
+    def register_service(
+        self,
+        service_id: str,
+        name: str,
+        address: str = "",
+        port: int = 0,
+        tags: Optional[List[str]] = None,
+        checks: Optional[List[dict]] = None,
+    ) -> None:
+        body = {
+            "ID": service_id,
+            "Name": name,
+            "Tags": list(tags or []),
+            "Address": address,
+            "Port": port,
+        }
+        if checks:
+            body["Checks"] = checks
+        self._call("PUT", "/v1/agent/service/register", body)
+
+    def deregister_service(self, service_id: str) -> None:
+        self._call("PUT", f"/v1/agent/service/deregister/{service_id}")
+
+    def services(self) -> Dict[str, dict]:
+        return self._call("GET", "/v1/agent/services") or {}
+
+    # -- task lifecycle hooks (consul/client.go RegisterWorkload) --------
+
+    @staticmethod
+    def _check_body(svc_name: str, c: dict) -> dict:
+        """Consul rejects TTL+Interval together; shape per check kind."""
+        body = {"Name": c.get("name", f"service: {svc_name} check")}
+        if c.get("ttl"):
+            body["TTL"] = c["ttl"]
+        elif c.get("http"):
+            body["HTTP"] = c["http"]
+            body["Interval"] = c.get("interval", "10s")
+        elif c.get("tcp"):
+            body["TCP"] = c["tcp"]
+            body["Interval"] = c.get("interval", "10s")
+        return body
+
+    @staticmethod
+    def _resolve_port(alloc, task, port_label: str) -> int:
+        """Map a service's port label to the alloc's assigned port value
+        (consul/client.go serviceRegs → GetTaskEnv port lookup)."""
+        if not port_label:
+            return 0
+        res = alloc.allocated_resources
+        task_res = res.tasks.get(task.name) if res is not None else None
+        networks = list(task_res.networks) if task_res is not None else []
+        for net in networks:
+            for port in list(net.dynamic_ports) + list(net.reserved_ports):
+                if port.label == port_label:
+                    return port.value
+        return 0
+
+    def register_task_services(self, alloc, task, address: str = "") -> List[str]:
+        """Register every service stanza on the task; returns the ids for
+        deregistration at task stop."""
+        ids = []
+        for svc in task.services or []:
+            sid = task_service_id(alloc.id, task.name, svc.name)
+            checks = [
+                self._check_body(svc.name, c)
+                for c in getattr(svc, "checks", []) or []
+            ]
+            try:
+                self.register_service(
+                    sid, svc.name, address=address,
+                    port=self._resolve_port(alloc, task, svc.port_label),
+                    tags=svc.tags, checks=checks or None,
+                )
+                ids.append(sid)
+            except ConsulError as e:
+                logger.warning("registering %s failed: %s", sid, e)
+        return ids
+
+    def deregister_ids(self, ids: List[str]) -> None:
+        for sid in ids:
+            try:
+                self.deregister_service(sid)
+            except ConsulError as e:
+                logger.warning("deregistering %s failed: %s", sid, e)
+
+
+# ---------------------------------------------------------------------------
+# In-tree mock Consul
+# ---------------------------------------------------------------------------
+
+
+class MockConsulServer:
+    """The slice of Consul's agent API the integration uses."""
+
+    def __init__(self) -> None:
+        import http.server
+        import socketserver
+
+        self.services: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code: int, obj=None) -> None:
+                payload = json.dumps(obj).encode() if obj is not None else b""
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/v1/agent/service/register":
+                    with outer._lock:
+                        outer.services[body["ID"]] = body
+                    return self._reply(200)
+                if self.path.startswith("/v1/agent/service/deregister/"):
+                    sid = self.path.rsplit("/", 1)[1]
+                    with outer._lock:
+                        outer.services.pop(sid, None)
+                    return self._reply(200)
+                return self._reply(404, {"error": "no handler"})
+
+            def do_GET(self):
+                if self.path == "/v1/agent/services":
+                    with outer._lock:
+                        return self._reply(200, dict(outer.services))
+                return self._reply(404, {"error": "no handler"})
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server(("127.0.0.1", 0), Handler)
+        self.address = "http://{}:{}".format(*self._srv.server_address)
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+
+    def start(self) -> "MockConsulServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

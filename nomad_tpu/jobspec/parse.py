@@ -367,7 +367,10 @@ def _parse_service(o: HCLObject, task_name: str) -> Service:
     if not name:
         name = f"${{JOB}}-{task_name}" if task_name else ""
     tags = [_str(t) for t in (o.get("tags") or [])]
-    return Service(name=name, port_label=_str(o.get("port", "")), tags=tags)
+    checks = [_plain(body) for body in o.get_all("check")]
+    return Service(
+        name=name, port_label=_str(o.get("port", "")), tags=tags, checks=checks
+    )
 
 
 # ---------------------------------------------------------------------------

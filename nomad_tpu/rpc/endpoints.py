@@ -48,6 +48,7 @@ def bind_server(server, rpc: RPCServer) -> None:
         return [allocs, index]
 
     rpc.register("Node.GetClientAllocs", get_client_allocs)
+    rpc.register("Node.DeriveVaultToken", server.derive_vault_token)
 
     # -- Job -----------------------------------------------------------
     rpc.register("Job.Register", server.register_job)
@@ -119,6 +120,10 @@ class RemoteServerProxy:
 
     def update_allocs(self, allocs: List[Allocation]) -> None:
         self.rpc.call("Node.UpdateAlloc", allocs)
+
+    def derive_vault_token(self, alloc_id: str, task_name: str) -> str:
+        tokens = self.rpc.call("Node.DeriveVaultToken", alloc_id, [task_name])
+        return tokens[task_name]
 
     def alloc_info(self, alloc_id: str):
         alloc = self.rpc.call("Alloc.GetAlloc", alloc_id)

@@ -47,6 +47,8 @@ PERIODIC_LAUNCH = "periodic-launch"
 ACL_POLICY_UPSERT = "acl-policy-upsert"
 ACL_POLICY_DELETE = "acl-policy-delete"
 ACL_TOKEN_UPSERT = "acl-token-upsert"
+VAULT_ACCESSOR_UPSERT = "vault-accessor-upsert"
+VAULT_ACCESSOR_DELETE = "vault-accessor-delete"
 ACL_TOKEN_DELETE = "acl-token-delete"
 ACL_TOKEN_BOOTSTRAP = "acl-token-bootstrap"
 
@@ -258,6 +260,12 @@ class NomadFSM:
     def _apply_acl_token_bootstrap(self, index: int, token):
         self.state.bootstrap_acl_token(index, token)
 
+    def _apply_vault_accessor_upsert(self, index: int, records):
+        self.state.upsert_vault_accessors(index, records)
+
+    def _apply_vault_accessor_delete(self, index: int, alloc_ids):
+        self.state.delete_vault_accessors(index, alloc_ids)
+
     def snapshot(self) -> StateStore:
         return self.state.snapshot()
 
@@ -292,4 +300,6 @@ _DISPATCH: Dict[str, Callable] = {
     ACL_TOKEN_UPSERT: NomadFSM._apply_acl_token_upsert,
     ACL_TOKEN_DELETE: NomadFSM._apply_acl_token_delete,
     ACL_TOKEN_BOOTSTRAP: NomadFSM._apply_acl_token_bootstrap,
+    VAULT_ACCESSOR_UPSERT: NomadFSM._apply_vault_accessor_upsert,
+    VAULT_ACCESSOR_DELETE: NomadFSM._apply_vault_accessor_delete,
 }

@@ -62,6 +62,7 @@ class ServerConfig:
     eval_gc_interval: float = 300.0
     unblock_failed_interval: float = 60.0
     scheduler_algorithm: str = "tpu_binpack"
+    vault: Optional[object] = None  # integrations.vault.VaultConfig
 
 
 class Server:
@@ -105,6 +106,13 @@ class Server:
         self.deployment_watcher = DeploymentsWatcher(self)
         self.node_drainer = NodeDrainer(self)
         self.periodic_dispatcher = PeriodicDispatch(self)
+
+        # Vault (nomad/vault.go): leader derives/revokes task tokens
+        self.vault = None
+        if self.config.vault is not None and getattr(self.config.vault, "enabled", False):
+            from ..integrations.vault import VaultClient
+
+            self.vault = VaultClient(self.config.vault)
 
         # Join before observing: the join-time election fires observers, and
         # start() handles the initial-leadership case explicitly.
@@ -177,6 +185,8 @@ class Server:
                                    self._reap_failed_evals)
         self._schedule_leader_task(gen, self.config.eval_gc_interval, self._create_gc_evals)
         self._schedule_leader_task(gen, 10.0, self._emit_stats)
+        if self.vault is not None:
+            self._schedule_leader_task(gen, 60.0, self._sweep_vault_accessors)
 
     def _emit_stats(self) -> None:
         """Publish broker/blocked/plan-queue gauges (reference
@@ -392,6 +402,16 @@ class Server:
 
     def register_job(self, job: Job) -> str:
         """Job.Register: upsert + create an eval (job_endpoint.go:73)."""
+        # Vault admission check (job_endpoint.go:175 validateJob): a job
+        # asking for Vault tokens needs a Vault-enabled server
+        if self.vault is None:
+            for tg in job.task_groups:
+                for task in tg.tasks:
+                    if task.vault:
+                        raise ValueError(
+                            f"task {task.name!r} has a vault stanza but the "
+                            "server has no Vault configured"
+                        )
         self.raft_apply(JOB_REGISTER, job)
         stored = self.fsm.state.job_by_id(job.namespace, job.id)
         # track/update/untrack with the dispatcher on every registration so
@@ -637,12 +657,83 @@ class Server:
     def delete_acl_tokens(self, accessors) -> None:
         self.raft_apply("acl-token-delete", list(accessors))
 
+    # -- vault (nomad/vault.go + node_endpoint.go DeriveVaultToken) ------
+
+    def derive_vault_token(self, alloc_id: str, task_names: List[str]) -> Dict[str, str]:
+        """Create per-task Vault tokens for an alloc's tasks; accessors
+        are raft-tracked so the tokens are revoked when the alloc dies."""
+        if self.vault is None:
+            raise ValueError("Vault is not configured on this server")
+        alloc = self.fsm.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id!r} not found")
+        if alloc.terminal_status():
+            raise ValueError(f"alloc {alloc_id!r} is terminal")
+        job = alloc.job or self.fsm.state.job_by_id(alloc.namespace, alloc.job_id)
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        tasks = {t.name: t for t in (tg.tasks if tg else [])}
+        tokens: Dict[str, str] = {}
+        records = []
+        for name in task_names:
+            task = tasks.get(name)
+            if task is None or not task.vault:
+                raise ValueError(f"task {name!r} has no vault stanza")
+            derived = self.vault.derive_token(list(task.vault.get("policies", [])))
+            tokens[name] = derived["token"]
+            records.append({
+                "alloc_id": alloc_id, "task": name,
+                "accessor": derived["accessor"],
+            })
+        from .fsm import VAULT_ACCESSOR_UPSERT
+
+        self.raft_apply(VAULT_ACCESSOR_UPSERT, records)
+        return tokens
+
+    def _sweep_vault_accessors(self) -> None:
+        """Leader retry sweep: revoke accessors whose allocs are terminal
+        or gone but whose revocation previously failed (vault.go
+        revokeDaemon semantics)."""
+        if self.vault is None:
+            return
+        stale = []
+        for alloc_id in list(self.fsm.state.vault_accessors_table):
+            alloc = self.fsm.state.alloc_by_id(alloc_id)
+            if alloc is None or alloc.terminal_status():
+                stale.append(alloc_id)
+        if stale:
+            self._revoke_vault_accessors(stale)
+
+    def _revoke_vault_accessors(self, alloc_ids: List[str]) -> None:
+        """Revoke + untrack token accessors of dead allocs (vault.go
+        RevokeTokens); failures stay tracked for the leader sweep."""
+        if self.vault is None:
+            return
+        to_delete = []
+        for alloc_id in alloc_ids:
+            accessors = self.fsm.state.vault_accessors_by_alloc(alloc_id)
+            if not accessors:
+                continue
+            failed = self.vault.revoke_accessors([a["accessor"] for a in accessors])
+            if not failed:
+                to_delete.append(alloc_id)
+        if to_delete:
+            from .fsm import VAULT_ACCESSOR_DELETE
+
+            self.raft_apply(VAULT_ACCESSOR_DELETE, to_delete)
+
     # -- client sync -----------------------------------------------------
 
     def update_allocs_from_client(self, allocs: List[Allocation]) -> None:
         """Node.UpdateAlloc: client status sync; failed allocs trigger
         reschedule evals via their job (node_endpoint.go)."""
         self.raft_apply(ALLOC_CLIENT_UPDATE, allocs)
+        dead = [a.id for a in allocs if a.terminal_status()]
+        if dead and self.vault is not None:
+            # off the RPC hot path: an unreachable Vault must not delay
+            # reschedule evals; the leader sweep retries failures
+            threading.Thread(
+                target=self._revoke_vault_accessors, args=(dead,), daemon=True
+            ).start()
         evals = []
         seen = set()
         for alloc in allocs:

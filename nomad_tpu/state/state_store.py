@@ -54,6 +54,9 @@ class StateStore:
         self.acl_tokens_table: Dict[str, "ACLToken"] = {}  # by accessor
         self._tokens_by_secret: Dict[str, str] = {}  # secret -> accessor
         self.acl_bootstrap_index = 0
+        # alloc id -> [{"task", "accessor"}] (reference schema.go
+        # vault_accessors table)
+        self.vault_accessors_table: Dict[str, list] = {}
 
         # secondary indexes
         self._allocs_by_node: Dict[str, set] = {}
@@ -100,6 +103,9 @@ class StateStore:
             snap.acl_tokens_table = dict(self.acl_tokens_table)
             snap._tokens_by_secret = dict(self._tokens_by_secret)
             snap.acl_bootstrap_index = self.acl_bootstrap_index
+            snap.vault_accessors_table = {
+                k: list(v) for k, v in self.vault_accessors_table.items()
+            }
             snap._allocs_by_node = {k: set(v) for k, v in self._allocs_by_node.items()}
             snap._allocs_by_job = {k: set(v) for k, v in self._allocs_by_job.items()}
             snap._allocs_by_eval = {k: set(v) for k, v in self._allocs_by_eval.items()}
@@ -562,6 +568,27 @@ class StateStore:
             for name in names:
                 self.acl_policies_table.pop(name, None)
             self._bump(index)
+
+    # -- vault accessors (state_store.go UpsertVaultAccessor) -----------
+
+    def upsert_vault_accessors(self, index: int, records) -> None:
+        """records: [{"alloc_id", "task", "accessor"}]."""
+        with self._lock:
+            for rec in records:
+                self.vault_accessors_table.setdefault(rec["alloc_id"], []).append(
+                    {"task": rec["task"], "accessor": rec["accessor"]}
+                )
+            self._bump(index)
+
+    def delete_vault_accessors(self, index: int, alloc_ids) -> None:
+        with self._lock:
+            for alloc_id in alloc_ids:
+                self.vault_accessors_table.pop(alloc_id, None)
+            self._bump(index)
+
+    def vault_accessors_by_alloc(self, alloc_id: str) -> list:
+        with self._lock:
+            return list(self.vault_accessors_table.get(alloc_id, []))
 
     def acl_policy_by_name(self, name: str):
         return self.acl_policies_table.get(name)

@@ -525,6 +525,9 @@ class Service:
     name: str = ""
     port_label: str = ""
     tags: List[str] = field(default_factory=list)
+    # check stanzas as plain dicts: {"name", "type", "ttl", "http",
+    # "interval", ...} (reference structs.go ServiceCheck)
+    checks: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @dataclass

@@ -1,0 +1,185 @@
+"""Vault + Consul integration tests (reference nomad/vault.go,
+command/agent/consul/): token derivation/revocation tracked through raft,
+the client task vault hook, and task service registration lifecycle —
+against in-tree mock Vault/Consul HTTP servers.
+"""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.integrations.consul import ConsulClient, ConsulConfig, MockConsulServer
+from nomad_tpu.integrations.vault import (
+    MockVaultServer,
+    VaultClient,
+    VaultConfig,
+    VaultError,
+)
+
+
+def wait_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def vault():
+    srv = MockVaultServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def consul():
+    srv = MockConsulServer().start()
+    yield srv
+    srv.stop()
+
+
+class TestVaultClient:
+    def test_derive_renew_revoke(self, vault):
+        client = VaultClient(VaultConfig(enabled=True, address=vault.address,
+                                         token="root"))
+        derived = client.derive_token(["db-read", "kv-write"])
+        assert derived["token"].startswith("s.") and derived["accessor"]
+        tok = vault.by_accessor[derived["accessor"]]
+        assert tok.policies == ["db-read", "kv-write"]
+        client.renew(derived["token"])
+        assert tok.renewals == 1
+        client.revoke_accessor(derived["accessor"])
+        assert tok.revoked
+
+    def test_bad_server_token_rejected(self, vault):
+        client = VaultClient(VaultConfig(enabled=True, address=vault.address,
+                                         token="wrong"))
+        with pytest.raises(VaultError):
+            client.derive_token(["p"])
+
+    def test_revoke_accessors_reports_failures(self, vault):
+        client = VaultClient(VaultConfig(enabled=True, address=vault.address,
+                                         token="root"))
+        ok = client.derive_token(["a"])
+        failed = client.revoke_accessors([ok["accessor"], "no-such-accessor"])
+        assert failed == ["no-such-accessor"]
+
+
+class TestConsulClient:
+    def test_register_deregister(self, consul):
+        client = ConsulClient(ConsulConfig(address=consul.address))
+        client.register_service("web-1", "web", address="10.0.0.1", port=8080,
+                                tags=["prod"])
+        services = client.services()
+        assert services["web-1"]["Name"] == "web"
+        assert services["web-1"]["Tags"] == ["prod"]
+        client.deregister_service("web-1")
+        assert client.services() == {}
+
+
+class TestServerVaultLifecycle:
+    def test_derive_tracks_and_terminal_revokes(self, vault):
+        from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(
+            num_schedulers=1, heartbeat_min_ttl=60, heartbeat_max_ttl=60,
+            vault=VaultConfig(enabled=True, address=vault.address, token="root"),
+        ))
+        server.start()
+        client = Client(ServerProxy(server), ClientConfig())
+        try:
+            client.start()
+            job = mock.job()
+            job.task_groups[0].count = 1
+            task = job.task_groups[0].tasks[0]
+            task.driver = "raw_exec"
+            task.vault = {"policies": ["db-read"], "env": True}
+            task.config = {
+                "command": "/bin/sh",
+                "args": ["-c", 'echo "tok=$VAULT_TOKEN" > $NOMAD_TASK_DIR/v; sleep 60'],
+            }
+            server.register_job(job)
+
+            def running():
+                allocs = server.fsm.state.allocs_by_job("default", job.id, True)
+                return [a for a in allocs if a.client_status == "running"]
+
+            wait_until(lambda: running(), msg="alloc running with vault token")
+            alloc = running()[0]
+            # accessor tracked in raft-backed state
+            accessors = server.fsm.state.vault_accessors_by_alloc(alloc.id)
+            assert len(accessors) == 1 and accessors[0]["task"] == task.name
+            tok = vault.by_accessor[accessors[0]["accessor"]]
+            assert tok.policies == ["db-read"] and not tok.revoked
+
+            # token on disk + in env
+            secrets = os.path.join(client.alloc_dir_base, alloc.id,
+                                   task.name, "secrets", "vault_token")
+            assert open(secrets).read() == tok.token
+            envfile = os.path.join(client.alloc_dir_base, alloc.id,
+                                   task.name, "local", "v")
+            wait_until(lambda: os.path.exists(envfile), msg="task env dump")
+            assert open(envfile).read().strip() == f"tok={tok.token}"
+
+            # alloc dies → token revoked + untracked
+            server.stop_alloc(alloc.id)
+            wait_until(lambda: tok.revoked, msg="token revoked on alloc stop")
+            wait_until(
+                lambda: server.fsm.state.vault_accessors_by_alloc(alloc.id) == [],
+                msg="accessor untracked",
+            )
+        finally:
+            client.shutdown()
+            server.stop()
+
+    def test_vault_job_rejected_without_vault(self):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_schedulers=0))
+        job = mock.job()
+        job.task_groups[0].tasks[0].vault = {"policies": ["p"]}
+        with pytest.raises(ValueError, match="vault stanza"):
+            server.register_job(job)
+        server.stop()
+
+
+class TestTaskServiceRegistration:
+    def test_services_follow_task_lifecycle(self, consul):
+        from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.structs import Service
+
+        server = Server(ServerConfig(num_schedulers=1, heartbeat_min_ttl=60,
+                                     heartbeat_max_ttl=60))
+        server.start()
+        client = Client(
+            ServerProxy(server),
+            ClientConfig(consul=ConsulConfig(address=consul.address)),
+        )
+        try:
+            client.start()
+            job = mock.job()
+            job.task_groups[0].count = 1
+            task = job.task_groups[0].tasks[0]
+            task.driver = "mock"
+            task.config = {"run_for": "2s"}
+            task.services = [Service(name="web", tags=["v1"],
+                                     checks=[{"name": "alive", "ttl": "10s"}])]
+            server.register_job(job)
+
+            wait_until(lambda: len(consul.services) == 1,
+                       msg="service registered while running")
+            (sid, svc), = consul.services.items()
+            assert svc["Name"] == "web" and svc["Tags"] == ["v1"]
+            assert sid.startswith("_nomad-task-")
+            assert svc["Checks"][0]["Name"] == "alive"
+
+            wait_until(lambda: len(consul.services) == 0, timeout=60,
+                       msg="service deregistered after exit")
+        finally:
+            client.shutdown()
+            server.stop()
