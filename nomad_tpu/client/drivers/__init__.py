@@ -1,5 +1,14 @@
-"""Task drivers (reference ``drivers/``): mock, raw_exec/exec."""
-from . import base, exec_driver, mock_driver, raw_exec  # noqa: F401  (registration side effects)
+"""Task drivers (reference ``drivers/``): mock, raw_exec/exec, docker,
+java, qemu."""
+from . import (  # noqa: F401  (registration side effects)
+    base,
+    docker,
+    exec_driver,
+    java_driver,
+    mock_driver,
+    qemu,
+    raw_exec,
+)
 from .base import Driver, DriverError, TaskConfig, TaskHandle, available_drivers, new_driver
 
 __all__ = [

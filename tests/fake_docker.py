@@ -1,0 +1,212 @@
+"""Fake Docker daemon over a unix socket for driver tests — the role the
+reference's docker test harness plays (drivers/docker/driver_test.go runs
+against a real daemon; zero-egress CI gets this fake)."""
+from __future__ import annotations
+
+import json
+import socketserver
+import struct
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List
+
+
+class FakeContainer:
+    def __init__(self, name: str, config: dict):
+        self.id = uuid.uuid4().hex
+        self.name = name
+        self.config = config
+        self.state = "created"
+        self.exit_code = 0
+        self.exited = threading.Event()
+        self.log_frames: List[bytes] = []
+        self.log_cv = threading.Condition()
+        self.kill_signals: List[str] = []
+
+
+class FakeDocker:
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self.images: Dict[str, int] = {}  # image -> pull count
+        self.removed_images: List[str] = []
+        self.containers: Dict[str, FakeContainer] = {}
+        self.fail_pull = False
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, obj=None, raw=None):
+                payload = raw if raw is not None else (
+                    json.dumps(obj).encode() if obj is not None else b"")
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                if path == "/_ping":
+                    return self._reply(200, raw=b"OK")
+                if path == "/version":
+                    return self._reply(200, {"Version": "fake-24.0"})
+                if path.startswith("/images/") and path.endswith("/json"):
+                    image = urllib.parse.unquote(path[len("/images/"):-len("/json")])
+                    if image in outer.images:
+                        return self._reply(200, {"Id": "sha256:" + image})
+                    return self._reply(404, {"message": "no such image"})
+                if path == "/containers/json":
+                    out = [
+                        {"Id": c.id, "Names": [f"/{c.name}"],
+                         "Labels": c.config.get("Labels", {})}
+                        for c in outer.containers.values()
+                    ]
+                    return self._reply(200, out)
+                if path.endswith("/json") and path.startswith("/containers/"):
+                    cid = path.split("/")[2]
+                    c = outer.containers.get(cid)
+                    if c is None:
+                        return self._reply(404, {"message": "no such container"})
+                    return self._reply(200, {
+                        "Id": c.id,
+                        "State": {"Running": c.state == "running",
+                                  "ExitCode": c.exit_code},
+                        "Config": c.config,
+                    })
+                if path.endswith("/stats"):
+                    return self._reply(200, {
+                        "memory_stats": {"usage": 1024 * 1024},
+                        "cpu_stats": {"cpu_usage": {"total_usage": 200},
+                                      "system_cpu_usage": 1000},
+                        "precpu_stats": {"cpu_usage": {"total_usage": 100},
+                                         "system_cpu_usage": 500},
+                    })
+                if "/logs" in path:
+                    cid = path.split("/")[2]
+                    c = outer.containers.get(cid)
+                    if c is None:
+                        return self._reply(404, {"message": "no such container"})
+                    # follow semantics like the real daemon: stream frames
+                    # as they appear until the container exits
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.end_headers()
+                    sent = 0
+                    try:
+                        while True:
+                            with c.log_cv:
+                                while sent >= len(c.log_frames) and not c.exited.is_set():
+                                    c.log_cv.wait(timeout=0.2)
+                                frames = c.log_frames[sent:]
+                                sent = len(c.log_frames)
+                                done = c.exited.is_set() and sent >= len(c.log_frames)
+                            for frame in frames:
+                                self.wfile.write(frame)
+                                self.wfile.flush()
+                            if done:
+                                return
+                    except (BrokenPipeError, ConnectionResetError):
+                        return
+                if path.startswith("/exec/") and path.endswith("/json"):
+                    return self._reply(200, {"Running": False, "ExitCode": 7})
+                return self._reply(404, {"message": f"GET {path}"})
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                params = dict(urllib.parse.parse_qsl(query))
+                if path == "/images/create":
+                    if outer.fail_pull:
+                        return self._reply(500, {"message": "pull failed"})
+                    image = params.get("fromImage", "") + ":" + params.get("tag", "latest")
+                    with outer._lock:
+                        outer.images[image] = outer.images.get(image, 0) + 1
+                    return self._reply(200, raw=b'{"status":"Downloaded"}')
+                if path == "/containers/create":
+                    body = self._body()
+                    if body.get("Image") not in outer.images:
+                        return self._reply(404, {"message": "no such image"})
+                    c = FakeContainer(params.get("name", ""), body)
+                    with outer._lock:
+                        outer.containers[c.id] = c
+                    return self._reply(201, {"Id": c.id})
+                parts = path.split("/")
+                if len(parts) >= 4 and parts[1] == "containers":
+                    cid, action = parts[2], parts[3]
+                    c = outer.containers.get(cid)
+                    if c is None:
+                        return self._reply(404, {"message": "no such container"})
+                    if action == "start":
+                        c.state = "running"
+                        return self._reply(204)
+                    if action == "wait":
+                        c.exited.wait()
+                        return self._reply(200, {"StatusCode": c.exit_code})
+                    if action == "stop":
+                        outer.finish(cid, 0)
+                        return self._reply(204)
+                    if action == "kill":
+                        c.kill_signals.append(params.get("signal", "SIGKILL"))
+                        outer.finish(cid, 137)
+                        return self._reply(204)
+                    if action == "exec":
+                        return self._reply(201, {"Id": "exec-" + cid})
+                if path.startswith("/exec/") and path.endswith("/start"):
+                    return self._reply(200)
+                return self._reply(404, {"message": f"POST {path}"})
+
+            def do_DELETE(self):
+                path, _, _ = self.path.partition("?")
+                if path.startswith("/images/"):
+                    image = urllib.parse.unquote(path[len("/images/"):])
+                    with outer._lock:
+                        outer.images.pop(image, None)
+                        outer.removed_images.append(image)
+                    return self._reply(200, [])
+                if path.startswith("/containers/"):
+                    cid = path.split("/")[2]
+                    with outer._lock:
+                        outer.containers.pop(cid, None)
+                    return self._reply(204)
+                return self._reply(404, {"message": "delete?"})
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        self._srv = Server(socket_path, Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+
+    def finish(self, cid: str, exit_code: int) -> None:
+        c = self.containers.get(cid)
+        if c is not None and c.state != "exited":
+            c.state = "exited"
+            c.exit_code = exit_code
+            c.exited.set()
+            with c.log_cv:
+                c.log_cv.notify_all()
+
+    def add_log(self, cid: str, stream: int, data: bytes) -> None:
+        c = self.containers[cid]
+        with c.log_cv:
+            c.log_frames.append(
+                bytes([stream, 0, 0, 0]) + struct.pack(">I", len(data)) + data
+            )
+            c.log_cv.notify_all()
+
+    def preload_image(self, image: str) -> None:
+        self.images[image] = 1
+
+    def start(self) -> "FakeDocker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -1,0 +1,197 @@
+"""Docker/java/qemu driver tests (reference drivers/docker,
+drivers/java, drivers/qemu) — docker against the in-tree fake daemon,
+java/qemu as command-construction + gating checks.
+"""
+import os
+import time
+
+import pytest
+
+from nomad_tpu.client.drivers.base import (
+    DriverError,
+    HEALTH_HEALTHY,
+    HEALTH_UNDETECTED,
+    TaskConfig,
+)
+from nomad_tpu.client.drivers.docker import DockerDriver
+from nomad_tpu.client.drivers.java_driver import JavaDriver, java_cmd_args
+from nomad_tpu.client.drivers.qemu import QemuDriver, qemu_args
+
+from fake_docker import FakeDocker
+
+
+@pytest.fixture
+def dockerd(tmp_path):
+    sock = str(tmp_path / "docker.sock")
+    fake = FakeDocker(sock).start()
+    yield fake
+    fake.stop()
+
+
+@pytest.fixture
+def driver(dockerd):
+    d = DockerDriver(dockerd.socket_path)
+    d.coordinator.image_gc = True
+    return d
+
+
+class TestDockerDriver:
+    def test_fingerprint(self, driver, tmp_path):
+        fp = driver.fingerprint()
+        assert fp.health == HEALTH_HEALTHY
+        assert fp.attributes["driver.docker.version"] == "fake-24.0"
+        dead = DockerDriver(str(tmp_path / "nope.sock"))
+        assert dead.fingerprint().health == HEALTH_UNDETECTED
+
+    def test_full_lifecycle(self, driver, dockerd, tmp_path):
+        cfg = TaskConfig(
+            id="a1/web", name="web", alloc_id="a1",
+            env={"PORT": "80"},
+            config={"image": "redis:7", "command": "redis-server",
+                    "args": ["--appendonly", "yes"]},
+            cpu_limit=500, memory_limit_mb=256,
+        )
+        handle = driver.start_task(cfg)
+        cid = handle.driver_state["container_id"]
+        assert "redis:7" in dockerd.images, "image pulled"
+        c = dockerd.containers[cid]
+        assert c.state == "running"
+        assert c.config["Cmd"] == ["redis-server", "--appendonly", "yes"]
+        assert "PORT=80" in c.config["Env"]
+        assert c.config["HostConfig"]["Memory"] == 256 << 20
+        assert driver.inspect_task("a1/web").state == "running"
+        assert driver.wait_task("a1/web", timeout=0.2) is None
+
+        stats = driver.task_stats("a1/web")
+        assert stats.memory_rss_bytes == 1024 * 1024
+
+        dockerd.finish(cid, 3)
+        res = driver.wait_task("a1/web", timeout=5.0)
+        assert res is not None and res.exit_code == 3
+        driver.destroy_task("a1/web")
+        assert cid not in dockerd.containers
+        assert "redis:7" in dockerd.removed_images, "image gc on last release"
+
+    def test_stop_uses_graceful_then_kill(self, driver, dockerd):
+        cfg = TaskConfig(id="a2/t", name="t", alloc_id="a2",
+                         config={"image": "busybox:latest"})
+        handle = driver.start_task(cfg)
+        cid = handle.driver_state["container_id"]
+        driver.stop_task("a2/t", timeout_s=1.0)
+        res = driver.wait_task("a2/t", timeout=5.0)
+        assert res is not None
+        assert dockerd.containers[cid].state == "exited"
+
+    def test_image_refcounting(self, driver, dockerd):
+        h1 = driver.start_task(TaskConfig(id="r1/t", name="t", alloc_id="r1",
+                                          config={"image": "shared:1"}))
+        h2 = driver.start_task(TaskConfig(id="r2/t", name="t", alloc_id="r2",
+                                          config={"image": "shared:1"}))
+        assert dockerd.images["shared:1"] == 1, "one pull for two tasks"
+        dockerd.finish(h1.driver_state["container_id"], 0)
+        driver.wait_task("r1/t", timeout=5)
+        driver.destroy_task("r1/t")
+        assert "shared:1" not in dockerd.removed_images, "still referenced"
+        dockerd.finish(h2.driver_state["container_id"], 0)
+        driver.wait_task("r2/t", timeout=5)
+        driver.destroy_task("r2/t")
+        assert "shared:1" in dockerd.removed_images
+
+    def test_log_pump_demuxes_streams(self, driver, dockerd, tmp_path):
+        out_path = str(tmp_path / "t.stdout.0")
+        err_path = str(tmp_path / "t.stderr.0")
+        cfg = TaskConfig(id="l1/t", name="t", alloc_id="l1",
+                         config={"image": "busybox:latest"},
+                         stdout_path=out_path, stderr_path=err_path)
+        handle = driver.start_task(cfg)
+        cid = handle.driver_state["container_id"]
+        dockerd.add_log(cid, 1, b"to stdout\n")
+        dockerd.add_log(cid, 2, b"to stderr\n")
+        # the pump reads the (non-follow in fake) stream once available
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if os.path.exists(out_path) and os.path.getsize(out_path) > 0:
+                break
+            time.sleep(0.05)
+        assert open(out_path, "rb").read() == b"to stdout\n"
+        assert open(err_path, "rb").read() == b"to stderr\n"
+        dockerd.finish(cid, 0)
+
+    def test_reconciler_removes_dangling(self, driver, dockerd):
+        handle = driver.start_task(TaskConfig(id="k1/t", name="t", alloc_id="k1",
+                                              config={"image": "busybox:latest"}))
+        tracked_cid = handle.driver_state["container_id"]
+        # a leaked container with the nomad label
+        from fake_docker import FakeContainer
+
+        leaked = FakeContainer("nomad-leaked", {
+            "Labels": {"com.hashicorp.nomad.alloc_id": "dead"}})
+        dockerd.containers[leaked.id] = leaked
+        removed = driver.reconcile_dangling()
+        assert removed == [leaked.id]
+        assert tracked_cid in dockerd.containers, "tracked container kept"
+
+    def test_recover_running_container(self, driver, dockerd):
+        cfg = TaskConfig(id="rec/t", name="t", alloc_id="rec",
+                         config={"image": "busybox:latest"})
+        handle = driver.start_task(cfg)
+        fresh = DockerDriver(dockerd.socket_path)
+        fresh.recover_task(handle)
+        assert fresh.inspect_task("rec/t").state == "running"
+        dockerd.finish(handle.driver_state["container_id"], 0)
+        assert fresh.wait_task("rec/t", timeout=5.0) is not None
+
+    def test_pull_failure_surfaces(self, driver, dockerd):
+        dockerd.fail_pull = True
+        with pytest.raises(DriverError, match="pull failed"):
+            driver.start_task(TaskConfig(id="p/t", name="t", alloc_id="p",
+                                         config={"image": "nope:latest"}))
+
+    def test_exec(self, driver, dockerd):
+        handle = driver.start_task(TaskConfig(id="e/t", name="t", alloc_id="e",
+                                              config={"image": "busybox:latest"}))
+        out, code = driver.exec_task("e/t", ["echo", "hi"], timeout_s=5.0)
+        assert code == 7  # fake reports ExitCode 7
+        dockerd.finish(handle.driver_state["container_id"], 0)
+
+
+class TestJavaDriver:
+    def test_cmd_args(self):
+        assert java_cmd_args({"jar_path": "/x/app.jar", "args": ["serve"],
+                              "jvm_options": ["-Xmx256m"]}) == \
+            ["-Xmx256m", "-jar", "/x/app.jar", "serve"]
+        assert java_cmd_args({"class": "com.App", "class_path": "/lib/*"}) == \
+            ["-cp", "/lib/*", "com.App"]
+        with pytest.raises(DriverError):
+            java_cmd_args({})
+
+    def test_fingerprint_gated(self):
+        import shutil
+
+        fp = JavaDriver().fingerprint()
+        if shutil.which("java"):
+            assert fp.health == HEALTH_HEALTHY
+        else:
+            assert fp.health == HEALTH_UNDETECTED
+
+
+class TestQemuDriver:
+    def test_args(self):
+        cfg = TaskConfig(name="vm", memory_limit_mb=1024,
+                         config={"image_path": "/img/linux.qcow2",
+                                 "port_map": {"22": 2222}})
+        args = qemu_args(cfg)
+        assert "-m" in args and "1024M" in args
+        assert "file=/img/linux.qcow2" in " ".join(args)
+        assert any("hostfwd=tcp::2222-:22" in a for a in args)
+        with pytest.raises(DriverError):
+            qemu_args(TaskConfig(config={}))
+
+    def test_fingerprint_gated(self):
+        import shutil
+
+        fp = QemuDriver().fingerprint()
+        if shutil.which("qemu-system-x86_64"):
+            assert fp.health == HEALTH_HEALTHY
+        else:
+            assert fp.health == HEALTH_UNDETECTED
