@@ -49,6 +49,7 @@ class AgentConfig:
     # (reference server.go bootstrap_expect semantics).
     wire_raft: bool = False
     data_dir: str = ""  # durable raft log + snapshots (and client state)
+    enable_debug: bool = False  # /v1/agent/pprof dumps (http.go:220)
 
 
 class Agent:
@@ -163,6 +164,18 @@ class Agent:
                 ]
                 self.membership.on_server_change = self._on_server_change
                 self.server.raft.leadership_observers.append(self._on_raft_leadership)
+        # monitor + autopilot (reference command/agent/monitor, autopilot.go)
+        from .monitor import AgentMonitor
+
+        self.monitor = AgentMonitor().attach()
+        self.autopilot = None
+        if self.server is not None:
+            from ..server.autopilot import Autopilot
+
+            self.autopilot = Autopilot(
+                self.server, membership=self.membership, wire_raft=self.wire_raft
+            )
+
         self._started = False
         self._join_done = None
         self._raft_started = False
@@ -186,6 +199,8 @@ class Agent:
                 if self.config.retry_join:
                     self._start_retry_join()
             self._maybe_bootstrap_raft()
+            if self.autopilot is not None:
+                self.autopilot.start()
             # HTTP before the client: the node registration advertises this
             # agent's HTTP address for cross-node fs/logs proxying
             self.http.start()
@@ -255,6 +270,9 @@ class Agent:
             self.http.stop()
             if self.client is not None:
                 self.client.shutdown()
+            if self.autopilot is not None:
+                self.autopilot.stop()
+            self.monitor.detach()
             if getattr(self, "_join_done", None) is not None:
                 self._join_done.set()  # stop an unfinished retry-join loop
             if self.membership is not None:
@@ -328,6 +346,18 @@ class Agent:
     def raft_servers(self) -> List[Tuple[str, str, bool]]:
         if self.server is None:
             return []
+        if self.wire_raft is not None:
+            # the actual consensus configuration — this is what autopilot's
+            # dead-server cleanup mutates
+            out = [(
+                self.wire_raft.node_id,
+                "{}:{}".format(*self.rpc.addr),
+                self.server.is_leader,
+            )]
+            leader_id = self.wire_raft.leader_id
+            for peer_id, addr in self.wire_raft.peers.items():
+                out.append((peer_id, "{}:{}".format(*addr), peer_id == leader_id))
+            return out
         if self.membership is not None:
             return [
                 (s.name, f"{s.rpc_host}:{s.rpc_port}", s.is_leader)

@@ -102,6 +102,10 @@ class Routes:
         r("/v1/status/peers", self.status_peers)
         r("/v1/operator/scheduler/configuration", self.operator_scheduler_config)
         r("/v1/operator/raft/configuration", self.operator_raft_config)
+        r("/v1/operator/autopilot/configuration", self.operator_autopilot_config)
+        r("/v1/operator/autopilot/health", self.operator_autopilot_health)
+        r("/v1/agent/monitor", self.agent_monitor)
+        r("/v1/agent/pprof", self.agent_pprof)
         r("/v1/system/gc", self.system_gc)
         r("/v1/system/reconcile/summaries", self.system_reconcile)
         r("/v1/agent/self", self.agent_self)
@@ -573,6 +577,58 @@ class Routes:
             ],
             "Index": self.state.latest_index,
         }
+
+    def operator_autopilot_config(self, req: Request):
+        from ..server.autopilot import AutopilotConfig
+
+        if req.method == "GET":
+            self._authorize(req, "operator:read")
+            index, config = self.state.autopilot_config()
+            req.response_index = index
+            return config or AutopilotConfig()
+        if req.method in ("PUT", "POST"):
+            self._authorize(req, "operator:write")
+            body = req.json() or {}
+            config = jsonapi.from_json_obj(AutopilotConfig, body)
+            self.server.raft_apply("autopilot-config", config)
+            return {"Updated": True, "Index": self.state.latest_index}
+        raise HTTPError(405, "method not allowed")
+
+    def operator_autopilot_health(self, req: Request):
+        self._authorize(req, "operator:read")
+        if self.agent.autopilot is None:
+            raise HTTPError(404, "autopilot requires a server-mode agent")
+        servers = self.agent.autopilot.server_health()
+        healthy = all(s.healthy for s in servers) if servers else False
+        voters = sum(1 for s in servers if s.voter and s.healthy)
+        return {
+            "Healthy": healthy,
+            "FailureTolerance": max(0, voters - (len(servers) // 2 + 1)),
+            "Servers": [jsonapi.to_json_obj(s) for s in servers],
+        }
+
+    def agent_monitor(self, req: Request):
+        """Poll-based log tail (reference /v1/agent/monitor streams)."""
+        self._authorize(req, "agent:read")
+        try:
+            seq = int(req.param("seq", "0"))
+        except ValueError:
+            raise HTTPError(400, "seq must be an integer")
+        return self.agent.monitor.tail(seq=seq, level=req.param("log_level", "info"))
+
+    def agent_pprof(self, req: Request):
+        """Debug dumps gated on enable_debug (http.go:220 pprof)."""
+        if not self.agent.config.enable_debug:
+            raise HTTPError(404, "debug endpoints disabled (enable_debug)")
+        self._authorize(req, "agent:read")
+        kind = req.param("type", "threads")
+        from . import monitor as monitor_mod
+
+        if kind in ("threads", "goroutine"):
+            return monitor_mod.thread_dump().encode()
+        if kind == "heap":
+            return monitor_mod.heap_dump()
+        raise HTTPError(400, f"unknown profile type {kind!r}")
 
     def system_gc(self, req: Request):
         if req.method not in ("PUT", "POST"):

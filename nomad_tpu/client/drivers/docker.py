@@ -190,20 +190,46 @@ class DockerAPI:
 
     def exec_in_container(self, cid: str, cmd: List[str],
                           timeout_s: float) -> Tuple[bytes, int]:
+        """Attached exec: the start response carries the multiplexed
+        output stream; exit code comes from exec inspect (what the
+        reference uses for script checks and alloc exec)."""
         out = self._request("POST", f"/containers/{cid}/exec", body={
-            "Cmd": cmd, "AttachStdout": False, "AttachStderr": False,
-            "Detach": True,
+            "Cmd": cmd, "AttachStdout": True, "AttachStderr": True,
         })
         exec_id = out["Id"]
-        self._request("POST", f"/exec/{exec_id}/start",
-                      body={"Detach": True})
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            info = self._request("GET", f"/exec/{exec_id}/json") or {}
-            if not info.get("Running", False):
-                return b"", int(info.get("ExitCode") or 0)
-            time.sleep(0.1)
-        return b"", -1
+        conn = _UnixHTTPConnection(self.socket_path, timeout=timeout_s)
+        try:
+            conn.request(
+                "POST", f"/exec/{exec_id}/start",
+                body=json.dumps({"Detach": False, "Tty": False}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise DriverError(f"exec stream failed: {e}") from e
+        finally:
+            conn.close()
+        output = _demux_docker_stream(raw)
+        info = self._request("GET", f"/exec/{exec_id}/json") or {}
+        return output, int(info.get("ExitCode") or 0)
+
+
+def _demux_docker_stream(raw: bytes) -> bytes:
+    """Strip the 8-byte frame headers from docker's multiplexed stream;
+    pass non-framed (tty) payloads through untouched."""
+    out = bytearray()
+    pos = 0
+    while pos + 8 <= len(raw):
+        stream = raw[pos]
+        if stream not in (0, 1, 2) or raw[pos + 1:pos + 4] != b"\x00\x00\x00":
+            return raw  # not framed (tty mode)
+        size = struct.unpack(">I", raw[pos + 4:pos + 8])[0]
+        out.extend(raw[pos + 8:pos + 8 + size])
+        pos += 8 + size
+    if pos != len(raw) and not out:
+        return raw
+    return bytes(out)
 
 
 class ImageCoordinator:
@@ -222,8 +248,24 @@ class ImageCoordinator:
         self._lock = threading.Lock()
         self._refs: Dict[str, int] = {}
         self._pulls: Dict[str, "ImageCoordinator._Pull"] = {}
+        # images with an acquire in flight: release() must not gc these —
+        # the acquirer may have already probed image_exists()=True
+        self._acquiring: Dict[str, int] = {}
 
     def acquire(self, image: str) -> None:
+        with self._lock:
+            self._acquiring[image] = self._acquiring.get(image, 0) + 1
+        try:
+            self._acquire_inner(image)
+        finally:
+            with self._lock:
+                n = self._acquiring.get(image, 1) - 1
+                if n:
+                    self._acquiring[image] = n
+                else:
+                    self._acquiring.pop(image, None)
+
+    def _acquire_inner(self, image: str) -> None:
         # probe outside the lock: a slow daemon must not serialize every
         # unrelated acquire/release behind one HTTP round trip
         with self._lock:
@@ -263,6 +305,8 @@ class ImageCoordinator:
                 self._refs[image] = n
                 return
             self._refs.pop(image, None)
+            if self._acquiring.get(image):
+                return  # a racing acquire will re-reference it
         if self.image_gc:
             try:
                 self.api.remove_image(image)
@@ -339,11 +383,14 @@ class DockerDriver(Driver):
         "image_gc": {"type": "bool"},
     }
 
+    RECONCILE_INTERVAL = 300.0  # docker/reconciler.go default period
+
     def __init__(self, socket_path: str = DEFAULT_SOCKET) -> None:
         self.api = DockerAPI(socket_path)
         self.coordinator = ImageCoordinator(self.api)
         self.tasks: Dict[str, _DockerTask] = {}
         self._lock = threading.Lock()
+        self._reconciler_started = False
 
     def set_config(self, config: dict) -> None:
         if config.get("endpoint"):
@@ -414,10 +461,30 @@ class DockerDriver(Driver):
         task = _DockerTask(self, cfg, cid)
         with self._lock:
             self.tasks[cfg.id] = task
+        self._ensure_reconciler()
         return TaskHandle(
             driver=self.name, config=cfg, state="running",
             driver_state={"container_id": cid, "image": image},
         )
+
+    def _ensure_reconciler(self) -> None:
+        """Lazy periodic dangling-container sweep: starts with the first
+        task so idle drivers (and fingerprint-only instances) spawn no
+        threads."""
+        with self._lock:
+            if self._reconciler_started:
+                return
+            self._reconciler_started = True
+
+        def loop() -> None:
+            while True:
+                time.sleep(self.RECONCILE_INTERVAL)
+                removed = self.reconcile_dangling()
+                if removed:
+                    logger.info("reconciler removed %d dangling containers",
+                                len(removed))
+
+        threading.Thread(target=loop, name="docker-reconciler", daemon=True).start()
 
     def _get(self, task_id: str) -> _DockerTask:
         t = self.tasks.get(task_id)

@@ -49,6 +49,7 @@ ACL_POLICY_DELETE = "acl-policy-delete"
 ACL_TOKEN_UPSERT = "acl-token-upsert"
 VAULT_ACCESSOR_UPSERT = "vault-accessor-upsert"
 VAULT_ACCESSOR_DELETE = "vault-accessor-delete"
+AUTOPILOT_CONFIG = "autopilot-config"
 ACL_TOKEN_DELETE = "acl-token-delete"
 ACL_TOKEN_BOOTSTRAP = "acl-token-bootstrap"
 
@@ -266,6 +267,9 @@ class NomadFSM:
     def _apply_vault_accessor_delete(self, index: int, alloc_ids):
         self.state.delete_vault_accessors(index, alloc_ids)
 
+    def _apply_autopilot_config(self, index: int, config):
+        self.state.autopilot_set_config(index, config)
+
     def snapshot(self) -> StateStore:
         return self.state.snapshot()
 
@@ -302,4 +306,5 @@ _DISPATCH: Dict[str, Callable] = {
     ACL_TOKEN_BOOTSTRAP: NomadFSM._apply_acl_token_bootstrap,
     VAULT_ACCESSOR_UPSERT: NomadFSM._apply_vault_accessor_upsert,
     VAULT_ACCESSOR_DELETE: NomadFSM._apply_vault_accessor_delete,
+    AUTOPILOT_CONFIG: NomadFSM._apply_autopilot_config,
 }

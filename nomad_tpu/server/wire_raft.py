@@ -256,7 +256,9 @@ class WireRaft:
             self._start_replicator(peer_id)
 
     def remove_peer(self, peer_id: str) -> None:
-        """leader.go:952 removeRaftPeer."""
+        """leader.go:952 removeRaftPeer — LOCAL view only. For a
+        cluster-wide removal (autopilot dead-server cleanup) use
+        remove_peer_replicated, or every node's quorum math diverges."""
         with self._lock:
             self.peers.pop(peer_id, None)
             self.next_index.pop(peer_id, None)
@@ -264,6 +266,14 @@ class WireRaft:
             client = self._clients.pop(peer_id, None)
         if client is not None:
             client.close()
+
+    PEER_REMOVE = "_raft-peer-remove"
+
+    def remove_peer_replicated(self, peer_id: str) -> None:
+        """Leader-only: commit the removal through the log so every
+        replica shrinks its configuration at the same log position (the
+        single-server membership-change protocol)."""
+        self.apply(0, self.PEER_REMOVE, peer_id)
 
     # -- persistence -----------------------------------------------------
 
@@ -544,6 +554,13 @@ class WireRaft:
             if not entry:
                 break
             index, term, entry_type, payload = entry[0]
+            if entry_type == self.PEER_REMOVE:
+                if payload != self.node_id:
+                    # RLock: safe to re-enter remove_peer while applying
+                    self.remove_peer(payload)
+                if self.state == LEADER:
+                    self._apply_results[index] = None
+                continue
             if entry_type != "_raft-barrier" and self.fsm is not None:
                 try:
                     result = self.fsm.apply(index, entry_type, payload)

@@ -49,6 +49,7 @@ class StateStore:
         # schema.go periodic_launch table)
         self.periodic_launch_table: Dict[Tuple[str, str], int] = {}
         self.scheduler_config_entry: Optional[SchedulerConfiguration] = None
+        self.autopilot_config_entry = None  # server.autopilot.AutopilotConfig
         # ACL tables (reference schema.go acl_policy / acl_token)
         self.acl_policies_table: Dict[str, "ACLPolicy"] = {}
         self.acl_tokens_table: Dict[str, "ACLToken"] = {}  # by accessor
@@ -99,6 +100,7 @@ class StateStore:
             snap.deployments_table = dict(self.deployments_table)
             snap.periodic_launch_table = dict(self.periodic_launch_table)
             snap.scheduler_config_entry = self.scheduler_config_entry
+            snap.autopilot_config_entry = self.autopilot_config_entry
             snap.acl_policies_table = dict(self.acl_policies_table)
             snap.acl_tokens_table = dict(self.acl_tokens_table)
             snap._tokens_by_secret = dict(self._tokens_by_secret)
@@ -546,6 +548,20 @@ class StateStore:
             else:
                 config.create_index = self.scheduler_config_entry.create_index
             self.scheduler_config_entry = config
+            self._bump(index)
+
+    def autopilot_config(self):
+        cfg = self.autopilot_config_entry
+        return (cfg.modify_index if cfg else 0), cfg
+
+    def autopilot_set_config(self, index: int, config) -> None:
+        with self._lock:
+            config.modify_index = index
+            if self.autopilot_config_entry is None:
+                config.create_index = index
+            else:
+                config.create_index = self.autopilot_config_entry.create_index
+            self.autopilot_config_entry = config
             self._bump(index)
 
     # ------------------------------------------------------------------

@@ -159,7 +159,9 @@ class FakeDocker:
                     if action == "exec":
                         return self._reply(201, {"Id": "exec-" + cid})
                 if path.startswith("/exec/") and path.endswith("/start"):
-                    return self._reply(200)
+                    # attached exec: multiplexed stdout frame in the body
+                    frame = bytes([1, 0, 0, 0]) + struct.pack(">I", 3) + b"hi\n"
+                    return self._reply(200, raw=frame)
                 return self._reply(404, {"message": f"POST {path}"})
 
             def do_DELETE(self):

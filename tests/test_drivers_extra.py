@@ -152,6 +152,7 @@ class TestDockerDriver:
                                               config={"image": "busybox:latest"}))
         out, code = driver.exec_task("e/t", ["echo", "hi"], timeout_s=5.0)
         assert code == 7  # fake reports ExitCode 7
+        assert out == b"hi\n", "attached exec output demuxed"
         dockerd.finish(handle.driver_state["container_id"], 0)
 
 

@@ -323,3 +323,27 @@ class TestAgentsOnWireRaft:
         finally:
             for a in agents:
                 a.shutdown()
+
+
+class TestReplicatedPeerRemoval:
+    def test_remove_peer_replicated_shrinks_all_views(self, cluster):
+        """Autopilot-style removal goes through the log: every replica's
+        peer set shrinks, not just the leader's."""
+        nodes = cluster(3)
+        wait_until(lambda: leader_of(nodes) is not None)
+        leader = leader_of(nodes)
+        followers = [n for n in nodes if n is not leader]
+        victim = followers[0]
+        victim.stop()
+        leader.raft.remove_peer_replicated(victim.node_id)
+        survivor = followers[1]
+        wait_until(
+            lambda: victim.node_id not in leader.raft.peers
+            and victim.node_id not in survivor.raft.peers,
+            msg="peer removed on every replica",
+        )
+        # the shrunken cluster still commits
+        n = mock.node()
+        leader.raft.apply(0, NODE_REGISTER, n)
+        wait_until(lambda: survivor.fsm.state.node_by_id(n.id) is not None,
+                   msg="post-removal commit")
