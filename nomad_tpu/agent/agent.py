@@ -132,6 +132,9 @@ class Agent:
         self.acl_routes.register_all(self.http)
         self.fs_routes = FSRoutes(self)
         self.fs_routes.register_all(self.http)
+        from .ui import register_ui
+
+        register_ui(self.http, self)
 
         # distributed wiring: RPC endpoints + gossip membership
         # (reference agent.go:560 setupServer → nomad.NewServer → setupRPC/Serf)
@@ -355,7 +358,8 @@ class Agent:
                 self.server.is_leader,
             )]
             leader_id = self.wire_raft.leader_id
-            for peer_id, addr in self.wire_raft.peers.items():
+            # snapshot: autopilot prunes peers concurrently
+            for peer_id, addr in dict(self.wire_raft.peers).items():
                 out.append((peer_id, "{}:{}".format(*addr), peer_id == leader_id))
             return out
         if self.membership is not None:

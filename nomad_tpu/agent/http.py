@@ -74,6 +74,8 @@ class Request:
     options: QueryOptions = field(default_factory=QueryOptions)
     # handlers set this to stamp X-Nomad-Index
     response_index: Optional[int] = None
+    # handlers returning bytes may override the content type (UI assets)
+    response_content_type: Optional[str] = None
 
     def param(self, name: str, default: str = "") -> str:
         vals = self.query.get(name)
@@ -175,7 +177,7 @@ class HTTPServer:
             def _send_json(self, obj, req: Request):
                 if isinstance(obj, bytes):
                     payload = obj
-                    ctype = "application/octet-stream"
+                    ctype = req.response_content_type or "application/octet-stream"
                 else:
                     pretty = "pretty" in req.query
                     payload = jsonapi.dumps(obj, pretty=pretty).encode("utf-8")

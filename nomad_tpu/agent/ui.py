@@ -1,0 +1,275 @@
+"""Built-in web UI served at /ui.
+
+Fills the role of the reference's Ember SPA (``ui/``, served by the agent
+at http.go:213) with a no-build-step single-file app over the same /v1
+JSON API: jobs (list/detail/stop), allocations (task states, events, log
+viewer via the fs API), nodes (attributes, drain/eligibility), evals,
+deployments (promote/fail), and servers (members, raft config, autopilot
+health). ACL token entry is stored in localStorage and sent as
+X-Nomad-Token, like the reference UI's token page.
+"""
+from __future__ import annotations
+
+UI_HTML = r"""<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>Nomad-TPU</title>
+<style>
+:root{--bg:#f7f8fa;--panel:#fff;--ink:#1f2430;--mut:#68707f;--line:#e3e6eb;
+--brand:#16a394;--bad:#c4442e;--warn:#b98a00;--ok:#2f855a;--mono:ui-monospace,Menlo,monospace}
+*{box-sizing:border-box}body{margin:0;font:14px/1.45 system-ui,sans-serif;
+background:var(--bg);color:var(--ink)}
+header{display:flex;align-items:center;gap:18px;background:var(--panel);
+border-bottom:1px solid var(--line);padding:10px 20px;position:sticky;top:0}
+header b{color:var(--brand);font-size:16px}
+nav a{color:var(--mut);text-decoration:none;margin-right:14px;padding:4px 2px}
+nav a.on{color:var(--ink);border-bottom:2px solid var(--brand)}
+#token{margin-left:auto;font:12px var(--mono);width:200px;padding:4px 6px;
+border:1px solid var(--line);border-radius:4px}
+main{max-width:1100px;margin:18px auto;padding:0 16px}
+table{width:100%;border-collapse:collapse;background:var(--panel);
+border:1px solid var(--line);border-radius:6px;overflow:hidden}
+th,td{text-align:left;padding:8px 12px;border-bottom:1px solid var(--line)}
+th{font-size:12px;text-transform:uppercase;letter-spacing:.04em;color:var(--mut)}
+tr:last-child td{border-bottom:0}
+tbody tr:hover{background:#f0f4f8;cursor:pointer}
+.tag{display:inline-block;padding:1px 8px;border-radius:10px;font-size:12px}
+.t-running,.t-ready,.t-complete,.t-successful,.t-alive{background:#e3f5ec;color:var(--ok)}
+.t-pending,.t-paused{background:#fdf3d7;color:var(--warn)}
+.t-failed,.t-dead,.t-down,.t-lost{background:#fbe6e0;color:var(--bad)}
+.t-blocked,.t-other{background:#e8eaf0;color:var(--mut)}
+h2{margin:18px 0 10px}h3{margin:16px 0 8px}
+.kv{display:grid;grid-template-columns:220px 1fr;gap:4px 14px;background:var(--panel);
+border:1px solid var(--line);border-radius:6px;padding:12px}
+.kv div:nth-child(odd){color:var(--mut)}
+pre{background:#101418;color:#d6dde6;padding:12px;border-radius:6px;
+overflow:auto;font:12px/1.5 var(--mono);max-height:420px;white-space:pre-wrap}
+button{background:var(--brand);color:#fff;border:0;border-radius:4px;
+padding:6px 12px;cursor:pointer;margin-right:8px}
+button.risk{background:var(--bad)}
+.crumb{color:var(--mut);margin-bottom:6px}.crumb a{color:var(--brand)}
+.err{background:#fbe6e0;color:var(--bad);padding:10px;border-radius:6px;margin:10px 0}
+.mut{color:var(--mut)}
+</style>
+</head>
+<body>
+<header>
+  <b>nomad-tpu</b>
+  <nav id="nav"></nav>
+  <input id="token" placeholder="ACL token" title="X-Nomad-Token">
+</header>
+<main id="main">loading…</main>
+<script>
+"use strict";
+const $ = s => document.querySelector(s);
+const NAV = [["jobs","Jobs"],["nodes","Nodes"],["allocs","Allocations"],
+             ["evals","Evaluations"],["deploys","Deployments"],["servers","Servers"]];
+const tokenBox = $("#token");
+tokenBox.value = localStorage.getItem("nomad_token") || "";
+tokenBox.onchange = () => { localStorage.setItem("nomad_token", tokenBox.value); render(); };
+
+async function api(path, opts) {
+  const headers = {};
+  const tok = localStorage.getItem("nomad_token");
+  if (tok) headers["X-Nomad-Token"] = tok;
+  const r = await fetch(path, Object.assign({headers}, opts || {}));
+  if (!r.ok) throw new Error(r.status + " " + await r.text());
+  const ct = r.headers.get("Content-Type") || "";
+  return ct.includes("json") ? r.json() : r.text();
+}
+const esc = s => String(s ?? "").replace(/[&<>"'`]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;","`":"&#96;"}[c]));
+const tag = s => { const k = String(s||"other").toLowerCase();
+  const known = ["running","ready","complete","successful","alive","pending",
+                 "paused","failed","dead","down","lost","blocked"];
+  return `<span class="tag t-${known.includes(k)?k:"other"}">${esc(s)}</span>`; };
+const short = id => esc(String(id||"").slice(0,8));
+const when = ns => ns ? new Date(ns/1e6).toLocaleString() : "";
+function table(headers, rows, onclickPrefix) {
+  const h = headers.map(x=>`<th>${x}</th>`).join("");
+  const b = rows.map(r => {
+    // navigation via a data attribute + delegated listener: IDs are
+    // user-controlled and must never be spliced into inline JS
+    const link = onclickPrefix && r.__id ?
+      ` data-href="${esc(onclickPrefix + "/" + encodeURIComponent(r.__id))}"` : "";
+    return `<tr${link}>` + r.cells.map(c=>`<td>${c}</td>`).join("") + "</tr>";
+  }).join("");
+  return `<table><thead><tr>${h}</tr></thead><tbody>${b || ""}</tbody></table>`
+    + (rows.length ? "" : `<p class="mut">none</p>`);
+}
+document.addEventListener("click", e => {
+  const row = e.target.closest("tr[data-href]");
+  if (row) location.hash = row.dataset.href;
+});
+
+const pages = {
+  async jobs() {
+    const jobs = await api("/v1/jobs");
+    return `<h2>Jobs</h2>` + table(
+      ["ID","Type","Priority","Status","Groups"],
+      jobs.map(j => ({__id: j.ID, cells: [
+        esc(j.ID), esc(j.Type), j.Priority, tag(j.Status),
+        Object.keys(j.JobSummary?.Summary || {}).length]})),
+      "#/jobs");
+  },
+  async job(id) {
+    const j = await api("/v1/job/" + encodeURIComponent(id));
+    const allocs = await api(`/v1/job/${encodeURIComponent(id)}/allocations?all=true`);
+    const evals = await api(`/v1/job/${encodeURIComponent(id)}/evaluations`);
+    return `<div class="crumb"><a href="#/jobs">jobs</a> / ${esc(id)}</div>
+      <h2>${esc(j.Name || id)} ${tag(j.Status)}</h2>
+      <p><button class="risk" data-stop-job="${esc(id)}">Stop job</button></p>
+      <div class="kv"><div>Type</div><div>${esc(j.Type)}</div>
+        <div>Priority</div><div>${j.Priority}</div>
+        <div>Datacenters</div><div>${esc((j.Datacenters||[]).join(", "))}</div>
+        <div>Version</div><div>${j.Version ?? 0}</div></div>
+      <h3>Allocations</h3>` + table(
+        ["ID","Group","Desired","Client status","Node"],
+        (allocs||[]).map(a => ({__id: a.ID, cells: [
+          short(a.ID), esc(a.TaskGroup), esc(a.DesiredStatus),
+          tag(a.ClientStatus), short(a.NodeID)]})), "#/allocs")
+      + `<h3>Evaluations</h3>` + table(
+        ["ID","Triggered by","Status"],
+        (evals||[]).map(e => ({cells: [short(e.ID), esc(e.TriggeredBy),
+                                       tag(e.Status)]})));
+  },
+  async allocs() {
+    const allocs = await api("/v1/allocations");
+    return `<h2>Allocations</h2>` + table(
+      ["ID","Job","Group","Desired","Client status","Modified"],
+      allocs.map(a => ({__id: a.ID, cells: [
+        short(a.ID), esc(a.JobID), esc(a.TaskGroup), esc(a.DesiredStatus),
+        tag(a.ClientStatus), when(a.ModifyTime)]})), "#/allocs");
+  },
+  async alloc(id) {
+    const a = await api("/v1/allocation/" + encodeURIComponent(id));
+    const states = a.TaskStates || {};
+    const tasks = Object.keys(states);
+    let logs = "";
+    if (tasks.length) {
+      const t = tasks[0];
+      try {
+        logs = await api(`/v1/client/fs/logs/${encodeURIComponent(id)}?task=${encodeURIComponent(t)}&type=stdout`);
+      } catch (e) { logs = "(logs unavailable: " + e.message + ")"; }
+    }
+    return `<div class="crumb"><a href="#/allocs">allocations</a> / ${short(id)}</div>
+      <h2>${esc(a.Name || id)} ${tag(a.ClientStatus)}</h2>
+      <div class="kv"><div>Job</div><div><a href="#/jobs/${esc(a.JobID)}">${esc(a.JobID)}</a></div>
+        <div>Node</div><div><a href="#/nodes/${esc(a.NodeID)}">${short(a.NodeID)}</a></div>
+        <div>Desired</div><div>${esc(a.DesiredStatus)}</div>
+        <div>Previous alloc</div><div>${short(a.PreviousAllocation) || "—"}</div></div>
+      ${tasks.map(t => `<h3>Task ${esc(t)} ${tag(states[t].State)}</h3>` + table(
+        ["Time","Type","Message"],
+        (states[t].Events||[]).map(e => ({cells: [
+          when(e.Time), esc(e.Type), esc(e.DisplayMessage || e.Message || "")]}))
+      )).join("")}
+      <h3>Logs (stdout)</h3><pre>${esc(logs) || "(empty)"}</pre>`;
+  },
+  async nodes() {
+    const nodes = await api("/v1/nodes");
+    return `<h2>Nodes</h2>` + table(
+      ["ID","Name","DC","Class","Eligibility","Status"],
+      nodes.map(n => ({__id: n.ID, cells: [
+        short(n.ID), esc(n.Name), esc(n.Datacenter), esc(n.NodeClass||"—"),
+        esc(n.SchedulingEligibility), tag(n.Status)]})), "#/nodes");
+  },
+  async node(id) {
+    const n = await api("/v1/node/" + encodeURIComponent(id));
+    const allocs = await api(`/v1/node/${encodeURIComponent(id)}/allocations`);
+    const attrs = Object.entries(n.Attributes || {}).sort();
+    return `<div class="crumb"><a href="#/nodes">nodes</a> / ${short(id)}</div>
+      <h2>${esc(n.Name)} ${tag(n.Status)}</h2>
+      <div class="kv"><div>Datacenter</div><div>${esc(n.Datacenter)}</div>
+        <div>Class</div><div>${esc(n.NodeClass)||"—"}</div>
+        <div>Drain</div><div>${n.Drain ? "yes" : "no"}</div>
+        <div>Eligibility</div><div>${esc(n.SchedulingEligibility)}</div>
+        <div>HTTP</div><div>${esc(n.HTTPAddr||"")}</div></div>
+      <h3>Allocations</h3>` + table(
+        ["ID","Job","Client status"],
+        (allocs||[]).map(a => ({__id: a.ID, cells: [
+          short(a.ID), esc(a.JobID), tag(a.ClientStatus)]})), "#/allocs")
+      + `<h3>Attributes</h3>` + table(["Key","Value"],
+        attrs.map(([k,v]) => ({cells: [esc(k), esc(v)]})));
+  },
+  async evals() {
+    const evals = await api("/v1/evaluations");
+    return `<h2>Evaluations</h2>` + table(
+      ["ID","Job","Type","Triggered by","Status"],
+      evals.map(e => ({cells: [short(e.ID), esc(e.JobID), esc(e.Type),
+                               esc(e.TriggeredBy), tag(e.Status)]})));
+  },
+  async deploys() {
+    const ds = await api("/v1/deployments");
+    return `<h2>Deployments</h2>` + table(
+      ["ID","Job","Status","Description"],
+      ds.map(d => ({cells: [short(d.ID), esc(d.JobID), tag(d.Status),
+                            esc(d.StatusDescription)]})));
+  },
+  async servers() {
+    const members = await api("/v1/agent/members");
+    const ms = members.Members || members;
+    let raft = {Servers: []}, health = null;
+    try { raft = await api("/v1/operator/raft/configuration"); } catch (e) {}
+    try { health = await api("/v1/operator/autopilot/health"); } catch (e) {}
+    return `<h2>Server members</h2>` + table(
+      ["Name","Address","Status","Leader","Region"],
+      ms.map(m => ({cells: [esc(m.Name), esc(m.Addr)+":"+m.Port, tag(m.Status),
+                            m.Leader ? "yes" : "", esc(m.Tags?.region||"")]})))
+      + `<h3>Raft configuration</h3>` + table(
+        ["ID","Address","Leader","Voter"],
+        (raft.Servers||[]).map(s => ({cells: [esc(s.ID), esc(s.Address),
+          s.Leader ? "yes" : "", s.Voter ? "yes" : ""]})))
+      + (health ? `<h3>Autopilot ${health.Healthy ? tag("ready") : tag("failed")}</h3>`
+        + table(["Server","Serf","Healthy","Last index"],
+          (health.Servers||[]).map(s => ({cells: [esc(s.Name), esc(s.SerfStatus),
+            s.Healthy ? tag("ready") : tag("failed"), s.LastIndex]}))) : "");
+  },
+};
+
+async function stopJob(id) {
+  if (!confirm("Stop job " + id + "?")) return;
+  try { await api("/v1/job/" + encodeURIComponent(id), {method: "DELETE"}); }
+  catch (e) { alert(e.message); }
+  render();
+}
+document.addEventListener("click", e => {
+  const btn = e.target.closest("[data-stop-job]");
+  if (btn) stopJob(btn.dataset.stopJob);
+});
+
+let timer = null;
+async function render() {
+  const hash = location.hash.replace(/^#\//, "") || "jobs";
+  const [page, id] = hash.split("/");
+  $("#nav").innerHTML = NAV.map(([k, label]) =>
+    `<a href="#/${k}" class="${page===k?"on":""}">${label}</a>`).join("");
+  const fn = id && pages[page.replace(/s$/, "")] ? pages[page.replace(/s$/, "")]
+           : pages[page] || pages.jobs;
+  try {
+    $("#main").innerHTML = await fn(id ? decodeURIComponent(id) : undefined);
+  } catch (e) {
+    $("#main").innerHTML = `<div class="err">${esc(e.message)}</div>`;
+  }
+  clearTimeout(timer);
+  if (!id) timer = setTimeout(render, 4000);  // auto-refresh list pages
+}
+window.addEventListener("hashchange", render);
+render();
+</script>
+</body>
+</html>
+"""
+
+
+def register_ui(mux, agent) -> None:
+    """Serve the SPA at /ui — http.go:213's slot. (No catch-all "/"
+    route: the mux treats trailing-slash prefixes as wildcards, and the
+    UI must not shadow unknown /v1 paths' 404s.)"""
+
+    def serve(req):
+        req.response_content_type = "text/html; charset=utf-8"
+        return UI_HTML.encode()
+
+    mux.register("/ui", serve)
+    mux.register("/ui/", serve)

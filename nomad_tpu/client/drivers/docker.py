@@ -307,7 +307,12 @@ class ImageCoordinator:
             self._refs.pop(image, None)
             if self._acquiring.get(image):
                 return  # a racing acquire will re-reference it
-        if self.image_gc:
+            if not self.image_gc:
+                return
+            # removal happens UNDER the lock: a racing acquire registered
+            # after the check above blocks here, then re-probes and finds
+            # the image gone, triggering a fresh pull instead of holding a
+            # reference to a deleted image
             try:
                 self.api.remove_image(image)
             except DriverError as e:

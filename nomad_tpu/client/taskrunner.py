@@ -134,8 +134,18 @@ class TaskRunner:
         )
         self._thread.start()
 
+    MAX_SYNCED_EVENTS = 10  # reference structs.go taskState event cap
+
     def _emit(self, event: TaskEvent) -> None:
         self.events.append(event)
+        self.state.events.append({
+            "Type": event.type,
+            "Message": event.message,
+            "DisplayMessage": event.message or event.type,
+            "Time": event.time_ns,
+        })
+        if len(self.state.events) > self.MAX_SYNCED_EVENTS:
+            self.state.events = self.state.events[-self.MAX_SYNCED_EVENTS:]
         self.state.restarts = max(0, self.restart_tracker.count - 1)
         if self.on_state_change is not None:
             self.on_state_change()

@@ -139,9 +139,6 @@ class Autopilot:
             self._health[meta.name] = health
         return out
 
-    def num_healthy(self) -> int:
-        return sum(1 for h in self.server_health() if h.healthy)
-
     # -- dead server cleanup (autopilot.go pruneDeadServers) -------------
 
     def prune_dead_servers(self) -> List[str]:
@@ -158,7 +155,7 @@ class Autopilot:
         alive = {m.name for m in self.membership.members() if m.status == "alive"}
         dead = [peer_id for peer_id in peers if peer_id not in alive]
         # never remove more servers than keeps a healthy quorum
-        removable = max(0, (cluster - quorum) - 0)
+        removable = max(0, cluster - quorum)
         removed = []
         remove = getattr(
             self.wire_raft, "remove_peer_replicated", self.wire_raft.remove_peer

@@ -107,6 +107,7 @@ class WireRaft:
 
         self._shutdown = threading.Event()
         self._started = False
+        self._config_replay_boundary = 0
         self._last_contact = time.monotonic()
         self._election_deadline = self._random_deadline()
         self._threads: List[threading.Thread] = []
@@ -204,6 +205,12 @@ class WireRaft:
 
     def start(self) -> "WireRaft":
         self._started = True
+        # membership-change entries at or below this index are HISTORY:
+        # replaying them during catch-up would remove peers that have since
+        # rejoined (the live peer set comes from gossip bootstrap). Only
+        # entries committed after we started participating apply.
+        with self._lock:
+            self._config_replay_boundary = self._last_index()
         t = threading.Thread(
             target=self._election_loop, name=f"raft-election-{self.node_id}", daemon=True
         )
@@ -555,7 +562,8 @@ class WireRaft:
                 break
             index, term, entry_type, payload = entry[0]
             if entry_type == self.PEER_REMOVE:
-                if payload != self.node_id:
+                boundary = getattr(self, "_config_replay_boundary", 0)
+                if payload != self.node_id and index > boundary:
                     # RLock: safe to re-enter remove_peer while applying
                     self.remove_peer(payload)
                 if self.state == LEADER:

@@ -801,6 +801,10 @@ class TaskState:
     restarts: int = 0
     started_at_ns: int = 0
     finished_at_ns: int = 0
+    # event trail synced to the server (reference structs.go TaskState
+    # .Events → `nomad alloc status` / UI); entries are
+    # {"Type", "Message", "DisplayMessage", "Time"} dicts
+    events: List[Dict[str, Any]] = field(default_factory=list)
 
     def successful(self) -> bool:
         return self.state == "dead" and not self.failed
