@@ -50,6 +50,9 @@ class AgentConfig:
     wire_raft: bool = False
     data_dir: str = ""  # durable raft log + snapshots (and client state)
     enable_debug: bool = False  # /v1/agent/pprof dumps (http.go:220)
+    # client-only agents dial these server RPC addrs ("host:port") —
+    # reference client config `servers` list
+    servers: List[str] = field(default_factory=list)
 
 
 class Agent:
@@ -93,6 +96,16 @@ class Agent:
                     data_dir=data_dir,
                 )
                 raft = self.wire_raft
+            elif self.config.data_dir:
+                # single-server durability: the in-proc raft persists its
+                # log/snapshots so a restarted agent replays server state
+                import os as _os
+
+                from ..server.raft import InProcRaft
+
+                raft = InProcRaft(
+                    data_dir=_os.path.join(self.config.data_dir, "raft")
+                )
             self.server = Server(
                 ServerConfig(
                     num_schedulers=self.config.num_schedulers,
@@ -102,18 +115,49 @@ class Agent:
                 name=self.config.name,
             )
         if self.client is None and self.config.client_enabled:
-            if self.server is None:
+            if self.server is not None:
+                proxy = ServerProxy(self.server)
+            elif self.config.servers:
+                from ..rpc.endpoints import RemoteServerProxy
+                from ..rpc.transport import RPCClient, RPCError
+
+                addrs = []
+                for a in self.config.servers:
+                    host, sep, port = a.rpartition(":")
+                    if not sep or not port.isdigit():
+                        raise ValueError(
+                            f"server address {a!r} must be host:port"
+                        )
+                    addrs.append((host, int(port)))
+                # first answering server wins (client/servers round-robin
+                # failover is per-call in the reference; this picks at boot)
+                chosen = addrs[0]
+                for addr in addrs:
+                    probe = RPCClient(*addr, timeout=3.0)
+                    try:
+                        probe.call("Status.ping")
+                        chosen = addr
+                        break
+                    except (RPCError, OSError):
+                        continue
+                    finally:
+                        probe.close()
+                proxy = RemoteServerProxy(*chosen)
+            else:
                 raise ValueError(
-                    "client-only agents need a server to dial; pass client="
+                    "client-only agents need -servers addresses or a server"
                 )
-            self.client = Client(
-                ServerProxy(self.server),
-                ClientConfig(
-                    datacenter=self.config.datacenter,
-                    node_class=self.config.node_class,
-                    meta=dict(self.config.meta),
-                ),
+            client_cfg = ClientConfig(
+                datacenter=self.config.datacenter,
+                node_class=self.config.node_class,
+                meta=dict(self.config.meta),
             )
+            if self.config.data_dir:
+                import os as _os
+
+                client_cfg.state_dir = _os.path.join(self.config.data_dir, "client")
+                client_cfg.persist_state = True
+            self.client = Client(proxy, client_cfg)
 
         self.http = HTTPServer(self.config.http_bind, self.config.http_port)
         self.routes = Routes(self)

@@ -239,18 +239,23 @@ document.addEventListener("click", e => {
 });
 
 let timer = null;
+let renderSeq = 0;
 async function render() {
+  const seq = ++renderSeq;  // stale async completions must not clobber
   const hash = location.hash.replace(/^#\//, "") || "jobs";
   const [page, id] = hash.split("/");
   $("#nav").innerHTML = NAV.map(([k, label]) =>
     `<a href="#/${k}" class="${page===k?"on":""}">${label}</a>`).join("");
   const fn = id && pages[page.replace(/s$/, "")] ? pages[page.replace(/s$/, "")]
            : pages[page] || pages.jobs;
+  let html;
   try {
-    $("#main").innerHTML = await fn(id ? decodeURIComponent(id) : undefined);
+    html = await fn(id ? decodeURIComponent(id) : undefined);
   } catch (e) {
-    $("#main").innerHTML = `<div class="err">${esc(e.message)}</div>`;
+    html = `<div class="err">${esc(e.message)}</div>`;
   }
+  if (seq !== renderSeq) return;  // navigation happened mid-fetch
+  $("#main").innerHTML = html;
   clearTimeout(timer);
   if (!id) timer = setTimeout(render, 4000);  // auto-refresh list pages
 }

@@ -78,7 +78,9 @@ _VALUE_FLAGS = {
     "address", "region", "namespace", "token", "job", "output", "type",
     "deadline", "meta", "payload", "name", "policy", "rules",
     "description", "bind", "http-port", "config", "version", "limit",
-    "per-page", "node-class", "datacenter", "task",
+    "per-page", "node-class", "datacenter", "task", "dc",
+    "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
+    "servers",
 }
 
 
@@ -111,12 +113,27 @@ def cmd_agent(ctx: Ctx, args: List[str]) -> int:
     from ..agent import Agent, AgentConfig
 
     dev = _truthy(flags, "dev")
+    server_enabled = _truthy(flags, "server") or dev or not _truthy(flags, "client")
     cfg = AgentConfig(
         dev_mode=dev,
         name=flags.get("name", "agent-1"),
+        region=flags.get("region", "global"),
+        datacenter=flags.get("dc", "dc1"),
+        server_enabled=server_enabled,
+        client_enabled=_truthy(flags, "client") or dev,
         http_bind=flags.get("bind", "127.0.0.1"),
         http_port=int(flags.get("http-port", "4646")),
+        rpc_port=int(flags.get("rpc-port", "0")),
+        serf_port=int(flags.get("serf-port", "0")),
+        retry_join=[a for a in flags.get("retry-join", "").split(",") if a],
+        bootstrap_expect=int(flags.get("bootstrap-expect", "1")),
+        wire_raft=_truthy(flags, "wire-raft"),
+        data_dir=flags.get("data-dir", ""),
+        node_class=flags.get("node-class", ""),
+        servers=[a for a in flags.get("servers", "").split(",") if a],
         acl_enabled=_truthy(flags, "acl-enabled"),
+        enable_debug=_truthy(flags, "enable-debug"),
+        gossip_enabled=not _truthy(flags, "no-gossip"),
     )
     agent = Agent(cfg)
     agent.start()

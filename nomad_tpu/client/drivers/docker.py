@@ -251,6 +251,9 @@ class ImageCoordinator:
         # images with an acquire in flight: release() must not gc these —
         # the acquirer may have already probed image_exists()=True
         self._acquiring: Dict[str, int] = {}
+        # images being deleted right now: acquire waits these out instead
+        # of trusting a stale image_exists probe
+        self._removing: set = set()
 
     def acquire(self, image: str) -> None:
         with self._lock:
@@ -266,6 +269,17 @@ class ImageCoordinator:
                     self._acquiring.pop(image, None)
 
     def _acquire_inner(self, image: str) -> None:
+        # wait out an in-flight removal of THIS image so the exists-probe
+        # below can't observe a half-deleted state
+        deadline = time.monotonic() + 120
+        while True:
+            with self._lock:
+                removing = image in self._removing
+            if not removing:
+                break
+            if time.monotonic() > deadline:
+                raise DriverError(f"image {image} stuck in removal")
+            time.sleep(0.05)
         # probe outside the lock: a slow daemon must not serialize every
         # unrelated acquire/release behind one HTTP round trip
         with self._lock:
@@ -309,14 +323,16 @@ class ImageCoordinator:
                 return  # a racing acquire will re-reference it
             if not self.image_gc:
                 return
-            # removal happens UNDER the lock: a racing acquire registered
-            # after the check above blocks here, then re-probes and finds
-            # the image gone, triggering a fresh pull instead of holding a
-            # reference to a deleted image
-            try:
-                self.api.remove_image(image)
-            except DriverError as e:
-                logger.debug("image gc of %s skipped: %s", image, e)
+            # mark-then-remove outside the lock: acquires of THIS image
+            # wait out the marker; unrelated images stay unblocked
+            self._removing.add(image)
+        try:
+            self.api.remove_image(image)
+        except DriverError as e:
+            logger.debug("image gc of %s skipped: %s", image, e)
+        finally:
+            with self._lock:
+                self._removing.discard(image)
 
 
 class _DockerTask:

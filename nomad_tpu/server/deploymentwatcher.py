@@ -73,6 +73,9 @@ class DeploymentsWatcher:
         self._thread: Optional[threading.Thread] = None
         self._generation = 0
         self._lock = threading.Lock()
+        # deployment id → last observed healthy-alloc total, for detecting
+        # mid-rollout health transitions that must kick the scheduler
+        self._last_healthy: dict = {}
 
     # -- lifecycle -------------------------------------------------------
 
@@ -112,13 +115,19 @@ class DeploymentsWatcher:
     def tick(self, now_ns: Optional[int] = None) -> None:
         now_ns = now_ns if now_ns is not None else time.time_ns()
         state = self.server.fsm.state
+        active_ids = set()
         for d in state.deployments():
             if not d.active():
                 continue
+            active_ids.add(d.id)
             try:
                 self._check_deployment(state, d, now_ns)
             except Exception:  # noqa: BLE001
                 self.logger.exception("deployment %s check failed", d.id)
+        # failed/cancelled deployments must not leak health counters
+        for did in list(self._last_healthy):
+            if did not in active_ids:
+                self._last_healthy.pop(did, None)
 
     def _check_deployment(self, state, d: Deployment, now_ns: int) -> None:
         job = state.job_by_id(d.namespace, d.job_id)
@@ -167,10 +176,24 @@ class DeploymentsWatcher:
         if d.task_groups and all(
             ds.healthy_allocs >= ds.desired_total for ds in d.task_groups.values()
         ):
+            self._last_healthy.pop(d.id, None)
             self._update_status(d, DEPLOYMENT_STATUS_SUCCESSFUL, DESC_SUCCESSFUL)
             self.server.raft_apply(
                 "job-stability", (d.namespace, d.job_id, d.job_version, True)
             )
+            return
+
+        # progress: an alloc newly became healthy mid-rollout — kick the
+        # scheduler so the next max_parallel batch places (reference
+        # deployment_watcher.go createBatchedUpdateEvaluation on alloc
+        # health transitions; without this a rolling update stalls after
+        # its first batch)
+        total_healthy = sum(ds.healthy_allocs for ds in d.task_groups.values())
+        prev = self._last_healthy.get(d.id)
+        self._last_healthy[d.id] = total_healthy
+        if prev is not None and total_healthy > prev:
+            ev = self._make_eval(d, job)
+            self.server.raft_apply("eval-update", [ev])
 
     # -- transitions -----------------------------------------------------
 

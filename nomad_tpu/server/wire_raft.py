@@ -591,6 +591,17 @@ class WireRaft:
                 self._step_down_locked(term)
             self.leader_id = leader_id
             self._election_deadline = self._random_deadline()
+            # a FRESH node (empty log, no snapshot) joining an established
+            # cluster: everything already committed is pre-join history —
+            # its peer set came from gossip bootstrap, so historical
+            # PEER_REMOVE entries must not apply (the removed peer may
+            # have long since rejoined)
+            if (
+                self._config_replay_boundary == 0
+                and self._snapshot_index == 0
+                and not self.log
+            ):
+                self._config_replay_boundary = leader_commit
             # consistency check
             if prev_index > 0 and self._term_at(prev_index) != prev_term:
                 return [self.current_term, False, min(self._last_index(), prev_index - 1)]
@@ -624,6 +635,10 @@ class WireRaft:
             self._election_deadline = self._random_deadline()
             if last_index <= self._snapshot_index:
                 return self.current_term
+            if self._config_replay_boundary == 0:
+                # snapshot install = joining established history (see
+                # append-entries fresh-node boundary)
+                self._config_replay_boundary = last_index
             self._snapshot_index = last_index
             self._snapshot_term = last_term
             self._snapshot_state = state_blob

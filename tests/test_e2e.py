@@ -1,0 +1,171 @@
+"""E2E suites over real agent processes (reference e2e/: rescheduling/,
+spread/, deployment/, clientstate/) — black-box through the SDK only.
+"""
+import os
+import time
+
+import pytest
+
+from e2e_framework import (
+    AgentProc,
+    allocs_of,
+    running_allocs,
+    service_job,
+    wait_until,
+)
+
+
+@pytest.fixture(scope="module")
+def dev():
+    agent = AgentProc("-dev", "-no-gossip", name="dev")
+    yield agent
+    agent.stop()
+
+
+class TestJobLifecycle:
+    def test_run_update_stop(self, dev):
+        api = dev.api
+        job = service_job("e2e-life", count=2, command="sleep 300")
+        api.jobs.register(job)
+        wait_until(lambda: len(running_allocs(api, "e2e-life")) == 2,
+                   msg="2 allocs running")
+        # scale down via re-register
+        job["TaskGroups"][0]["Count"] = 1
+        api.jobs.register(job)
+        wait_until(lambda: len(running_allocs(api, "e2e-life")) == 1,
+                   msg="scaled to 1")
+        api.jobs.deregister("e2e-life")
+        wait_until(lambda: not running_allocs(api, "e2e-life"),
+                   msg="all stopped")
+
+
+class TestRescheduling:
+    def test_failed_alloc_rescheduled(self, dev):
+        """reference e2e/rescheduling: a dying task is replaced on a new
+        alloc rather than restarted forever in place."""
+        api = dev.api
+        job = service_job("e2e-resched", count=1, command="exit 1")
+        job["TaskGroups"][0]["Tasks"][0]["RestartPolicy"] = {
+            "Attempts": 0, "Mode": "fail", "IntervalNs": 5_000_000_000,
+            "DelayNs": 100_000_000,
+        }
+        job["TaskGroups"][0]["ReschedulePolicy"] = {
+            "Attempts": 2, "IntervalNs": 60_000_000_000,
+            "DelayNs": 500_000_000, "DelayFunction": "constant",
+            "Unlimited": False,
+        }
+        api.jobs.register(job)
+        wait_until(
+            lambda: len([a for a in allocs_of(api, "e2e-resched")
+                         if a["ClientStatus"] == "failed"]) >= 1
+            and len(allocs_of(api, "e2e-resched")) >= 2,
+            msg="failed alloc replaced by reschedule",
+        )
+        # replacements chain via PreviousAllocation/NextAllocation
+        allocs = allocs_of(api, "e2e-resched")
+        infos = [api.allocations.info(a["ID"])[0] for a in allocs]
+        assert any(i.get("PreviousAllocation") for i in infos), \
+            "reschedule links predecessor"
+
+
+class TestSpreadAcrossNodes:
+    def test_allocs_spread_on_two_clients(self):
+        """reference e2e/spread: a spread stanza distributes allocs
+        across client nodes (real server + 2 real client processes)."""
+        server = AgentProc("-server", "-no-gossip", name="spread-srv")
+        # discover the server's RPC address through its API
+        raft, _ = server.api.get("/v1/operator/raft/configuration")
+        rpc_addr = raft["Servers"][0]["Address"]
+        clients = [
+            AgentProc("-client", "-servers", rpc_addr, "-no-gossip",
+                      "-node-class", f"rack{i}", name=f"spread-c{i}")
+            for i in range(2)
+        ]
+        try:
+            api = server.api
+            wait_until(lambda: len((api.nodes.list()[0]) or []) == 2,
+                       timeout=180, msg="2 nodes registered")
+            job = service_job("e2e-spread", count=4, command="sleep 300")
+            job["TaskGroups"][0]["Spreads"] = [
+                {"Attribute": "${node.class}", "Weight": 100}
+            ]
+            api.jobs.register(job)
+            wait_until(lambda: len(running_allocs(api, "e2e-spread")) == 4,
+                       timeout=180, msg="4 allocs running")
+            nodes_used = {a["NodeID"] for a in running_allocs(api, "e2e-spread")}
+            assert len(nodes_used) == 2, "spread across both nodes"
+            per_node = [sum(1 for a in running_allocs(api, "e2e-spread")
+                            if a["NodeID"] == n) for n in nodes_used]
+            assert sorted(per_node) == [2, 2], f"even spread, got {per_node}"
+        finally:
+            for c in clients:
+                c.stop()
+            server.stop()
+
+
+class TestDeployment:
+    def test_rolling_update_completes(self, dev):
+        """reference e2e/deployment: an update stanza drives a rolling
+        deployment to 'successful'."""
+        api = dev.api
+        job = service_job("e2e-deploy", count=2, command="sleep 300")
+        job["TaskGroups"][0]["Update"] = {
+            "MaxParallel": 1, "MinHealthyTimeNs": 100_000_000,
+            "HealthyDeadlineNs": 30_000_000_000,
+        }
+        api.jobs.register(job)
+        wait_until(lambda: len(running_allocs(api, "e2e-deploy")) == 2,
+                   msg="initial rollout")
+        # destructive update → new deployment
+        job["TaskGroups"][0]["Tasks"][0]["Config"]["args"] = ["-c", "sleep 301"]
+        api.jobs.register(job)
+
+        def deployment_successful():
+            deps, _ = api.jobs.deployments("e2e-deploy")
+            return any(d["Status"] == "successful" and d["JobVersion"] >= 1
+                       for d in deps or [])
+
+        wait_until(deployment_successful, timeout=90,
+                   msg="rolling deployment successful")
+
+
+class TestClientState:
+    def test_hard_kill_recovery(self, tmp_path_factory):
+        """reference e2e/clientstate: kill -9 the agent; a restarted agent
+        with the same data dir re-attaches to the live task instead of
+        starting a second copy."""
+        data_dir = str(tmp_path_factory.mktemp("e2e-state"))
+        marker = os.path.join(data_dir, "counter")
+        agent = AgentProc("-dev", "-no-gossip", "-data-dir", data_dir,
+                          name="state-1")
+        try:
+            api = agent.api
+            # the task appends its pid once at start: a restarted task
+            # would append again
+            job = service_job(
+                "e2e-state", count=1,
+                command=f"echo $$ >> {marker}; sleep 600",
+            )
+            api.jobs.register(job)
+            wait_until(lambda: len(running_allocs(api, "e2e-state")) == 1,
+                       timeout=150, msg="alloc running")
+            wait_until(lambda: os.path.exists(marker), msg="task marker")
+            pid_before = open(marker).read().strip()
+
+            agent.kill_hard()
+            # the task itself survives the agent's death (detached)
+            assert open(marker).read().strip() == pid_before
+
+            agent2 = AgentProc("-dev", "-no-gossip", "-data-dir", data_dir,
+                               name="state-2")
+            try:
+                api2 = agent2.api
+                wait_until(lambda: len(running_allocs(api2, "e2e-state")) == 1,
+                           timeout=150, msg="alloc recovered after restart")
+                time.sleep(1.0)
+                assert open(marker).read().strip() == pid_before, \
+                    "task re-attached, not restarted"
+            finally:
+                agent2.stop()
+        finally:
+            agent.stop()
