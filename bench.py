@@ -24,14 +24,11 @@ def log(*args):
 
 
 def c1m_inputs(n_nodes=5000, total=1_000_000, n_tgs=8, seed=0):
-    """1M tiny containers over 5K nodes, every score term active."""
-    from nomad_tpu.tpu.engine import (
-        DIM_CPU,
-        DIM_MEM,
-        NUM_DIMS,
-        chunk_schedule,
-        example_scan_inputs,
-    )
+    """1M tiny containers over 5K nodes, every score term active.
+    Scores run in float32: the throughput scan's top-K ordering doesn't
+    need the parity path's float64 bit-exactness, and f64 is emulated on
+    TPU vector units."""
+    from nomad_tpu.tpu.engine import DIM_CPU, DIM_MEM, NUM_DIMS, example_scan_inputs
 
     n_pad, static, carry, _ = example_scan_inputs(
         n_nodes=n_nodes, n_tgs=n_tgs, n_placements=64, seed=seed
@@ -42,35 +39,68 @@ def c1m_inputs(n_nodes=5000, total=1_000_000, n_tgs=8, seed=0):
     asks[:, DIM_MEM] = 30
     static[2] = asks
     static[3] = np.ones_like(static[3])  # no constraint filtering in C1M
-    static = tuple(static)
-    tg_idx, want = chunk_schedule([(gi, total // n_tgs) for gi in range(n_tgs)])
-    return n_pad, static, carry, (tg_idx, want)
+
+    def f32(t):
+        return tuple(
+            np.asarray(a).astype(np.float32)
+            if np.asarray(a).dtype.kind == "f" else np.asarray(a)
+            for a in t
+        )
+
+    return n_pad, f32(static), f32(carry), None
+
+
+BULK_K = 1024  # big chunks clear ~88% of the load in few device steps
+TAIL_K = 256  # small chunks + deficit retries place the exact remainder
+
+
+def c1m_schedules(total=1_000_000, n_tgs=8, bulk_frac=0.88):
+    from nomad_tpu.tpu.engine import chunk_schedule
+
+    per_tg = total // n_tgs
+    bulk = int(per_tg * bulk_frac)
+    xs_bulk = chunk_schedule([(g, bulk) for g in range(n_tgs)], chunk=BULK_K)
+    xs_tail = chunk_schedule(
+        [(g, per_tg - bulk) for g in range(n_tgs)], chunk=TAIL_K, retry_rounds=12
+    )
+    return xs_bulk, xs_tail
 
 
 def bench_c1m():
+    """Hybrid two-phase scan: bulk top-1024 chunks, then top-256 chunks
+    with deficit-absorbing retries for the capacity-constrained tail."""
     from nomad_tpu.tpu.engine import _build_chunk_scan
 
-    scan = _build_chunk_scan()
+    scan_bulk = _build_chunk_scan(BULK_K)
+    scan_tail = _build_chunk_scan(TAIL_K)
     total = 1_000_000
+    xs_bulk, xs_tail = c1m_schedules(total)
 
-    n_pad, static, carry, xs = c1m_inputs(seed=0)
-    t0 = time.perf_counter()
-    out = scan(n_pad, static, carry, xs)
-    placed = int(np.asarray(out[1][3]).sum())
-    log(f"C1M compile+first run: {time.perf_counter()-t0:.1f}s placed={placed}")
+    def run(seed):
+        n_pad, static, carry, _ = c1m_inputs(seed=seed)
+        t0 = time.perf_counter()
+        mid_carry, deficit, out_b = scan_bulk(n_pad, static, carry, xs_bulk)
+        _, _, out_t = scan_tail(n_pad, static, mid_carry, xs_tail, deficit)
+        placed = int(np.asarray(out_b[3]).sum() + np.asarray(out_t[3]).sum())
+        return time.perf_counter() - t0, placed
+
+    t, placed = run(seed=0)
+    log(f"C1M compile+first run: {t:.1f}s placed={placed}")
 
     best = float("inf")
+    min_placed = placed
     for r in range(3):
-        n_pad, static, carry, xs = c1m_inputs(seed=100 + r)
-        t0 = time.perf_counter()
-        out = scan(n_pad, static, carry, xs)
-        placed = int(np.asarray(out[1][3]).sum())  # forces device->host sync
-        best = min(best, time.perf_counter() - t0)
+        t, placed = run(seed=100 + r)
+        best = min(best, t)
+        min_placed = min(min_placed, placed)
+    placed = min_placed
     rate = total / best
     log(
         f"C1M replay: {total:,} placements / 5K nodes in {best:.2f}s -> "
         f"{rate:,.0f} placements/s ({placed:,} placed)"
     )
+    if placed != total:
+        log(f"WARNING: placed {placed:,} != {total:,}")
     return rate, placed
 
 

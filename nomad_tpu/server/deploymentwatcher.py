@@ -189,9 +189,12 @@ class DeploymentsWatcher:
         # health transitions; without this a rolling update stalls after
         # its first batch)
         total_healthy = sum(ds.healthy_allocs for ds in d.task_groups.values())
-        prev = self._last_healthy.get(d.id)
+        # default 0, not None: a deployment first observed with healthy
+        # allocs already recorded (health landed before our first tick)
+        # must still kick the scheduler, or the rollout stalls forever
+        prev = self._last_healthy.get(d.id, 0)
         self._last_healthy[d.id] = total_healthy
-        if prev is not None and total_healthy > prev:
+        if total_healthy > prev:
             ev = self._make_eval(d, job)
             self.server.raft_apply("eval-update", [ev])
 

@@ -752,7 +752,7 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
 CHUNK_K = 128
 
 
-def _build_chunk_scan():
+def _build_chunk_scan(chunk_k: int = CHUNK_K):
     """Throughput-mode scan: each step places up to K instances of one task
     group on the top-K scoring distinct feasible nodes.
 
@@ -762,13 +762,21 @@ def _build_chunk_scan():
     semantics (kept in the parity scan) for ~K x fewer sequential device
     steps — the reference itself already subsamples candidates per placement
     (log2 N window), so chunked top-K dominates it on both quality and speed.
+
+    A per-TG DEFICIT rides an internal carry: a chunk that places fewer
+    than asked (feasible set momentarily smaller than K) rolls the
+    shortfall into that TG's later chunks — including want=0 retry steps
+    appended by ``chunk_schedule(retry_rounds=...)`` — so large chunk
+    sizes keep exact placement counts instead of dropping the tail.
     """
     import jax
     import jax.numpy as jnp
 
     jax.config.update("jax_enable_x64", True)
+    CHUNK = int(chunk_k)
 
-    def step(static, carry, x):
+    def step(static, carry_and_deficit, x):
+        carry, deficit = carry_and_deficit
         (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
          dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
          spread_has_targets, spread_active, sum_spread_weights, n_real) = static
@@ -826,9 +834,12 @@ def _build_chunk_scan():
 
         neg_inf = -jnp.inf
         masked = jnp.where(feasible, final, neg_inf)
-        top_scores, top_idx = jax.lax.top_k(masked, CHUNK_K)
-        valid = (jnp.arange(CHUNK_K, dtype=jnp.int32) < want) & (top_scores > neg_inf)
+        top_scores, top_idx = jax.lax.top_k(masked, CHUNK)
+        want_total = want + deficit[g]
+        want_eff = jnp.minimum(want_total, CHUNK)
+        valid = (jnp.arange(CHUNK, dtype=jnp.int32) < want_eff) & (top_scores > neg_inf)
         placed = jnp.sum(valid.astype(jnp.int32))
+        deficit = deficit.at[g].set(want_total - placed)
 
         vi = valid.astype(fdt)
         used = used.at[top_idx].add(ask[None, :] * vi[:, None])
@@ -841,27 +852,47 @@ def _build_chunk_scan():
 
         new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry, offset, failed)
         out = (top_idx, jnp.where(valid, top_scores, 0.0), valid, placed)
-        return new_carry, out
+        return (new_carry, deficit), out
 
     @partial(jax.jit, static_argnames=("n_pad",))
-    def chunk_scan(n_pad, static, init_carry, xs):
+    def chunk_scan(n_pad, static, init_carry, xs, deficit=None):
         import jax.lax as lax
 
-        return lax.scan(lambda c, x: step(static, c, x), init_carry, xs)
+        n_tgs = static[2].shape[0]
+        if deficit is None:
+            deficit = jnp.zeros(n_tgs, jnp.int32)
+        (carry, deficit_out), ys = lax.scan(
+            lambda c, x: step(static, c, x), (init_carry, deficit), xs
+        )
+        # deficit_out rides along so multi-phase schedules (bulk chunks →
+        # tail chunks) hand unfilled counts to the next phase
+        return carry, deficit_out, ys
 
     return chunk_scan
 
 
-def chunk_schedule(counts_by_tg, chunk: int = CHUNK_K):
-    """Expand per-TG placement counts into (tg_idx, want) step arrays, with
-    one retry round per TG to absorb capacity discovered mid-chunk."""
+def chunk_schedule(counts_by_tg, chunk: int = CHUNK_K, retry_rounds: int = 0):
+    """Expand per-TG placement counts into (tg_idx, want) step arrays.
+
+    ``retry_rounds`` appends want=0 sweeps per TG: the scan's deficit
+    carry drains any shortfall through them (capacity freed or discovered
+    after a TG's main chunks have passed), never over-placing — a want=0
+    step with zero deficit is a no-op."""
+    # round-robin across TGs: scheduling one TG to completion before the
+    # next starves the last TGs of capacity and piles the whole deficit on
+    # them; interleaving spreads both load and shortfall evenly
+    remaining = {gi: count for gi, count in counts_by_tg}
     tg_steps = []
-    for gi, count in counts_by_tg:
-        remaining = count
-        while remaining > 0:
-            take = min(remaining, chunk)
+    while any(v > 0 for v in remaining.values()):
+        for gi, _count in counts_by_tg:
+            if remaining[gi] <= 0:
+                continue
+            take = min(remaining[gi], chunk)
             tg_steps.append((gi, take))
-            remaining -= take
+            remaining[gi] -= take
+    for _ in range(max(0, retry_rounds)):
+        for gi, _count in counts_by_tg:
+            tg_steps.append((gi, 0))
     tg_idx = np.asarray([s[0] for s in tg_steps], np.int32)
     want = np.asarray([s[1] for s in tg_steps], np.int32)
     return tg_idx, want

@@ -35,6 +35,9 @@ class AgentProc:
     """One real agent process (testutil.TestServer)."""
 
     def __init__(self, *flags: str, name: str = "e2e") -> None:
+        import queue
+        import threading
+
         self.name = name
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "nomad_tpu.cli", "agent",
@@ -44,19 +47,37 @@ class AgentProc:
             env=_env(),
             text=True,
         )
-        self.http_addr = self._await_banner()
+        # a pump thread owns stdout for the process lifetime: the banner
+        # wait must be able to time out (readline blocks), and a chatty
+        # agent must never stall on a full pipe after the banner
         self.lines: List[str] = []
+        self._line_q: "queue.Queue[str]" = queue.Queue()
 
-    def _await_banner(self, timeout: float = 60.0) -> str:
+        def _pump() -> None:
+            try:
+                for line in self.proc.stdout:
+                    self.lines.append(line)
+                    self._line_q.put(line)
+            except (ValueError, OSError):
+                pass
+
+        threading.Thread(target=_pump, daemon=True,
+                         name=f"agent-pump-{name}").start()
+        self.http_addr = self._await_banner()
+
+    def _await_banner(self, timeout: float = 120.0) -> str:
+        import queue
+
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            line = self.proc.stdout.readline()
-            if not line:
+            try:
+                line = self._line_q.get(timeout=0.2)
+            except queue.Empty:
                 if self.proc.poll() is not None:
                     raise RuntimeError(
-                        f"agent {self.name} exited {self.proc.returncode}"
+                        f"agent {self.name} exited {self.proc.returncode}: "
+                        + "".join(self.lines[-10:])
                     )
-                time.sleep(0.05)
                 continue
             if "HTTP at" in line:
                 return line.rsplit(" ", 1)[1].strip()
@@ -104,7 +125,7 @@ def service_job(job_id: str, count: int = 1, command: str = "sleep",
         "Tasks": [{
             "Name": "t", "Driver": "raw_exec",
             "Config": {"command": "/bin/sh",
-                       "args": ["-c", command if args is None else command]},
+                       "args": ["-c", command] if args is None else args},
             "Resources": {"CPU": 50, "MemoryMB": 32},
         }],
     }
