@@ -29,6 +29,8 @@ class FSRoutes:
         mux.register("/v1/client/fs/cat/", self.cat)
         mux.register("/v1/client/fs/readat/", self.readat)
         mux.register("/v1/client/fs/logs/", self.logs)
+        mux.register("/v1/client/stats", self.host_stats)
+        mux.register("/v1/client/allocation/", self.alloc_stats)
 
     # -- helpers ---------------------------------------------------------
 
@@ -177,6 +179,120 @@ class FSRoutes:
         with open(path, "rb") as f:
             f.seek(offset)
             return f.read(max(0, limit))
+
+    def host_stats(self, req: Request):
+        """/v1/client/stats (reference command/agent/stats_endpoint.go →
+        ClientStats.Stats, ACL NodeRead): host CPU/memory/disk/uptime. On a
+        server agent, ?node_id= proxies to that node
+        (client_stats_endpoint.go rpcHandlerForNode)."""
+        self.agent.authorize(req, ("node:read",), "default")
+        if self.agent.client is None:
+            node_id = req.param("node_id")
+            server = self.agent.server
+            if not node_id or server is None:
+                raise HTTPError(404, "not a client node (pass ?node_id= on servers)")
+            node = server.fsm.state.node_by_id(node_id)
+            if node is None or not node.http_addr:
+                raise HTTPError(404, f"node {node_id} has no reachable HTTP address")
+            url = f"http://{node.http_addr}/v1/client/stats"
+            preq = urllib.request.Request(url)
+            if req.options.auth_token:
+                preq.add_header("X-Nomad-Token", req.options.auth_token)
+            try:
+                import json as json_mod
+
+                with urllib.request.urlopen(preq, timeout=30) as resp:
+                    return json_mod.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                raise HTTPError(e.code, e.read().decode(errors="replace"))
+            except OSError as e:
+                raise HTTPError(502, f"proxy to {node.http_addr} failed: {e}")
+        import os as os_mod
+        import shutil as shutil_mod
+        import time as time_mod
+
+        mem = {}
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    mem[k.strip()] = int(v.split()[0]) * 1024
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            load1, load5, load15 = os_mod.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = 0.0
+        try:
+            du = shutil_mod.disk_usage(self.agent.client.config.state_dir)
+            disk = {"Size": du.total, "Used": du.used, "Available": du.free,
+                    "UsedPercent": du.used / du.total * 100.0 if du.total else 0.0,
+                    "Device": self.agent.client.config.state_dir}
+        except OSError:
+            disk = {}
+        uptime = 0
+        try:
+            with open("/proc/uptime") as f:
+                uptime = int(float(f.read().split()[0]))
+        except (OSError, ValueError, IndexError):
+            pass
+        return {
+            "Timestamp": time_mod.time_ns(),
+            "Uptime": uptime,
+            "CPUTicksConsumed": 0.0,
+            "CPU": [{"CPU": f"cpu{i}"} for i in range(os_mod.cpu_count() or 1)],
+            "LoadAvg": {"Load1": load1, "Load5": load5, "Load15": load15},
+            "Memory": {
+                "Total": mem.get("MemTotal", 0),
+                "Available": mem.get("MemAvailable", 0),
+                "Used": max(0, mem.get("MemTotal", 0) - mem.get("MemAvailable", 0)),
+                "Free": mem.get("MemFree", 0),
+            },
+            "DiskStats": [disk] if disk else [],
+        }
+
+    def alloc_stats(self, req: Request):
+        """/v1/client/allocation/<id>/stats (reference
+        client_allocations_endpoint.go Stats): per-task resource usage
+        aggregated from the drivers."""
+        rest = _tail(req, "/v1/client/allocation/")
+        alloc_id, _, verb = rest.partition("/")
+        if verb != "stats":
+            raise HTTPError(404, f"no handler for {req.path}")
+        self._authorize(req, alloc_id, "read-job")
+        client = self.agent.client
+        runner = client.allocrunners.get(alloc_id) if client is not None else None
+        if runner is None:
+            import json
+
+            return json.loads(self._proxy(req, alloc_id) or b"{}")
+        import time as time_mod
+
+        tasks = {}
+        total_cpu = 0.0
+        total_rss = 0
+        for name, tr in runner.task_runners.items():
+            try:
+                st = tr.driver.task_stats(tr.task_id)
+            except Exception:  # noqa: BLE001 — dead tasks have no stats
+                continue
+            tasks[name] = {
+                "ResourceUsage": {
+                    "CpuStats": {"Percent": st.cpu_percent},
+                    "MemoryStats": {"RSS": st.memory_rss_bytes},
+                },
+                "Timestamp": st.timestamp_ns,
+            }
+            total_cpu += st.cpu_percent
+            total_rss += st.memory_rss_bytes
+        return {
+            "ResourceUsage": {
+                "CpuStats": {"Percent": total_cpu},
+                "MemoryStats": {"RSS": total_rss},
+            },
+            "Tasks": tasks,
+            "Timestamp": time_mod.time_ns(),
+        }
 
     def logs(self, req: Request) -> bytes:
         """Non-follow log read across the rotated sequence

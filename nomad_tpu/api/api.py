@@ -270,6 +270,14 @@ class Nodes(_Sub):
     def info(self, node_id: str, q: Optional[QueryOptions] = None):
         return self.client.get(f"/v1/node/{node_id}", q)
 
+    def stats(self, node_id: str = "", q: Optional[QueryOptions] = None):
+        """Host stats (api/nodes.go Stats → /v1/client/stats); node_id
+        makes a server agent proxy to that node."""
+        q = q or QueryOptions()
+        if node_id:
+            q.params["node_id"] = node_id
+        return self.client.get("/v1/client/stats", q)
+
     def allocations(self, node_id: str, q: Optional[QueryOptions] = None):
         return self.client.get(f"/v1/node/{node_id}/allocations", q)
 
@@ -314,6 +322,10 @@ class Allocations(_Sub):
 
     def stop(self, alloc_id: str, q: Optional[QueryOptions] = None):
         return self.client.put(f"/v1/allocation/{alloc_id}/stop", {}, q)
+
+    def stats(self, alloc_id: str, q: Optional[QueryOptions] = None):
+        """Per-task resource usage (api/allocations.go Stats)."""
+        return self.client.get(f"/v1/client/allocation/{alloc_id}/stats", q)
 
 
 class AllocFS(_Sub):

@@ -213,3 +213,17 @@ class TestCrossNodeProxy:
         finally:
             client_agent.shutdown()
             server_agent.shutdown()
+
+
+class TestClientStats:
+    def test_host_and_alloc_stats(self, dev_agent):
+        agent, alloc, task = dev_agent
+        host = _get(agent, "/v1/client/stats")
+        # server-side proxying by node id hits the same node
+        host2 = _get(agent, f"/v1/client/stats?node_id={alloc.node_id}")
+        assert host2["Memory"]["Total"] == host["Memory"]["Total"]
+        assert host["Memory"]["Total"] > 0
+        assert "LoadAvg" in host and host["Uptime"] > 0
+        stats = _get(agent, f"/v1/client/allocation/{alloc.id}/stats")
+        assert task in stats["Tasks"]
+        assert stats["ResourceUsage"]["MemoryStats"]["RSS"] >= 0
