@@ -66,7 +66,29 @@ class FSRoutes:
             raise HTTPError(403, "path escapes allocation directory")
         return candidate
 
-    def _proxy(self, req: Request, alloc_id: str) -> bytes:
+    def _forward(self, req: Request, http_addr: str, path: str,
+                 method: str = "GET", body: bytes = b"") -> bytes:
+        """One node-addressed HTTP hop with token + query passthrough."""
+        query = urllib.parse.urlencode(
+            {k: v[0] for k, v in req.query.items()}, safe="/"
+        )
+        url = f"http://{http_addr}{path}"
+        if query:
+            url += f"?{query}"
+        preq = urllib.request.Request(url, method=method, data=body or None)
+        token = req.options.auth_token
+        if token:
+            preq.add_header("X-Nomad-Token", token)
+        try:
+            with urllib.request.urlopen(preq, timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise HTTPError(e.code, e.read().decode(errors="replace"))
+        except OSError as e:
+            raise HTTPError(502, f"proxy to {http_addr} failed: {e}")
+
+    def _proxy(self, req: Request, alloc_id: str, method: str = "GET",
+               body: bytes = b"") -> bytes:
         """Forward to the node that owns the alloc (client_fs_endpoint.go
         server→client hop)."""
         server = self.agent.server
@@ -82,24 +104,7 @@ class FSRoutes:
             )
         if node.http_addr == "{}:{}".format(*self.agent.http.addr):
             raise HTTPError(404, f"alloc {alloc_id} directory not found")
-        query = urllib.parse.urlencode(
-            {k: v[0] for k, v in req.query.items()}, safe="/"
-        )
-        url = f"http://{node.http_addr}{req.path}"
-        if query:
-            url += f"?{query}"
-        preq = urllib.request.Request(url)
-        token = req.options.auth_token
-        if token:
-            preq.add_header("X-Nomad-Token", token)
-        try:
-            with urllib.request.urlopen(preq, timeout=30) as resp:
-                data = resp.read()
-        except urllib.error.HTTPError as e:
-            raise HTTPError(e.code, e.read().decode(errors="replace"))
-        except OSError as e:
-            raise HTTPError(502, f"proxy to {node.http_addr} failed: {e}")
-        return data
+        return self._forward(req, node.http_addr, req.path, method, body)
 
     # -- handlers --------------------------------------------------------
 
@@ -186,27 +191,22 @@ class FSRoutes:
         server agent, ?node_id= proxies to that node
         (client_stats_endpoint.go rpcHandlerForNode)."""
         self.agent.authorize(req, ("node:read",), "default")
-        if self.agent.client is None:
-            node_id = req.param("node_id")
+        node_id = req.param("node_id")
+        local = self.agent.client
+        if node_id and (local is None or local.node.id != node_id):
+            # not (or not only) this node: hop to the target's agent
             server = self.agent.server
-            if not node_id or server is None:
-                raise HTTPError(404, "not a client node (pass ?node_id= on servers)")
+            if server is None:
+                raise HTTPError(404, f"node {node_id} is not this client")
             node = server.fsm.state.node_by_id(node_id)
             if node is None or not node.http_addr:
                 raise HTTPError(404, f"node {node_id} has no reachable HTTP address")
-            url = f"http://{node.http_addr}/v1/client/stats"
-            preq = urllib.request.Request(url)
-            if req.options.auth_token:
-                preq.add_header("X-Nomad-Token", req.options.auth_token)
-            try:
-                import json as json_mod
+            import json as json_mod
 
-                with urllib.request.urlopen(preq, timeout=30) as resp:
-                    return json_mod.loads(resp.read())
-            except urllib.error.HTTPError as e:
-                raise HTTPError(e.code, e.read().decode(errors="replace"))
-            except OSError as e:
-                raise HTTPError(502, f"proxy to {node.http_addr} failed: {e}")
+            return json_mod.loads(self._forward(
+                req, node.http_addr, "/v1/client/stats") or b"{}")
+        if local is None:
+            raise HTTPError(404, "not a client node (pass ?node_id= on servers)")
         import os as os_mod
         import shutil as shutil_mod
         import time as time_mod
@@ -252,11 +252,13 @@ class FSRoutes:
         }
 
     def alloc_stats(self, req: Request):
-        """/v1/client/allocation/<id>/stats (reference
-        client_allocations_endpoint.go Stats): per-task resource usage
-        aggregated from the drivers."""
+        """/v1/client/allocation/<id>/{stats,restart,signal,exec}
+        (reference client_allocations_endpoint.go + alloc_endpoint.go):
+        stats aggregation plus task lifecycle verbs."""
         rest = _tail(req, "/v1/client/allocation/")
         alloc_id, _, verb = rest.partition("/")
+        if verb in ("restart", "signal", "exec"):
+            return self._alloc_lifecycle(req, alloc_id, verb)
         if verb != "stats":
             raise HTTPError(404, f"no handler for {req.path}")
         self._authorize(req, alloc_id, "read-job")
@@ -293,6 +295,55 @@ class FSRoutes:
             "Tasks": tasks,
             "Timestamp": time_mod.time_ns(),
         }
+
+    def _alloc_lifecycle(self, req: Request, alloc_id: str, verb: str):
+        """restart/signal: alloc-lifecycle capability; exec: alloc-exec
+        (reference acl.NamespaceCapabilityAllocLifecycle / AllocExec)."""
+        cap = "alloc-exec" if verb == "exec" else "alloc-lifecycle"
+        self._authorize(req, alloc_id, cap)
+        client = self.agent.client
+        runner = client.allocrunners.get(alloc_id) if client is not None else None
+        if runner is None:
+            import json
+
+            return json.loads(self._proxy(req, alloc_id, method=req.method,
+                                          body=req.body) or b"{}")
+        body = {}
+        if req.body:
+            import json
+
+            try:
+                body = json.loads(req.body)
+            except ValueError:
+                raise HTTPError(400, "bad request body")
+        task = body.get("Task", "") or req.param("task", "")
+        if verb == "restart":
+            runner.restart_task(task)
+            return {"Index": 0}
+        if verb == "signal":
+            sig = body.get("Signal", "") or req.param("signal", "SIGTERM")
+            try:
+                runner.signal_task(task, sig)
+            except KeyError:
+                raise HTTPError(404, f"unknown task {task!r}")
+            except Exception as e:  # noqa: BLE001 — bad signal names are 400s
+                raise HTTPError(400, str(e))
+            return {"Index": 0}
+        # exec (one-shot, non-interactive)
+        cmd = body.get("Cmd") or []
+        if not task or not cmd:
+            raise HTTPError(400, "exec requires Task and Cmd")
+        try:
+            timeout_s = float(req.param("timeout", "30"))
+        except ValueError:
+            raise HTTPError(400, "timeout must be a number")
+        try:
+            output, code = runner.exec_task(task, cmd, timeout_s)
+        except KeyError:
+            raise HTTPError(404, f"unknown task {task!r}")
+        except Exception as e:  # noqa: BLE001 — driver may not support exec
+            raise HTTPError(400, str(e))
+        return {"Output": output.decode(errors="replace"), "ExitCode": code}
 
     def logs(self, req: Request) -> bytes:
         """Non-follow log read across the rotated sequence

@@ -327,6 +327,28 @@ class Allocations(_Sub):
         """Per-task resource usage (api/allocations.go Stats)."""
         return self.client.get(f"/v1/client/allocation/{alloc_id}/stats", q)
 
+    def restart(self, alloc_id: str, task: str = "", q=None):
+        """api/allocations.go Restart."""
+        return self.client.put(
+            f"/v1/client/allocation/{alloc_id}/restart", {"Task": task}, q
+        )
+
+    def signal(self, alloc_id: str, signal: str, task: str = "", q=None):
+        """api/allocations.go Signal."""
+        return self.client.put(
+            f"/v1/client/allocation/{alloc_id}/signal",
+            {"Signal": signal, "Task": task}, q,
+        )
+
+    def exec_task(self, alloc_id: str, task: str, cmd, timeout: float = 30.0, q=None):
+        """One-shot exec (the reference's alloc-exec, non-interactive)."""
+        q = q or QueryOptions()
+        q.params["timeout"] = str(timeout)
+        return self.client.post(
+            f"/v1/client/allocation/{alloc_id}/exec",
+            {"Task": task, "Cmd": list(cmd)}, q,
+        )
+
 
 class AllocFS(_Sub):
     """Alloc filesystem/log access (api/fs.go AllocFS)."""

@@ -78,7 +78,7 @@ _VALUE_FLAGS = {
     "address", "region", "namespace", "token", "job", "output", "type",
     "deadline", "meta", "payload", "name", "policy", "rules",
     "description", "bind", "http-port", "config", "version", "limit",
-    "per-page", "node-class", "datacenter", "task", "dc",
+    "per-page", "node-class", "datacenter", "task", "dc", "s",
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
     "servers",
 }
@@ -530,6 +530,65 @@ def cmd_alloc_fs(ctx: Ctx, args: List[str]) -> int:
     return 0
 
 
+def cmd_alloc_restart(ctx: Ctx, args: List[str]) -> int:
+    """nomad alloc restart <alloc-id> [task] (command/alloc_restart.go)."""
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad alloc restart <alloc-id> [task]")
+    match = _find_alloc(ctx, rest[0])
+    ctx.client.allocations.restart(match["ID"], rest[1] if len(rest) > 1 else "")
+    ctx.out(f'Allocation "{short_id(match["ID"])}" restarted')
+    return 0
+
+
+def cmd_alloc_signal(ctx: Ctx, args: List[str]) -> int:
+    """nomad alloc signal [-s SIGNAL] <alloc-id> [task]."""
+    flags, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad alloc signal [-s <signal>] <alloc-id> [task]")
+    match = _find_alloc(ctx, rest[0])
+    sig = flags.get("s", "SIGKILL")
+    ctx.client.allocations.signal(match["ID"], sig,
+                                  rest[1] if len(rest) > 1 else "")
+    ctx.out(f'Signalled allocation "{short_id(match["ID"])}" with {sig}')
+    return 0
+
+
+def cmd_alloc_exec(ctx: Ctx, args: List[str]) -> int:
+    """nomad alloc exec -task <name> <alloc-id> <cmd>... (one-shot).
+
+    Flag parsing stops at the alloc id: everything after is the command
+    verbatim (the command's own flags like ``sh -c`` must survive)."""
+    flags: Dict[str, str] = {}
+    i = 0
+    while i < len(args) and args[i].startswith("-"):
+        name = args[i].lstrip("-")
+        if "=" in name:
+            k, _, v = name.partition("=")
+            flags[k] = v
+            i += 1
+        elif i + 1 < len(args):
+            flags[name] = args[i + 1]
+            i += 2
+        else:
+            raise CLIError(f"flag -{name} needs a value")
+    rest = args[i:]
+    if len(rest) < 2:
+        raise CLIError("usage: nomad alloc exec [-task <name>] <alloc-id> <cmd>...")
+    match = _find_alloc(ctx, rest[0])
+    task = flags.get("task", "")
+    if not task:
+        alloc, _ = ctx.client.allocations.info(match["ID"])
+        tasks = sorted((alloc.get("TaskStates") or {}).keys())
+        if len(tasks) != 1:
+            raise CLIError("pass -task (have: %s)" % ", ".join(tasks))
+        task = tasks[0]
+    out, _ = ctx.client.allocations.exec_task(match["ID"], task, rest[1:])
+    if out.get("Output"):
+        ctx.out(out["Output"].rstrip("\n"))
+    return int(out.get("ExitCode", 0))
+
+
 def cmd_alloc_status(ctx: Ctx, args: List[str]) -> int:
     _, rest = _split_flags(args)
     if not rest:
@@ -863,7 +922,9 @@ COMMANDS: Dict[str, Callable[[Ctx, List[str]], int]] = {
     "node": cmd_node,
     "alloc": lambda c, a: _dispatch(
         c, a,
-        {"status": cmd_alloc_status, "logs": cmd_alloc_logs, "fs": cmd_alloc_fs},
+        {"status": cmd_alloc_status, "logs": cmd_alloc_logs, "fs": cmd_alloc_fs,
+         "restart": cmd_alloc_restart, "signal": cmd_alloc_signal,
+         "exec": cmd_alloc_exec},
         "alloc",
     ),
     "eval": lambda c, a: _dispatch(c, a, {"status": cmd_eval_status}, "eval"),
@@ -888,7 +949,15 @@ def main(argv: Optional[List[str]] = None, out: Callable[[str], None] = print) -
     argv = list(sys.argv[1:] if argv is None else argv)
     ctx = Ctx()
     ctx.out = out
-    # peel global flags wherever they appear
+    # a "--" terminator protects pass-through arguments (alloc exec
+    # commands) from global-flag peeling: nothing after it is ours
+    if "--" in argv:
+        cut = argv.index("--")
+        passthrough = argv[cut + 1:]
+        argv = argv[:cut]
+    else:
+        passthrough = []
+    # peel global flags wherever they appear (before any --)
     flags, rest = _split_flags(argv)
     _apply_global_flags(ctx, flags)
     # put non-global flags back for the subcommand (they were consumed;
@@ -906,6 +975,7 @@ def main(argv: Optional[List[str]] = None, out: Callable[[str], None] = print) -
                 skip = True
             continue
         cleaned.append(a)
+    cleaned.extend(passthrough)
     if not cleaned:
         out("usage: nomad <command> [args]")
         out("Commands: " + ", ".join(sorted(COMMANDS)))

@@ -188,6 +188,30 @@ class AllocRunner:
         if alloc.desired_status != ALLOC_DESIRED_RUN:
             self.stop()
 
+    def restart_task(self, task_name: str = "") -> None:
+        """Alloc.Restart: restart one task, or all (alloc_endpoint.go)."""
+        targets = (
+            [self.task_runners[task_name]] if task_name
+            else list(self.task_runners.values())
+        )
+        for tr in targets:
+            tr.restart()
+
+    def signal_task(self, task_name: str, sig: str) -> None:
+        """Alloc.Signal (alloc_endpoint.go Signal)."""
+        targets = (
+            [self.task_runners[task_name]] if task_name
+            else list(self.task_runners.values())
+        )
+        for tr in targets:
+            tr.driver.signal_task(tr.task_id, sig)
+
+    def exec_task(self, task_name: str, cmd, timeout_s: float = 30.0):
+        """One-shot exec in a task's context (the reference streams over a
+        websocket — alloc-exec here is non-interactive)."""
+        tr = self.task_runners[task_name]
+        return tr.driver.exec_task(tr.task_id, list(cmd), timeout_s)
+
     def stop(self) -> None:
         for tr in self.task_runners.values():
             tr.kill_requested.set()

@@ -118,6 +118,7 @@ class TaskRunner:
         self.state = TaskState(state=STATE_PENDING)
         self.events: List[TaskEvent] = []
         self.kill_requested = threading.Event()
+        self._user_restart = threading.Event()
         self.done = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -201,6 +202,10 @@ class TaskRunner:
                     f"exit_code={result.exit_code} signal={result.signal}",
                 )
             )
+            if self._user_restart.is_set():
+                self._user_restart.clear()
+                self._emit(TaskEvent(EV_RESTARTING, "user requested"))
+                continue  # unconditional, no policy attempt consumed
             behavior, wait_s = self.restart_tracker.next(result, failure=False)
             if behavior == "kill":
                 self._set_state(STATE_DEAD, failed=not result.successful())
@@ -415,7 +420,10 @@ class TaskRunner:
         self.done.wait(timeout=timeout)
 
     def restart(self) -> None:
-        """Restart in place (alloc restart CLI)."""
+        """User-requested in-place restart (alloc restart CLI). Bypasses
+        the restart policy counter — the reference's Alloc.Restart is
+        unconditional, not a policy event."""
+        self._user_restart.set()
         if self.handle is not None:
             try:
                 self.driver.stop_task(self.task_id, 5.0)

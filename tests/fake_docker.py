@@ -122,6 +122,8 @@ class FakeDocker:
             def do_POST(self):
                 path, _, query = self.path.partition("?")
                 params = dict(urllib.parse.parse_qsl(query))
+                body = self._body()  # always drain: replying with an
+                # unread request body makes the client's sendall race a RST
                 if path == "/images/create":
                     if outer.fail_pull:
                         return self._reply(500, {"message": "pull failed"})
@@ -130,7 +132,6 @@ class FakeDocker:
                         outer.images[image] = outer.images.get(image, 0) + 1
                     return self._reply(200, raw=b'{"status":"Downloaded"}')
                 if path == "/containers/create":
-                    body = self._body()
                     if body.get("Image") not in outer.images:
                         return self._reply(404, {"message": "no such image"})
                     c = FakeContainer(params.get("name", ""), body)

@@ -227,3 +227,38 @@ class TestClientStats:
         stats = _get(agent, f"/v1/client/allocation/{alloc.id}/stats")
         assert task in stats["Tasks"]
         assert stats["ResourceUsage"]["MemoryStats"]["RSS"] >= 0
+
+
+class TestAllocLifecycle:
+    def test_signal_and_exec(self, dev_agent):
+        import urllib.request as _ur
+
+        agent, alloc, task = dev_agent
+        # exec through the raw_exec driver
+        req = _ur.Request(
+            agent.http_addr + f"/v1/client/allocation/{alloc.id}/exec",
+            data=json.dumps({"Task": task, "Cmd": ["/bin/echo", "exec-ok"]}).encode(),
+            method="POST")
+        out = json.load(_ur.urlopen(req))
+        assert out["ExitCode"] == 0 and "exec-ok" in out["Output"]
+        # signal with a harmless signal
+        req = _ur.Request(
+            agent.http_addr + f"/v1/client/allocation/{alloc.id}/signal",
+            data=json.dumps({"Signal": "SIGCONT", "Task": task}).encode(),
+            method="PUT")
+        assert json.load(_ur.urlopen(req)) == {"Index": 0}
+
+    def test_cli_restart(self, dev_agent):
+        from nomad_tpu.cli.main import main as run_cli
+
+        agent, alloc, task = dev_agent
+        out = []
+        code = run_cli(["-address", agent.http_addr, "alloc", "restart",
+                        alloc.id[:8]], out.append)
+        assert code == 0 and any("restarted" in line for line in out)
+        # the task comes back up after the in-place restart
+        wait_until(
+            lambda: agent.server.fsm.state.alloc_by_id(alloc.id).client_status
+            == "running",
+            msg="task running again after restart",
+        )
