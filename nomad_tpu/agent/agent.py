@@ -55,6 +55,68 @@ class AgentConfig:
     servers: List[str] = field(default_factory=list)
 
 
+class _LeaderFailoverProxy:
+    """Client⇆server surface for a client colocated with a wire-raft
+    server: local calls first; writes rejected with NotLeader retry over
+    RPC against the gossip-learned leader (the reference client always
+    RPCs and the transport forwards — this keeps the fast local path for
+    reads and leader-mode)."""
+
+    def __init__(self, agent: "Agent", local) -> None:
+        self._agent = agent
+        self._local = local
+        self._remote = None
+        self._remote_lock = threading.Lock()
+
+    def _leader_remote(self):
+        from ..rpc.endpoints import RemoteServerProxy
+
+        addr = self._agent.rpc.leader_addr if self._agent.rpc else None
+        if addr is None:
+            raise RuntimeError("no known leader")
+        addr = tuple(addr)
+        # locked check-close-create: heartbeat/sync/vault threads all come
+        # through here concurrently, and a leader flap must not leak conns
+        with self._remote_lock:
+            if self._remote is not None and self._remote.rpc.addr != addr:
+                self._remote.close()
+                self._remote = None
+            if self._remote is None:
+                self._remote = RemoteServerProxy(*addr)
+            return self._remote
+
+    def close(self) -> None:
+        with self._remote_lock:
+            if self._remote is not None:
+                self._remote.close()
+                self._remote = None
+
+    def _call(self, name, *args):
+        # writes carry leader-side effects (heartbeat TTL timers live on
+        # the leader): route them there whenever we aren't it
+        if self._agent.server is not None and self._agent.server.is_leader:
+            return getattr(self._local, name)(*args)
+        return getattr(self._leader_remote(), name)(*args)
+
+    def register_node(self, node):
+        return self._call("register_node", node)
+
+    def heartbeat(self, node_id):
+        return self._call("heartbeat", node_id)
+
+    def pull_allocs(self, node_id, min_index, timeout):
+        return self._local.pull_allocs(node_id, min_index, timeout)  # local read
+
+    def update_allocs(self, allocs):
+        return self._call("update_allocs", allocs)
+
+    def alloc_info(self, alloc_id):
+        return self._local.alloc_info(alloc_id)
+
+    def derive_vault_token(self, alloc_id, task_name):
+        return self._call("derive_vault_token", alloc_id, task_name)
+
+
 class Agent:
     def __init__(
         self,
@@ -117,6 +179,10 @@ class Agent:
         if self.client is None and self.config.client_enabled:
             if self.server is not None:
                 proxy = ServerProxy(self.server)
+                if self.config.wire_raft:
+                    # a colocated client on a FOLLOWER can't write through
+                    # the in-process server; wrap with leader-RPC failover
+                    proxy = _LeaderFailoverProxy(self, proxy)
             elif self.config.servers:
                 from ..rpc.endpoints import RemoteServerProxy
                 from ..rpc.transport import RPCClient, RPCError
@@ -190,6 +256,9 @@ class Agent:
             bind_server(self.server, self.rpc)
             self.rpc.register("Region.List", self.regions)
             self.rpc.is_leader = lambda: self.server.is_leader
+            # follower workers dequeue from the leader through this
+            # (worker.go:161 Eval.Dequeue; address learned via gossip)
+            self.server.get_leader_rpc_addr = lambda: self.rpc.leader_addr
             if self.config.gossip_enabled:
                 from ..gossip.memberlist import resolve_advertise_host
 

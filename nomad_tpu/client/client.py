@@ -188,7 +188,17 @@ class Client:
 
             self.device_manager.on_devices_changed = _devices_changed
             self.device_manager.start()
-        self.heartbeat_ttl = self.proxy.register_node(self.node)
+        try:
+            self.heartbeat_ttl = self.proxy.register_node(self.node)
+        except Exception as e:  # noqa: BLE001 — no leader yet at boot
+            self.logger.warning(
+                "node registration failed (retrying in background): %s", e
+            )
+            t = threading.Thread(
+                target=self._register_retry_loop, name="client-register", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
         for target, name in (
             (self._heartbeat_loop, "heartbeat"),
             (self._watch_allocations, "watchallocs"),
@@ -197,6 +207,17 @@ class Client:
             t = threading.Thread(target=target, name=f"client-{name}", daemon=True)
             t.start()
             self._threads.append(t)
+
+    def _register_retry_loop(self) -> None:
+        """Keep trying to register until a leader exists
+        (client.go:1670 retryRegisterNode)."""
+        while not self._shutdown.wait(2.0):
+            try:
+                self.heartbeat_ttl = self.proxy.register_node(self.node)
+                self.logger.info("node registered")
+                return
+            except Exception as e:  # noqa: BLE001
+                self.logger.debug("registration retry failed: %s", e)
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -209,6 +230,9 @@ class Client:
             self.device_manager.stop()
         if self.plugin_catalog is not None:
             self.plugin_catalog.close()
+        close_proxy = getattr(self.proxy, "close", None)
+        if close_proxy is not None:
+            close_proxy()
         # stop the subprocess drivers this client owns
         with self._external_lock:
             instances = list(self._external_driver_instances.values())

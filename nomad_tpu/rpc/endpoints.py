@@ -69,6 +69,45 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Eval.List", lambda: state.evals())
     rpc.register("Eval.Allocations", state.allocs_by_eval)
 
+    # -- worker protocol (follower workers dequeue from the leader's
+    #    broker and submit plans to its queue: worker.go:161 Eval.Dequeue,
+    #    :277 Plan.Submit — the reference's horizontal scheduler scaling)
+    def eval_dequeue(schedulers, timeout: float):
+        ev, token = server.eval_broker.dequeue(schedulers, timeout=min(timeout, 2.0))
+        return [ev, token or ""]
+
+    rpc.register("Eval.Dequeue", eval_dequeue)
+    rpc.register("Eval.Ack", server.eval_broker.ack)
+    rpc.register("Eval.Nack", server.eval_broker.nack)
+
+    def eval_update(evals):
+        return server.raft_apply("eval-update", evals)[0]
+
+    rpc.register("Eval.Update", eval_update)
+
+    def eval_reblock(evaluation, token: str):
+        if server.eval_broker.outstanding(evaluation.id) != token:
+            raise ValueError(f"eval {evaluation.id} token mismatch")
+        server.raft_apply("eval-update", [evaluation])
+        server.blocked_evals.reblock(evaluation, token)
+
+    rpc.register("Eval.Reblock", eval_reblock)
+
+    def plan_submit(plan):
+        # pause the nack timer while the plan waits in the queue, exactly
+        # as the colocated worker does (worker.go:277)
+        server.eval_broker.pause_nack_timeout(plan.eval_id, plan.eval_token)
+        try:
+            pending = server.plan_queue.enqueue(plan)
+            return pending.future.result(timeout=60)
+        finally:
+            try:
+                server.eval_broker.resume_nack_timeout(plan.eval_id, plan.eval_token)
+            except Exception:  # noqa: BLE001 — eval may have been acked
+                pass
+
+    rpc.register("Plan.Submit", plan_submit)
+
     # -- Alloc ---------------------------------------------------------
     rpc.register("Alloc.GetAlloc", state.alloc_by_id)
     rpc.register("Alloc.List", lambda: state.allocs())

@@ -195,7 +195,13 @@ class RPCClient:
         *args: Any,
         no_forward: bool = False,
         region: Optional[str] = None,
+        timeout: Optional[float] = None,
+        no_retry: bool = False,
     ) -> Any:
+        """``timeout`` overrides the connection timeout for this call;
+        ``no_retry`` disables the reconnect-resend (required for
+        non-idempotent calls like Plan.Submit, where a resend would
+        enqueue the work twice)."""
         with self._lock:
             self._seq += 1
             req = {"seq": self._seq, "method": method, "body": tuple(args)}
@@ -205,14 +211,31 @@ class RPCClient:
                 req["region"] = region
             try:
                 sock = self._connect()
-                _send_frame(sock, encode(req))
-                resp = decode(_recv_frame(sock))
+                if timeout is not None:
+                    sock.settimeout(timeout)
+                try:
+                    _send_frame(sock, encode(req))
+                    resp = decode(_recv_frame(sock))
+                finally:
+                    if timeout is not None:
+                        sock.settimeout(self.timeout)
             except (ConnectionError, OSError):
-                # one reconnect attempt (pool behavior on dead conns)
                 self._close_locked()
+                if no_retry:
+                    raise
+                # one reconnect attempt (pool behavior on dead conns)
                 sock = self._connect()
-                _send_frame(sock, encode(req))
-                resp = decode(_recv_frame(sock))
+                if timeout is not None:
+                    sock.settimeout(timeout)
+                try:
+                    _send_frame(sock, encode(req))
+                    resp = decode(_recv_frame(sock))
+                finally:
+                    if timeout is not None:
+                        try:
+                            sock.settimeout(self.timeout)
+                        except OSError:
+                            pass
         if resp.get("error"):
             raise RPCError(resp["error"])
         return resp.get("body")
@@ -228,3 +251,31 @@ class RPCClient:
     def close(self) -> None:
         with self._lock:
             self._close_locked()
+
+
+class LeaderConn:
+    """Thread-safe cache of one RPCClient keyed on the (changing) leader
+    address: get() reconnects when the address moves, close() tears down.
+    Shared by everything that follows the leader (follower workers, the
+    colocated-client failover proxy, RPC write forwarding)."""
+
+    def __init__(self, timeout: float = 30.0) -> None:
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._client: Optional[RPCClient] = None
+
+    def get(self, addr) -> RPCClient:
+        addr = tuple(addr)
+        with self._lock:
+            if self._client is not None and self._client.addr != addr:
+                self._client.close()
+                self._client = None
+            if self._client is None:
+                self._client = RPCClient(*addr, timeout=self.timeout)
+            return self._client
+
+    def close(self) -> None:
+        with self._lock:
+            if self._client is not None:
+                self._client.close()
+                self._client = None

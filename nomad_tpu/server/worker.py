@@ -31,6 +31,11 @@ class Worker:
         # set per-eval while scheduling
         self._eval_token = ""
         self._snapshot_index = 0
+        # follower mode: RPC connection to the leader's broker/plan queue
+        from ..rpc.transport import LeaderConn
+
+        self._remote = LeaderConn(timeout=30.0)
+        self._active_remote = None
         self.stats = {"evals_processed": 0, "plans_submitted": 0, "nacks": 0}
 
     def start(self) -> None:
@@ -44,20 +49,76 @@ class Worker:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self._close_remote()
 
     # ------------------------------------------------------------------
+
+    # -- remote (follower) mode ------------------------------------------
+    # Followers run schedulers too (worker.go runs on every server): they
+    # dequeue from the LEADER's broker and submit plans to its queue over
+    # RPC, scheduling against their own replicated state snapshot.
+
+    def _leader_rpc(self):
+        """RPC client to the current leader, or None when we are the
+        leader / no leader is known. Reconnects on leader change."""
+        if self.server.is_leader:
+            self._close_remote()
+            return None
+        get_addr = getattr(self.server, "get_leader_rpc_addr", None)
+        addr = get_addr() if get_addr is not None else None
+        if not addr:
+            self._close_remote()
+            return None
+        return self._remote.get(addr)
+
+    def _close_remote(self) -> None:
+        self._remote.close()
+
+    @staticmethod
+    def _map_remote_error(e) -> None:
+        """Benign broker token races cross the wire as error strings;
+        re-raise them as their local exception types so the run loop's
+        handling stays identical in both modes."""
+        msg = str(e)
+        if "NotOutstandingError" in msg:
+            raise NotOutstandingError(msg) from e
+        if "TokenMismatchError" in msg or "token mismatch" in msg:
+            raise TokenMismatchError(msg) from e
+        raise e
 
     def _run(self) -> None:
         schedulers = BUILTIN_SCHEDULERS + [CORE_SCHEDULER]
         while not self._stop.is_set():
-            evaluation, token = self.server.eval_broker.dequeue(schedulers, timeout=0.25)
+            try:
+                remote = self._leader_rpc()
+            except Exception:  # noqa: BLE001
+                remote = None
+            self._active_remote = remote
+            try:
+                if remote is not None:
+                    # core (GC) evals mutate raft directly and only run on
+                    # the leader; followers dequeue the builtin types only
+                    evaluation, token = remote.call(
+                        "Eval.Dequeue", BUILTIN_SCHEDULERS, 1.0, no_forward=True
+                    )
+                    token = token or ""
+                else:
+                    evaluation, token = self.server.eval_broker.dequeue(
+                        schedulers, timeout=0.25
+                    )
+            except Exception:  # noqa: BLE001 — leader gone mid-poll
+                self._close_remote()
+                self._stop.wait(0.5)
+                continue
             if evaluation is None:
+                if remote is not None:
+                    self._stop.wait(0.1)
                 continue
             metrics.incr_counter("nomad.worker.dequeue_eval")
             self._eval_token = token
             try:
                 self._process(evaluation, token)
-                self.server.eval_broker.ack(evaluation.id, token)
+                self._ack(evaluation.id, token)
                 self.stats["evals_processed"] += 1
             except (NotOutstandingError, TokenMismatchError):
                 pass
@@ -65,9 +126,31 @@ class Worker:
                 self.logger.exception("eval %s failed", evaluation.id)
                 self.stats["nacks"] += 1
                 try:
-                    self.server.eval_broker.nack(evaluation.id, token)
-                except (NotOutstandingError, TokenMismatchError):
+                    self._nack(evaluation.id, token)
+                except Exception:  # noqa: BLE001
                     pass
+
+    def _ack(self, eval_id: str, token: str) -> None:
+        if self._active_remote is not None:
+            from ..rpc.transport import RPCError
+
+            try:
+                self._active_remote.call("Eval.Ack", eval_id, token, no_forward=True)
+            except RPCError as e:
+                self._map_remote_error(e)
+        else:
+            self.server.eval_broker.ack(eval_id, token)
+
+    def _nack(self, eval_id: str, token: str) -> None:
+        if self._active_remote is not None:
+            from ..rpc.transport import RPCError
+
+            try:
+                self._active_remote.call("Eval.Nack", eval_id, token, no_forward=True)
+            except RPCError as e:
+                self._map_remote_error(e)
+        else:
+            self.server.eval_broker.nack(eval_id, token)
 
     def _process(self, evaluation: Evaluation, token: str) -> None:
         if evaluation.type == CORE_SCHEDULER:
@@ -101,18 +184,30 @@ class Worker:
         # the newest index — the plan applier uses this to decide how much
         # optimistic re-validation the plan needs
         plan.snapshot_index = self._snapshot_index
-        self.server.eval_broker.pause_nack_timeout(plan.eval_id, self._eval_token)
-        try:
-            pending = self.server.plan_queue.enqueue(plan)
-            result: PlanResult = pending.future.result(timeout=60)
-        finally:
+        if self._active_remote is not None:
+            # the leader-side handler waits up to 60s on the plan queue;
+            # the socket must outlast it, and a resend would enqueue the
+            # plan twice — fail instead
+            result: PlanResult = self._active_remote.call(
+                "Plan.Submit", plan, no_forward=True, timeout=90.0, no_retry=True
+            )
+        else:
+            self.server.eval_broker.pause_nack_timeout(plan.eval_id, self._eval_token)
             try:
-                self.server.eval_broker.resume_nack_timeout(plan.eval_id, self._eval_token)
-            except (NotOutstandingError, TokenMismatchError):
-                pass
+                pending = self.server.plan_queue.enqueue(plan)
+                result = pending.future.result(timeout=60)
+            finally:
+                try:
+                    self.server.eval_broker.resume_nack_timeout(
+                        plan.eval_id, self._eval_token
+                    )
+                except (NotOutstandingError, TokenMismatchError):
+                    pass
         self.stats["plans_submitted"] += 1
 
         if result.refresh_index:
+            # the follower's replicated state catches up to the leader's
+            # commit; schedulers always refresh from LOCAL state
             new_state = self.server.fsm.state.snapshot_min_index(result.refresh_index)
             self._snapshot_index = new_state.latest_index
             return result, new_state
@@ -120,6 +215,9 @@ class Worker:
 
     def update_eval(self, evaluation: Evaluation) -> None:
         evaluation.update_modify_time()
+        if self._active_remote is not None:
+            self._active_remote.call("Eval.Update", [evaluation], no_forward=True)
+            return
         self.server.raft_apply(EVAL_UPDATE, [evaluation])
 
     def create_eval(self, evaluation: Evaluation) -> None:
@@ -130,11 +228,25 @@ class Worker:
         if not evaluation.snapshot_index:
             evaluation.snapshot_index = self._snapshot_index
         evaluation.update_modify_time()
+        if self._active_remote is not None:
+            self._active_remote.call("Eval.Update", [evaluation], no_forward=True)
+            return
         self.server.raft_apply(EVAL_UPDATE, [evaluation])
 
     def reblock_eval(self, evaluation: Evaluation) -> None:
         # Update in raft so a leader change re-blocks it, then re-insert
         # into the in-memory tracker (reference worker.go:426).
+        if self._active_remote is not None:
+            from ..rpc.transport import RPCError
+
+            evaluation.update_modify_time()
+            try:
+                self._active_remote.call(
+                    "Eval.Reblock", evaluation, self._eval_token, no_forward=True
+                )
+            except RPCError as e:
+                self._map_remote_error(e)
+            return
         token = self.server.eval_broker.outstanding(evaluation.id)
         if token != self._eval_token:
             raise TokenMismatchError(evaluation.id)
