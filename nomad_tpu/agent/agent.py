@@ -53,6 +53,11 @@ class AgentConfig:
     # client-only agents dial these server RPC addrs ("host:port") —
     # reference client config `servers` list
     servers: List[str] = field(default_factory=list)
+    # mutual TLS for the RPC plane (reference agent `tls` stanza +
+    # helper/tlsutil): all three paths required to enable
+    tls_ca_file: str = ""
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
 
 
 class _LeaderFailoverProxy:
@@ -82,7 +87,9 @@ class _LeaderFailoverProxy:
                 self._remote.close()
                 self._remote = None
             if self._remote is None:
-                self._remote = RemoteServerProxy(*addr)
+                self._remote = RemoteServerProxy(
+                    *addr, tls=self._agent.tls
+                )
             return self._remote
 
     def close(self) -> None:
@@ -132,6 +139,20 @@ class Agent:
         self.server: Optional[Server] = server
         self.client: Optional[Client] = client
         self.wire_raft = None
+        self.tls = None
+        tls_parts = (self.config.tls_ca_file, self.config.tls_cert_file,
+                     self.config.tls_key_file)
+        if any(tls_parts):
+            if not all(tls_parts):
+                # a half-configured stanza silently serving plaintext is
+                # the worst failure mode mTLS can have
+                raise ValueError(
+                    "TLS requires all of tls_ca_file, tls_cert_file and "
+                    "tls_key_file (got a partial set)"
+                )
+            from ..rpc.transport import TLSConfig
+
+            self.tls = TLSConfig(*tls_parts)
         # the RPC listener binds before the server exists: wire raft needs
         # its address to register handlers, and peers need it to dial us
         self.rpc = None
@@ -139,7 +160,8 @@ class Agent:
             from ..rpc.transport import RPCServer
 
             self.rpc = RPCServer(
-                self.config.rpc_bind, self.config.rpc_port, region=self.config.region
+                self.config.rpc_bind, self.config.rpc_port,
+                region=self.config.region, tls=self.tls,
             )
         if self.server is None and self.config.server_enabled:
             raft = None
@@ -199,7 +221,7 @@ class Agent:
                 # failover is per-call in the reference; this picks at boot)
                 chosen = addrs[0]
                 for addr in addrs:
-                    probe = RPCClient(*addr, timeout=3.0)
+                    probe = RPCClient(*addr, timeout=3.0, tls=self.tls)
                     try:
                         probe.call("Status.ping")
                         chosen = addr
@@ -208,7 +230,7 @@ class Agent:
                         continue
                     finally:
                         probe.close()
-                proxy = RemoteServerProxy(*chosen)
+                proxy = RemoteServerProxy(*chosen, tls=self.tls)
             else:
                 raise ValueError(
                     "client-only agents need -servers addresses or a server"
@@ -259,6 +281,7 @@ class Agent:
             # follower workers dequeue from the leader through this
             # (worker.go:161 Eval.Dequeue; address learned via gossip)
             self.server.get_leader_rpc_addr = lambda: self.rpc.leader_addr
+            self.server.rpc_tls = self.tls
             if self.config.gossip_enabled:
                 from ..gossip.memberlist import resolve_advertise_host
 

@@ -79,6 +79,7 @@ _VALUE_FLAGS = {
     "deadline", "meta", "payload", "name", "policy", "rules",
     "description", "bind", "http-port", "config", "version", "limit",
     "per-page", "node-class", "datacenter", "task", "dc", "s",
+    "ca-file", "cert-file", "key-file",
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
     "servers",
 }
@@ -134,6 +135,9 @@ def cmd_agent(ctx: Ctx, args: List[str]) -> int:
         acl_enabled=_truthy(flags, "acl-enabled"),
         enable_debug=_truthy(flags, "enable-debug"),
         gossip_enabled=not _truthy(flags, "no-gossip"),
+        tls_ca_file=flags.get("ca-file", ""),
+        tls_cert_file=flags.get("cert-file", ""),
+        tls_key_file=flags.get("key-file", ""),
     )
     agent = Agent(cfg)
     agent.start()

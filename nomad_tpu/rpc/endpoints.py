@@ -140,10 +140,10 @@ class RemoteServerProxy:
     """Client-side server connection over the wire (client/rpc.go) —
     drop-in for the in-process ``client.ServerProxy``."""
 
-    def __init__(self, host: str, port: int) -> None:
-        self.rpc = RPCClient(host, port)
+    def __init__(self, host: str, port: int, tls=None) -> None:
+        self.rpc = RPCClient(host, port, tls=tls)
         # a second connection so long-poll pulls don't block status syncs
-        self.rpc_blocking = RPCClient(host, port, timeout=90.0)
+        self.rpc_blocking = RPCClient(host, port, timeout=90.0, tls=tls)
 
     def register_node(self, node: Node) -> float:
         return self.rpc.call("Node.Register", node)

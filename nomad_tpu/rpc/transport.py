@@ -14,6 +14,7 @@ import logging
 import random
 import socket
 import socketserver
+import ssl
 import struct
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -26,6 +27,51 @@ MAX_FRAME = 256 << 20
 
 class RPCError(Exception):
     pass
+
+
+class TLSConfig:
+    """Mutual-TLS material (reference helper/tlsutil + agent tls stanza):
+    one CA, one cert+key per agent, client certs required on both sides —
+    the reference's ``verify_server_hostname``-style posture for RPC."""
+
+    def __init__(self, ca_file: str, cert_file: str, key_file: str,
+                 verify: bool = True) -> None:
+        self.ca_file = ca_file
+        self.cert_file = cert_file
+        self.key_file = key_file
+        self.verify = verify
+        self._server_ctx: Optional[ssl.SSLContext] = None
+        self._client_ctx: Optional[ssl.SSLContext] = None
+        self._ctx_lock = threading.Lock()
+
+    def server_context(self) -> ssl.SSLContext:
+        # built once and shared: SSLContext is designed for reuse, and the
+        # per-connection path must not re-read key material from disk
+        with self._ctx_lock:
+            if self._server_ctx is None:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(self.cert_file, self.key_file)
+                ctx.load_verify_locations(self.ca_file)
+                if self.verify:
+                    ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+                self._server_ctx = ctx
+            return self._server_ctx
+
+    def client_context(self) -> ssl.SSLContext:
+        with self._ctx_lock:
+            if self._client_ctx is None:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                ctx.load_cert_chain(self.cert_file, self.key_file)
+                ctx.load_verify_locations(self.ca_file)
+                # cluster certs share a CA; hostname checks don't fit
+                # dynamic addresses (the reference pins
+                # "server.<region>.nomad" names)
+                ctx.check_hostname = False
+                ctx.verify_mode = (
+                    ssl.CERT_REQUIRED if self.verify else ssl.CERT_NONE
+                )
+                self._client_ctx = ctx
+            return self._client_ctx
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -52,8 +98,15 @@ def _recv_frame(sock: socket.socket) -> bytes:
 class RPCServer:
     """Dispatches "Noun.Verb" methods to registered handlers."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, region: str = "global") -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        region: str = "global",
+        tls: Optional["TLSConfig"] = None,
+    ) -> None:
         self.logger = logging.getLogger("nomad_tpu.rpc.server")
+        self.tls = tls
         self.handlers: Dict[str, Callable[..., Any]] = {}
         # set to (host, port) of the leader for transparent forwarding
         self.leader_addr: Optional[Tuple[str, int]] = None
@@ -72,13 +125,21 @@ class RPCServer:
             def handle(self) -> None:
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if outer.tls is not None:
+                    try:
+                        sock = outer.tls.server_context().wrap_socket(
+                            sock, server_side=True
+                        )
+                    except (OSError, ssl.SSLError) as e:
+                        outer.logger.debug("TLS handshake failed: %s", e)
+                        return
                 try:
                     while True:
                         frame = _recv_frame(sock)
                         req = decode(frame)
                         resp = outer._dispatch(req)
                         _send_frame(sock, encode(resp))
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError, ssl.SSLError):
                     pass
 
         class Server(socketserver.ThreadingTCPServer):
@@ -138,7 +199,7 @@ class RPCServer:
         if self._forward_pool is None or self._forward_pool.addr != self.leader_addr:
             if self._forward_pool is not None:
                 self._forward_pool.close()
-            self._forward_pool = RPCClient(*self.leader_addr)
+            self._forward_pool = RPCClient(*self.leader_addr, tls=self.tls)
         return self._forward_pool.call(method, *body, no_forward=True)
 
     def _forward_region(self, region: str, method: str, body) -> Any:
@@ -149,7 +210,7 @@ class RPCServer:
         with self._region_pools_lock:
             pool = self._region_pools.get(addr)
             if pool is None:
-                pool = self._region_pools[addr] = RPCClient(*addr)
+                pool = self._region_pools[addr] = RPCClient(*addr, tls=self.tls)
         # keep the region tag: the remote sees its own region and serves it
         return pool.call(method, *body, region=region)
 
@@ -175,9 +236,11 @@ class RPCClient:
     """Pooled client: one persistent connection, serialized calls
     (helper/pool ConnPool's role for a single peer)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 tls: Optional[TLSConfig] = None) -> None:
         self.addr = (host, port)
         self.timeout = timeout
+        self.tls = tls
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._seq = 0
@@ -186,6 +249,10 @@ class RPCClient:
         if self._sock is None:
             s = socket.create_connection(self.addr, timeout=self.timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.tls is not None:
+                s = self.tls.client_context().wrap_socket(
+                    s, server_hostname=self.addr[0]
+                )
             self._sock = s
         return self._sock
 
@@ -259,8 +326,10 @@ class LeaderConn:
     Shared by everything that follows the leader (follower workers, the
     colocated-client failover proxy, RPC write forwarding)."""
 
-    def __init__(self, timeout: float = 30.0) -> None:
+    def __init__(self, timeout: float = 30.0,
+                 tls: Optional[TLSConfig] = None) -> None:
         self.timeout = timeout
+        self.tls = tls
         self._lock = threading.Lock()
         self._client: Optional[RPCClient] = None
 
@@ -271,7 +340,7 @@ class LeaderConn:
                 self._client.close()
                 self._client = None
             if self._client is None:
-                self._client = RPCClient(*addr, timeout=self.timeout)
+                self._client = RPCClient(*addr, timeout=self.timeout, tls=self.tls)
             return self._client
 
     def close(self) -> None:

@@ -458,7 +458,8 @@ class WireRaft:
         if c is None:
             host, port = self.peers[peer_id]
             c = self._clients[peer_id] = RPCClient(
-                host, port, timeout=self.config.rpc_timeout
+                host, port, timeout=self.config.rpc_timeout,
+                tls=getattr(self.rpc, "tls", None),
             )
         return c
 

@@ -34,7 +34,9 @@ class Worker:
         # follower mode: RPC connection to the leader's broker/plan queue
         from ..rpc.transport import LeaderConn
 
-        self._remote = LeaderConn(timeout=30.0)
+        self._remote = LeaderConn(
+            timeout=30.0, tls=getattr(server, "rpc_tls", None)
+        )
         self._active_remote = None
         self.stats = {"evals_processed": 0, "plans_submitted": 0, "nacks": 0}
 

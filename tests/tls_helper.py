@@ -1,0 +1,32 @@
+"""Test-only CA + cert mint via the openssl CLI (the reference's
+helper/tlsutil test fixtures role)."""
+from __future__ import annotations
+
+import os
+import subprocess
+
+
+def make_cluster_certs(directory: str, names=("server", "client")) -> dict:
+    """One CA and one signed cert per name. Returns
+    {name: (ca, cert, key)} path tuples."""
+    os.makedirs(directory, exist_ok=True)
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True, cwd=directory)
+
+    ca_key = os.path.join(directory, "ca.key")
+    ca_crt = os.path.join(directory, "ca.crt")
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", ca_key, "-out", ca_crt, "-days", "1",
+        "-subj", "/CN=nomad-tpu-test-ca")
+    out = {}
+    for name in names:
+        key = os.path.join(directory, f"{name}.key")
+        csr = os.path.join(directory, f"{name}.csr")
+        crt = os.path.join(directory, f"{name}.crt")
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", key, "-out", csr, "-subj", f"/CN={name}.global.nomad")
+        run("openssl", "x509", "-req", "-in", csr, "-CA", ca_crt,
+            "-CAkey", ca_key, "-CAcreateserial", "-out", crt, "-days", "1")
+        out[name] = (ca_crt, crt, key)
+    return out
