@@ -58,6 +58,7 @@ class AgentConfig:
     tls_ca_file: str = ""
     tls_cert_file: str = ""
     tls_key_file: str = ""
+    tls_http: bool = False  # also serve the /v1 API over HTTPS (mTLS)
 
 
 class _LeaderFailoverProxy:
@@ -247,7 +248,10 @@ class Agent:
                 client_cfg.persist_state = True
             self.client = Client(proxy, client_cfg)
 
-        self.http = HTTPServer(self.config.http_bind, self.config.http_port)
+        self.http = HTTPServer(
+            self.config.http_bind, self.config.http_port,
+            tls=self.tls if self.config.tls_http else None,
+        )
         self.routes = Routes(self)
         self.routes.register_all(self.http)
         self.acl_resolver = None
@@ -349,7 +353,10 @@ class Agent:
                 http_host = resolve_advertise_host(
                     self.config.advertise_addr or self.http.addr[0]
                 )
-                self.client.node.http_addr = f"{http_host}:{self.http.addr[1]}"
+                addr = f"{http_host}:{self.http.addr[1]}"
+                if self.http.tls is not None:
+                    addr = f"https://{addr}"
+                self.client.node.http_addr = addr
                 self.client.start()
             self._started = True
         return self
@@ -462,9 +469,13 @@ class Agent:
                 self.wire_raft.remove_peer(meta.name)
 
     @property
+    def http_scheme(self) -> str:
+        return "https" if self.http.tls is not None else "http"
+
+    @property
     def http_addr(self) -> str:
         host, port = self.http.addr
-        return f"http://{host}:{port}"
+        return f"{self.http_scheme}://{host}:{port}"
 
     # -- surface used by routes ------------------------------------------
 

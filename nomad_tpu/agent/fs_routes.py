@@ -72,15 +72,19 @@ class FSRoutes:
         query = urllib.parse.urlencode(
             {k: v[0] for k, v in req.query.items()}, safe="/"
         )
-        url = f"http://{http_addr}{path}"
+        base = http_addr if "://" in http_addr else f"http://{http_addr}"
+        url = f"{base}{path}"
         if query:
             url += f"?{query}"
         preq = urllib.request.Request(url, method=method, data=body or None)
         token = req.options.auth_token
         if token:
             preq.add_header("X-Nomad-Token", token)
+        ctx = None
+        if url.startswith("https://") and self.agent.tls is not None:
+            ctx = self.agent.tls.client_context()
         try:
-            with urllib.request.urlopen(preq, timeout=30) as resp:
+            with urllib.request.urlopen(preq, timeout=30, context=ctx) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             raise HTTPError(e.code, e.read().decode(errors="replace"))
@@ -102,7 +106,7 @@ class FSRoutes:
             raise HTTPError(
                 404, f"node for alloc {alloc_id} has no reachable HTTP address"
             )
-        if node.http_addr == "{}:{}".format(*self.agent.http.addr):
+        if node.http_addr.split("://")[-1] == "{}:{}".format(*self.agent.http.addr):
             raise HTTPError(404, f"alloc {alloc_id} directory not found")
         return self._forward(req, node.http_addr, req.path, method, body)
 

@@ -94,12 +94,14 @@ Handler = Callable[[Request], Any]
 class HTTPServer:
     """Prefix-matching mux + JSON wrap, mirroring http.go's mux semantics."""
 
-    def __init__(self, bind: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, bind: str = "127.0.0.1", port: int = 0,
+                 tls=None) -> None:
         self._routes: List[Tuple[str, Handler]] = []
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._bind = bind
         self._port = port
+        self.tls = tls  # rpc.transport.TLSConfig: serve HTTPS with mTLS
 
     def register(self, prefix: str, handler: Handler) -> None:
         self._routes.append((prefix, handler))
@@ -207,6 +209,10 @@ class HTTPServer:
 
         self._server = ThreadingHTTPServer((self._bind, self._port), _Handler)
         self._server.daemon_threads = True
+        if self.tls is not None:
+            self._server.socket = self.tls.server_context().wrap_socket(
+                self._server.socket, server_side=True
+            )
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="http", daemon=True
         )

@@ -66,6 +66,24 @@ class Config:
     namespace: str = ""
     token: str = ""
     timeout: float = 65.0
+    # mutual-TLS material for https:// addresses (reference api.go
+    # TLSConfig; env NOMAD_CACERT / NOMAD_CLIENT_CERT / NOMAD_CLIENT_KEY)
+    ca_cert: str = ""
+    client_cert: str = ""
+    client_key: str = ""
+
+    def ssl_context(self):
+        if not self.address.startswith("https://"):
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        if self.ca_cert:
+            ctx.load_verify_locations(self.ca_cert)
+        if self.client_cert and self.client_key:
+            ctx.load_cert_chain(self.client_cert, self.client_key)
+        ctx.check_hostname = False
+        return ctx
 
 
 class Client:
@@ -129,7 +147,9 @@ class Client:
             headers["Content-Type"] = "application/json"
         req = urllib.request.Request(url, data=data, method=method, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.config.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.config.timeout, context=self.config.ssl_context()
+            ) as resp:
                 payload = resp.read()
                 meta = QueryMeta(
                     last_index=int(resp.headers.get("X-Nomad-Index") or 0),

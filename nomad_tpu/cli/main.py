@@ -34,6 +34,9 @@ class Ctx:
         self.region = os.environ.get("NOMAD_REGION", "")
         self.namespace = os.environ.get("NOMAD_NAMESPACE", "")
         self.token = os.environ.get("NOMAD_TOKEN", "")
+        self.ca_cert = os.environ.get("NOMAD_CACERT", "")
+        self.client_cert = os.environ.get("NOMAD_CLIENT_CERT", "")
+        self.client_key = os.environ.get("NOMAD_CLIENT_KEY", "")
         self.out: Callable[[str], None] = print
         self._client: Optional[Client] = None
 
@@ -46,6 +49,9 @@ class Ctx:
                     region=self.region,
                     namespace=self.namespace,
                     token=self.token,
+                    ca_cert=self.ca_cert,
+                    client_cert=self.client_cert,
+                    client_key=self.client_key,
                 )
             )
         return self._client
@@ -138,6 +144,7 @@ def cmd_agent(ctx: Ctx, args: List[str]) -> int:
         tls_ca_file=flags.get("ca-file", ""),
         tls_cert_file=flags.get("cert-file", ""),
         tls_key_file=flags.get("key-file", ""),
+        tls_http=_truthy(flags, "tls-http"),
     )
     agent = Agent(cfg)
     agent.start()

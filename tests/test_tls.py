@@ -113,3 +113,36 @@ class TestTLSCluster:
         finally:
             client_agent.shutdown()
             server_agent.shutdown()
+
+
+class TestHTTPSAgent:
+    def test_https_api_with_sdk(self, certs):
+        """The /v1 API over HTTPS with mTLS: SDK and endpoints work; a
+        client without certs is refused."""
+        from nomad_tpu.api import APIError, Client, Config
+        from nomad_tpu.agent.agent import Agent, AgentConfig
+
+        ca, crt, key = certs["server"]
+        agent = Agent(AgentConfig(
+            name="https", gossip_enabled=False, num_schedulers=0,
+            tls_ca_file=ca, tls_cert_file=crt, tls_key_file=key,
+            tls_http=True,
+        ))
+        try:
+            agent.start()
+            assert agent.http_addr.startswith("https://")
+            cca, ccrt, ckey = certs["client"]
+            api = Client(Config(address=agent.http_addr, ca_cert=cca,
+                                client_cert=ccrt, client_key=ckey))
+            jobs, _ = api.jobs.list()
+            assert jobs == []
+            info = api.agent.self()
+            if isinstance(info, tuple):
+                info = info[0]
+            assert info["config"]["NodeName"] == "https"
+            # no client cert → handshake refused
+            bare = Client(Config(address=agent.http_addr, ca_cert=cca))
+            with pytest.raises(APIError):
+                bare.jobs.list()
+        finally:
+            agent.shutdown()
