@@ -154,6 +154,10 @@ class Agent:
             from ..rpc.transport import TLSConfig
 
             self.tls = TLSConfig(*tls_parts)
+        if self.config.tls_http and self.tls is None:
+            raise ValueError(
+                "tls_http requires tls_ca_file/tls_cert_file/tls_key_file"
+            )
         # the RPC listener binds before the server exists: wire raft needs
         # its address to register handlers, and peers need it to dial us
         self.rpc = None
@@ -240,6 +244,7 @@ class Agent:
                 datacenter=self.config.datacenter,
                 node_class=self.config.node_class,
                 meta=dict(self.config.meta),
+                tls=self.tls,
             )
             if self.config.data_dir:
                 import os as _os

@@ -207,12 +207,33 @@ class HTTPServer:
             do_PUT = _handle
             do_DELETE = _handle
 
-        self._server = ThreadingHTTPServer((self._bind, self._port), _Handler)
-        self._server.daemon_threads = True
-        if self.tls is not None:
-            self._server.socket = self.tls.server_context().wrap_socket(
-                self._server.socket, server_side=True
-            )
+        tls_cfg = self.tls
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def finish_request(self, request, client_address):
+                # handshake in the per-connection thread: wrapping the
+                # LISTENER would run handshakes in the accept loop, where
+                # one stalled client freezes the whole API
+                if tls_cfg is not None:
+                    import ssl as ssl_mod
+
+                    try:
+                        request.settimeout(30)
+                        request = tls_cfg.server_context().wrap_socket(
+                            request, server_side=True
+                        )
+                        request.settimeout(None)
+                    except (OSError, ssl_mod.SSLError):
+                        try:
+                            request.close()
+                        except OSError:
+                            pass
+                        return
+                super().finish_request(request, client_address)
+
+        self._server = _Server((self._bind, self._port), _Handler)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="http", daemon=True
         )

@@ -71,10 +71,17 @@ class Config:
     ca_cert: str = ""
     client_cert: str = ""
     client_key: str = ""
+    # hostname verification is ON by default; cluster certs pinned to
+    # "<role>.<region>.nomad" names need the explicit opt-out (the
+    # reference CLI's -tls-skip-verify / api.TLSConfig.Insecure)
+    tls_skip_verify: bool = False
 
     def ssl_context(self):
         if not self.address.startswith("https://"):
             return None
+        cached = getattr(self, "_ssl_ctx", None)
+        if cached is not None:
+            return cached
         import ssl
 
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
@@ -82,7 +89,9 @@ class Config:
             ctx.load_verify_locations(self.ca_cert)
         if self.client_cert and self.client_key:
             ctx.load_cert_chain(self.client_cert, self.client_key)
-        ctx.check_hostname = False
+        if self.tls_skip_verify:
+            ctx.check_hostname = False
+        object.__setattr__(self, "_ssl_ctx", ctx)
         return ctx
 
 

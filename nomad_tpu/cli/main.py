@@ -37,6 +37,9 @@ class Ctx:
         self.ca_cert = os.environ.get("NOMAD_CACERT", "")
         self.client_cert = os.environ.get("NOMAD_CLIENT_CERT", "")
         self.client_key = os.environ.get("NOMAD_CLIENT_KEY", "")
+        self.tls_skip_verify = os.environ.get(
+            "NOMAD_TLS_SKIP_VERIFY", ""
+        ).lower() in ("1", "true", "yes")
         self.out: Callable[[str], None] = print
         self._client: Optional[Client] = None
 
@@ -52,6 +55,7 @@ class Ctx:
                     ca_cert=self.ca_cert,
                     client_cert=self.client_cert,
                     client_key=self.client_key,
+                    tls_skip_verify=self.tls_skip_verify,
                 )
             )
         return self._client

@@ -41,6 +41,7 @@ class PrevAllocWatcher:
         poll_interval: float = 0.2,
         timeout: float = 300.0,
         auth_token: str = "",
+        tls=None,  # rpc.transport.TLSConfig for https node addresses
     ) -> None:
         self.alloc = alloc
         self.prev_alloc_id = prev_alloc_id
@@ -50,6 +51,7 @@ class PrevAllocWatcher:
         self.poll_interval = poll_interval
         self.timeout = timeout
         self.auth_token = auth_token
+        self.tls = tls
 
     # -- the prerun hook --------------------------------------------------
 
@@ -169,11 +171,15 @@ class PrevAllocWatcher:
         fetch("/alloc/data", dest)
 
     def _remote_raw(self, http_addr: str, path: str, params: dict) -> bytes:
-        url = f"http://{http_addr}{path}?{urllib.parse.urlencode(params)}"
+        base = http_addr if "://" in http_addr else f"http://{http_addr}"
+        url = f"{base}{path}?{urllib.parse.urlencode(params)}"
         req = urllib.request.Request(url)
         if self.auth_token:
             req.add_header("X-Nomad-Token", self.auth_token)
-        with urllib.request.urlopen(req, timeout=30) as resp:
+        ctx = None
+        if url.startswith("https://") and self.tls is not None:
+            ctx = self.tls.client_context()
+        with urllib.request.urlopen(req, timeout=30, context=ctx) as resp:
             return resp.read()
 
     def _remote_json(self, http_addr: str, path: str, params: dict):

@@ -39,6 +39,7 @@ class ClientConfig:
     persist_state: bool = False
     heartbeat_grace: float = 0.5
     token: str = ""  # ACL token for server + cross-node fs calls
+    tls: Optional[object] = None  # TLSConfig for https node addresses
     # Consul agent address for task service registration (command/agent/
     # consul ServiceClient); empty = disabled
     consul: Optional[object] = None  # integrations.consul.ConsulConfig
@@ -366,6 +367,7 @@ class Client:
             alloc_dir_base=self.alloc_dir_base,
             remote_alloc_info=getattr(self.proxy, "alloc_info", None),
             auth_token=self.config.token,
+            tls=self.config.tls,
         ).wait_and_migrate
 
     def _add_alloc(self, alloc: Allocation) -> None:
