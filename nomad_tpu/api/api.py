@@ -406,12 +406,23 @@ class AllocFS(_Sub):
     def logs(self, alloc_id: str, task: str, log_type: str = "stdout",
              offset: int = 0, origin: str = "start",
              q: Optional[QueryOptions] = None) -> bytes:
+        data, _ = self.logs_at(alloc_id, task, log_type, offset, origin, q)
+        return data
+
+    def logs_at(self, alloc_id: str, task: str, log_type: str = "stdout",
+                offset: int = 0, origin: str = "start",
+                q: Optional[QueryOptions] = None):
+        """(data, next_offset): the server returns the next stream offset
+        in X-Nomad-Index so followers survive log rotation."""
         q = q or QueryOptions()
         q.params.update({
             "task": task, "type": log_type,
             "offset": str(offset), "origin": origin,
         })
-        return self.client.get_raw(f"/v1/client/fs/logs/{alloc_id}", q)
+        data, meta = self.client._do(
+            "GET", f"/v1/client/fs/logs/{alloc_id}", None, q, raw=True
+        )
+        return data, meta.last_index
 
 
 class Evaluations(_Sub):

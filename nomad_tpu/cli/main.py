@@ -89,7 +89,7 @@ _VALUE_FLAGS = {
     "deadline", "meta", "payload", "name", "policy", "rules",
     "description", "bind", "http-port", "config", "version", "limit",
     "per-page", "node-class", "datacenter", "task", "dc", "s",
-    "ca-file", "cert-file", "key-file",
+    "ca-file", "cert-file", "key-file", "n",
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
     "servers",
 }
@@ -503,11 +503,13 @@ def _find_alloc(ctx: Ctx, prefix: str) -> dict:
 
 
 def cmd_alloc_logs(ctx: Ctx, args: List[str]) -> int:
-    """nomad alloc logs [-stderr] [-task <name>] <alloc-id>
-    (reference command/alloc_logs.go)."""
+    """nomad alloc logs [-stderr] [-f] [-n <lines>] [-task <name>] <alloc-id>
+    (reference command/alloc_logs.go; -f polls the offset API)."""
     flags, rest = _split_flags(args)
     if not rest:
-        raise CLIError("usage: nomad alloc logs [-stderr] [-task <name>] <alloc-id>")
+        raise CLIError(
+            "usage: nomad alloc logs [-stderr] [-f] [-n <lines>] [-task <name>] <alloc-id>"
+        )
     match = _find_alloc(ctx, rest[0])
     task = flags.get("task") or (rest[1] if len(rest) > 1 else "")
     if not task:
@@ -519,9 +521,53 @@ def cmd_alloc_logs(ctx: Ctx, args: List[str]) -> int:
             )
         task = tasks[0]
     log_type = "stderr" if "stderr" in flags else "stdout"
-    data = ctx.client.alloc_fs.logs(match["ID"], task, log_type)
-    ctx.out(data.decode(errors="replace").rstrip("\n"))
-    return 0
+    if "n" in flags:
+        try:
+            n = int(flags["n"])
+        except ValueError:
+            raise CLIError("-n takes a line count")
+        # fetch a window back from the END so -n tails the real tail, not
+        # the first MB of a big log
+        data, offset = ctx.client.alloc_fs.logs_at(
+            match["ID"], task, log_type, offset=1 << 20, origin="end"
+        )
+        if n <= 0:
+            lines = []
+        else:
+            lines = data.split(b"\n")
+            if lines and lines[-1] == b"":
+                lines.pop()
+            lines = lines[-n:]
+        if lines:
+            ctx.out(b"\n".join(lines).decode(errors="replace"))
+    else:
+        data, offset = ctx.client.alloc_fs.logs_at(match["ID"], task, log_type)
+        if data.rstrip(b"\n"):
+            ctx.out(data.rstrip(b"\n").decode(errors="replace"))
+    if not _truthy(flags, "f"):
+        return 0
+    # follow: the server hands back the next stream offset, which stays
+    # valid across log rotation; buffer partial lines so mid-line and
+    # mid-UTF-8 poll boundaries don't mangle output
+    pending = b""
+    try:
+        sys.stdout.flush()
+        while True:
+            time.sleep(1.0)
+            chunk, offset = ctx.client.alloc_fs.logs_at(
+                match["ID"], task, log_type, offset=offset
+            )
+            if not chunk:
+                continue
+            pending += chunk
+            complete, sep, pending = pending.rpartition(b"\n")
+            if sep:
+                ctx.out(complete.decode(errors="replace"))
+                sys.stdout.flush()  # follow mode must stream when piped
+    except KeyboardInterrupt:
+        if pending:
+            ctx.out(pending.decode(errors="replace"))
+        return 0
 
 
 def cmd_alloc_fs(ctx: Ctx, args: List[str]) -> int:

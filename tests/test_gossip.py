@@ -29,7 +29,7 @@ def fast_config(name: str) -> MemberlistConfig:
     )
 
 
-def wait_until(fn, timeout=5.0, msg="condition"):
+def wait_until(fn, timeout=15.0, msg="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if fn():
