@@ -59,6 +59,10 @@ class AgentConfig:
     tls_cert_file: str = ""
     tls_key_file: str = ""
     tls_http: bool = False  # also serve the /v1 API over HTTPS (mTLS)
+    # verify the dialed server's cert SAN is "server.<region>.nomad" so a
+    # client-cert holder can't pose as a server (verify_server_hostname);
+    # requires role-named certs — disable for legacy address-named certs
+    tls_verify_server_hostname: bool = True
 
 
 class _LeaderFailoverProxy:
@@ -121,8 +125,10 @@ class _LeaderFailoverProxy:
     def alloc_info(self, alloc_id):
         return self._local.alloc_info(alloc_id)
 
-    def derive_vault_token(self, alloc_id, task_name):
-        return self._call("derive_vault_token", alloc_id, task_name)
+    def derive_vault_token(self, alloc_id, task_name, node_id="", node_secret=""):
+        return self._call(
+            "derive_vault_token", alloc_id, task_name, node_id, node_secret
+        )
 
 
 class Agent:
@@ -153,7 +159,11 @@ class Agent:
                 )
             from ..rpc.transport import TLSConfig
 
-            self.tls = TLSConfig(*tls_parts)
+            self.tls = TLSConfig(
+                *tls_parts,
+                server_name=f"server.{self.config.region}.nomad",
+                verify_server_hostname=self.config.tls_verify_server_hostname,
+            )
         if self.config.tls_http and self.tls is None:
             raise ValueError(
                 "tls_http requires tls_ca_file/tls_cert_file/tls_key_file"

@@ -82,7 +82,7 @@ class FSRoutes:
             preq.add_header("X-Nomad-Token", token)
         ctx = None
         if url.startswith("https://") and self.agent.tls is not None:
-            ctx = self.agent.tls.client_context()
+            ctx = self.agent.tls.http_client_context()
         try:
             with urllib.request.urlopen(preq, timeout=30, context=ctx) as resp:
                 return resp.read()

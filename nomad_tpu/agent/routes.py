@@ -386,7 +386,7 @@ class Routes:
         self._authorize(req, "node:read")
         return _blocking(
             req, self.state,
-            lambda s: _require(s.node_by_id(rest), f"node {rest!r}"),
+            lambda s: _require(s.node_by_id(rest), f"node {rest!r}").without_secret(),
         )
 
     def _node_evaluate(self, req: Request, node_id: str):

@@ -178,7 +178,7 @@ class PrevAllocWatcher:
             req.add_header("X-Nomad-Token", self.auth_token)
         ctx = None
         if url.startswith("https://") and self.tls is not None:
-            ctx = self.tls.client_context()
+            ctx = self.tls.http_client_context()
         with urllib.request.urlopen(req, timeout=30, context=ctx) as resp:
             return resp.read()
 

@@ -100,9 +100,13 @@ class ServerProxy:
             "node_http_addr": node.http_addr if node is not None else "",
         }
 
-    def derive_vault_token(self, alloc_id: str, task_name: str) -> str:
+    def derive_vault_token(
+        self, alloc_id: str, task_name: str, node_id: str = "", node_secret: str = ""
+    ) -> str:
         """Node.DeriveVaultToken (node_endpoint.go)."""
-        return self.server.derive_vault_token(alloc_id, [task_name])[task_name]
+        return self.server.derive_vault_token(
+            alloc_id, [task_name], node_id, node_secret
+        )[task_name]
 
 
 class Client:
@@ -351,7 +355,16 @@ class Client:
 
     def _vault_fn(self):
         fn = getattr(self.proxy, "derive_vault_token", None)
-        return fn
+        if fn is None:
+            return None
+        # bind this node's identity: the server verifies (node_id, secret)
+        # against the registered node before minting tokens
+        node = self.node
+
+        def derive(alloc_id: str, task_name: str) -> str:
+            return fn(alloc_id, task_name, node.id, node.secret_id)
+
+        return derive
 
     def _make_prev_watcher(self, alloc: Allocation):
         """Upstream-alloc hook: replacements await their predecessor and

@@ -9,12 +9,30 @@ malicious peer can only produce known struct types.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Dict, Type
 
 import msgpack
 
 _TYPE_KEY = "__t"
 _REGISTRY: Dict[str, Type] = {}
+_REGISTRY_READY = False
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _ensure_registry() -> None:
+    """Thread-safe one-time full registration. Gating on registry
+    non-emptiness is wrong twice over: a concurrent first call can observe
+    a PARTIALLY-filled registry mid-registration, and an early
+    register_struct() call would suppress full registration forever."""
+    global _REGISTRY_READY
+    if _REGISTRY_READY:
+        return
+    with _REGISTRY_LOCK:
+        if _REGISTRY_READY:
+            return
+        _register_all_structs()
+        _REGISTRY_READY = True
 
 
 def register_struct(cls: Type) -> Type:
@@ -62,6 +80,18 @@ def _register_all_structs() -> None:
     from ..client.allocdir import TaskDir
 
     _REGISTRY[TaskDir.__name__] = TaskDir
+
+    # ACL + operator payloads (ride raft snapshots and RPC)
+    from ..structs import acl as acl_structs
+
+    for name in dir(acl_structs):
+        obj = getattr(acl_structs, name)
+        if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+            _REGISTRY[obj.__name__] = obj
+
+    from ..server.autopilot import AutopilotConfig
+
+    _REGISTRY[AutopilotConfig.__name__] = AutopilotConfig
 
 
 def _to_wire(obj: Any) -> Any:
@@ -126,12 +156,10 @@ def _from_wire(obj: Any) -> Any:
 
 
 def encode(obj: Any) -> bytes:
-    if not _REGISTRY:
-        _register_all_structs()
+    _ensure_registry()
     return msgpack.packb(_to_wire(obj), use_bin_type=True)
 
 
 def decode(data: bytes) -> Any:
-    if not _REGISTRY:
-        _register_all_structs()
+    _ensure_registry()
     return _from_wire(msgpack.unpackb(data, raw=False, strict_map_key=False))

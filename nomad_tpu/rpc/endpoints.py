@@ -31,8 +31,13 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Node.UpdateDrain", server.update_node_drain)
     rpc.register("Node.UpdateEligibility", server.update_node_eligibility)
     rpc.register("Node.UpdateAlloc", server.update_allocs_from_client)
-    rpc.register("Node.List", lambda: state.nodes())
-    rpc.register("Node.GetNode", state.node_by_id)
+    rpc.register("Node.List", lambda: [n.without_secret() for n in state.nodes()])
+    rpc.register(
+        "Node.GetNode",
+        lambda node_id: (lambda n: n.without_secret() if n else None)(
+            state.node_by_id(node_id)
+        ),
+    )
 
     def get_client_allocs(node_id: str, min_index: int, timeout: float):
         def run(s):
@@ -160,8 +165,12 @@ class RemoteServerProxy:
     def update_allocs(self, allocs: List[Allocation]) -> None:
         self.rpc.call("Node.UpdateAlloc", allocs)
 
-    def derive_vault_token(self, alloc_id: str, task_name: str) -> str:
-        tokens = self.rpc.call("Node.DeriveVaultToken", alloc_id, [task_name])
+    def derive_vault_token(
+        self, alloc_id: str, task_name: str, node_id: str = "", node_secret: str = ""
+    ) -> str:
+        tokens = self.rpc.call(
+            "Node.DeriveVaultToken", alloc_id, [task_name], node_id, node_secret
+        )
         return tokens[task_name]
 
     def alloc_info(self, alloc_id: str):

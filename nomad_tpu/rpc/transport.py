@@ -31,18 +31,33 @@ class RPCError(Exception):
 
 class TLSConfig:
     """Mutual-TLS material (reference helper/tlsutil + agent tls stanza):
-    one CA, one cert+key per agent, client certs required on both sides —
-    the reference's ``verify_server_hostname``-style posture for RPC."""
+    one CA, one cert+key per agent, client certs required on both sides.
+
+    When ``server_name`` is set (e.g. ``server.<region>.nomad``) and
+    ``verify_server_hostname`` is true, RPC clients verify the server's
+    certificate SAN against that pinned name — so a mere cluster-CA cert
+    holder (a client agent's cert) cannot impersonate a server
+    (the reference's ``verify_server_hostname`` role pinning). Pass
+    ``verify_server_hostname=False`` to opt out (the
+    ``api.Config.tls_skip_verify`` posture)."""
 
     def __init__(self, ca_file: str, cert_file: str, key_file: str,
-                 verify: bool = True) -> None:
+                 verify: bool = True, server_name: str = "",
+                 verify_server_hostname: bool = True) -> None:
         self.ca_file = ca_file
         self.cert_file = cert_file
         self.key_file = key_file
         self.verify = verify
+        self.server_name = server_name
+        self.verify_server_hostname = verify_server_hostname
         self._server_ctx: Optional[ssl.SSLContext] = None
         self._client_ctx: Optional[ssl.SSLContext] = None
+        self._http_client_ctx: Optional[ssl.SSLContext] = None
         self._ctx_lock = threading.Lock()
+
+    @property
+    def pin_server_name(self) -> bool:
+        return bool(self.server_name) and self.verify_server_hostname and self.verify
 
     def server_context(self) -> ssl.SSLContext:
         # built once and shared: SSLContext is designed for reuse, and the
@@ -57,21 +72,32 @@ class TLSConfig:
                 self._server_ctx = ctx
             return self._server_ctx
 
+    def _build_client_ctx(self, check_hostname: bool) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        ctx.load_verify_locations(self.ca_file)
+        ctx.check_hostname = check_hostname
+        ctx.verify_mode = ssl.CERT_REQUIRED if self.verify else ssl.CERT_NONE
+        return ctx
+
     def client_context(self) -> ssl.SSLContext:
+        """Context for the RPC plane: pins the server SAN when
+        ``server_name`` is configured (dial with
+        ``server_hostname=self.server_name``, not the peer address)."""
         with self._ctx_lock:
             if self._client_ctx is None:
-                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-                ctx.load_cert_chain(self.cert_file, self.key_file)
-                ctx.load_verify_locations(self.ca_file)
-                # cluster certs share a CA; hostname checks don't fit
-                # dynamic addresses (the reference pins
-                # "server.<region>.nomad" names)
-                ctx.check_hostname = False
-                ctx.verify_mode = (
-                    ssl.CERT_REQUIRED if self.verify else ssl.CERT_NONE
-                )
-                self._client_ctx = ctx
+                self._client_ctx = self._build_client_ctx(self.pin_server_name)
             return self._client_ctx
+
+    def http_client_context(self) -> ssl.SSLContext:
+        """Context for intra-cluster HTTPS (log fetch, ephemeral-disk
+        migration): peers are client agents at dynamic addresses whose
+        certs carry role names, not IPs — certificate chain is still
+        verified against the cluster CA, hostname is not."""
+        with self._ctx_lock:
+            if self._http_client_ctx is None:
+                self._http_client_ctx = self._build_client_ctx(False)
+            return self._http_client_ctx
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -250,8 +276,13 @@ class RPCClient:
             s = socket.create_connection(self.addr, timeout=self.timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if self.tls is not None:
+                sni = (
+                    self.tls.server_name
+                    if self.tls.pin_server_name
+                    else self.addr[0]
+                )
                 s = self.tls.client_context().wrap_socket(
-                    s, server_hostname=self.addr[0]
+                    s, server_hostname=sni
                 )
             self._sock = s
         return self._sock

@@ -659,14 +659,32 @@ class Server:
 
     # -- vault (nomad/vault.go + node_endpoint.go DeriveVaultToken) ------
 
-    def derive_vault_token(self, alloc_id: str, task_names: List[str]) -> Dict[str, str]:
+    def derive_vault_token(
+        self,
+        alloc_id: str,
+        task_names: List[str],
+        node_id: str = "",
+        node_secret: str = "",
+    ) -> Dict[str, str]:
         """Create per-task Vault tokens for an alloc's tasks; accessors
-        are raft-tracked so the tokens are revoked when the alloc dies."""
+        are raft-tracked so the tokens are revoked when the alloc dies.
+
+        The caller must prove it is the node the alloc is placed on:
+        (node_id, node_secret) must match the registered node's secret and
+        the alloc must actually live there (node_endpoint.go:1370) —
+        otherwise any RPC caller could mint tokens for any policy set."""
         if self.vault is None:
             raise ValueError("Vault is not configured on this server")
+        node = self.fsm.state.node_by_id(node_id) if node_id else None
+        if node is None or not node_secret or node.secret_id != node_secret:
+            raise PermissionError("node secret mismatch")
         alloc = self.fsm.state.alloc_by_id(alloc_id)
         if alloc is None:
             raise KeyError(f"alloc {alloc_id!r} not found")
+        if alloc.node_id != node_id:
+            raise PermissionError(
+                f"alloc {alloc_id!r} is not placed on node {node_id!r}"
+            )
         if alloc.terminal_status():
             raise ValueError(f"alloc {alloc_id!r} is terminal")
         job = alloc.job or self.fsm.state.job_by_id(alloc.namespace, alloc.job_id)

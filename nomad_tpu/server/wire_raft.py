@@ -20,13 +20,14 @@ from __future__ import annotations
 import json
 import logging
 import os
-import pickle
 import random
 import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..rpc.codec import decode as codec_decode
+from ..rpc.codec import encode as codec_encode
 from ..rpc.transport import RPCClient, RPCError, RPCServer
 from .fsm import NomadFSM
 from .raft import NotLeaderError
@@ -34,6 +35,38 @@ from .raft import NotLeaderError
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
+
+
+def _encode_fsm_state(state_store) -> bytes:
+    """FSM snapshot → msgpack bytes through the typed struct codec.
+
+    Snapshots cross the wire in InstallSnapshot, so they must never be
+    pickled: arbitrary deserialization there would hand code execution to
+    any peer that can reach the RPC port (the reference ships snapshot
+    data as msgpack, nomad/fsm.go persist/restore)."""
+    return codec_encode(state_store.__getstate__())
+
+
+def _decode_fsm_state(blob: bytes):
+    from ..state import StateStore
+
+    store = StateStore.__new__(StateStore)
+    store.__setstate__(codec_decode(blob))
+    return store
+
+
+def _decode_disk_blob(blob: bytes):
+    """Decode a locally-persisted record (log entry / snapshot wrapper).
+
+    New writes are always codec-encoded; data dirs written by builds that
+    pickled local state still load (pickle is acceptable for LOCAL files
+    we wrote ourselves — the wire never carries it)."""
+    try:
+        return codec_decode(blob)
+    except Exception:  # noqa: BLE001 — legacy format
+        import pickle  # local-disk fallback only
+
+        return pickle.loads(blob)
 
 
 @dataclass
@@ -126,7 +159,7 @@ class WireRaft:
                 raise ValueError("wire raft hosts exactly one FSM")
             self.fsm = fsm
             if self._snapshot_state is not None:
-                fsm.restore(pickle.loads(self._snapshot_state))
+                fsm.restore(_decode_fsm_state(self._snapshot_state))
                 self.last_applied = self._snapshot_index
             # committed entries re-apply on restart via commit advancement;
             # a lone node (no peers) self-commits everything it has
@@ -172,7 +205,7 @@ class WireRaft:
             if index == 0:
                 return 0
             term = self._term_at(index)
-            state_blob = pickle.dumps(self.fsm.snapshot())
+            state_blob = _encode_fsm_state(self.fsm.snapshot())
             self._snapshot_state = state_blob
             self._snapshot_term = term
             self.log = [e for e in self.log if e[0] > index]
@@ -180,7 +213,7 @@ class WireRaft:
             if self._snapshot_path is not None:
                 tmp = self._snapshot_path + ".tmp"
                 with open(tmp, "wb") as f:
-                    f.write(pickle.dumps((index, term, state_blob)))
+                    f.write(codec_encode((index, term, state_blob)))
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, self._snapshot_path)
@@ -292,16 +325,25 @@ class WireRaft:
             self.voted_for = meta.get("voted_for")
         if self._snapshot_path and os.path.exists(self._snapshot_path):
             with open(self._snapshot_path, "rb") as f:
-                self._snapshot_index, self._snapshot_term, self._snapshot_state = (
-                    pickle.load(f)
-                )
+                index, term, state_blob = _decode_disk_blob(f.read())
+            try:
+                codec_decode(state_blob)
+            except Exception:  # noqa: BLE001 — legacy pickled StateStore:
+                # normalize now so restore and InstallSnapshot only ever
+                # see codec bytes
+                import pickle
+
+                state_blob = _encode_fsm_state(pickle.loads(state_blob))
+            self._snapshot_index = index
+            self._snapshot_term = term
+            self._snapshot_state = state_blob
         if self.store is not None:
             first, last = self.store.first_index, self.store.last_index
             for index in range(max(first, self._snapshot_index + 1), last + 1):
                 blob = self.store.get(index)
                 if blob is None:
                     continue
-                term, entry_type, payload = pickle.loads(blob)
+                term, entry_type, payload = _decode_disk_blob(blob)
                 self.log.append((index, term, entry_type, payload))
 
     def _persist_meta_locked(self) -> None:
@@ -317,7 +359,7 @@ class WireRaft:
         if self.store is not None:
             self.store.append(
                 index,
-                pickle.dumps((term, entry_type, payload)),
+                codec_encode((term, entry_type, payload)),
                 sync=self.config.sync_writes,
             )
 
@@ -535,9 +577,13 @@ class WireRaft:
                 if self.next_index[peer_id] <= self._last_index():
                     self._repl_cv.notify_all()  # more to send
             else:
-                # consistency check failed: back up (peer reports its last
-                # index as a hint to skip large gaps)
-                self.next_index[peer_id] = max(1, min(next_idx - 1, match + 1))
+                # consistency check failed: the hint is the peer's last
+                # index (back up past gaps) or its snapshot boundary (jump
+                # FORWARD — everything at or below it is committed there)
+                if match + 1 > next_idx:
+                    self.next_index[peer_id] = match + 1
+                else:
+                    self.next_index[peer_id] = max(1, min(next_idx - 1, match + 1))
                 self._repl_cv.notify_all()
 
     def _advance_commit_locked(self) -> None:
@@ -560,6 +606,10 @@ class WireRaft:
             self.last_applied += 1
             entry = self._entries_from(self.last_applied, 1)
             if not entry:
+                # entry not present (should be unreachable): roll back the
+                # counter so the index is retried rather than silently
+                # skipped — skipping would diverge the FSM from the log
+                self.last_applied -= 1
                 break
             index, term, entry_type, payload = entry[0]
             if entry_type == self.PEER_REMOVE:
@@ -603,11 +653,22 @@ class WireRaft:
                 and not self.log
             ):
                 self._config_replay_boundary = leader_commit
+            # prev below the snapshot boundary: the overlap is committed by
+            # definition, but our term knowledge was compacted — hint the
+            # snapshot index so the leader advances next_index past it (or
+            # falls back to InstallSnapshot) instead of backing up forever
+            if 0 < prev_index < self._snapshot_index:
+                return [self.current_term, False, self._snapshot_index]
             # consistency check
             if prev_index > 0 and self._term_at(prev_index) != prev_term:
                 return [self.current_term, False, min(self._last_index(), prev_index - 1)]
             for e in entries:
                 index, e_term, entry_type, payload = e
+                if index <= self._snapshot_index:
+                    # covered by the snapshot — committed by definition;
+                    # entering the truncation path here would compute a
+                    # negative slice position and wipe the whole tail
+                    continue
                 existing = self._term_at(index)
                 if existing == e_term:
                     continue  # already have it
@@ -650,7 +711,7 @@ class WireRaft:
                 # must be durable first or a crash loses committed state
                 tmp = self._snapshot_path + ".tmp"
                 with open(tmp, "wb") as f:
-                    f.write(pickle.dumps((last_index, last_term, state_blob)))
+                    f.write(codec_encode((last_index, last_term, state_blob)))
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, self._snapshot_path)
@@ -658,7 +719,7 @@ class WireRaft:
                 self.store.truncate_before(last_index + 1)
                 self.store.sync()
             if self.fsm is not None:
-                self.fsm.restore(pickle.loads(state_blob))
+                self.fsm.restore(_decode_fsm_state(state_blob))
             self.last_applied = last_index
             self.commit_index = max(self.commit_index, last_index)
             return self.current_term

@@ -374,6 +374,10 @@ class Node:
     """A client node (reference structs.go:1508)."""
 
     id: str = field(default_factory=generate_uuid)
+    # shared secret minted by the client at first boot; authenticates
+    # node-scoped RPCs like Node.DeriveVaultToken (structs.go Node.SecretID)
+    # — scrubbed from read endpoints, never returned to other callers
+    secret_id: str = field(default_factory=generate_uuid)
     name: str = ""
     datacenter: str = "dc1"
     node_class: str = ""
@@ -420,6 +424,16 @@ class Node:
         import copy as _copy
 
         return _copy.deepcopy(self)
+
+    def without_secret(self) -> "Node":
+        """Shallow copy with secret_id cleared — what read endpoints
+        return (node_endpoint.go GetNode clears SecretID before replying).
+        Shallow is safe: stored nodes are treated as immutable."""
+        if not self.secret_id:
+            return self
+        import dataclasses as _dc
+
+        return _dc.replace(self, secret_id="")
 
 
 # ---------------------------------------------------------------------------

@@ -146,6 +146,51 @@ class TestServerVaultLifecycle:
             server.register_job(job)
         server.stop()
 
+    def test_derive_requires_matching_node_secret(self, vault):
+        """DeriveVaultToken is node-authenticated: the caller must present
+        the placed node's secret_id, and the alloc must live on that node
+        (node_endpoint.go:1370) — otherwise any RPC caller could mint
+        tokens for any policy set."""
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.structs import Allocation
+
+        server = Server(ServerConfig(
+            num_schedulers=0,
+            vault=VaultConfig(enabled=True, address=vault.address, token="root"),
+        ))
+        try:
+            node = mock.node()
+            other = mock.node()
+            server.register_node(node)
+            server.register_node(other)
+            job = mock.job()
+            task = job.task_groups[0].tasks[0]
+            task.vault = {"policies": ["db-read"]}
+            alloc = mock.alloc()
+            alloc.job = job
+            alloc.job_id = job.id
+            alloc.node_id = node.id
+            server.raft_apply("alloc-update", [alloc])
+
+            # no credentials
+            with pytest.raises(PermissionError):
+                server.derive_vault_token(alloc.id, [task.name])
+            # wrong secret
+            with pytest.raises(PermissionError):
+                server.derive_vault_token(alloc.id, [task.name], node.id, "bogus")
+            # right secret, wrong node (alloc not placed there)
+            with pytest.raises(PermissionError):
+                server.derive_vault_token(
+                    alloc.id, [task.name], other.id, other.secret_id
+                )
+            # the placed node with its real secret succeeds
+            tokens = server.derive_vault_token(
+                alloc.id, [task.name], node.id, node.secret_id
+            )
+            assert task.name in tokens
+        finally:
+            server.stop()
+
 
 class TestTaskServiceRegistration:
     def test_services_follow_task_lifecycle(self, consul):

@@ -72,6 +72,54 @@ class TestTLSTransport:
             rpc.stop()
 
 
+class TestServerHostnameVerification:
+    def test_pinned_name_accepts_real_server(self, certs):
+        srv_tls = TLSConfig(*certs["server"])
+        cli_tls = TLSConfig(*certs["client"], server_name="server.global.nomad")
+        assert cli_tls.pin_server_name
+        rpc = RPCServer(tls=srv_tls)
+        rpc.register("Echo.hello", lambda x: x)
+        rpc.start()
+        try:
+            cli = RPCClient(*rpc.addr, tls=cli_tls)
+            assert cli.call("Echo.hello", "pin") == "pin"
+            cli.close()
+        finally:
+            rpc.stop()
+
+    def test_client_cert_cannot_impersonate_server(self, certs):
+        """A cluster-CA client cert presented by a listener must be
+        rejected by callers pinning the server role name — otherwise any
+        agent cert holder can MITM the RPC plane."""
+        impostor = RPCServer(tls=TLSConfig(*certs["client"]))
+        impostor.register("Echo.hello", lambda x: x)
+        impostor.start()
+        try:
+            cli = RPCClient(
+                *impostor.addr,
+                tls=TLSConfig(*certs["client"], server_name="server.global.nomad"),
+            )
+            with pytest.raises(Exception):
+                cli.call("Echo.hello", "x")
+            cli.close()
+        finally:
+            impostor.stop()
+
+    def test_opt_out_restores_ca_only_check(self, certs):
+        cli_tls = TLSConfig(*certs["client"], server_name="server.global.nomad",
+                            verify_server_hostname=False)
+        assert not cli_tls.pin_server_name
+        rpc = RPCServer(tls=TLSConfig(*certs["client"]))
+        rpc.register("Echo.hello", lambda x: x)
+        rpc.start()
+        try:
+            cli = RPCClient(*rpc.addr, tls=cli_tls)
+            assert cli.call("Echo.hello", "ok") == "ok"
+            cli.close()
+        finally:
+            rpc.stop()
+
+
 class TestTLSCluster:
     def test_server_and_remote_client_over_tls(self, certs):
         """Full topology on mutual TLS: server agent + client-only agent

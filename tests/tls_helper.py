@@ -24,9 +24,18 @@ def make_cluster_certs(directory: str, names=("server", "client")) -> dict:
         key = os.path.join(directory, f"{name}.key")
         csr = os.path.join(directory, f"{name}.csr")
         crt = os.path.join(directory, f"{name}.crt")
+        ext = os.path.join(directory, f"{name}.ext")
+        # role-named SAN: hostname pinning (verify_server_hostname)
+        # matches "server.<region>.nomad" against the SAN, not the CN
+        with open(ext, "w") as f:
+            f.write(
+                f"subjectAltName=DNS:{name}.global.nomad,"
+                "DNS:localhost,IP:127.0.0.1\n"
+            )
         run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
             "-keyout", key, "-out", csr, "-subj", f"/CN={name}.global.nomad")
         run("openssl", "x509", "-req", "-in", csr, "-CA", ca_crt,
-            "-CAkey", ca_key, "-CAcreateserial", "-out", crt, "-days", "1")
+            "-CAkey", ca_key, "-CAcreateserial", "-out", crt, "-days", "1",
+            "-extfile", ext)
         out[name] = (ca_crt, crt, key)
     return out
