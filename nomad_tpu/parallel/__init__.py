@@ -1,6 +1,6 @@
 """Device-mesh parallelism for the placement engine."""
 from .sharding import (  # noqa: F401
     batched_place_scan,
+    batched_scan_shardings,
     make_mesh,
-    scan_input_shardings,
 )

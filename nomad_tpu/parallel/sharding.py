@@ -38,75 +38,66 @@ def make_mesh(n_devices: Optional[int] = None, eval_parallel: int = 1):
     return Mesh(grid, ("evals", "nodes"))
 
 
-def scan_input_shardings(mesh, batched: bool):
-    """(static, carry, xs) PartitionSpecs for the placement scan.
-
-    ``batched`` adds a leading eval axis (sharded over "evals") to carry/xs.
-    Node-dim arrays shard over "nodes"; small per-TG tables replicate.
+def batched_scan_shardings(mesh):
+    """(static, carry, xs) NamedShardings for the FULLY-batched scan
+    (engine._build_batched_scan): every array carries a leading eval axis
+    (concurrent evals see different snapshots/node sets/jobs, so node
+    tables batch too). Eval axis shards over "evals"; node dims over
+    "nodes"; small per-TG/spread tables replicate within an eval shard.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    b = ("evals",) if batched else ()
-
+    e = "evals"
     static = (
-        ns("nodes", None),        # totals [N, D]
-        ns("nodes", None),        # reserved [N, D]
-        ns(None, None),           # asks [G, D]
-        ns(None, "nodes"),        # feas [G, N]
-        ns(None, "nodes"),        # aff_score [G, N]
-        ns(None, "nodes"),        # aff_present [G, N]
-        ns(None),                 # desired_counts [G]
-        ns(None),                 # dh_job [G]
-        ns(None),                 # dh_tg [G]
-        ns(None),                 # limits [G]
-        ns(None, None, "nodes"),  # spread_vids [G, S, N]
-        ns(None, None, None),     # spread_desired [G, S, V]
-        ns(None, None),           # spread_weights [G, S]
-        ns(None, None),           # spread_has_targets [G, S]
-        ns(None, None),           # spread_active [G, S]
-        ns(None),                 # sum_spread_weights [G]
-        ns(),                     # n_real scalar
+        ns(e, "nodes", None),        # totals [B, N, D]
+        ns(e, "nodes", None),        # reserved [B, N, D]
+        ns(e, None, None),           # asks [B, G, D]
+        ns(e, None, "nodes"),        # feas [B, G, N]
+        ns(e, None, "nodes"),        # aff_score [B, G, N]
+        ns(e, None, "nodes"),        # aff_present [B, G, N]
+        ns(e, None),                 # desired_counts [B, G]
+        ns(e, None),                 # dh_job [B, G]
+        ns(e, None),                 # dh_tg [B, G]
+        ns(e, None),                 # limits [B, G]
+        ns(e, None, None, "nodes"),  # spread_vids [B, G, S, N]
+        ns(e, None, None, None),     # spread_desired [B, G, S, V]
+        ns(e, None, None),           # spread_weights [B, G, S]
+        ns(e, None, None),           # spread_has_targets [B, G, S]
+        ns(e, None, None),           # spread_active [B, G, S]
+        ns(e, None),                 # sum_spread_weights [B, G]
+        ns(e),                       # n_real [B]
     )
     carry = (
-        ns(*b, "nodes", None),    # used [N, D]
-        ns(*b, None, "nodes"),    # tg_counts [G, N]
-        ns(*b, "nodes"),          # job_counts [N]
-        ns(*b, None, None, None),  # spread_counts [G, S, V]
-        ns(*b, None, None, None),  # spread_entry [G, S, V]
-        ns(*b),                   # offset
-        ns(*b, None),             # failed [G]
+        ns(e, "nodes", None),        # used [B, N, D]
+        ns(e, None, "nodes"),        # tg_counts [B, G, N]
+        ns(e, "nodes"),              # job_counts [B, N]
+        ns(e, None, None, None),     # spread_counts [B, G, S, V]
+        ns(e, None, None, None),     # spread_entry [B, G, S, V]
+        ns(e),                       # offset [B]
+        ns(e, None),                 # failed [B, G]
     )
     xs = (
-        ns(*b, None),             # tg_idx [P]
-        ns(*b, None, None),       # penalty_idx [P, K]
-        ns(*b, None),             # evict_node [P]
-        ns(*b, None, None),       # evict_res [P, D]
-        ns(*b, None),             # evict_tg [P]
-        ns(*b, None),             # limit_p [P]
-        ns(*b, None),             # sum_sw_p [P]
+        ns(e, None),                 # tg_idx [B, P]
+        ns(e, None, None),           # penalty_idx [B, P, K]
+        ns(e, None),                 # evict_node [B, P]
+        ns(e, None, None),           # evict_res [B, P, D]
+        ns(e, None),                 # evict_tg [B, P]
+        ns(e, None),                 # limit_p [B, P]
+        ns(e, None),                 # sum_sw_p [B, P]
     )
     return static, carry, xs
 
 
-def batched_place_scan(mesh, n_pad: int):
-    """A jit'd, mesh-sharded, eval-batched placement scan.
-
-    vmaps the single-eval scan over a leading batch axis (independent evals)
-    and shards: batch over "evals", node axis over "nodes". Static (node
-    table / TG spec) arrays are shared by all evals in the batch.
+def batched_place_scan(mesh):
+    """The mesh-sharded, eval-batched placement scan over FULLY batched
+    inputs (node tables included — see batched_scan_shardings). Thin
+    wrapper over the ONE builder (engine._build_batched_scan); the
+    production path is tpu.batcher.DeviceBatcher, which pads/stacks real
+    EncodedEvals and uses these same shardings.
     """
-    import jax
+    from ..tpu.engine import _build_batched_scan
 
-    from ..tpu.engine import _build_place_scan
-
-    place_scan = _build_place_scan()
-
-    static_s, carry_s, xs_s = scan_input_shardings(mesh, batched=True)
-
-    def run(static, carry_b, xs_b):
-        return jax.vmap(lambda c, x: place_scan(n_pad, static, c, x))(carry_b, xs_b)
-
-    return jax.jit(run, in_shardings=(static_s, carry_s, xs_s))
+    return _build_batched_scan(in_shardings=batched_scan_shardings(mesh))

@@ -63,6 +63,15 @@ class ServerConfig:
     unblock_failed_interval: float = 60.0
     scheduler_algorithm: str = "tpu_binpack"
     vault: Optional[object] = None  # integrations.vault.VaultConfig
+    # Eval-batched device scheduling (SURVEY §2.6 row 1): up to this many
+    # concurrently-scheduling evals share ONE device dispatch of the
+    # batched placement scan. 0/1 disables batching (per-eval dispatch).
+    device_batch: int = 8
+    # how long the batcher waits for co-arriving evals before dispatching
+    device_batch_window_ms: float = 1.0
+    # shard the eval batch over an ("evals", "nodes") jax device mesh when
+    # multiple accelerator devices are visible (multi-chip)
+    device_mesh: bool = False
 
 
 class Server:
@@ -114,6 +123,35 @@ class Server:
 
             self.vault = VaultClient(self.config.vault)
 
+        # Eval-batched device scheduling: workers submit encoded evals here
+        # so K concurrent evals ride one device dispatch (the TPU-native
+        # analog of the reference's N workers per server, server.go:1307).
+        # The batcher's thread starts lazily on first use.
+        self.device_batcher = None
+        if self.config.device_batch > 1:
+            from ..tpu.batcher import DeviceBatcher
+
+            mesh = None
+            if self.config.device_mesh:
+                try:
+                    import jax
+
+                    from ..parallel import make_mesh
+
+                    n_dev = len(jax.devices())
+                    if n_dev > 1:
+                        mesh = make_mesh(
+                            n_dev,
+                            eval_parallel=min(self.config.device_batch, n_dev),
+                        )
+                except Exception:  # noqa: BLE001 — no devices: run unsharded
+                    mesh = None
+            self.device_batcher = DeviceBatcher(
+                max_batch=self.config.device_batch,
+                window_ms=self.config.device_batch_window_ms,
+                mesh=mesh,
+            )
+
         # Join before observing: the join-time election fires observers, and
         # start() handles the initial-leadership case explicitly.
         self.peer = self.raft.join(self.fsm)
@@ -143,6 +181,8 @@ class Server:
             w.stop()
         if self.planner is not None:
             self.planner.stop()
+        if self.device_batcher is not None:
+            self.device_batcher.stop()
         self._revoke_leadership()
 
     # -- leadership ------------------------------------------------------
@@ -202,6 +242,9 @@ class Server:
             "nomad.blocked_evals.total_blocked",
             self.blocked_evals.stats().get("total_blocked", 0),
         )
+        if self.device_batcher is not None:
+            for key, value in self.device_batcher.stats.items():
+                metrics.set_gauge(f"nomad.device_batcher.{key}", value)
         metrics.set_gauge(
             "nomad.plan.queue_depth", self.plan_queue.stats().get("depth", 0)
         )

@@ -180,6 +180,14 @@ class Worker:
 
     # -- Planner protocol ------------------------------------------------
 
+    @property
+    def device_batcher(self):
+        """The server's eval-batcher: schedulers route their placement
+        scans through it so concurrent evals share one device dispatch
+        (works identically in leader and follower mode — scheduling is
+        local; only plan submission crosses the wire)."""
+        return getattr(self.server, "device_batcher", None)
+
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         plan.eval_token = self._eval_token
         # stamp the snapshot the scheduler actually saw (worker.go:277), not

@@ -1,0 +1,326 @@
+"""DeviceBatcher: gathers concurrent evals into ONE device dispatch.
+
+The production realization of SURVEY §2.6 row 1 — the TPU-native analog of
+the reference's N scheduler workers per server (nomad/server.go:1307
+setupWorkers, worker.go:244). Host workers still dequeue and run the
+scheduler logic concurrently; when each reaches its placement step it
+submits an ``EncodedEval`` here and blocks. A dispatcher thread gathers the
+requests that arrive within a small window, pads them to shared bucketed
+shapes, stacks them along a leading eval axis and runs the eval-batched
+scan (engine._build_batched_scan) — one device dispatch for the whole
+batch, amortizing host→device transfer and dispatch latency, and sharding
+over the ("evals", "nodes") mesh when one is configured.
+
+Per-eval semantics are untouched: the batched scan vmaps the exact
+single-eval parity scan, so each eval's plan is identical to what the
+single dispatch produces; cross-eval conflicts resolve in the plan applier
+exactly as with the reference's optimistically-concurrent workers.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .engine import EncodedEval, _build_batched_scan, _round_up
+
+logger = logging.getLogger("nomad_tpu.tpu.batcher")
+
+
+def _pow2ceil(x: int) -> int:
+    p = 1
+    while p < x:
+        p *= 2
+    return p
+
+
+def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
+                v_pad: int, p_pad: int, dtype) -> Tuple[tuple, tuple, tuple]:
+    """Pad one eval's arrays to the batch's shared bucketed dims.
+
+    Padding is semantically inert by construction:
+      - nodes beyond n_real are infeasible and outside the ring window
+      - task-group slots >= g are born with failed=True in the carry
+      - placement steps beyond p index a padded (pre-failed) TG slot, so
+        the scan body skips them (skip_step) and mutates nothing
+      - spread rows beyond s are inactive; the invalid vocab bucket is
+        remapped from v-1 to v_pad-1
+    """
+    (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
+     dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
+     spread_has_targets, spread_active, sum_spread_weights, n_real) = enc.static
+    (used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
+     offset0, failed0) = enc.carry
+    (tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
+     limit_p, sum_sw_p) = enc.xs
+
+    n0, g0, s0, v0, p0 = enc.n_pad, enc.g, enc.s, enc.v, enc.p
+    dn, dg, ds, dv, dp = (n_pad - n0, g_pad - g0, s_pad - s0,
+                          v_pad - v0, p_pad - p0)
+    assert min(dn, dg, ds, dv, dp) >= 0
+    assert dp == 0 or g_pad > g0  # padded steps need a pre-failed TG slot
+
+    def pad(arr, widths, fill=0):
+        if all(w == (0, 0) for w in widths):
+            return np.asarray(arr, dtype=arr.dtype)
+        return np.pad(arr, widths, constant_values=fill)
+
+    f = lambda a: np.asarray(a, dtype)  # noqa: E731 — common float cast
+
+    # spread_vids: remap this eval's invalid bucket (v0-1) onto the shared
+    # one (v_pad-1) BEFORE padding, then pad new cells as invalid too
+    vids = np.where(spread_vids >= v0 - 1, v_pad - 1, spread_vids)
+    vids = pad(vids, ((0, dg), (0, ds), (0, dn)), v_pad - 1)
+
+    static = (
+        pad(f(totals), ((0, dn), (0, 0))),
+        pad(f(reserved), ((0, dn), (0, 0))),
+        pad(f(asks), ((0, dg), (0, 0))),
+        pad(feas, ((0, dg), (0, dn)), False),
+        pad(f(aff_score), ((0, dg), (0, dn))),
+        pad(aff_present, ((0, dg), (0, dn)), False),
+        pad(desired_counts, ((0, dg),), 1),
+        pad(dh_job, ((0, dg),), False),
+        pad(dh_tg, ((0, dg),), False),
+        pad(limits, ((0, dg),), 0),
+        vids.astype(np.int32),
+        pad(f(spread_desired), ((0, dg), (0, ds), (0, dv)), -1.0),
+        pad(f(spread_weights), ((0, dg), (0, ds))),
+        pad(spread_has_targets, ((0, dg), (0, ds)), False),
+        pad(spread_active, ((0, dg), (0, ds)), False),
+        pad(f(sum_spread_weights), ((0, dg),)),
+        np.int32(n_real),
+    )
+    carry = (
+        pad(f(used0), ((0, dn), (0, 0))),
+        pad(tg_counts0, ((0, dg), (0, dn)), 0),
+        pad(job_counts0, ((0, dn),), 0),
+        pad(f(spread_counts0), ((0, dg), (0, ds), (0, dv))),
+        pad(spread_entry0, ((0, dg), (0, ds), (0, dv)), False),
+        np.int32(offset0),
+        # padded TG slots are pre-failed -> padded steps are no-ops
+        pad(failed0, ((0, dg),), True),
+    )
+    xs = (
+        pad(tg_idx, ((0, dp),), g0),  # g0 = first padded (pre-failed) slot
+        pad(penalty_idx, ((0, dp), (0, 0)), -1),
+        pad(evict_node, ((0, dp),), -1),
+        pad(f(evict_res), ((0, dp), (0, 0))),
+        pad(evict_tg, ((0, dp),), -1),
+        pad(limit_p, ((0, dp),), 0),
+        pad(f(sum_sw_p), ((0, dp),), 1.0),
+    )
+    return static, carry, xs
+
+
+class _Request:
+    __slots__ = ("enc", "event", "result", "error")
+
+    def __init__(self, enc: EncodedEval) -> None:
+        self.enc = enc
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class DeviceBatcher:
+    """Gather-window batcher in front of the eval-batched placement scan.
+
+    ``run(enc)`` blocks the calling worker until its eval's slice of the
+    batched result is ready. The dispatcher thread starts lazily on first
+    use and stops with ``stop()``.
+    """
+
+    def __init__(self, max_batch: int = 8, window_ms: float = 1.0,
+                 mesh=None) -> None:
+        self.max_batch = max(1, int(max_batch))
+        self.window_s = max(0.0, float(window_ms)) / 1000.0
+        self.mesh = mesh
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._scan = None
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # observability — the server publishes these as
+        # nomad.device_batcher.* gauges in its stats sweep (/v1/metrics)
+        self.stats = {
+            "dispatches": 0,
+            "evals": 0,
+            "max_batch_seen": 0,
+            "padded_evals": 0,
+        }
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _ensure_started(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._dispatch_loop, name="device-batcher",
+                    daemon=True,
+                )
+                self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        # release anyone still parked
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = RuntimeError("device batcher stopped")
+            req.event.set()
+
+    # -- worker-facing ---------------------------------------------------
+
+    def run(self, enc: EncodedEval):
+        """Submit one encoded eval; blocks until its results are ready.
+        Returns (chosen, scores, pulls, skipped) numpy arrays of length
+        enc.p (already sliced back from the padded batch).
+
+        Robust against a concurrent stop(): the wait loop re-ensures the
+        dispatcher is alive, so a request that slipped into the queue
+        after stop() drained it is picked up by the restarted thread
+        rather than parking its worker forever."""
+        self._ensure_started()
+        req = _Request(enc)
+        self._queue.put(req)
+        while not req.event.wait(timeout=0.5):
+            self._ensure_started()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- dispatcher ------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            batch = [first]
+            if self.window_s > 0 and self.max_batch > 1:
+                import time
+
+                deadline = time.monotonic() + self.window_s
+                while len(batch) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+            else:
+                while len(batch) < self.max_batch:
+                    try:
+                        batch.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        break
+            # dtype-homogeneous sub-batches: co-batching must never change
+            # an eval's arithmetic (f32 evals upcast to f64 could select
+            # differently than they would alone)
+            for dtype in (np.float64, np.float32):
+                group = [r for r in batch if r.enc.dtype == dtype]
+                if group:
+                    self._run_batch_safe(group)
+
+    def _run_batch_safe(self, batch: List[_Request]) -> None:
+        try:
+            self._run_batch(batch)
+        except BaseException:  # noqa: BLE001 — confine the blast radius
+            logger.exception(
+                "batched dispatch failed; retrying %d evals individually",
+                len(batch),
+            )
+            from .engine import TpuPlacementEngine
+
+            engine = TpuPlacementEngine.shared()
+            for req in batch:
+                try:
+                    req.result = engine.run_scan_single(req.enc)
+                except BaseException as e:  # noqa: BLE001
+                    req.error = e
+                req.event.set()
+
+    def _scan_fn(self):
+        """The ONE batched-scan builder (engine._build_batched_scan),
+        sharded over the configured mesh when present."""
+        if self._scan is None:
+            shardings = None
+            if self.mesh is not None:
+                from ..parallel.sharding import batched_scan_shardings
+
+                shardings = batched_scan_shardings(self.mesh)
+            self._scan = _build_batched_scan(in_shardings=shardings)
+        return self._scan
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        encs = [r.enc for r in batch]
+        # shared bucketed dims (pow2 to bound recompiles); G always gets a
+        # padded slot so padded steps have a pre-failed TG to point at
+        n_pad = max(_round_up(e.n_real) for e in encs)
+        g_pad = _pow2ceil(max(e.g for e in encs) + 1)
+        s_pad = _pow2ceil(max(max(e.s for e in encs), 1))
+        v_pad = _pow2ceil(max(max(e.v for e in encs), 2))
+        p_pad = _pow2ceil(max(e.p for e in encs))
+        dtype = encs[0].dtype  # dispatch loop groups by dtype
+
+        padded = [
+            pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype)
+            for e in encs
+        ]
+
+        b = len(padded)
+        b_pad = _pow2ceil(b)
+        if self.mesh is not None:
+            ep = self.mesh.shape.get("evals", 1)
+            b_pad = ((b_pad + ep - 1) // ep) * ep
+            nn = self.mesh.shape.get("nodes", 1)
+            n_pad2 = ((n_pad + nn - 1) // nn) * nn
+            if n_pad2 != n_pad:
+                padded = [
+                    pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype)
+                    for e in encs
+                ]
+                n_pad = n_pad2
+        while len(padded) < b_pad:
+            padded.append(padded[0])  # inert copies; results discarded
+
+        static_b = tuple(
+            np.stack([p[0][i] for p in padded]) for i in range(len(padded[0][0]))
+        )
+        carry_b = tuple(
+            np.stack([p[1][i] for p in padded]) for i in range(len(padded[0][1]))
+        )
+        xs_b = tuple(
+            np.stack([p[2][i] for p in padded]) for i in range(len(padded[0][2]))
+        )
+
+        scan = self._scan_fn()
+        _carry, (chosen, scores, pulls, skipped) = scan(static_b, carry_b, xs_b)
+        chosen = np.asarray(chosen)
+        scores = np.asarray(scores)
+        pulls = np.asarray(pulls)
+        skipped = np.asarray(skipped)
+
+        self.stats["dispatches"] += 1
+        self.stats["evals"] += b
+        self.stats["padded_evals"] += b_pad - b
+        self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], b)
+
+        for bi, req in enumerate(batch):
+            p = req.enc.p
+            req.result = (
+                chosen[bi, :p], scores[bi, :p], pulls[bi, :p], skipped[bi, :p]
+            )
+            req.event.set()

@@ -60,6 +60,36 @@ MAX_SKIP = 3
 SKIP_SCORE_THRESHOLD = 0.0
 
 
+class EncodedEval:
+    """One evaluation's placement problem as dense numpy arrays, plus the
+    host-side context needed to materialize results into a Plan. Produced
+    by ``TpuPlacementEngine.encode_eval``; consumed by the single-eval scan
+    or stacked with other evals by the DeviceBatcher."""
+
+    __slots__ = (
+        "n_real", "n_pad", "g", "s", "v", "p", "dtype",
+        "static", "carry", "xs",
+        "missing_list", "nodes", "table", "start_ns",
+    )
+
+    def __init__(self, *, n_real, n_pad, g, s, v, p, dtype,
+                 static, carry, xs, missing_list, nodes, table, start_ns):
+        self.n_real = n_real
+        self.n_pad = n_pad
+        self.g = g
+        self.s = s
+        self.v = v
+        self.p = p
+        self.dtype = dtype
+        self.static = static
+        self.carry = carry
+        self.xs = xs
+        self.missing_list = missing_list
+        self.nodes = nodes
+        self.table = table
+        self.start_ns = start_ns
+
+
 def _round_up(n: int, multiple: int = 128) -> int:
     if n <= multiple:
         # small clusters: pad to next power of two to bound recompiles
@@ -75,13 +105,11 @@ def _round_up(n: int, multiple: int = 128) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _build_place_scan():
-    import jax
+def _make_step():
+    """The per-placement scan body, shared by the single-eval scan, the
+    eval-batched scan (vmapped over independent evals — the production
+    multi-eval path) and the dryrun. Pure function of arrays."""
     import jax.numpy as jnp
-
-    # Parity mode scores in float64 (the host pipeline is float64; float32
-    # collapses sub-ULP score differences into ties and flips selections).
-    jax.config.update("jax_enable_x64", True)
 
     def step(static, carry, x):
         (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
@@ -287,6 +315,17 @@ def _build_place_scan():
         out = (chosen, jnp.where(success, best_score, 0.0), pulls, skip_step)
         return new_carry, out
 
+    return step
+
+
+def _build_place_scan():
+    import jax
+
+    # Parity mode scores in float64 (the host pipeline is float64; float32
+    # collapses sub-ULP score differences into ties and flips selections).
+    jax.config.update("jax_enable_x64", True)
+    step = _make_step()
+
     @partial(jax.jit, static_argnames=("n_pad",))
     def place_scan(n_pad, static, init_carry, xs):
         import jax.lax as lax
@@ -294,6 +333,38 @@ def _build_place_scan():
         return lax.scan(lambda c, x: step(static, c, x), init_carry, xs)
 
     return place_scan
+
+
+def _build_batched_scan(in_shardings=None):
+    """Eval-batched scan: vmap the per-eval scan over a leading batch axis.
+
+    EVERYTHING is batched — node tables included — because concurrent evals
+    see different snapshots, different datacenter-filtered node sets and
+    different jobs. Each eval keeps the exact sequential parity semantics of
+    the single scan; the batch axis is pure data parallelism over
+    independent evaluations (the device analog of the reference's
+    N-scheduler-workers-per-server, nomad/server.go:1307).
+
+    ``in_shardings``: optional (static, carry, xs) NamedSharding tuples
+    (parallel.sharding.batched_scan_shardings) to shard the dispatch over
+    an ("evals", "nodes") mesh — the ONE builder both the unsharded and
+    mesh production paths share."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    step = _make_step()
+
+    def body(static_b, carry_b, xs_b):
+        import jax.lax as lax
+
+        def one(static, carry, xs):
+            return lax.scan(lambda c, x: step(static, c, x), carry, xs)
+
+        return jax.vmap(one)(static_b, carry_b, xs_b)
+
+    if in_shardings is not None:
+        return jax.jit(body, in_shardings=in_shardings)
+    return jax.jit(body)
 
 
 # ---------------------------------------------------------------------------
@@ -329,10 +400,35 @@ class TpuPlacementEngine:
         """Batch the eval's whole placement list through one device scan.
 
         Returns True when handled; NotImplemented to fall back to the host
-        iterator path (unsupported features).
+        iterator path (unsupported features). When the scheduler's planner
+        carries a ``device_batcher`` (the production server does —
+        server.go:1307's N-workers analog), the encoded eval is submitted
+        there so concurrent evals share ONE eval-batched device dispatch;
+        otherwise it runs as a single-eval scan.
         """
+        enc = self.encode_eval(sched, destructive, place)
+        if enc is NotImplemented:
+            return NotImplemented
+        if enc is True:
+            return True
+        batcher = getattr(sched.planner, "device_batcher", None)
+        if batcher is not None:
+            chosen, scores, pulls, skipped_steps = batcher.run(enc)
+        else:
+            chosen, scores, pulls, skipped_steps = self.run_scan_single(enc)
+        self._apply_results(
+            sched, enc.missing_list, enc.nodes, enc.table, chosen, scores,
+            pulls, skipped_steps, enc.start_ns,
+        )
+        return True
+
+    def encode_eval(self, sched, destructive: List, place: List):
+        """Encode one eval's placement problem into dense numpy arrays.
+
+        Returns an EncodedEval, True (nothing to place) or NotImplemented
+        (unsupported feature — host fallback)."""
         try:
-            import jax.numpy as jnp
+            import jax  # noqa: F401 — device path requires jax
         except ImportError:
             return NotImplemented
 
@@ -503,41 +599,46 @@ class TpuPlacementEngine:
                     if prev.job_id == job.id:
                         evict_tg[pi] = tg_name_to_gi.get(prev.task_group, -1)
 
+        static = (
+            totals, reserved, asks, feas, aff_score, aff_present,
+            desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
+            spread_weights, spread_has_targets, spread_active,
+            sum_spread_weights, np.int32(n_real),
+        )
+        init_carry = (
+            used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
+            np.int32(0), np.zeros(g_count, bool),
+        )
+        xs = (
+            tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
+            limit_p, sum_sw_p,
+        )
+
+        return EncodedEval(
+            n_real=n_real, n_pad=n_pad, g=g_count, s=sv, v=vv, p=p,
+            dtype=fdtype, static=static, carry=init_carry, xs=xs,
+            missing_list=missing_list, nodes=nodes, table=table,
+            start_ns=start,
+        )
+
+    def run_scan_single(self, enc: "EncodedEval"):
+        """Run one encoded eval through the single-eval jit'd scan."""
         # Build the scan (enables x64) BEFORE converting arrays, or the
         # float64 inputs silently truncate to float32.
         place_scan = self._scan_fn()
+        import jax.numpy as jnp
 
-        static = (
-            jnp.asarray(totals), jnp.asarray(reserved), jnp.asarray(asks),
-            jnp.asarray(feas), jnp.asarray(aff_score), jnp.asarray(aff_present),
-            jnp.asarray(desired_counts), jnp.asarray(dh_job), jnp.asarray(dh_tg),
-            jnp.asarray(limits), jnp.asarray(spread_vids), jnp.asarray(spread_desired),
-            jnp.asarray(spread_weights), jnp.asarray(spread_has_targets),
-            jnp.asarray(spread_active), jnp.asarray(sum_spread_weights),
-            jnp.int32(n_real),
-        )
-        init_carry = (
-            jnp.asarray(used0), jnp.asarray(tg_counts0), jnp.asarray(job_counts0),
-            jnp.asarray(spread_counts0), jnp.asarray(spread_entry0),
-            jnp.int32(0), jnp.zeros(g_count, bool),
-        )
-        xs = (
-            jnp.asarray(tg_idx), jnp.asarray(penalty_idx), jnp.asarray(evict_node),
-            jnp.asarray(evict_res), jnp.asarray(evict_tg),
-            jnp.asarray(limit_p), jnp.asarray(sum_sw_p),
-        )
+        static = tuple(jnp.asarray(a) for a in enc.static)
+        init_carry = tuple(jnp.asarray(a) for a in enc.carry)
+        xs = tuple(jnp.asarray(a) for a in enc.xs)
 
-        _carry, (chosen, scores, pulls, skipped) = place_scan(n_pad, static, init_carry, xs)
-        chosen = np.asarray(chosen)
-        scores = np.asarray(scores)
-        pulls = np.asarray(pulls)
-        skipped_steps = np.asarray(skipped)
-
-        self._apply_results(
-            sched, missing_list, nodes, table, chosen, scores, pulls,
-            skipped_steps, start,
+        _carry, (chosen, scores, pulls, skipped) = place_scan(
+            enc.n_pad, static, init_carry, xs
         )
-        return True
+        return (
+            np.asarray(chosen), np.asarray(scores),
+            np.asarray(pulls), np.asarray(skipped),
+        )
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,328 @@
+"""Eval-batched device scheduling (SURVEY §2.6 row 1).
+
+Covers the production path the reference realizes as N scheduler workers
+per server (nomad/server.go:1307): here, concurrent evals' placement scans
+share ONE device dispatch through tpu.batcher.DeviceBatcher. Parity is the
+bar: the batched scan must produce bit-identical selections to the
+single-eval scan, and batcher-routed scheduling must produce identical
+plans to the host pipeline.
+"""
+import copy
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.structs import (
+    EVAL_TRIGGER_JOB_REGISTER,
+    Evaluation,
+    SchedulerConfiguration,
+    Spread,
+    SpreadTarget,
+)
+from nomad_tpu.tpu.batcher import DeviceBatcher, pad_encoded, _pow2ceil
+from nomad_tpu.tpu.engine import (
+    EncodedEval,
+    TpuPlacementEngine,
+    example_scan_inputs,
+)
+
+
+def synthetic_enc(n_nodes, n_tgs, n_placements, n_spreads=1, seed=0,
+                  dtype=np.float64):
+    n_pad, static, carry, xs = example_scan_inputs(
+        n_nodes=n_nodes, n_tgs=n_tgs, n_placements=n_placements,
+        n_spreads=n_spreads, seed=seed, dtype=dtype,
+    )
+    return EncodedEval(
+        n_real=n_nodes, n_pad=n_pad, g=n_tgs, s=static[10].shape[1],
+        v=static[11].shape[2], p=n_placements, dtype=dtype,
+        static=static, carry=carry, xs=xs,
+        missing_list=[], nodes=[], table=None, start_ns=0,
+    )
+
+
+def run_concurrent(batcher, encs):
+    results = [None] * len(encs)
+    errors = []
+
+    def submit(i):
+        try:
+            results[i] = batcher.run(encs[i])
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=submit, args=(i,))
+               for i in range(len(encs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    return results
+
+
+class TestBatchedScanParity:
+    def test_heterogeneous_batch_matches_single(self):
+        """Evals of different node counts, TG counts, placement counts and
+        spread shapes padded into one batch must each produce exactly the
+        single-eval scan's output (padding is semantically inert)."""
+        engine = TpuPlacementEngine.shared()
+        encs = [
+            synthetic_enc(17, 1, 3, n_spreads=0, seed=1),
+            synthetic_enc(64, 3, 16, n_spreads=1, seed=2),
+            synthetic_enc(33, 2, 7, n_spreads=2, seed=3),
+            synthetic_enc(8, 1, 1, n_spreads=0, seed=4),
+            synthetic_enc(50, 4, 11, n_spreads=1, seed=5),
+        ]
+        singles = [engine.run_scan_single(e) for e in encs]
+
+        batcher = DeviceBatcher(max_batch=len(encs), window_ms=200.0)
+        try:
+            batched = run_concurrent(batcher, encs)
+        finally:
+            batcher.stop()
+
+        assert batcher.stats["max_batch_seen"] == len(encs)
+        assert batcher.stats["dispatches"] == 1
+        for i, (single, batch_r) in enumerate(zip(singles, batched)):
+            for k, name in enumerate(("chosen", "scores", "pulls", "skipped")):
+                np.testing.assert_array_equal(
+                    np.asarray(single[k]), np.asarray(batch_r[k]),
+                    err_msg=f"eval {i} {name} diverged under batching",
+                )
+
+    def test_uneven_batch_padding(self):
+        """3 evals -> batch padded to 4; the inert pad copy must not
+        perturb real results."""
+        engine = TpuPlacementEngine.shared()
+        encs = [synthetic_enc(24, 2, 5, seed=s) for s in (7, 8, 9)]
+        singles = [engine.run_scan_single(e) for e in encs]
+        batcher = DeviceBatcher(max_batch=8, window_ms=200.0)
+        try:
+            batched = run_concurrent(batcher, encs)
+        finally:
+            batcher.stop()
+        assert batcher.stats["padded_evals"] == 1  # 3 -> pow2 4
+        for single, batch_r in zip(singles, batched):
+            np.testing.assert_array_equal(single[0], batch_r[0])
+            np.testing.assert_array_equal(single[1], batch_r[1])
+
+    def test_pad_encoded_shapes(self):
+        enc = synthetic_enc(10, 2, 4, n_spreads=1, seed=0)
+        static, carry, xs = pad_encoded(
+            enc, n_pad=32, g_pad=4, s_pad=2, v_pad=8, p_pad=8,
+            dtype=np.float64,
+        )
+        assert static[0].shape == (32, 4)          # totals
+        assert static[3].shape == (4, 32)          # feas
+        assert static[10].shape == (4, 2, 32)      # spread_vids
+        assert static[11].shape == (4, 2, 8)       # spread_desired
+        assert carry[6].shape == (4,)              # failed
+        assert carry[6][enc.g:].all()              # padded TGs pre-failed
+        assert xs[0].shape == (8,)
+        assert (xs[0][enc.p:] == enc.g).all()      # padded steps -> failed TG
+        # remapped invalid vocab bucket
+        assert (static[10] <= 7).all()
+        assert (static[10][:, :, enc.n_pad:] == 7).all()
+
+    def test_mesh_sharded_batch_matches_single(self):
+        """The mesh-sharded dispatch (production multi-chip path) is
+        bit-identical to the unsharded single scan."""
+        import jax
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >=4 devices")
+        from nomad_tpu.parallel import make_mesh
+
+        engine = TpuPlacementEngine.shared()
+        encs = [synthetic_enc(32, 2, 6, seed=s) for s in (11, 12)]
+        singles = [engine.run_scan_single(e) for e in encs]
+        mesh = make_mesh(4, eval_parallel=2)
+        batcher = DeviceBatcher(max_batch=4, window_ms=200.0, mesh=mesh)
+        try:
+            batched = run_concurrent(batcher, encs)
+        finally:
+            batcher.stop()
+        for single, batch_r in zip(singles, batched):
+            np.testing.assert_array_equal(single[0], batch_r[0])
+            np.testing.assert_array_equal(single[1], batch_r[1])
+
+    def test_stop_errors_parked_requests(self):
+        """stop() must release requests already sitting in the queue (a
+        worker parked in run()) with an error, not leave them hanging."""
+        from nomad_tpu.tpu.batcher import _Request
+
+        batcher = DeviceBatcher(max_batch=4, window_ms=50.0)
+        # park a request WITHOUT a dispatcher thread running
+        req = _Request(synthetic_enc(8, 1, 1, seed=0))
+        batcher._queue.put(req)
+        batcher.stop()
+        assert req.event.is_set()
+        assert isinstance(req.error, RuntimeError)
+
+    def test_run_after_stop_restarts_lazily(self):
+        batcher = DeviceBatcher(max_batch=4, window_ms=50.0)
+        batcher._ensure_started()
+        batcher.stop()
+        # run() restarts the dispatcher lazily; never deadlocks
+        out = batcher.run(synthetic_enc(8, 1, 1, seed=0))
+        assert out[0].shape == (1,)
+        batcher.stop()
+
+    def test_failed_batch_falls_back_per_eval(self):
+        """A poisoned co-batched eval must not fail its companions: the
+        dispatcher retries each request through the single-eval scan."""
+        good = synthetic_enc(16, 1, 2, seed=0)
+        bad = synthetic_enc(16, 1, 2, seed=1)
+        # corrupt one eval so the stacked dispatch raises (shape mismatch
+        # at np.stack time inside _run_batch)
+        bad.static = bad.static[:-1]  # drop n_real -> unzips wrong
+        batcher = DeviceBatcher(max_batch=2, window_ms=200.0)
+        try:
+            results = [None, None]
+            errors = [None, None]
+
+            def submit(i, enc):
+                try:
+                    results[i] = batcher.run(enc)
+                except BaseException as e:  # noqa: BLE001
+                    errors[i] = e
+
+            t0 = threading.Thread(target=submit, args=(0, good))
+            t1 = threading.Thread(target=submit, args=(1, bad))
+            t0.start(); t1.start(); t0.join(); t1.join()
+            assert results[0] is not None, f"good eval failed: {errors[0]}"
+            assert errors[1] is not None, "corrupt eval should error"
+        finally:
+            batcher.stop()
+
+
+def make_nodes(num, seed):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(num):
+        n = mock.node()
+        n.name = f"node-{i}"
+        n.node_resources.cpu_shares = rng.choice([2000, 4000, 8000])
+        n.node_resources.memory_mb = rng.choice([4096, 8192, 16384])
+        n.attributes["rack"] = f"r{rng.randint(0, 3)}"
+        n.compute_class()
+        nodes.append(n)
+    return nodes
+
+
+def scheduler_plans(nodes, jobs, batcher=None):
+    """Run jobs through the harness under tpu_binpack; return
+    {(job, alloc name) -> node id} placements."""
+    h = Harness()
+    if batcher is not None:
+        h.device_batcher = batcher
+    h.state.scheduler_set_config(
+        h.next_index(), SchedulerConfiguration(scheduler_algorithm="tpu_binpack")
+    )
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), copy.deepcopy(n))
+    for job in jobs:
+        h.state.upsert_job(h.next_index(), copy.deepcopy(job))
+    for job in jobs:
+        ev = Evaluation(
+            priority=job.priority, type=job.type,
+            triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+            job_id=job.id, namespace=job.namespace,
+        )
+        h.process("service", ev)
+    out = {}
+    for plan in h.plans:
+        for node_id, allocs in plan.node_allocation.items():
+            for a in allocs:
+                out[(a.job_id, a.name)] = node_id
+    return out
+
+
+class TestSchedulerThroughBatcher:
+    def test_real_scheduler_plans_identical_via_batcher(self):
+        """Full scheduler pipeline routed through the DeviceBatcher yields
+        the same plans as the direct single-dispatch engine path."""
+        nodes = make_nodes(30, seed=42)
+        jobs = []
+        for i in range(4):
+            job = mock.job()
+            job.id = f"job-batch-{i}"
+            job.task_groups[0].count = 3
+            if i % 2:
+                job.task_groups[0].spreads = [Spread(
+                    attribute="${meta.rack}", weight=50,
+                    spread_target=[SpreadTarget(value="r0", percent=50),
+                                   SpreadTarget(value="r1", percent=50)],
+                )]
+            jobs.append(job)
+
+        direct = scheduler_plans(nodes, jobs, batcher=None)
+        batcher = DeviceBatcher(max_batch=4, window_ms=5.0)
+        try:
+            via_batcher = scheduler_plans(nodes, jobs, batcher=batcher)
+        finally:
+            batcher.stop()
+        assert direct == via_batcher
+        assert len(via_batcher) == sum(j.task_groups[0].count for j in jobs)
+        assert batcher.stats["evals"] == len(jobs)
+
+
+class TestServerBatchedScheduling:
+    def test_concurrent_evals_share_device_dispatch(self):
+        """N concurrent evals on a running server are placed via fewer
+        device dispatches than evals (the production wiring of SURVEY
+        §2.6 row 1), with every allocation placed."""
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        server = Server(ServerConfig(
+            num_schedulers=0, device_batch=8, device_batch_window_ms=25.0,
+        ))
+        try:
+            server.start()
+            for i in range(12):
+                n = mock.node()
+                n.name = f"srv-node-{i}"
+                n.compute_class()
+                server.register_node(n)
+
+            # enqueue all evals BEFORE workers exist so the flood hits the
+            # broker at once (deterministic batching pressure)
+            jobs = []
+            for i in range(8):
+                job = mock.job()
+                job.id = f"batched-job-{i}"
+                job.task_groups[0].count = 2
+                jobs.append(job)
+                server.register_job(job)
+
+            from nomad_tpu.server.worker import Worker
+
+            for i in range(4):
+                w = Worker(server, i)
+                server.workers.append(w)
+                w.start()
+
+            deadline = time.monotonic() + 30
+            def placed():
+                return sum(
+                    1 for j in jobs
+                    for a in server.fsm.state.allocs_by_job("default", j.id, True)
+                )
+            while time.monotonic() < deadline and placed() < 16:
+                time.sleep(0.05)
+            assert placed() == 16, f"only {placed()}/16 allocs placed"
+
+            stats = server.device_batcher.stats
+            assert stats["evals"] >= 8
+            assert stats["max_batch_seen"] >= 2, (
+                f"no eval batching observed: {stats}"
+            )
+            assert stats["dispatches"] < stats["evals"], stats
+        finally:
+            server.stop()
