@@ -4,9 +4,21 @@ Semantics follow reference ``nomad/plan_queue.go`` and ``nomad/plan_apply.go``:
 workers submit plans optimistically; the leader's single applier thread
 re-validates every touched node against current state (AllocsFit,
 plan_apply.go:628), partially commits what fits, and returns a RefreshIndex
-forcing stale workers to re-plan. The per-node feasibility fan-out the
-reference does over a goroutine pool (plan_apply_pool.go) is a vectorized
-batch here — the same capacity math the TPU engine runs, host-side.
+forcing stale workers to re-plan.
+
+Two of the reference's throughput mechanisms are reproduced here:
+
+* **Pipelined commit** (plan_apply.go:45–70): while plan N's raft apply is
+  in flight, plan N+1 is evaluated against an OPTIMISTIC snapshot that
+  already includes N's results. Before dispatching N+1's apply we wait for
+  N to commit; the worker's response is delivered asynchronously from the
+  apply waiter, so the applier thread is never parked on raft latency
+  while work is queued.
+* **Batched node re-check**: the per-node feasibility fan-out the
+  reference does over a goroutine pool (plan_apply_pool.go) is one
+  numpy pass here — every touched node's cpu/mem/disk totals vs proposed
+  usage compare at once; only nodes that pass capacity run the discrete
+  port-collision / device host checks.
 """
 from __future__ import annotations
 
@@ -18,7 +30,10 @@ import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
-from ..structs.funcs import allocs_fit, remove_allocs
+import numpy as np
+
+from ..structs.funcs import remove_allocs
+from ..structs.network import NetworkIndex
 from ..utils import metrics
 from ..structs.structs import (
     EVAL_STATUS_PENDING,
@@ -102,25 +117,97 @@ class Planner:
             self._thread.join(timeout=5)
 
     def _run(self) -> None:
+        # Pipelined applier (plan_apply.go:45–70): track one outstanding
+        # raft apply (apply_future resolves to its committed index, 0 on
+        # failure) and an optimistic snapshot that already includes it.
+        apply_future: Optional[Future] = None
+        snap = None
+        prev_plan_result_index = 0
+
         while not self._stop.is_set():
             pending = self.plan_queue.dequeue(timeout=0.2)
             if pending is None:
                 continue
             metrics.set_gauge("nomad.plan.queue_depth", self.plan_queue.stats().get("depth", 0))
             try:
+                # Previous plan committed during dequeue? Discard the
+                # optimistic view; future snapshots must include it.
+                if apply_future is not None and apply_future.done():
+                    idx = self._future_index(apply_future)
+                    prev_plan_result_index = max(prev_plan_result_index, idx)
+                    apply_future = None
+                    snap = None
+
+                min_index = max(prev_plan_result_index, pending.plan.snapshot_index)
+                if snap is not None and snap.latest_index < min_index:
+                    snap = None
+                # Does the evaluation snapshot include the in-flight plan's
+                # results? Only the retained optimistic snapshot does; a
+                # fresh snapshot taken while an apply is still in flight
+                # may lack them, and an evaluation against it cannot be
+                # trusted not to double-commit the same capacity.
+                saw_inflight = True
+                if apply_future is None or snap is None:
+                    snap = self._snapshot_min_index(min_index)
+                    saw_inflight = apply_future is None
+
                 start = metrics.now()
-                result = self.apply_plan(pending.plan)
-                metrics.measure_since("nomad.plan.apply", start)
-                pending.future.set_result(result)
+                result = self.evaluate_plan(snap, pending.plan)
+                metrics.measure_since("nomad.plan.evaluate", start)
+
+                if result.is_noop():
+                    pending.future.set_result(result)
+                    continue
+
+                # Ensure any parallel apply completed before dispatching
+                # the next one (bounds how stale the optimism can get).
+                if apply_future is not None:
+                    idx = self._future_index(apply_future, wait=True)
+                    prev_plan_result_index = max(prev_plan_result_index, idx)
+                    apply_future = None
+                    snap = self._snapshot_min_index(
+                        max(prev_plan_result_index, pending.plan.snapshot_index)
+                    )
+                    if not saw_inflight:
+                        # the evaluation ran blind to the plan that just
+                        # committed — re-validate against state including it
+                        result = self.evaluate_plan(snap, pending.plan)
+                        if result.is_noop():
+                            pending.future.set_result(result)
+                            continue
+
+                apply_future = self._dispatch_apply(pending, result, snap)
             except Exception as e:  # noqa: BLE001 — worker gets the error
                 self.logger.exception("plan apply failed")
-                pending.future.set_exception(e)
+                if not pending.future.done():
+                    pending.future.set_exception(e)
+
+        if apply_future is not None:
+            apply_future.result()
+
+    @staticmethod
+    def _future_index(future: Future, wait: bool = False) -> int:
+        try:
+            return future.result() if wait else future.result(timeout=0)
+        except Exception:  # noqa: BLE001 — failed apply: index unknown
+            return 0
+
+    def _snapshot_min_index(self, min_index: int):
+        start = metrics.now()
+        snap = self.fsm.state.snapshot_min_index(min_index)
+        metrics.measure_since("nomad.plan.wait_for_index", start)
+        return snap
 
     # ------------------------------------------------------------------
 
     def evaluate_plan(self, snapshot, plan: Plan) -> PlanResult:
         """Re-check every touched node against current state; keep what fits
-        (reference plan_apply.go:399/:436/:628)."""
+        (reference plan_apply.go:399/:436/:628).
+
+        The capacity math for ALL touched nodes runs as one numpy batch
+        (the vectorized analog of plan_apply_pool.go's goroutine fan-out);
+        only nodes that pass capacity run the discrete port-collision and
+        device checks host-side."""
         result = PlanResult(
             node_update=plan.node_update,
             node_allocation={},
@@ -129,56 +216,125 @@ class Planner:
             deployment_updates=list(plan.deployment_updates),
         )
         partial = False
-        for node_id, allocs in plan.node_allocation.items():
-            ok = self._evaluate_node_plan(snapshot, plan, node_id)
+
+        node_ids: List[str] = []
+        proposed_by_node: List[Optional[List[Allocation]]] = []
+        nodes = []
+        for node_id in plan.node_allocation:
+            new_allocs = plan.node_allocation[node_id]
+            node = snapshot.node_by_id(node_id)
+            if node is None:
+                if new_allocs:
+                    partial = True
+                continue
+            if node.drain or not node.ready():
+                partial = True
+                continue
+            existing = snapshot.allocs_by_node(node_id)
+            existing = [a for a in existing if not a.terminal_status()]
+            # Remove planned evictions, preemptions, AND prior versions of
+            # the planned allocations (in-place updates must not double
+            # count).
+            remove = list(plan.node_update.get(node_id, []))
+            remove.extend(plan.node_preemptions.get(node_id, []))
+            remove.extend(new_allocs)
+            if remove:
+                existing = remove_allocs(existing, remove)
+            node_ids.append(node_id)
+            nodes.append(node)
+            proposed_by_node.append(existing + new_allocs)
+
+        fit_mask = self._batch_capacity_check(nodes, proposed_by_node)
+
+        for i, node_id in enumerate(node_ids):
+            ok = bool(fit_mask[i])
             if ok:
-                result.node_allocation[node_id] = allocs
+                ok = self._node_discrete_checks(nodes[i], proposed_by_node[i])
+            if ok:
+                result.node_allocation[node_id] = plan.node_allocation[node_id]
                 if node_id in plan.node_preemptions:
                     result.node_preemptions[node_id] = plan.node_preemptions[node_id]
             else:
+                self.logger.debug("plan for node %s rejected", node_id)
                 partial = True
+
         if partial:
             # Invalid placements: cancel deployment bits if everything failed
             if not result.node_allocation:
                 result.deployment = None
                 result.deployment_updates = []
+            # COMMITTED state only: an optimistic (uncommitted) index here
+            # could strand the re-planning worker waiting for an index that
+            # never lands if the in-flight apply fails. For dispatched
+            # plans the apply waiter raises this to the real alloc_index.
             result.refresh_index = self.fsm.state.latest_index
         return result
 
-    def _evaluate_node_plan(self, snapshot, plan: Plan, node_id: str) -> bool:
-        new_allocs = plan.node_allocation.get(node_id, [])
-        node = snapshot.node_by_id(node_id)
-        if node is None:
-            return not new_allocs
-        if node.drain or not node.ready():
-            return False
+    @staticmethod
+    def _batch_capacity_check(nodes, proposed_by_node) -> np.ndarray:
+        """One vectorized cpu/mem/disk superset check over all touched
+        nodes (the math of funcs.allocs_fit/ComparableResources.superset,
+        columnized). Returns a [M] bool mask."""
+        m = len(nodes)
+        if m == 0:
+            return np.zeros(0, bool)
+        totals = np.zeros((m, 3), np.float64)
+        used = np.zeros((m, 3), np.float64)
+        for i, node in enumerate(nodes):
+            nr = node.node_resources
+            totals[i, 0] = nr.cpu_shares
+            totals[i, 1] = nr.memory_mb
+            totals[i, 2] = nr.disk_mb
+            rr = node.reserved_resources
+            if rr is not None:
+                used[i, 0] += rr.cpu_shares
+                used[i, 1] += rr.memory_mb
+                used[i, 2] += rr.disk_mb
+            for alloc in proposed_by_node[i]:
+                if alloc.terminal_status():
+                    continue
+                cr = alloc.comparable_resources()
+                used[i, 0] += cr.flattened.cpu_shares
+                used[i, 1] += cr.flattened.memory_mb
+                used[i, 2] += cr.shared.disk_mb
+        return np.all(used <= totals, axis=1)
 
-        existing = snapshot.allocs_by_node(node_id)
-        existing = [a for a in existing if not a.terminal_status()]
-        # Remove planned evictions, preemptions, AND prior versions of the
-        # planned allocations (in-place updates must not double count).
-        remove = list(plan.node_update.get(node_id, []))
-        remove.extend(plan.node_preemptions.get(node_id, []))
-        remove.extend(new_allocs)
-        if remove:
-            existing = remove_allocs(existing, remove)
-        proposed = existing + new_allocs
+    @staticmethod
+    def _node_discrete_checks(node, proposed) -> bool:
+        """Port-collision / per-device-bandwidth / device-count checks —
+        the parts of allocs_fit that are discrete structures, run only for
+        nodes that passed the batched capacity check and only when the
+        proposed set actually uses networks/devices."""
+        has_networks = False
+        has_devices = False
+        for alloc in proposed:
+            ar = alloc.allocated_resources
+            if ar is None:
+                continue
+            if ar.shared.networks:
+                has_networks = True
+            for tr in ar.tasks.values():
+                if tr.networks:
+                    has_networks = True
+                if getattr(tr, "devices", None):
+                    has_devices = True
+        if has_networks:
+            net_idx = NetworkIndex()
+            if net_idx.set_node(node) or net_idx.add_allocs(proposed):
+                return False
+            if net_idx.overcommitted():
+                return False
+        if has_devices:
+            from ..structs.devices import DeviceAccounter
 
-        fit, reason, _util = allocs_fit(node, proposed, None, check_devices=True)
-        if not fit:
-            self.logger.debug("plan for node %s rejected: %s", node_id, reason)
-        return fit
+            accounter = DeviceAccounter(node)
+            if accounter.add_allocs(proposed):
+                return False
+        return True
 
-    def apply_plan(self, plan: Plan) -> PlanResult:
-        snapshot = self.fsm.state.snapshot()
-        start = metrics.now()
-        result = self.evaluate_plan(snapshot, plan)
-        metrics.measure_since("nomad.plan.evaluate", start)
-        if result.is_noop():
-            return result
-
-        # Flatten + stamp, attaching the plan's job (the same struct-sharing
-        # the reference relies on in UpsertPlanResults).
+    def _build_payload(self, snapshot, plan: Plan, result: PlanResult) -> dict:
+        """Flatten + stamp, attaching the plan's job (the same struct-sharing
+        the reference relies on in UpsertPlanResults)."""
         alloc_updates: List[Allocation] = []
         for allocs in result.node_allocation.values():
             for alloc in allocs:
@@ -214,7 +370,7 @@ class Planner:
                 )
             )
 
-        payload = {
+        return {
             "alloc_updates": alloc_updates,
             "allocs_stopped": allocs_stopped,
             "allocs_preempted": allocs_preempted,
@@ -226,13 +382,77 @@ class Planner:
             # progress deadlines
             "timestamp_ns": time.time_ns(),
         }
-        index, _ = self.raft.apply(self.peer, APPLY_PLAN_RESULTS, payload)
-        result.alloc_index = index
 
-        # Stamp result allocs (the scheduler checks create==modify for "new")
-        for alloc in alloc_updates:
-            stored = self.fsm.state.alloc_by_id(alloc.id)
-            if stored is not None:
-                alloc.create_index = stored.create_index
-                alloc.modify_index = stored.modify_index
-        return result
+    def _dispatch_apply(self, pending: PendingPlan, result: PlanResult,
+                        snap) -> Future:
+        """Fire the raft apply asynchronously (plan_apply.go applyPlan +
+        asyncPlanWait): optimistically fold the results into ``snap`` so
+        the NEXT plan evaluates as if this one succeeded, respond to the
+        waiting worker from the apply waiter, and return a Future that
+        resolves to the committed index (0 on failure)."""
+        plan = pending.plan
+        payload = self._build_payload(snap, plan, result)
+
+        # Optimistic application to our private snapshot view: the raft
+        # log is the pessimistic truth; this view lets plan N+1 verify
+        # against plan N's expected outcome during N's apply latency.
+        guess_index = self.fsm.state.latest_index + 1
+        try:
+            # deployment COPIED: the store keeps (and index-stamps) the
+            # object it is given, and this one is also headed into the
+            # real FSM via raft — sharing it would alias two state stores
+            # to one mutable instance across threads
+            deployment = payload["deployment"]
+            snap.upsert_plan_results(
+                guess_index,
+                alloc_updates=payload["alloc_updates"],
+                allocs_stopped=payload["allocs_stopped"],
+                allocs_preempted=payload["allocs_preempted"],
+                deployment=deployment.copy() if deployment is not None else None,
+                deployment_updates=payload["deployment_updates"],
+                eval_id=payload["eval_id"],
+                timestamp_ns=payload["timestamp_ns"],
+            )
+        except Exception:  # noqa: BLE001 — optimism only; raft is truth
+            self.logger.exception("optimistic snapshot apply failed")
+
+        index_future: Future = Future()
+
+        def waiter() -> None:
+            try:
+                start = metrics.now()
+                index, _ = self.raft.apply(self.peer, APPLY_PLAN_RESULTS, payload)
+                metrics.measure_since("nomad.plan.apply", start)
+                result.alloc_index = index
+                if result.refresh_index:
+                    result.refresh_index = max(result.refresh_index, index)
+                # Stamp result allocs (the scheduler checks
+                # create==modify for "new")
+                for alloc in payload["alloc_updates"]:
+                    stored = self.fsm.state.alloc_by_id(alloc.id)
+                    if stored is not None:
+                        alloc.create_index = stored.create_index
+                        alloc.modify_index = stored.modify_index
+                pending.future.set_result(result)
+                index_future.set_result(index)
+            except Exception as e:  # noqa: BLE001
+                self.logger.exception("raft apply of plan failed")
+                if not pending.future.done():
+                    pending.future.set_exception(e)
+                index_future.set_result(0)
+
+        threading.Thread(target=waiter, name="plan-apply-wait", daemon=True).start()
+        return index_future
+
+    def apply_plan(self, plan: Plan) -> PlanResult:
+        """Synchronous evaluate+apply (tests / direct callers); the
+        pipelined loop in _run is the production path."""
+        snapshot = self.fsm.state.snapshot()
+        start = metrics.now()
+        result = self.evaluate_plan(snapshot, plan)
+        metrics.measure_since("nomad.plan.evaluate", start)
+        if result.is_noop():
+            return result
+        pending = PendingPlan(plan)
+        self._dispatch_apply(pending, result, snapshot)
+        return pending.future.result(timeout=60)

@@ -219,6 +219,21 @@ class AllocatedTaskResources:
         self.cpu_shares += other.cpu_shares
         self.memory_mb += other.memory_mb
 
+    def add_networks(self, networks: List[NetworkResource]) -> None:
+        """Merge networks BY DEVICE (reference structs.go:2981
+        AllocatedTaskResources.Add + Networks.NetIndex): an alloc with a
+        task net and a group net on the same NIC flattens to ONE entry
+        whose mbits/ports accumulate — preemption reads Networks[0]."""
+        for n in networks:
+            for mine in self.networks:
+                if mine.device == n.device:
+                    mine.mbits += n.mbits
+                    mine.reserved_ports = list(mine.reserved_ports) + list(n.reserved_ports)
+                    mine.dynamic_ports = list(mine.dynamic_ports) + list(n.dynamic_ports)
+                    break
+            else:
+                self.networks.append(n.copy())
+
     def subtract(self, other: "AllocatedTaskResources") -> None:
         self.cpu_shares -= other.cpu_shares
         self.memory_mb -= other.memory_mb
@@ -239,9 +254,9 @@ class AllocatedResources:
         c = ComparableResources()
         for tr in self.tasks.values():
             c.flattened.add(tr)
-            c.flattened.networks.extend(tr.networks)
+            c.flattened.add_networks(tr.networks)
         c.shared.disk_mb = self.shared.disk_mb
-        c.flattened.networks.extend(self.shared.networks)
+        c.flattened.add_networks(self.shared.networks)
         return c
 
 

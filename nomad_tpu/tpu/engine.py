@@ -108,7 +108,24 @@ def _round_up(n: int, multiple: int = 128) -> int:
 def _make_step():
     """The per-placement scan body, shared by the single-eval scan, the
     eval-batched scan (vmapped over independent evals — the production
-    multi-eval path) and the dryrun. Pure function of arrays."""
+    multi-eval path) and the dryrun. Pure function of arrays.
+
+    TPU-shaped by construction (empirically profiled on the real chip):
+      - NO gathers/scatters: dynamic row-selects (``asks[g]``-style) and
+        carry updates become one-hot ``where``+``sum``/outer-product adds —
+        batched gathers cost ~ms each on TPU while the one-hot forms fuse
+        into elementwise kernels.
+      - NO dot_general: f64 has no MXU path, so one-hot einsums would
+        lower to sequential while-loops; ``where``+``sum`` reduces stay on
+        the VPU.
+      - NO permutation: the ring-ordered LimitIterator emulation uses
+        offset-adjusted NATURAL cumsums (ring prefix at natural index i is
+        an elementwise function of one natural cumsum and two scalars),
+        and tie-breaks select via rank equality, never ``perm[idx]``.
+    All transformations are exact (integer adds / one-hot sums with a
+    single non-zero term), so outputs are bit-identical to the direct
+    indexed formulation — fuzz-asserted against the host pipeline in
+    tests/test_tpu_parity.py."""
     import jax.numpy as jnp
 
     def step(static, carry, x):
@@ -119,29 +136,61 @@ def _make_step():
         tg_idx, penalty_idx, evict_node, evict_res, evict_tg, limit_p, sum_sw_p = x
 
         n_pad = totals.shape[0]
+        g_count = asks.shape[0]
+        v_plus = spread_desired.shape[-1]
+        fdt = totals.dtype
         g = tg_idx
-        s_axis = jnp.arange(spread_vids.shape[1])
 
-        skip_step = failed[g]
+        iota_g = jnp.arange(g_count, dtype=jnp.int32)
+        sel_g = (iota_g == g)                       # [G] one-hot of the TG
+        iota = jnp.arange(n_pad, dtype=jnp.int32)
+        iota_v = jnp.arange(v_plus, dtype=jnp.int32)
 
-        # -- eviction of the previous alloc (destructive updates) ----------
+        def pick_g(arr, fill=0):
+            # arr[g] without gather/dot: one-hot mask + sum (exactly one
+            # non-zero term, so float results are exact)
+            shape = (g_count,) + (1,) * (arr.ndim - 1)
+            return jnp.sum(jnp.where(sel_g.reshape(shape), arr, fill), axis=0)
+
+        skip_step = jnp.any(sel_g & failed)
+
+        # -- eviction of the previous alloc (one-hot adds) -----------------
         do_evict = (evict_node >= 0) & (~skip_step)
         ev_node = jnp.maximum(evict_node, 0)
         ev_tg = jnp.maximum(evict_tg, 0)
-        evict_vec = jnp.where(do_evict, evict_res, 0.0)
-        used = used.at[ev_node].add(-evict_vec)
-        dec_tg = jnp.where(do_evict & (evict_tg >= 0), 1, 0)
-        tg_counts = tg_counts.at[ev_tg, ev_node].add(-dec_tg)
-        job_counts = job_counts.at[ev_node].add(-jnp.where(do_evict, 1, 0))
-        # The evicted alloc's spread usage clears too (host: propertyset
-        # cleared_values from plan.node_update; floor-at-zero applied at read).
-        ev_vids = spread_vids[ev_tg, :, ev_node]  # [S]
-        ev_dec = jnp.where(
-            do_evict & (evict_tg >= 0) & spread_active[ev_tg], 1.0, 0.0
-        )
-        spread_counts = spread_counts.at[ev_tg, s_axis, ev_vids].add(-ev_dec)
+        oh_ev_node = (iota == ev_node)              # [N]
+        oh_ev_nodef = oh_ev_node.astype(fdt)
+        sel_evg = (iota_g == ev_tg)                 # [G]
 
-        ask = asks[g]  # [D]
+        def pick_evg(arr, fill=0):
+            shape = (g_count,) + (1,) * (arr.ndim - 1)
+            return jnp.sum(jnp.where(sel_evg.reshape(shape), arr, fill), axis=0)
+
+        evict_vec = jnp.where(do_evict, evict_res, 0.0)  # [D]
+        used = used - oh_ev_nodef[:, None] * evict_vec[None, :]
+        dec_tg = jnp.where(do_evict & (evict_tg >= 0), 1, 0)
+        tg_counts = tg_counts - (sel_evg[:, None] & oh_ev_node[None, :]) * dec_tg
+        job_counts = job_counts - oh_ev_node * jnp.where(do_evict, 1, 0)
+        # The evicted alloc's spread usage clears too (host: propertyset
+        # cleared_values from plan.node_update; floor-at-zero at read).
+        ev_active = pick_evg(spread_active, False)       # [S]
+        ev_dec = jnp.where(do_evict & (evict_tg >= 0) & ev_active, 1.0, 0.0)
+        vids_evg = pick_evg(spread_vids)                 # [S, N]
+        ev_vid = jnp.sum(jnp.where(oh_ev_node[None, :], vids_evg, 0), axis=1)
+        oh_ev_vid = (iota_v[None, :] == ev_vid[:, None]).astype(fdt)  # [S, V]
+        spread_counts = spread_counts - jnp.where(
+            sel_evg[:, None, None], (oh_ev_vid * ev_dec[:, None])[None, :, :], 0.0
+        )
+
+        # -- row selects ---------------------------------------------------
+        ask = pick_g(asks)                               # [D]
+        feas_g = pick_g(feas, False)                     # [N]
+        tg_counts_g = pick_g(tg_counts)                  # [N]
+        desired_g = pick_g(desired_counts).astype(fdt)
+        dh_job_g = jnp.any(sel_g & dh_job)
+        dh_tg_g = jnp.any(sel_g & dh_tg)
+        aff = pick_g(aff_score)
+        aff_p = pick_g(aff_present, False)
 
         # -- feasibility ---------------------------------------------------
         util = used + reserved + ask[None, :]  # [N, D]
@@ -150,12 +199,12 @@ def _make_step():
         # job-level distinct_hosts: any co-located alloc of the job rejects;
         # tg-level requires both a job and task-group collision
         dh_mask = jnp.where(
-            dh_job[g],
+            dh_job_g,
             job_counts == 0,
-            jnp.where(dh_tg[g], ~((tg_counts[g] > 0) & (job_counts > 0)), True),
+            jnp.where(dh_tg_g, ~((tg_counts_g > 0) & (job_counts > 0)), True),
         )
 
-        feasible = feas[g] & fits & dh_mask  # [N]
+        feasible = feas_g & fits & dh_mask  # [N]
 
         # -- score terms ---------------------------------------------------
         node_cpu = totals[:, DIM_CPU] - reserved[:, DIM_CPU]
@@ -165,35 +214,33 @@ def _make_step():
         fitness = 20.0 - (jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem))
         binpack = jnp.clip(fitness, 0.0, 18.0) / 18.0
 
-        fdt = totals.dtype
-        collisions = tg_counts[g].astype(fdt)
+        collisions = tg_counts_g.astype(fdt)
         anti_present = collisions > 0
-        anti = jnp.where(
-            anti_present, -(collisions + 1.0) / desired_counts[g].astype(fdt), 0.0
-        )
+        anti = jnp.where(anti_present, -(collisions + 1.0) / desired_g, 0.0)
 
-        node_ids = jnp.arange(n_pad, dtype=jnp.int32)
-        pmask = jnp.any(node_ids[:, None] == penalty_idx[None, :], axis=-1)
+        pmask = jnp.any(iota[:, None] == penalty_idx[None, :], axis=-1)
         resched = jnp.where(pmask, -1.0, 0.0)
 
-        aff = aff_score[g]
-        aff_p = aff_present[g]
-
-        # spread scoring
-        vids = spread_vids[g]  # [S, N]
+        # spread scoring — value-id lookups as one-hot sums over V
+        vids = pick_g(spread_vids)                       # [S, N]
         # floor-at-zero matches the host's cleared-value clamping
-        s_counts = jnp.maximum(spread_counts[g], 0.0)  # [S, V+1]
-        s_entry = spread_entry[g]
-        v_plus = s_counts.shape[-1]
-        invalid_bucket = v_plus - 1
+        s_counts = jnp.maximum(pick_g(spread_counts), 0.0)  # [S, V]
+        s_entry = pick_g(spread_entry, False)            # [S, V]
+        desired_sv = pick_g(spread_desired)              # [S, V]
+        weights_s = pick_g(spread_weights)
+        has_targets_s = pick_g(spread_has_targets, False)
+        active_s = pick_g(spread_active, False)
 
-        big = jnp.finfo(totals.dtype).max / 16.0
-        used_count = jnp.take_along_axis(s_counts, vids, axis=1) + 1.0  # [S, N]
-        d = jnp.take_along_axis(spread_desired[g], vids, axis=1)  # [S, N]
+        invalid_bucket = v_plus - 1
+        big = jnp.finfo(fdt).max / 16.0
+        oh_vids = vids[:, None, :] == iota_v[None, :, None]  # [S, V, N]
+        current = jnp.sum(jnp.where(oh_vids, s_counts[:, :, None], 0.0), axis=1)
+        used_count = current + 1.0                       # [S, N]
+        d = jnp.sum(jnp.where(oh_vids, desired_sv[:, :, None], 0.0), axis=1)
         missing = vids == invalid_bucket
         # divisor: the host SpreadIterator's weight sum accumulates across
         # visited task groups in the eval -> passed per placement (sum_sw_p)
-        weight_frac = spread_weights[g][:, None] / jnp.maximum(sum_sw_p, 1e-9)
+        weight_frac = weights_s[:, None] / jnp.maximum(sum_sw_p, 1e-9)
         # Go float semantics: d == 0 -> -Inf boost (clamped large negative)
         targeted_raw = jnp.where(
             d > 0.0,
@@ -207,7 +254,6 @@ def _make_step():
         min_c = jnp.where(has_entries, jnp.min(entry_counts, axis=-1), 0.0)  # [S]
         max_counts = jnp.where(s_entry[:, :invalid_bucket], s_counts[:, :invalid_bucket], -jnp.inf)
         max_c = jnp.where(has_entries, jnp.max(max_counts, axis=-1), 0.0)
-        current = jnp.take_along_axis(s_counts, vids, axis=1)  # [S, N] (without +1)
         delta_boost = jnp.where(
             min_c[:, None] == 0.0, -1.0, (min_c[:, None] - current) / jnp.maximum(min_c[:, None], 1e-9)
         )
@@ -226,9 +272,9 @@ def _make_step():
         )
         even = jnp.where(has_entries[:, None], even, 0.0)
 
-        per_spread = jnp.where(spread_has_targets[g][:, None], targeted_raw, even)
+        per_spread = jnp.where(has_targets_s[:, None], targeted_raw, even)
         per_spread = jnp.where(missing, -1.0, per_spread)
-        per_spread = jnp.where(spread_active[g][:, None], per_spread, 0.0)
+        per_spread = jnp.where(active_s[:, None], per_spread, 0.0)
         spread_total = jnp.sum(per_spread, axis=0)  # [N]
         spread_p = spread_total != 0.0
 
@@ -241,75 +287,95 @@ def _make_step():
         )
         final = (binpack + anti + resched + jnp.where(aff_p, aff, 0.0) + spread_total) / num_terms
 
-        # -- ring-ordered limit + max-score selection ----------------------
-        iota = jnp.arange(n_pad, dtype=jnp.int32)
-        perm = jnp.where(iota < n_real, (offset + iota) % jnp.maximum(n_real, 1), 0)
+        # -- ring-ordered limit + max-score selection (no permutation) -----
+        # Ring prefix sums at natural index i: with S = natural inclusive
+        # cumsum, T = total, o = offset, the ring-order cumsum is
+        # S(i) - S(o-1) for i >= o and S(i) + (T - S(o-1)) for i < o —
+        # elementwise, so the LimitIterator emulation needs no gathers.
         valid = iota < n_real
+        nr = jnp.maximum(n_real, 1)
 
-        feas_r = jnp.where(valid, feasible[perm], False)
-        score_r = final[perm]
+        def ring_cumsum(a_int):
+            s_nat = jnp.cumsum(a_int)
+            total = s_nat[-1]
+            before_off = jnp.sum(jnp.where(iota < offset, a_int, 0))
+            return jnp.where(
+                iota >= offset, s_nat - before_off, s_nat + (total - before_off)
+            )
 
-        low = feas_r & (score_r <= SKIP_SCORE_THRESHOLD)
-        low_cum = jnp.cumsum(low.astype(jnp.int32))
+        feas_v = feasible & valid
+        low = feas_v & (final <= SKIP_SCORE_THRESHOLD)
+        low_i = low.astype(jnp.int32)
+        low_cum = ring_cumsum(low_i)
         skipped = low & (low_cum <= MAX_SKIP)
-        ret = feas_r & ~skipped
-        ret_cum = jnp.cumsum(ret.astype(jnp.int32))
-        ret_excl = ret_cum - ret.astype(jnp.int32)
+        ret = feas_v & ~skipped
+        ret_i = ret.astype(jnp.int32)
+        ret_cum = ring_cumsum(ret_i)
+        ret_excl = ret_cum - ret_i
 
         limit = limit_p
         pulled = valid & (ret_excl < limit)
         src_cand = ret & pulled
-        ret_total = ret_cum[-1] if n_pad > 0 else 0
+        ret_total = jnp.sum(ret_i)
         backlog_n = jnp.maximum(limit - ret_total, 0)
-        skip_cum = jnp.cumsum(skipped.astype(jnp.int32))
-        skip_excl = skip_cum - skipped.astype(jnp.int32)
+        skip_i = skipped.astype(jnp.int32)
+        skip_cum = ring_cumsum(skip_i)
+        skip_excl = skip_cum - skip_i
         backlog_cand = skipped & (skip_excl < backlog_n)
         cand = src_cand | backlog_cand
 
+        # ranks are unique across candidates (source ranks < ret_total <=
+        # backlog ranks), so (max score, min rank) names one node exactly
         rank = jnp.where(src_cand, ret_excl, ret_total + skip_excl)
 
         neg_inf = -jnp.inf
-        cand_scores = jnp.where(cand, score_r, neg_inf)
+        cand_scores = jnp.where(cand, final, neg_inf)
         best_score = jnp.max(cand_scores)
         winners = cand & (cand_scores == best_score)
         winner_rank = jnp.where(winners, rank, jnp.int32(2**31 - 1))
         best_rank = jnp.min(winner_rank)
-        chosen_r = jnp.argmax(winners & (rank == best_rank))
         any_cand = jnp.any(cand)
-        chosen = jnp.where(any_cand & (~skip_step), perm[chosen_r], -1)
+        chosen = jnp.where(
+            any_cand & (~skip_step),
+            jnp.argmax(winners & (rank == best_rank)).astype(jnp.int32),
+            -1,
+        )
 
         pulls = jnp.where(skip_step, 0, jnp.sum(pulled.astype(jnp.int32))).astype(jnp.int32)
-        offset = jnp.where(
-            skip_step, offset, (offset + pulls) % jnp.maximum(n_real, 1)
-        ).astype(jnp.int32)
+        offset = jnp.where(skip_step, offset, (offset + pulls) % nr).astype(jnp.int32)
 
-        # -- apply placement / revert eviction on failure ------------------
+        # -- apply placement / revert eviction (one-hot adds) --------------
         success = chosen >= 0
         ch = jnp.maximum(chosen, 0)
+        oh_ch = (iota == ch)
+        oh_chf = oh_ch.astype(fdt)
         add_vec = jnp.where(success, ask, 0.0)
-        used = used.at[ch].add(add_vec)
-        tg_counts = tg_counts.at[g, ch].add(jnp.where(success, 1, 0))
-        job_counts = job_counts.at[ch].add(jnp.where(success, 1, 0))
+        used = used + oh_chf[:, None] * add_vec[None, :]
+        inc_i = jnp.where(success, 1, 0)
+        tg_counts = tg_counts + (sel_g[:, None] & oh_ch[None, :]) * inc_i
+        job_counts = job_counts + oh_ch * inc_i
 
-        ch_vids = vids[:, ch]  # [S]
-        s_idx = jnp.arange(vids.shape[0])
-        inc = jnp.where(success & spread_active[g], 1.0, 0.0)
-        spread_counts = spread_counts.at[g, s_idx, ch_vids].add(inc)
-        spread_entry = spread_entry.at[g, s_idx, ch_vids].set(
-            spread_entry[g, s_idx, ch_vids] | (inc > 0)
+        ch_vid = jnp.sum(jnp.where(oh_ch[None, :], vids, 0), axis=1)  # [S]
+        oh_ch_vid = (iota_v[None, :] == ch_vid[:, None])              # [S, V]
+        inc = jnp.where(success & active_s, 1.0, 0.0)
+        spread_counts = spread_counts + jnp.where(
+            sel_g[:, None, None], (oh_ch_vid.astype(fdt) * inc[:, None])[None, :, :], 0.0
         )
+        entry_set = sel_g[:, None, None] & (oh_ch_vid & (inc > 0)[:, None])[None, :, :]
+        spread_entry = spread_entry | entry_set
 
         # failed placement: revert eviction, mark TG failed
         revert = do_evict & (~success)
-        used = used.at[ev_node].add(jnp.where(revert, evict_res, 0.0))
-        tg_counts = tg_counts.at[ev_tg, ev_node].add(
-            jnp.where(revert & (evict_tg >= 0), 1, 0)
+        used = used + oh_ev_nodef[:, None] * jnp.where(revert, evict_res, 0.0)[None, :]
+        rev_i = jnp.where(revert & (evict_tg >= 0), 1, 0)
+        tg_counts = tg_counts + (sel_evg[:, None] & oh_ev_node[None, :]) * rev_i
+        job_counts = job_counts + oh_ev_node * jnp.where(revert, 1, 0)
+        spread_counts = spread_counts + jnp.where(
+            sel_evg[:, None, None],
+            (oh_ev_vid * jnp.where(revert, ev_dec, 0.0)[:, None])[None, :, :],
+            0.0,
         )
-        job_counts = job_counts.at[ev_node].add(jnp.where(revert, 1, 0))
-        spread_counts = spread_counts.at[ev_tg, s_axis, ev_vids].add(
-            jnp.where(revert, ev_dec, 0.0)
-        )
-        failed = failed.at[g].set(failed[g] | ((~success) & (~skip_step)))
+        failed = failed | (sel_g & ((~success) & (~skip_step)))
 
         new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry, offset, failed)
         out = (chosen, jnp.where(success, best_score, 0.0), pulls, skip_step)

@@ -1,0 +1,580 @@
+"""Preemption tests, mirroring reference scheduler/preemption_test.go.
+
+Table-driven cases run through the full BinPackIterator(evict=True) path —
+the same entry the schedulers use — covering TG (cpu/mem/disk), network
+(bandwidth + static ports) and device preemption, distance metrics, the
+maxParallel penalty and the superset filter, plus an end-to-end system-job
+preemption scenario against a running server.
+"""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.preemption import (
+    MAX_PARALLEL_PENALTY,
+    basic_resource_distance,
+    network_resource_distance,
+    score_for_task_group,
+)
+from nomad_tpu.scheduler.rank import BinPackIterator, RankedNode, StaticRankIterator
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs.structs import (
+    AllocatedDeviceResource,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Allocation,
+    ComparableResources,
+    NetworkResource,
+    NodeDeviceInstance,
+    NodeDeviceResource,
+    NodeReservedResources,
+    NodeResources,
+    Port,
+    RequestedDevice,
+    Resources,
+    TaskGroup,
+    generate_uuid,
+)
+
+WEB = "web"
+
+
+def comparable(cpu=0, mem=0, disk=0, networks=()):
+    c = ComparableResources()
+    c.flattened.cpu_shares = cpu
+    c.flattened.memory_mb = mem
+    c.flattened.networks = list(networks)
+    c.shared.disk_mb = disk
+    return c
+
+
+class TestResourceDistance:
+    """Mirrors TestResourceDistance (preemption_test.go:16) — identical
+    asks/allocs, identical expected distances to 3 decimals."""
+
+    ASK = comparable(cpu=2048, mem=512, disk=4096,
+                     networks=[NetworkResource(device="eth0", mbits=1024)])
+
+    @pytest.mark.parametrize("alloc_res,expected", [
+        (comparable(2048, 512, 4096, [NetworkResource(device="eth0", mbits=1024)]), "0.000"),
+        (comparable(1024, 400, 1024, [NetworkResource(device="eth0", mbits=1024)]), "0.928"),
+        (comparable(8192, 200, 1024, [NetworkResource(device="eth0", mbits=512)]), "3.152"),
+        (comparable(2048, 500, 4096, [NetworkResource(device="eth0", mbits=1024)]), "0.023"),
+    ])
+    def test_distance(self, alloc_res, expected):
+        assert f"{basic_resource_distance(self.ASK, alloc_res):.3f}" == expected
+
+    def test_network_distance(self):
+        used = NetworkResource(device="eth0", mbits=1024)
+        need = NetworkResource(device="eth0", mbits=1024)
+        assert network_resource_distance(used, need) == 0.0
+        need2 = NetworkResource(device="eth0", mbits=512)
+        assert network_resource_distance(used, need2) == 1.0
+        assert network_resource_distance(None, need) == float("inf")
+        assert network_resource_distance(used, NetworkResource(mbits=0)) == float("inf")
+
+    def test_max_parallel_penalty(self):
+        ask = comparable(100, 100, 100)
+        used = comparable(100, 100, 100)
+        base = score_for_task_group(ask, used, max_parallel=0, num_preempted=5)
+        assert base == 0.0
+        # at/over the limit: +50 per excess eviction
+        assert score_for_task_group(ask, used, 2, 2) == MAX_PARALLEL_PENALTY
+        assert score_for_task_group(ask, used, 2, 3) == 2 * MAX_PARALLEL_PENALTY
+
+
+# ---------------------------------------------------------------------------
+# Table cases through BinPackIterator(evict=True) — preemption_test.go:144
+# ---------------------------------------------------------------------------
+
+
+def make_job(priority):
+    j = mock.job()
+    j.priority = priority
+    return j
+
+
+def create_alloc(alloc_id, job, cpu, mem, disk, networks=None, devices=None,
+                 tg_network=None):
+    """preemption_test.go createAllocInner equivalent."""
+    tr = AllocatedTaskResources(cpu_shares=cpu, memory_mb=mem,
+                                networks=list(networks or []))
+    if devices is not None:
+        tr.devices = [devices]
+    shared = AllocatedSharedResources(disk_mb=disk)
+    if tg_network is not None:
+        shared.networks = [tg_network]
+    return Allocation(
+        id=alloc_id,
+        job=job,
+        job_id=job.id,
+        namespace="default",
+        eval_id=generate_uuid(),
+        desired_status="run",
+        client_status="running",
+        task_group=WEB,
+        allocated_resources=AllocatedResources(tasks={WEB: tr}, shared=shared),
+    )
+
+
+def default_node_resources():
+    return NodeResources(
+        cpu_shares=4000,
+        memory_mb=8192,
+        disk_mb=100 * 1024,
+        networks=[NetworkResource(device="eth0", cidr="192.168.0.100/32",
+                                  ip="192.168.0.100", mbits=1000)],
+        devices=[
+            NodeDeviceResource(
+                vendor="nvidia", type="gpu", name="1080ti",
+                instances=[NodeDeviceInstance(id=f"dev{i}") for i in range(4)],
+            ),
+            NodeDeviceResource(
+                vendor="nvidia", type="gpu", name="2080ti",
+                instances=[NodeDeviceInstance(id=f"dev{i}") for i in range(4, 9)],
+            ),
+            NodeDeviceResource(
+                vendor="intel", type="fpga", name="F100",
+                instances=[NodeDeviceInstance(id="fpga1"),
+                           NodeDeviceInstance(id="fpga2", healthy=False)],
+            ),
+        ],
+    )
+
+
+RESERVED = NodeReservedResources(cpu_shares=100, memory_mb=256, disk_mb=4 * 1024)
+
+
+def run_case(current_allocs, job_priority, ask_resources, node_resources=None,
+             reserved=RESERVED, current_preemptions=None, devices=None):
+    """Run one table case through BinPackIterator(evict=True); returns the
+    selected option (or None) — preemption_test.go:1327 runner."""
+    node = mock.node()
+    node.node_resources = node_resources or default_node_resources()
+    node.reserved_resources = reserved
+    node.compute_class()
+
+    state = StateStore()
+    state.upsert_node(1000, node)
+    for alloc in current_allocs:
+        alloc.node_id = node.id
+    state.upsert_allocs(1001, current_allocs)
+
+    job = make_job(job_priority)
+    ev = mock.eval()
+    plan = ev.make_plan(job)
+    ctx = EvalContext(state, plan, deterministic=True)
+    if current_preemptions:
+        ctx.plan.node_preemptions[node.id] = current_preemptions
+
+    static = StaticRankIterator(ctx, [RankedNode(node)])
+    it = BinPackIterator(ctx, static, True, job_priority)
+    it.set_job(job)
+
+    import copy as _copy
+
+    tg = TaskGroup(name=WEB)
+    tg.tasks = [_copy.deepcopy(mock.job().task_groups[0].tasks[0])]
+    tg.tasks[0].name = WEB
+    tg.tasks[0].resources = ask_resources
+    if devices:
+        tg.tasks[0].resources.devices = devices
+    it.set_task_group(tg)
+    return it.next()
+
+
+def assert_preempted(option, expected_ids):
+    if expected_ids is None:
+        assert option is None, "expected no feasible option"
+        return
+    assert option is not None, "expected a feasible option with preemption"
+    got = {a.id for a in (option.preempted_allocs or [])}
+    assert got == set(expected_ids)
+
+
+A = [generate_uuid() for _ in range(6)]
+HIGH = make_job(100)
+LOW = make_job(30)
+LOW2 = make_job(40)
+
+
+def ask(cpu, mem, disk, networks=None):
+    r = Resources(cpu=cpu, memory_mb=mem)
+    r.disk_mb = disk
+    if networks:
+        r.networks = networks
+    return r
+
+
+class TestPreemptionTable:
+    def test_no_preemption_high_priority_existing(self):
+        """No preemption because existing allocs are not low priority."""
+        allocs = [create_alloc(A[0], HIGH, 3200, 7256, 4 * 1024,
+                               [NetworkResource(device="eth0", ip="192.168.0.100", mbits=50)])]
+        option = run_case(allocs, 100, ask(
+            2000, 256, 4 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=1,
+                             reserved_ports=[Port("ssh", 22)])]))
+        assert_preempted(option, None)
+
+    def test_low_priority_not_enough(self):
+        """Preempting low priority allocs not enough to meet resource ask."""
+        allocs = [create_alloc(A[0], LOW, 3200, 7256, 4 * 1024,
+                               [NetworkResource(device="eth0", ip="192.168.0.100", mbits=50)])]
+        option = run_case(allocs, 100, ask(
+            4000, 8192, 4 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=1,
+                             reserved_ports=[Port("ssh", 22)])]))
+        assert_preempted(option, None)
+
+    def test_static_port_held_by_high_priority(self):
+        """preemption impossible — static port needed is used by a higher
+        priority alloc."""
+        allocs = [
+            create_alloc(A[0], HIGH, 1200, 2256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], HIGH, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=600,
+                                          reserved_ports=[Port("db", 88)])]),
+        ]
+        option = run_case(allocs, 100, ask(
+            600, 1000, 25 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=700,
+                             reserved_ports=[Port("db", 88)])]))
+        assert_preempted(option, None)
+
+    def test_preempt_from_device_with_free_port(self):
+        """preempt only from device that has allocation with unused
+        reserved port (two-NIC node)."""
+        allocs = [
+            create_alloc(A[0], HIGH, 1200, 2256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], HIGH, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth1", ip="192.168.0.200", mbits=600,
+                                          reserved_ports=[Port("db", 88)])]),
+            create_alloc(A[2], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=600)]),
+        ]
+        two_nic = NodeResources(
+            cpu_shares=4000, memory_mb=8192, disk_mb=100 * 1024,
+            networks=[
+                NetworkResource(device="eth0", cidr="192.168.0.100/32",
+                                ip="192.168.0.100", mbits=1000),
+                NetworkResource(device="eth1", cidr="192.168.1.100/32",
+                                ip="192.168.1.100", mbits=1000),
+            ],
+        )
+        option = run_case(allocs, 100, ask(
+            600, 1000, 25 * 1024,
+            [NetworkResource(ip="192.168.0.100", mbits=700,
+                             reserved_ports=[Port("db", 88)])]),
+            node_resources=two_nic)
+        assert_preempted(option, {A[2]})
+
+    def test_high_low_mix_without_static_ports(self):
+        """Combination of high/low priority allocs, without static ports
+        (incl. a TG-level network alloc)."""
+        allocs = [
+            create_alloc(A[0], HIGH, 2800, 2256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=200)],
+                         tg_network=NetworkResource(device="eth0", ip="192.168.0.201", mbits=300)),
+            create_alloc(A[2], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=300)]),
+            create_alloc(A[3], LOW, 700, 256, 4 * 1024),
+        ]
+        option = run_case(allocs, 100, ask(
+            1100, 1000, 25 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=840)]))
+        assert_preempted(option, {A[1], A[2], A[3]})
+
+    def test_preempt_allocs_with_network(self):
+        """preempt allocs with network devices."""
+        allocs = [
+            create_alloc(A[0], LOW, 2800, 2256, 4 * 1024),
+            create_alloc(A[1], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=800)]),
+        ]
+        option = run_case(allocs, 100, ask(
+            1100, 1000, 25 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=840)]))
+        assert_preempted(option, {A[1]})
+
+    def test_close_priority_ignored_for_network(self):
+        """ignore allocs with close enough priority for network devices."""
+        allocs = [
+            create_alloc(A[0], LOW, 2800, 2256, 4 * 1024),
+            create_alloc(A[1], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=800)]),
+        ]
+        option = run_case(allocs, LOW.priority + 5, ask(
+            1100, 1000, 25 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=840)]))
+        assert_preempted(option, None)
+
+    def test_all_but_network(self):
+        """Preemption needed for all resources except network."""
+        allocs = [
+            create_alloc(A[0], HIGH, 2800, 2256, 40 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=50)]),
+            create_alloc(A[2], LOW, 200, 512, 25 * 1024),
+        ]
+        option = run_case(allocs, 100, ask(
+            1000, 3000, 50 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=50)]))
+        assert_preempted(option, {A[1], A[2]})
+
+    def test_only_one_low_priority_needed(self):
+        """Only one low priority alloc needs to be preempted."""
+        allocs = [
+            create_alloc(A[0], HIGH, 1200, 2256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=500)]),
+            create_alloc(A[2], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=320)]),
+        ]
+        option = run_case(allocs, 100, ask(
+            300, 500, 5 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=320)]))
+        assert_preempted(option, {A[2]})
+
+    def test_static_port_and_mbits_combination(self):
+        """one alloc meets static port need, another meets remaining mbits
+        needed."""
+        allocs = [
+            create_alloc(A[0], HIGH, 1200, 2256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=500,
+                                          reserved_ports=[Port("db", 88)])]),
+            create_alloc(A[2], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=200)]),
+        ]
+        option = run_case(allocs, 100, ask(
+            2700, 1000, 25 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=800,
+                             reserved_ports=[Port("db", 88)])]))
+        assert_preempted(option, {A[1], A[2]})
+
+    def test_static_port_alloc_covers_everything(self):
+        """alloc that meets static port need also meets other needs."""
+        allocs = [
+            create_alloc(A[0], HIGH, 1200, 2256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=600,
+                                          reserved_ports=[Port("db", 88)])]),
+            create_alloc(A[2], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=100)]),
+        ]
+        option = run_case(allocs, 100, ask(
+            600, 1000, 25 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=700,
+                             reserved_ports=[Port("db", 88)])]))
+        assert_preempted(option, {A[1]})
+
+    def test_existing_evictions_avoided(self):
+        """alloc from job that has existing evictions not chosen for
+        preemption (preemption_test.go:910 — the maxParallel penalty
+        steers selection away from lowPrioJob2, which already has a
+        planned eviction)."""
+        from nomad_tpu.structs.structs import MigrateStrategy
+
+        low2 = make_job(40)
+        low2.task_groups[0].name = WEB
+        low2.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+
+        allocs = [
+            create_alloc(A[0], HIGH, 1200, 2256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], LOW, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=500)]),
+            create_alloc(A[2], low2, 200, 256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=300)]),
+        ]
+        # a previous eviction of low2 is already in the plan
+        prior = create_alloc(generate_uuid(), low2, 200, 256, 4 * 1024,
+                             [NetworkResource(device="eth0", ip="192.168.0.100",
+                                              mbits=300)])
+        option = run_case(
+            allocs, 100,
+            ask(300, 500, 5 * 1024,
+                [NetworkResource(device="eth0", ip="192.168.0.100", mbits=320)]),
+            current_preemptions=[prior],
+        )
+        assert_preempted(option, {A[1]})
+
+
+def gpu_device(ids, name="1080ti"):
+    return AllocatedDeviceResource(vendor="nvidia", type="gpu", name=name,
+                                   device_ids=list(ids))
+
+
+class TestDevicePreemption:
+    def test_one_device_instance_per_alloc(self):
+        """Preemption with one device instance per alloc."""
+        allocs = [
+            create_alloc(A[0], LOW, 500, 512, 4 * 1024, devices=gpu_device(["dev0"])),
+            create_alloc(A[1], LOW, 200, 512, 4 * 1024, devices=gpu_device(["dev1"])),
+            create_alloc(A[2], LOW, 200, 512, 4 * 1024, devices=gpu_device(["dev2"])),
+            create_alloc(A[3], LOW, 100, 512, 4 * 1024, devices=gpu_device(["dev3"])),
+        ]
+        option = run_case(allocs, 100, ask(1000, 512, 4 * 1024),
+                          devices=[RequestedDevice(name="nvidia/gpu/1080ti", count=4)])
+        assert_preempted(option, {A[0], A[1], A[2], A[3]})
+
+    def test_multiple_devices_used(self):
+        """Preemption multiple devices used."""
+        allocs = [
+            create_alloc(A[0], LOW, 500, 512, 4 * 1024,
+                         devices=gpu_device(["dev0", "dev1"])),
+            create_alloc(A[1], LOW, 200, 512, 4 * 1024,
+                         devices=gpu_device(["fpga1"], name="F100")),
+        ]
+        # fix up the fpga alloc's device identity
+        allocs[1].allocated_resources.tasks[WEB].devices = [
+            AllocatedDeviceResource(vendor="intel", type="fpga", name="F100",
+                                    device_ids=["fpga1"])
+        ]
+        option = run_case(allocs, 100, ask(1000, 512, 4 * 1024),
+                          devices=[RequestedDevice(name="nvidia/gpu/1080ti", count=4)])
+        assert_preempted(option, {A[0]})
+
+    def test_lower_higher_priority_combination(self):
+        """Preemption with lower/higher priority combinations — prefer the
+        cheaper (lower net priority) option."""
+        allocs = [
+            create_alloc(A[0], LOW, 500, 512, 4 * 1024,
+                         devices=gpu_device(["dev0", "dev1"])),
+            create_alloc(A[1], LOW2, 200, 512, 4 * 1024,
+                         devices=gpu_device(["dev2", "dev3"])),
+            create_alloc(A[2], LOW, 200, 512, 4 * 1024,
+                         devices=gpu_device(["dev4", "dev5"], name="2080ti")),
+            create_alloc(A[3], LOW, 100, 512, 4 * 1024,
+                         devices=gpu_device(["dev6", "dev7"], name="2080ti")),
+        ]
+        option = run_case(allocs, 100, ask(1000, 512, 4 * 1024),
+                          devices=[RequestedDevice(name="nvidia/gpu/2080ti", count=4)])
+        assert_preempted(option, {A[2], A[3]})
+
+    def test_device_preemption_impossible(self):
+        """Device preemption not possible due to more instances needed
+        than available."""
+        allocs = [
+            create_alloc(A[0], LOW, 500, 512, 4 * 1024,
+                         devices=gpu_device(["dev0", "dev1"])),
+        ]
+        option = run_case(allocs, 100, ask(1000, 512, 4 * 1024),
+                          devices=[RequestedDevice(name="nvidia/gpu/1080ti", count=6)])
+        assert_preempted(option, None)
+
+    def test_free_instances_avoid_preemption(self):
+        """Enough free instances on another device: no preemption needed."""
+        allocs = [
+            create_alloc(A[0], LOW, 500, 512, 4 * 1024,
+                         devices=gpu_device(["dev0", "dev1"])),
+        ]
+        option = run_case(allocs, 100, ask(1000, 512, 4 * 1024),
+                          devices=[RequestedDevice(name="nvidia/gpu/2080ti", count=2)])
+        assert option is not None
+        assert not option.preempted_allocs
+
+
+class TestSupersetFilter:
+    def test_filter_out_covered_allocs(self):
+        """Filter out allocs whose resource usage superset is also in the
+        preemption list (preemption_test.go:1267)."""
+        allocs = [
+            create_alloc(A[0], HIGH, 1800, 2256, 4 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=150)]),
+            create_alloc(A[1], LOW, 1500, 256, 5 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.100", mbits=100)]),
+            create_alloc(A[2], LOW, 600, 256, 5 * 1024,
+                         [NetworkResource(device="eth0", ip="192.168.0.200", mbits=300)]),
+        ]
+        option = run_case(allocs, 100, ask(
+            1000, 256, 5 * 1024,
+            [NetworkResource(device="eth0", ip="192.168.0.100", mbits=50)]))
+        assert_preempted(option, {A[1]})
+
+
+class TestSystemJobPreemptionE2E:
+    def test_system_job_preempts_lower_priority_service(self):
+        """End-to-end: a high-priority system job displaces a low-priority
+        service alloc on a full node; the preempted job gets a follow-up
+        eval (EVAL_TRIGGER_PREEMPTION) and reschedules elsewhere."""
+        import time
+
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.structs import (
+            EVAL_TRIGGER_PREEMPTION,
+            SchedulerConfiguration,
+        )
+
+        server = Server(ServerConfig(num_schedulers=2))
+        try:
+            server.start()
+            # enable service/system preemption (PreemptionConfig)
+            _, cfg = server.fsm.state.scheduler_config()
+            cfg = cfg or SchedulerConfiguration()
+            cfg.preemption_config.system_scheduler_enabled = True
+            server.raft_apply("scheduler-config", cfg)
+
+            small = mock.node()
+            small.node_resources.cpu_shares = 1500
+            small.node_resources.memory_mb = 1500
+            small.compute_class()
+            server.register_node(small)
+
+            low_job = mock.job()
+            low_job.priority = 20
+            low_job.task_groups[0].count = 1
+            low_job.task_groups[0].tasks[0].resources.cpu = 1000
+            low_job.task_groups[0].tasks[0].resources.memory_mb = 900
+            low_job.task_groups[0].tasks[0].resources.networks = []
+            server.register_job(low_job)
+
+            def allocs_of(job):
+                return [
+                    a for a in server.fsm.state.allocs_by_job("default", job.id, True)
+                    if a.desired_status == "run"
+                ]
+
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not allocs_of(low_job):
+                time.sleep(0.05)
+            assert allocs_of(low_job), "low priority job should place first"
+
+            sys_job = mock.system_job()
+            sys_job.priority = 100
+            sys_job.task_groups[0].tasks[0].resources.cpu = 1000
+            sys_job.task_groups[0].tasks[0].resources.memory_mb = 900
+            sys_job.task_groups[0].tasks[0].resources.networks = []
+            server.register_job(sys_job)
+
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not allocs_of(sys_job):
+                time.sleep(0.05)
+            assert allocs_of(sys_job), "system job should place via preemption"
+
+            # the low-priority alloc was evicted and marked preempted
+            deadline = time.monotonic() + 10
+            def evicted():
+                return [
+                    a for a in server.fsm.state.allocs_by_job("default", low_job.id, True)
+                    if a.desired_status == "evict" or a.preempted_by_allocation
+                ]
+            while time.monotonic() < deadline and not evicted():
+                time.sleep(0.05)
+            assert evicted(), "low priority alloc should be preempted"
+
+            # a preemption-triggered follow-up eval exists for the loser
+            evals = server.fsm.state.evals_by_job("default", low_job.id)
+            assert any(e.triggered_by == EVAL_TRIGGER_PREEMPTION for e in evals)
+        finally:
+            server.stop()
