@@ -168,15 +168,23 @@ class Planner:
                     snap = self._snapshot_min_index(
                         max(prev_plan_result_index, pending.plan.snapshot_index)
                     )
-                    if not saw_inflight:
-                        # the evaluation ran blind to the plan that just
-                        # committed — re-validate against state including it
+                    # Re-validate against committed state when the
+                    # evaluation could not be trusted: it either ran blind
+                    # to the in-flight plan, or ran on optimism the failed
+                    # apply (idx == 0) never delivered — dispatching
+                    # unchecked in the latter case would commit placements
+                    # into capacity whose stops never landed.
+                    if not saw_inflight or idx == 0:
                         result = self.evaluate_plan(snap, pending.plan)
                         if result.is_noop():
                             pending.future.set_result(result)
                             continue
 
-                apply_future = self._dispatch_apply(pending, result, snap)
+                apply_future, snap_ok = self._dispatch_apply(pending, result, snap)
+                if not snap_ok:
+                    # the optimistic fold-in failed partway: the snapshot
+                    # is inconsistent — never evaluate against it again
+                    snap = None
             except Exception as e:  # noqa: BLE001 — worker gets the error
                 self.logger.exception("plan apply failed")
                 if not pending.future.done():
@@ -384,14 +392,17 @@ class Planner:
         }
 
     def _dispatch_apply(self, pending: PendingPlan, result: PlanResult,
-                        snap) -> Future:
+                        snap) -> Tuple[Future, bool]:
         """Fire the raft apply asynchronously (plan_apply.go applyPlan +
         asyncPlanWait): optimistically fold the results into ``snap`` so
         the NEXT plan evaluates as if this one succeeded, respond to the
-        waiting worker from the apply waiter, and return a Future that
-        resolves to the committed index (0 on failure)."""
+        waiting worker from the apply waiter, and return (index_future,
+        snap_ok) — the future resolves to the committed index (0 on
+        failure); snap_ok is False when the optimistic fold-in failed and
+        the snapshot must be discarded."""
         plan = pending.plan
         payload = self._build_payload(snap, plan, result)
+        snap_ok = True
 
         # Optimistic application to our private snapshot view: the raft
         # log is the pessimistic truth; this view lets plan N+1 verify
@@ -413,8 +424,10 @@ class Planner:
                 eval_id=payload["eval_id"],
                 timestamp_ns=payload["timestamp_ns"],
             )
-        except Exception:  # noqa: BLE001 — optimism only; raft is truth
+        except Exception:  # noqa: BLE001 — optimism only; raft is truth,
+            # but a half-mutated snapshot must not be reused
             self.logger.exception("optimistic snapshot apply failed")
+            snap_ok = False
 
         index_future: Future = Future()
 
@@ -442,7 +455,7 @@ class Planner:
                 index_future.set_result(0)
 
         threading.Thread(target=waiter, name="plan-apply-wait", daemon=True).start()
-        return index_future
+        return index_future, snap_ok
 
     def apply_plan(self, plan: Plan) -> PlanResult:
         """Synchronous evaluate+apply (tests / direct callers); the

@@ -12,6 +12,8 @@ are still rejected exactly as the sequential allocs_fit loop did.
 import threading
 import time
 
+import pytest
+
 from nomad_tpu import mock
 from nomad_tpu.server.plan_apply import Planner, PlanQueue
 from nomad_tpu.server.fsm import NODE_REGISTER, NomadFSM
@@ -254,6 +256,73 @@ class TestPipelinedApply:
             )
             on_node = [a for a in fsm.state.allocs() if a.node_id == node.id]
             assert len(on_node) == 1, "no double-commit on the full node"
+        finally:
+            planner.stop()
+
+    def test_failed_apply_revalidates_follow_up(self):
+        """If in-flight plan A's raft apply FAILS, plan B — evaluated
+        against the optimistic view that assumed A landed — must be
+        re-evaluated against committed state before dispatch. Here A would
+        have filled the node; A fails, so B must succeed."""
+        class FailFirstRaft(SlowRaft):
+            def __init__(self, delay):
+                super().__init__(delay)
+                self.failed_once = False
+
+            def apply(self, peer, entry_type, payload):
+                if entry_type == "apply-plan-results" and not self.failed_once:
+                    self.failed_once = True
+                    time.sleep(self.delay)
+                    raise RuntimeError("injected apply failure")
+                return super().apply(peer, entry_type, payload)
+
+        raft = FailFirstRaft(0.4)
+        fsm = NomadFSM()
+        peer = raft.join(fsm)
+        from nomad_tpu.server.plan_apply import PlanQueue, Planner
+
+        queue = PlanQueue()
+        queue.set_enabled(True)
+        planner = Planner(raft, peer, fsm, queue)
+        node = mock.node()
+        node.node_resources.cpu_shares = 1000
+        node.node_resources.memory_mb = 1000
+        node.compute_class()
+        raft.apply(peer, NODE_REGISTER, node)
+
+        planner.start()
+        try:
+            job_a, job_b = mock.job(), mock.job()
+            plan_a = Plan(eval_id="ea", priority=50, job=job_a)
+            plan_a.node_allocation = {
+                node.id: [make_alloc(job_a, node.id, cpu=700, mem=700)]
+            }
+            plan_b = Plan(eval_id="eb", priority=50, job=job_b)
+            plan_b.node_allocation = {
+                node.id: [make_alloc(job_b, node.id, cpu=700, mem=700)]
+            }
+            pa = queue.enqueue(plan_a)
+            pb = queue.enqueue(plan_b)
+            with pytest.raises(Exception):
+                pa.future.result(timeout=10)
+            rb = pb.future.result(timeout=10)
+            if not rb.node_allocation:
+                # B was fully rejected via the noop fast-path before A's
+                # failure was known: the worker re-plans at refresh_index
+                # (reference semantics). The retry must commit.
+                assert rb.refresh_index > 0
+                retry = Plan(eval_id="eb2", priority=50, job=job_b)
+                retry.snapshot_index = rb.refresh_index
+                retry.node_allocation = {
+                    node.id: [make_alloc(job_b, node.id, cpu=700, mem=700)]
+                }
+                rb = queue.enqueue(retry).future.result(timeout=10)
+            assert rb.node_allocation, (
+                "plan B must commit: A never landed, so the capacity is free"
+            )
+            on_node = [a for a in fsm.state.allocs() if a.node_id == node.id]
+            assert len(on_node) == 1
+            assert on_node[0].job_id == job_b.id
         finally:
             planner.stop()
 

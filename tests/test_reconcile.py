@@ -1,0 +1,639 @@
+"""Reconciler table tests, mirroring reference scheduler/reconcile_test.go.
+
+Each test pins the reconciler's diff output (place/stop/inplace/destructive
+counts, name indexes, desired-TG annotations) for one scenario block of the
+reference matrix: placements, scale up/down, in-place vs destructive
+updates, lost/drained nodes, stopped jobs, multi-TG, reschedule windows
+(now/later, batch/service), canaries, deployment lifecycle and name-index
+reuse.
+"""
+import logging
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.reconcile import AllocReconciler, new_deployment
+from nomad_tpu.scheduler.reconcile_util import alloc_index, alloc_name
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_LOST,
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP,
+    DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED,
+    DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    AllocDeploymentStatus,
+    DeploymentState,
+    DesiredUpdates,
+    Node,
+    RescheduleEvent,
+    ReschedulePolicy,
+    RescheduleTracker,
+    UpdateStrategy,
+    generate_uuid,
+)
+
+LOG = logging.getLogger("test.reconcile")
+NOW_NS = 1_700_000_000 * 10**9
+SECOND_NS = 10**9
+MINUTE_NS = 60 * SECOND_NS
+
+
+def update_fn_ignore(existing, new_job, new_tg):
+    return True, False, None
+
+
+def update_fn_destructive(existing, new_job, new_tg):
+    return False, True, None
+
+
+def update_fn_inplace(existing, new_job, new_tg):
+    return False, False, existing.copy_skip_job()
+
+
+def canary_update():
+    return UpdateStrategy(canary=2, max_parallel=2)
+
+
+def no_canary_update():
+    return UpdateStrategy(max_parallel=4)
+
+
+def make_allocs(job, count, name_idx=None, tg=0):
+    allocs = []
+    for i in range(count):
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        a.node_id = generate_uuid()
+        idx = name_idx(i) if name_idx else i
+        a.name = alloc_name(job.id, job.task_groups[tg].name, idx)
+        a.task_group = job.task_groups[tg].name
+        allocs.append(a)
+    return allocs
+
+
+def reconcile(update_fn, batch, job, allocs, deployment=None, tainted=None,
+              job_id=None, eval_id="eval-1", now_ns=NOW_NS):
+    r = AllocReconciler(
+        LOG, update_fn, batch, job_id or (job.id if job else "dead-job"),
+        job, deployment, allocs or [], tainted or {}, eval_id, now_ns=now_ns,
+    )
+    return r.compute()
+
+
+def assert_results(r, place=0, destructive=0, inplace=0, stop=0,
+                   attribute_updates=0, create_deployment=None,
+                   deployment_updates=0, desired=None):
+    assert len(r.place) == place, f"place: {len(r.place)} != {place}"
+    assert len(r.destructive_update) == destructive, (
+        f"destructive: {len(r.destructive_update)} != {destructive}"
+    )
+    assert len(r.inplace_update) == inplace, (
+        f"inplace: {len(r.inplace_update)} != {inplace}"
+    )
+    assert len(r.stop) == stop, f"stop: {len(r.stop)} != {stop}"
+    assert len(r.attribute_updates) == attribute_updates
+    if create_deployment is False:
+        assert r.deployment is None, f"unexpected deployment {r.deployment}"
+    elif create_deployment is True:
+        assert r.deployment is not None, "expected a created deployment"
+    assert len(r.deployment_updates) == deployment_updates, (
+        f"deployment updates: {r.deployment_updates}"
+    )
+    if desired is not None:
+        got = {name: du for name, du in r.desired_tg_updates.items()}
+        for name, exp in desired.items():
+            assert name in got, f"missing desired updates for {name}"
+            assert got[name] == exp, f"{name}: {got[name]} != {exp}"
+
+
+def names_of(results):
+    out = []
+    for p in results:
+        name = getattr(p, "name", None) or getattr(p, "place_name", None)
+        if name is None:
+            name = p.alloc.name
+        out.append(name)
+    return out
+
+
+def assert_name_indexes(expected_indexes, names):
+    got = sorted(alloc_index(n) for n in names)
+    assert got == sorted(expected_indexes), f"{got} != {sorted(expected_indexes)}"
+
+
+def irange(*pairs):
+    out = []
+    for i in range(0, len(pairs), 2):
+        out.extend(range(pairs[i], pairs[i + 1] + 1))
+    return out
+
+
+class TestPlacements:
+    def test_place_no_existing(self):
+        job = mock.job()
+        r = reconcile(update_fn_ignore, False, job, [])
+        assert_results(r, place=10, create_deployment=False,
+                       desired={"web": DesiredUpdates(place=10)})
+        assert_name_indexes(irange(0, 9), names_of(r.place))
+
+    def test_place_existing(self):
+        job = mock.job()
+        allocs = make_allocs(job, 5)
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, place=5, create_deployment=False,
+                       desired={"web": DesiredUpdates(place=5, ignore=5)})
+        assert_name_indexes(irange(5, 9), names_of(r.place))
+
+    def test_scale_down_partial(self):
+        job = mock.job()
+        allocs = make_allocs(job, 20)
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, stop=10, create_deployment=False,
+                       desired={"web": DesiredUpdates(ignore=10, stop=10)})
+        assert_name_indexes(irange(10, 19), names_of(r.stop))
+
+    def test_scale_down_zero(self):
+        job = mock.job()
+        job.task_groups[0].count = 0
+        allocs = make_allocs(job, 20)
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, stop=20, create_deployment=False,
+                       desired={"web": DesiredUpdates(stop=20)})
+        assert_name_indexes(irange(0, 19), names_of(r.stop))
+
+    def test_scale_down_zero_duplicate_names(self):
+        job = mock.job()
+        job.task_groups[0].count = 0
+        allocs = make_allocs(job, 20, name_idx=lambda i: i % 2)
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, stop=20, create_deployment=False,
+                       desired={"web": DesiredUpdates(stop=20)})
+        assert_name_indexes([i % 2 for i in range(20)], names_of(r.stop))
+
+
+class TestUpdates:
+    def test_inplace(self):
+        job = mock.job()
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_inplace, False, job, allocs)
+        assert_results(r, inplace=10, create_deployment=False,
+                       desired={"web": DesiredUpdates(in_place_update=10)})
+        assert_name_indexes(irange(0, 9), names_of(r.inplace_update))
+
+    def test_inplace_scale_up(self):
+        job = mock.job()
+        job.task_groups[0].count = 15
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_inplace, False, job, allocs)
+        assert_results(r, place=5, inplace=10, create_deployment=False,
+                       desired={"web": DesiredUpdates(place=5, in_place_update=10)})
+        assert_name_indexes(irange(0, 9), names_of(r.inplace_update))
+        assert_name_indexes(irange(10, 14), names_of(r.place))
+
+    def test_inplace_scale_down(self):
+        job = mock.job()
+        job.task_groups[0].count = 5
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_inplace, False, job, allocs)
+        assert_results(r, inplace=5, stop=5, create_deployment=False,
+                       desired={"web": DesiredUpdates(stop=5, in_place_update=5)})
+        assert_name_indexes(irange(0, 4), names_of(r.inplace_update))
+        assert_name_indexes(irange(5, 9), names_of(r.stop))
+
+    def test_destructive(self):
+        job = mock.job()
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert_results(r, destructive=10, create_deployment=False,
+                       desired={"web": DesiredUpdates(destructive_update=10)})
+        assert_name_indexes(irange(0, 9), names_of(r.destructive_update))
+
+    def test_destructive_max_parallel(self):
+        job = mock.job()
+        job.task_groups[0].update = UpdateStrategy(max_parallel=2)
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert_results(r, destructive=2, create_deployment=True,
+                       desired={"web": DesiredUpdates(destructive_update=2, ignore=8)})
+        assert_name_indexes(irange(0, 1), names_of(r.destructive_update))
+
+    def test_destructive_scale_up(self):
+        job = mock.job()
+        job.task_groups[0].count = 15
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert_results(r, place=5, destructive=10, create_deployment=False,
+                       desired={"web": DesiredUpdates(place=5, destructive_update=10)})
+        assert_name_indexes(irange(0, 9), names_of(r.destructive_update))
+        assert_name_indexes(irange(10, 14), names_of(r.place))
+
+    def test_destructive_scale_down(self):
+        job = mock.job()
+        job.task_groups[0].count = 5
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert_results(r, destructive=5, stop=5, create_deployment=False,
+                       desired={"web": DesiredUpdates(destructive_update=5, stop=5)})
+        assert_name_indexes(irange(5, 9), names_of(r.stop))
+        assert_name_indexes(irange(0, 4), names_of(r.destructive_update))
+
+
+def down_node():
+    n = mock.node()
+    n.status = "down"
+    return n
+
+
+class TestTaintedNodes:
+    def test_lost_node(self):
+        job = mock.job()
+        allocs = make_allocs(job, 10)
+        tainted = {}
+        for i in range(2):
+            n = down_node()
+            allocs[i].node_id = n.id
+            tainted[n.id] = n
+        r = reconcile(update_fn_ignore, False, job, allocs, tainted=tainted)
+        assert_results(r, place=2, stop=2, create_deployment=False,
+                       desired={"web": DesiredUpdates(place=2, stop=2, ignore=8)})
+        assert_name_indexes(irange(0, 1), names_of(r.place))
+        assert_name_indexes(irange(0, 1), names_of(r.stop))
+
+    def test_lost_node_scale_up(self):
+        job = mock.job()
+        job.task_groups[0].count = 15
+        allocs = make_allocs(job, 10)
+        tainted = {}
+        for i in range(2):
+            n = down_node()
+            allocs[i].node_id = n.id
+            tainted[n.id] = n
+        r = reconcile(update_fn_ignore, False, job, allocs, tainted=tainted)
+        assert_results(r, place=7, stop=2, create_deployment=False,
+                       desired={"web": DesiredUpdates(place=7, stop=2, ignore=8)})
+        assert_name_indexes(irange(0, 1) + irange(10, 14), names_of(r.place))
+        assert_name_indexes(irange(0, 1), names_of(r.stop))
+
+    def test_lost_node_scale_down(self):
+        job = mock.job()
+        job.task_groups[0].count = 5
+        allocs = make_allocs(job, 10)
+        tainted = {}
+        for i in range(2):
+            n = down_node()
+            allocs[i].node_id = n.id
+            tainted[n.id] = n
+        r = reconcile(update_fn_ignore, False, job, allocs, tainted=tainted)
+        assert_results(r, stop=5, create_deployment=False,
+                       desired={"web": DesiredUpdates(stop=5, ignore=5)})
+        assert_name_indexes(irange(0, 1) + irange(7, 9), names_of(r.stop))
+
+    def test_drain_node(self):
+        job = mock.job()
+        allocs = make_allocs(job, 10)
+        tainted = {}
+        for i in range(2):
+            n = mock.node()
+            n.drain = True
+            allocs[i].node_id = n.id
+            allocs[i].desired_transition.migrate = True
+            tainted[n.id] = n
+        r = reconcile(update_fn_ignore, False, job, allocs, tainted=tainted)
+        assert_results(r, place=2, stop=2, create_deployment=False,
+                       desired={"web": DesiredUpdates(migrate=2, ignore=8)})
+        assert all(p.previous_alloc is not None for p in r.place)
+
+    def test_drain_node_scale_up(self):
+        job = mock.job()
+        job.task_groups[0].count = 15
+        allocs = make_allocs(job, 10)
+        tainted = {}
+        for i in range(2):
+            n = mock.node()
+            n.drain = True
+            allocs[i].node_id = n.id
+            allocs[i].desired_transition.migrate = True
+            tainted[n.id] = n
+        r = reconcile(update_fn_ignore, False, job, allocs, tainted=tainted)
+        assert_results(r, place=7, stop=2, create_deployment=False,
+                       desired={"web": DesiredUpdates(place=5, migrate=2, ignore=8)})
+
+    def test_drain_node_scale_down(self):
+        job = mock.job()
+        job.task_groups[0].count = 8
+        allocs = make_allocs(job, 10)
+        tainted = {}
+        for i in range(3):
+            n = mock.node()
+            n.drain = True
+            allocs[i].node_id = n.id
+            allocs[i].desired_transition.migrate = True
+            tainted[n.id] = n
+        r = reconcile(update_fn_ignore, False, job, allocs, tainted=tainted)
+        assert_results(r, place=1, stop=3, create_deployment=False,
+                       desired={"web": DesiredUpdates(migrate=1, stop=2, ignore=7)})
+
+
+class TestJobLifecycle:
+    def test_removed_task_group(self):
+        job = mock.job()
+        job.task_groups[0].name = "different"
+        allocs = []
+        for i in range(10):
+            a = mock.alloc()
+            a.job = job
+            a.job_id = job.id
+            a.node_id = generate_uuid()
+            a.name = alloc_name(job.id, "web", i)
+            a.task_group = "web"
+            allocs.append(a)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert_results(r, place=10, stop=10, create_deployment=False,
+                       desired={"web": DesiredUpdates(stop=10),
+                                "different": DesiredUpdates(place=10)})
+
+    def test_job_stopped(self):
+        job = mock.job()
+        job.stop = True
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, stop=10, create_deployment=False,
+                       desired={"web": DesiredUpdates(stop=10)})
+
+    def test_job_stopped_terminal_allocs(self):
+        job = mock.job()
+        job.stop = True
+        allocs = make_allocs(job, 10)
+        for a in allocs:
+            a.desired_status = ALLOC_DESIRED_STOP
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, stop=0, create_deployment=False)
+
+    def test_multi_tg(self):
+        job = mock.job()
+        tg2 = job.task_groups[0].copy() if hasattr(job.task_groups[0], "copy") else None
+        import copy as _copy
+
+        tg2 = _copy.deepcopy(job.task_groups[0])
+        tg2.name = "foo"
+        job.task_groups.append(tg2)
+        allocs = make_allocs(job, 2)
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, place=18, create_deployment=False,
+                       desired={"web": DesiredUpdates(place=8, ignore=2),
+                                "foo": DesiredUpdates(place=10)})
+
+
+def fail_allocs(allocs, n, when_ns):
+    """Mark the first n allocs client-failed at the given time."""
+    from nomad_tpu.structs.structs import TaskState
+
+    for a in allocs[:n]:
+        a.client_status = ALLOC_CLIENT_FAILED
+        a.task_states = {
+            "web": TaskState(
+                state="dead", failed=True, finished_at_ns=when_ns,
+            )
+        }
+    return allocs
+
+
+class TestReschedule:
+    def test_reschedule_later_service(self):
+        """A recently-failed service alloc with a delay creates a delayed
+        follow-up eval and an attribute update, not an immediate place
+        (reconcile_test.go:1545)."""
+        job = mock.job()
+        job.task_groups[0].count = 5
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=1, interval_ns=15 * MINUTE_NS, delay_ns=10 * SECOND_NS,
+            delay_function="constant",
+        )
+        allocs = make_allocs(job, 5)
+        fail_allocs(allocs, 1, NOW_NS - 2 * SECOND_NS)  # failed 2s ago, delay 10s
+        r = reconcile(update_fn_ignore, False, job, allocs, eval_id="eval-x")
+        assert_results(r, place=0, attribute_updates=1, create_deployment=False,
+                       desired={"web": DesiredUpdates(ignore=5)})
+        # a delayed followup eval is created and stamped on the alloc
+        followups = [e for evs in r.desired_followup_evals.values() for e in evs]
+        assert len(followups) == 1
+        updated = list(r.attribute_updates.values())[0]
+        assert updated.followup_eval_id == followups[0].id
+
+    def test_reschedule_now_service(self):
+        """A failed service alloc past its delay is rescheduled now."""
+        job = mock.job()
+        job.task_groups[0].count = 5
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=1, interval_ns=15 * MINUTE_NS, delay_ns=5 * SECOND_NS,
+            delay_function="constant",
+        )
+        allocs = make_allocs(job, 5)
+        fail_allocs(allocs, 2, NOW_NS - 10 * SECOND_NS)  # past the 5s delay
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert len(r.place) == 2
+        rescheduled = [p for p in r.place if p.reschedule]
+        assert len(rescheduled) == 2
+        assert all(p.previous_alloc is not None for p in rescheduled)
+
+    def test_reschedule_now_batch(self):
+        """Batch jobs reschedule failed allocs with the batch filter
+        (reconcile_test.go:1464)."""
+        job = mock.batch_job()
+        job.task_groups[0].count = 4
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=1, interval_ns=24 * 60 * MINUTE_NS, delay_ns=5 * SECOND_NS,
+            delay_function="constant",
+        )
+        allocs = make_allocs(job, 4)
+        fail_allocs(allocs, 1, NOW_NS - 10 * SECOND_NS)
+        r = reconcile(update_fn_ignore, True, job, allocs)
+        rescheduled = [p for p in r.place if p.reschedule]
+        assert len(rescheduled) == 1
+
+    def test_dont_reschedule_previously_rescheduled_at_limit(self):
+        """An alloc whose reschedule attempts are exhausted within the
+        interval is not rescheduled again (reconcile_test.go:2339)."""
+        job = mock.job()
+        job.task_groups[0].count = 5
+        job.task_groups[0].reschedule_policy = ReschedulePolicy(
+            attempts=1, interval_ns=15 * MINUTE_NS, delay_ns=5 * SECOND_NS,
+            delay_function="constant",
+        )
+        allocs = make_allocs(job, 5)
+        fail_allocs(allocs, 1, NOW_NS - 10 * SECOND_NS)
+        allocs[0].reschedule_tracker = RescheduleTracker(events=[
+            RescheduleEvent(
+                reschedule_time_ns=NOW_NS - 1 * MINUTE_NS,
+                prev_alloc_id=generate_uuid(),
+                prev_node_id=generate_uuid(),
+                delay_ns=5 * SECOND_NS,
+            ),
+        ])
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        rescheduled = [p for p in r.place if p.reschedule]
+        assert len(rescheduled) == 0
+
+    def test_service_client_status_complete_replaced(self):
+        """A service alloc that 'completed' is replaced
+        (reconcile_test.go:1627)."""
+        job = mock.job()
+        job.task_groups[0].count = 5
+        allocs = make_allocs(job, 5)
+        allocs[0].client_status = ALLOC_CLIENT_COMPLETE
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, place=1, create_deployment=False)
+        assert alloc_index(r.place[0].name) == 0
+
+
+class TestCanariesAndDeployments:
+    def test_create_deployment_rolling_upgrade_destructive(self):
+        job = mock.job()
+        job.task_groups[0].update = no_canary_update()
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert r.deployment is not None
+        assert len(r.destructive_update) == 4
+        dstate = r.deployment.task_groups["web"]
+        assert dstate.desired_total == 10
+
+    def test_dont_create_deployment_no_changes(self):
+        job = mock.job()
+        job.task_groups[0].update = no_canary_update()
+        allocs = make_allocs(job, 10)
+        for a in allocs:
+            a.job = job
+            a.deployment_status = AllocDeploymentStatus(healthy=True)
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, create_deployment=False,
+                       desired={"web": DesiredUpdates(ignore=10)})
+
+    def test_new_canaries(self):
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert r.deployment is not None
+        state = r.deployment.task_groups["web"]
+        assert state.desired_canaries == 2
+        assert state.desired_total == 10
+        assert_results(r, place=2, create_deployment=True,
+                       desired={"web": DesiredUpdates(canary=2, ignore=10)})
+        assert_name_indexes(irange(0, 1), names_of(r.place))
+
+    def test_new_canaries_scale_up(self):
+        """Canary placement happens before scale up (reconcile_test.go:3329)."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        job.task_groups[0].count = 15
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert_results(r, place=2, create_deployment=True,
+                       desired={"web": DesiredUpdates(canary=2, ignore=10)})
+
+    def test_new_canaries_scale_down(self):
+        """Scale down happens before canary placement
+        (reconcile_test.go:3377)."""
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        job.task_groups[0].count = 5
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_destructive, False, job, allocs)
+        assert_results(r, place=2, stop=5, create_deployment=True,
+                       desired={"web": DesiredUpdates(canary=2, stop=5, ignore=5)})
+        assert_name_indexes(irange(5, 9), names_of(r.stop))
+
+    def test_paused_deployment_no_more_canaries(self):
+        job = mock.job()
+        job.task_groups[0].update = canary_update()
+        d = new_deployment(job)
+        d.status = DEPLOYMENT_STATUS_PAUSED
+        d.task_groups["web"] = DeploymentState(
+            promoted=False, desired_canaries=2, desired_total=10,
+            placed_allocs=1,
+        )
+        allocs = make_allocs(job, 10)
+        # one canary already placed
+        canary = mock.alloc()
+        canary.job = job
+        canary.job_id = job.id
+        canary.node_id = generate_uuid()
+        canary.name = alloc_name(job.id, "web", 0)
+        canary.task_group = "web"
+        canary.deployment_id = d.id
+        canary.deployment_status = AllocDeploymentStatus(canary=True)
+        d.task_groups["web"].placed_canaries = [canary.id]
+        allocs.append(canary)
+        r = reconcile(update_fn_destructive, False, job, allocs, deployment=d)
+        assert len(r.place) == 0, "paused deployment must not place more canaries"
+
+    def test_cancel_deployment_job_stop(self):
+        job = mock.job()
+        job.stop = True
+        d = new_deployment(job)
+        d.task_groups["web"] = DeploymentState(desired_total=10)
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_ignore, False, job, allocs, deployment=d)
+        assert len(r.deployment_updates) == 1
+        assert r.deployment_updates[0].status == DEPLOYMENT_STATUS_CANCELLED
+        assert len(r.stop) == 10
+
+    def test_cancel_deployment_job_update(self):
+        """A deployment for an older job version cancels on job update."""
+        job = mock.job()
+        job.version = 2
+        d = new_deployment(job)
+        d.job_version = 1
+        d.task_groups["web"] = DeploymentState(desired_total=10)
+        allocs = make_allocs(job, 10)
+        r = reconcile(update_fn_ignore, False, job, allocs, deployment=d)
+        assert any(
+            u.status == DEPLOYMENT_STATUS_CANCELLED for u in r.deployment_updates
+        )
+
+    def test_complete_deployment(self):
+        job = mock.job()
+        job.task_groups[0].update = no_canary_update()
+        d = new_deployment(job)
+        d.task_groups["web"] = DeploymentState(
+            promoted=True, desired_total=10, placed_allocs=10, healthy_allocs=10,
+        )
+        allocs = make_allocs(job, 10)
+        for a in allocs:
+            a.deployment_id = d.id
+            a.deployment_status = AllocDeploymentStatus(healthy=True)
+        r = reconcile(update_fn_ignore, False, job, allocs, deployment=d)
+        assert any(
+            u.status == DEPLOYMENT_STATUS_SUCCESSFUL for u in r.deployment_updates
+        )
+
+
+class TestNameIndexReuse:
+    def test_fill_names(self):
+        """Placement names fill the holes in the index space before
+        extending it (reconcile_test.go:3426 NewCanaries_FillNames
+        spirit + basic hole-filling)."""
+        job = mock.job()
+        job.task_groups[0].count = 10
+        # existing allocs hold indexes 0,1,4,5
+        allocs = make_allocs(job, 4, name_idx=lambda i: [0, 1, 4, 5][i])
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert_results(r, place=6, create_deployment=False)
+        assert_name_indexes([2, 3, 6, 7, 8, 9], names_of(r.place))
+
+    def test_duplicate_indexes_collapse(self):
+        """Duplicate name indexes: scale-down stops prefer duplicates
+        (ScaleDown_Zero_DuplicateNames analog at non-zero count)."""
+        job = mock.job()
+        job.task_groups[0].count = 2
+        allocs = make_allocs(job, 4, name_idx=lambda i: i % 2)
+        r = reconcile(update_fn_ignore, False, job, allocs)
+        assert len(r.stop) == 2
+        remaining = {a.id for a in (x.alloc for x in r.stop)}
+        assert len(remaining) == 2
