@@ -15,8 +15,14 @@ import urllib.parse
 import urllib.request
 from typing import Optional
 
-from .http import HTTPError, HTTPServer, Request
+from .http import Hijacker, HTTPError, HTTPServer, Request, StreamingResponse
 from .routes import _tail
+
+
+# Follow-mode streams end after this long with no data AND no way to
+# observe the peer (disconnects only surface on write); bounds the threads
+# abandoned followers can pin.
+MAX_STREAM_IDLE_S = 600.0
 
 
 class FSRoutes:
@@ -91,10 +97,10 @@ class FSRoutes:
         except OSError as e:
             raise HTTPError(502, f"proxy to {http_addr} failed: {e}")
 
-    def _proxy(self, req: Request, alloc_id: str, method: str = "GET",
-               body: bytes = b"") -> bytes:
-        """Forward to the node that owns the alloc (client_fs_endpoint.go
-        server→client hop)."""
+    def _resolve_remote_node(self, alloc_id: str):
+        """The node owning the alloc, for server→client forwarding.
+        Raises 404 when the node is unknown, unreachable, or IS this very
+        agent (a self-proxy would recurse until fd exhaustion)."""
         server = self.agent.server
         if server is None:
             raise HTTPError(404, f"alloc {alloc_id} not on this node")
@@ -108,6 +114,13 @@ class FSRoutes:
             )
         if node.http_addr.split("://")[-1] == "{}:{}".format(*self.agent.http.addr):
             raise HTTPError(404, f"alloc {alloc_id} directory not found")
+        return node
+
+    def _proxy(self, req: Request, alloc_id: str, method: str = "GET",
+               body: bytes = b"") -> bytes:
+        """Forward to the node that owns the alloc (client_fs_endpoint.go
+        server→client hop)."""
+        node = self._resolve_remote_node(alloc_id)
         return self._forward(req, node.http_addr, req.path, method, body)
 
     # -- handlers --------------------------------------------------------
@@ -307,6 +320,12 @@ class FSRoutes:
         self._authorize(req, alloc_id, cap)
         client = self.agent.client
         runner = client.allocrunners.get(alloc_id) if client is not None else None
+        if verb == "exec" and (req.headers.get("Upgrade") or "").lower() == "websocket":
+            # INTERACTIVE exec (alloc_endpoint.go execStream): upgrade to a
+            # websocket and bridge json-framed stdio to the task
+            if runner is None:
+                return self._exec_ws_bridge(req, alloc_id)
+            return self._exec_ws_local(req, runner)
         if runner is None:
             import json
 
@@ -349,13 +368,158 @@ class FSRoutes:
             raise HTTPError(400, str(e))
         return {"Output": output.decode(errors="replace"), "ExitCode": code}
 
-    def logs(self, req: Request) -> bytes:
-        """Non-follow log read across the rotated sequence
-        (fs_endpoint.go logs; follow/framing is the CLI's tail loop)."""
+    def _exec_ws_local(self, req: Request, runner):
+        """Serve an interactive exec session over a websocket upgrade.
+        Frames are json, reference exec protocol shape:
+          client -> {"stdin": {"data": b64}} | {"stdin": {"close": true}}
+          server -> {"stdout": {"data": b64}} ... {"exit_code": N}
+        """
+        import base64
+        import json
+        import threading
+
+        from . import websocket as ws
+
+        task = req.param("task", "")
+        try:
+            cmd = json.loads(req.param("command", "[]"))
+        except ValueError:
+            raise HTTPError(400, "command must be a json array")
+        if not task or not cmd:
+            raise HTTPError(400, "exec requires task and command parameters")
+        try:
+            session = runner.exec_task_streaming(task, cmd)
+        except KeyError:
+            raise HTTPError(404, f"unknown task {task!r}")
+        except Exception as e:  # noqa: BLE001 — driver may not support it
+            raise HTTPError(400, str(e))
+
+        def serve(handler) -> None:
+            if not ws.server_handshake(handler):
+                session.kill()
+                return
+            stop = threading.Event()
+
+            def pump_stdin() -> None:
+                try:
+                    while not stop.is_set():
+                        opcode, payload = ws.read_frame(handler.rfile)
+                        if opcode == ws.OP_CLOSE:
+                            session.stdin_close()
+                            return
+                        if opcode == ws.OP_PING:
+                            ws.write_frame(handler.wfile, payload, ws.OP_PONG)
+                            continue
+                        try:
+                            frame = json.loads(payload or b"{}")
+                        except ValueError:
+                            continue
+                        stdin = frame.get("stdin") or {}
+                        if stdin.get("close"):
+                            session.stdin_close()
+                        elif stdin.get("data"):
+                            session.stdin_write(base64.b64decode(stdin["data"]))
+                except (ConnectionError, OSError):
+                    session.kill()
+
+            t = threading.Thread(target=pump_stdin, daemon=True)
+            t.start()
+            try:
+                while True:
+                    chunk = session.read_output(timeout=0.25)
+                    if chunk is None:
+                        break
+                    if chunk:
+                        frame = json.dumps({
+                            "stdout": {"data": base64.b64encode(chunk).decode()}
+                        }).encode()
+                        ws.write_frame(handler.wfile, frame, ws.OP_TEXT)
+                code = session.exit_code()
+                ws.write_frame(
+                    handler.wfile,
+                    json.dumps({"exit_code": 0 if code is None else code}).encode(),
+                    ws.OP_TEXT,
+                )
+                ws.write_frame(handler.wfile, b"", ws.OP_CLOSE)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                session.kill()
+            finally:
+                stop.set()
+
+        return Hijacker(serve)
+
+    def _exec_ws_bridge(self, req: Request, alloc_id: str):
+        """Server-mode agent: bridge the websocket to the owning node
+        (the reference's server->client streaming-RPC hop)."""
+        node = self._resolve_remote_node(alloc_id)
+        addr = node.http_addr.split("://")[-1]
+        host, _, port = addr.rpartition(":")
+        query = urllib.parse.urlencode(
+            {k: v[0] for k, v in req.query.items()}, safe="/"
+        )
+        path = req.path + (f"?{query}" if query else "")
+        headers = {}
+        if req.options.auth_token:
+            headers["X-Nomad-Token"] = req.options.auth_token
+        tls_ctx = None
+        if node.http_addr.startswith("https://") and self.agent.tls is not None:
+            tls_ctx = self.agent.tls.http_client_context()
+
+        from . import websocket as ws
+
+        def serve(handler) -> None:
+            import threading
+
+            try:
+                upstream = ws.WebSocketClient(
+                    host, int(port), path, headers=headers, tls_context=tls_ctx
+                )
+            except (OSError, ConnectionError) as e:
+                handler.wfile.write(
+                    f"HTTP/1.1 502 Bad Gateway\r\nContent-Length: 0\r\n\r\n".encode()
+                )
+                return
+            if not ws.server_handshake(handler):
+                upstream.close()
+                return
+
+            def downstream_to_upstream() -> None:
+                try:
+                    while True:
+                        opcode, payload = ws.read_frame(handler.rfile)
+                        if opcode == ws.OP_CLOSE:
+                            upstream.close()
+                            return
+                        upstream.send(payload, opcode)
+                except (ConnectionError, OSError):
+                    upstream.close()
+
+            t = threading.Thread(target=downstream_to_upstream, daemon=True)
+            t.start()
+            try:
+                while True:
+                    opcode, payload = upstream.recv()
+                    if opcode == ws.OP_CLOSE:
+                        ws.write_frame(handler.wfile, b"", ws.OP_CLOSE)
+                        return
+                    ws.write_frame(handler.wfile, payload, opcode)
+            except (ConnectionError, OSError):
+                pass
+
+        return Hijacker(serve)
+
+    def logs(self, req: Request):
+        """Log read across the rotated sequence (fs_endpoint.go logs).
+        ``follow=true`` switches to SERVER-PUSH streaming: the agent keeps
+        the response open and pushes new bytes as the task writes them
+        (the reference's streaming-RPC log frames; chunked here)."""
         alloc_id = _tail(req, "/v1/client/fs/logs/")
         self._authorize(req, alloc_id, "read-logs")
+        follow = req.param("follow", "") in ("true", "1")
         root = self._alloc_root(alloc_id)
         if root is None:
+            if follow:
+                return self._proxy_stream(req, alloc_id)
             return self._proxy(req, alloc_id)
         task = req.param("task", "")
         if not task:
@@ -371,8 +535,78 @@ class FSRoutes:
         from ..client.logmon import read_logs
 
         log_dir = os.path.join(root, "alloc", "logs")
-        data, next_offset = read_logs(
-            log_dir, task, kind, offset=offset, origin=origin
+        if not follow:
+            data, next_offset = read_logs(
+                log_dir, task, kind, offset=offset, origin=origin
+            )
+            req.response_index = next_offset
+            return data
+
+        runner = (self.agent.client.allocrunners.get(alloc_id)
+                  if self.agent.client is not None else None)
+
+        def task_dead() -> bool:
+            if runner is None:
+                return True
+            tr = runner.task_runners.get(task)
+            return tr is None or tr.done.is_set()
+
+        def stream():
+            import time as time_mod
+
+            pos = offset
+            first_origin = origin
+            idle_deadline = time_mod.monotonic() + MAX_STREAM_IDLE_S
+            while True:
+                data, pos = read_logs(
+                    log_dir, task, kind, offset=pos, origin=first_origin
+                )
+                first_origin = "start"  # offsets are absolute afterwards
+                if data:
+                    idle_deadline = time_mod.monotonic() + MAX_STREAM_IDLE_S
+                    yield data
+                    continue
+                # the reference's frame stream ends at task completion;
+                # the idle cap bounds abandoned followers (a disconnect
+                # is only detectable on write)
+                if task_dead() or time_mod.monotonic() > idle_deadline:
+                    return
+                time_mod.sleep(0.2)
+
+        return StreamingResponse(stream())
+
+    def _proxy_stream(self, req: Request, alloc_id: str):
+        """Streaming pass-through to the owning node (server→client hop
+        for follow-mode logs)."""
+        node = self._resolve_remote_node(alloc_id)
+        query = urllib.parse.urlencode(
+            {k: v[0] for k, v in req.query.items()}, safe="/"
         )
-        req.response_index = next_offset
-        return data
+        base = node.http_addr if "://" in node.http_addr else f"http://{node.http_addr}"
+        url = f"{base}{req.path}"
+        if query:
+            url += f"?{query}"
+        preq = urllib.request.Request(url)
+        if req.options.auth_token:
+            preq.add_header("X-Nomad-Token", req.options.auth_token)
+        ctx = None
+        if url.startswith("https://") and self.agent.tls is not None:
+            ctx = self.agent.tls.http_client_context()
+        try:
+            resp = urllib.request.urlopen(preq, timeout=3600, context=ctx)
+        except urllib.error.HTTPError as e:
+            raise HTTPError(e.code, e.read().decode(errors="replace"))
+        except OSError as e:
+            raise HTTPError(502, f"proxy to {node.http_addr} failed: {e}")
+
+        def stream():
+            try:
+                while True:
+                    chunk = resp.read1(8192) if hasattr(resp, "read1") else resp.read(8192)
+                    if not chunk:
+                        return
+                    yield chunk
+            finally:
+                resp.close()
+
+        return StreamingResponse(stream())

@@ -91,6 +91,28 @@ class Request:
 Handler = Callable[[Request], Any]
 
 
+class StreamingResponse:
+    """Handler return value that streams chunks to the client
+    (Transfer-Encoding: chunked) — the HTTP realization of the
+    reference's streaming RPC frames (structs/streaming_rpc.go,
+    command/agent/http.go:187). ``gen`` yields bytes; the stream ends
+    when it returns or the client disconnects."""
+
+    def __init__(self, gen, content_type: str = "application/octet-stream") -> None:
+        self.gen = gen
+        self.content_type = content_type
+
+
+class Hijacker:
+    """Handler return value that takes over the raw connection (the
+    reference's WebSocket upgrade path for interactive exec,
+    alloc_endpoint.go execStream). ``fn`` receives the
+    BaseHTTPRequestHandler; it owns the socket afterwards."""
+
+    def __init__(self, fn) -> None:
+        self.fn = fn
+
+
 class HTTPServer:
     """Prefix-matching mux + JSON wrap, mirroring http.go's mux semantics."""
 
@@ -174,7 +196,37 @@ class HTTPServer:
                 except Exception as e:  # 500 with message, like wrap()
                     traceback.print_exc()
                     return self._send_err(500, f"{type(e).__name__}: {e}")
+                if isinstance(result, Hijacker):
+                    self.close_connection = True
+                    result.fn(self)
+                    return
+                if isinstance(result, StreamingResponse):
+                    return self._send_stream(result, req)
                 self._send_json(result, req)
+
+            def _send_stream(self, stream: "StreamingResponse", req: Request):
+                self.send_response(200)
+                self.send_header("Content-Type", stream.content_type)
+                self.send_header("Transfer-Encoding", "chunked")
+                if req.response_index is not None:
+                    self.send_header("X-Nomad-Index", str(req.response_index))
+                self.end_headers()
+                self.close_connection = True
+                try:
+                    for chunk in stream.gen:
+                        if not chunk:
+                            continue
+                        self.wfile.write(b"%x\r\n" % len(chunk))
+                        self.wfile.write(chunk)
+                        self.wfile.write(b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # client went away — generator GC closes sources
+                finally:
+                    close = getattr(stream.gen, "close", None)
+                    if close is not None:
+                        close()
 
             def _send_json(self, obj, req: Request):
                 if isinstance(obj, bytes):

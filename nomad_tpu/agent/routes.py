@@ -608,13 +608,42 @@ class Routes:
         }
 
     def agent_monitor(self, req: Request):
-        """Poll-based log tail (reference /v1/agent/monitor streams)."""
+        """Agent log tail (reference /v1/agent/monitor). Default is one
+        poll; ``follow=true`` keeps the response open and SERVER-PUSHES
+        new log lines as they are emitted (chunked, one line per chunk
+        batch — the reference's streaming monitor frames)."""
         self._authorize(req, "agent:read")
         try:
             seq = int(req.param("seq", "0"))
         except ValueError:
             raise HTTPError(400, "seq must be an integer")
-        return self.agent.monitor.tail(seq=seq, level=req.param("log_level", "info"))
+        level = req.param("log_level", "info")
+        if req.param("follow", "") not in ("true", "1"):
+            return self.agent.monitor.tail(seq=seq, level=level)
+
+        monitor = self.agent.monitor
+
+        def stream():
+            import time as time_mod
+
+            cursor = seq
+            # idle cap bounds abandoned followers (disconnects are only
+            # observable on write)
+            idle_deadline = time_mod.monotonic() + 600.0
+            while True:
+                out = monitor.tail(seq=cursor, level=level)
+                lines, cursor = out["Lines"], out["Seq"]
+                if lines:
+                    idle_deadline = time_mod.monotonic() + 600.0
+                    yield ("\n".join(lines) + "\n").encode()
+                    continue
+                if time_mod.monotonic() > idle_deadline:
+                    return
+                time_mod.sleep(0.25)
+
+        from .http import StreamingResponse
+
+        return StreamingResponse(stream(), content_type="text/plain")
 
     def agent_pprof(self, req: Request):
         """Debug dumps gated on enable_debug (http.go:220 pprof)."""

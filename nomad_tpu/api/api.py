@@ -378,6 +378,79 @@ class Allocations(_Sub):
             {"Task": task, "Cmd": list(cmd)}, q,
         )
 
+    def exec_stream(self, alloc_id: str, task: str, command) -> "ExecStream":
+        """INTERACTIVE exec over a websocket (api/allocations.go Exec /
+        the reference's execStream): returns a session with stdin/stdout
+        pumps and the remote exit code."""
+        import json as json_mod
+
+        cfg = self.client.config
+        parsed = urllib.parse.urlsplit(cfg.address)
+        default_port = 443 if parsed.scheme == "https" else 80
+        host, port = parsed.hostname, parsed.port or default_port
+        params = {"task": task, "command": json_mod.dumps(list(command))}
+        path = (
+            f"/v1/client/allocation/{alloc_id}/exec?"
+            + urllib.parse.urlencode(params)
+        )
+        headers = {}
+        if cfg.token:
+            headers["X-Nomad-Token"] = cfg.token
+        from ..agent.websocket import WebSocketClient
+
+        ws = WebSocketClient(
+            host, port, path, headers=headers, tls_context=cfg.ssl_context(),
+        )
+        return ExecStream(ws)
+
+
+class ExecStream:
+    """Client side of the interactive exec protocol: json frames with
+    base64 stdio, terminated by an {"exit_code": N} frame."""
+
+    def __init__(self, ws) -> None:
+        self._ws = ws
+        self.exit_code: Optional[int] = None
+
+    def send_stdin(self, data: bytes) -> None:
+        import base64
+        import json as json_mod
+
+        frame = {"stdin": {"data": base64.b64encode(data).decode()}}
+        self._ws.send(json_mod.dumps(frame).encode(), opcode=0x1)
+
+    def close_stdin(self) -> None:
+        import json as json_mod
+
+        self._ws.send(json_mod.dumps({"stdin": {"close": True}}).encode(), opcode=0x1)
+
+    def read_output(self) -> Optional[bytes]:
+        """Next stdout chunk, or None when the session ended (exit_code
+        is set afterwards)."""
+        import base64
+        import json as json_mod
+
+        while True:
+            try:
+                opcode, payload = self._ws.recv()
+            except (ConnectionError, OSError):
+                return None
+            if opcode == 0x8:  # close
+                return None
+            try:
+                frame = json_mod.loads(payload or b"{}")
+            except ValueError:
+                continue
+            if "exit_code" in frame:
+                self.exit_code = frame["exit_code"]
+                return None
+            data = (frame.get("stdout") or {}).get("data")
+            if data:
+                return base64.b64decode(data)
+
+    def close(self) -> None:
+        self._ws.close()
+
 
 class AllocFS(_Sub):
     """Alloc filesystem/log access (api/fs.go AllocFS)."""
@@ -423,6 +496,37 @@ class AllocFS(_Sub):
             "GET", f"/v1/client/fs/logs/{alloc_id}", None, q, raw=True
         )
         return data, meta.last_index
+
+    def logs_follow(self, alloc_id: str, task: str, log_type: str = "stdout",
+                    offset: int = 0, origin: str = "start",
+                    q: Optional[QueryOptions] = None):
+        """SERVER-PUSH log stream (follow=true): yields byte chunks as the
+        task writes them; the generator ends when the caller closes it or
+        the agent goes away."""
+        q = q or QueryOptions()
+        q.params.update({
+            "task": task, "type": log_type, "offset": str(offset),
+            "origin": origin, "follow": "true",
+        })
+        url = self.client._url(f"/v1/client/fs/logs/{alloc_id}", q)
+        req = urllib.request.Request(url)
+        if self.client.config.token:
+            req.add_header("X-Nomad-Token", self.client.config.token)
+        resp = urllib.request.urlopen(
+            req, timeout=3600, context=self.client.config.ssl_context()
+        )
+
+        def gen():
+            try:
+                while True:
+                    chunk = resp.read1(8192) if hasattr(resp, "read1") else resp.read(8192)
+                    if not chunk:
+                        return
+                    yield chunk
+            finally:
+                resp.close()
+
+        return gen()
 
 
 class Evaluations(_Sub):

@@ -546,28 +546,28 @@ def cmd_alloc_logs(ctx: Ctx, args: List[str]) -> int:
             ctx.out(data.rstrip(b"\n").decode(errors="replace"))
     if not _truthy(flags, "f"):
         return 0
-    # follow: the server hands back the next stream offset, which stays
-    # valid across log rotation; buffer partial lines so mid-line and
-    # mid-UTF-8 poll boundaries don't mangle output
+    # follow: SERVER-PUSH stream (follow=true keeps the response open and
+    # the agent pushes bytes as the task writes — no client polling);
+    # buffer partial lines so mid-line and mid-UTF-8 chunk boundaries
+    # don't mangle output
     pending = b""
     try:
         sys.stdout.flush()
-        while True:
-            time.sleep(1.0)
-            chunk, offset = ctx.client.alloc_fs.logs_at(
-                match["ID"], task, log_type, offset=offset
-            )
-            if not chunk:
-                continue
+        for chunk in ctx.client.alloc_fs.logs_follow(
+            match["ID"], task, log_type, offset=offset
+        ):
             pending += chunk
             complete, sep, pending = pending.rpartition(b"\n")
             if sep:
                 ctx.out(complete.decode(errors="replace"))
                 sys.stdout.flush()  # follow mode must stream when piped
     except KeyboardInterrupt:
-        if pending:
-            ctx.out(pending.decode(errors="replace"))
-        return 0
+        pass
+    except OSError:
+        pass  # stream ended (agent idle-capped or went away)
+    if pending:
+        ctx.out(pending.decode(errors="replace"))
+    return 0
 
 
 def cmd_alloc_fs(ctx: Ctx, args: List[str]) -> int:
@@ -628,6 +628,9 @@ def cmd_alloc_exec(ctx: Ctx, args: List[str]) -> int:
             k, _, v = name.partition("=")
             flags[k] = v
             i += 1
+        elif name in ("i", "interactive"):  # boolean flags
+            flags[name] = "true"
+            i += 1
         elif i + 1 < len(args):
             flags[name] = args[i + 1]
             i += 2
@@ -635,7 +638,9 @@ def cmd_alloc_exec(ctx: Ctx, args: List[str]) -> int:
             raise CLIError(f"flag -{name} needs a value")
     rest = args[i:]
     if len(rest) < 2:
-        raise CLIError("usage: nomad alloc exec [-task <name>] <alloc-id> <cmd>...")
+        raise CLIError(
+            "usage: nomad alloc exec [-i] [-task <name>] <alloc-id> <cmd>..."
+        )
     match = _find_alloc(ctx, rest[0])
     task = flags.get("task", "")
     if not task:
@@ -644,6 +649,38 @@ def cmd_alloc_exec(ctx: Ctx, args: List[str]) -> int:
         if len(tasks) != 1:
             raise CLIError("pass -task (have: %s)" % ", ".join(tasks))
         task = tasks[0]
+    if "i" in flags or "interactive" in flags:
+        # INTERACTIVE: websocket session bridging this terminal's stdio to
+        # the task (reference command/alloc_exec.go over execStream)
+        import threading
+
+        stream = ctx.client.allocations.exec_stream(match["ID"], task, rest[1:])
+
+        def pump_stdin() -> None:
+            try:
+                while True:
+                    line = sys.stdin.buffer.readline()
+                    if not line:
+                        stream.close_stdin()
+                        return
+                    stream.send_stdin(line)
+            except (OSError, ValueError):
+                pass
+
+        t = threading.Thread(target=pump_stdin, daemon=True)
+        t.start()
+        try:
+            while True:
+                chunk = stream.read_output()
+                if chunk is None:
+                    break
+                sys.stdout.buffer.write(chunk)
+                sys.stdout.buffer.flush()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            stream.close()
+        return int(stream.exit_code or 0)
     out, _ = ctx.client.allocations.exec_task(match["ID"], task, rest[1:])
     if out.get("Output"):
         ctx.out(out["Output"].rstrip("\n"))

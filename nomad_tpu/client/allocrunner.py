@@ -207,10 +207,15 @@ class AllocRunner:
             tr.driver.signal_task(tr.task_id, sig)
 
     def exec_task(self, task_name: str, cmd, timeout_s: float = 30.0):
-        """One-shot exec in a task's context (the reference streams over a
-        websocket — alloc-exec here is non-interactive)."""
+        """One-shot exec in a task's context."""
         tr = self.task_runners[task_name]
         return tr.driver.exec_task(tr.task_id, list(cmd), timeout_s)
+
+    def exec_task_streaming(self, task_name: str, cmd):
+        """Interactive exec session (the reference's websocket-backed
+        `nomad alloc exec`, alloc_endpoint.go execStream)."""
+        tr = self.task_runners[task_name]
+        return tr.driver.exec_task_streaming(tr.task_id, list(cmd))
 
     def stop(self) -> None:
         for tr in self.task_runners.values():

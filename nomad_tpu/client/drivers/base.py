@@ -179,6 +179,107 @@ class Driver:
     def exec_task(self, task_id: str, cmd: List[str], timeout_s: float) -> Tuple[bytes, int]:
         raise DriverError(f"driver {self.name} does not support exec")
 
+    def exec_task_streaming(self, task_id: str, cmd: List[str]) -> "ExecSession":
+        """Interactive exec in the task's context (the reference's
+        websocket-backed `nomad alloc exec`, driver ExecTaskStreaming)."""
+        raise DriverError(f"driver {self.name} does not support streaming exec")
+
+
+class ExecSession:
+    """A live interactive command: stdin sink + stdout source + exit code.
+    The transport layer (websocket bridge) pumps both directions."""
+
+    def stdin_write(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def stdin_close(self) -> None:
+        raise NotImplementedError
+
+    def read_output(self, timeout: float = 0.25) -> Optional[bytes]:
+        """Next output chunk; b"" when none ready yet; None at EOF."""
+        raise NotImplementedError
+
+    def exit_code(self) -> Optional[int]:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+
+class SubprocessExecSession(ExecSession):
+    """ExecSession over a local subprocess (raw_exec / exec drivers)."""
+
+    def __init__(self, cmd: List[str], env=None, cwd=None) -> None:
+        import queue as queue_mod
+        import subprocess
+        import threading
+
+        self.proc = subprocess.Popen(
+            cmd, env=env, cwd=cwd,
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, bufsize=0,
+        )
+        self._q: "queue_mod.Queue[Optional[bytes]]" = queue_mod.Queue()
+
+        def pump() -> None:
+            try:
+                while True:
+                    # bufsize=0 gives a raw FileIO: read() returns as soon
+                    # as ANY bytes are available (one syscall)
+                    chunk = self.proc.stdout.read(65536)
+                    if not chunk:
+                        break
+                    self._q.put(chunk)
+            finally:
+                self._q.put(None)
+
+        self._pump = threading.Thread(target=pump, daemon=True)
+        self._pump.start()
+        self._eof = False
+
+    def stdin_write(self, data: bytes) -> None:
+        try:
+            self.proc.stdin.write(data)
+            self.proc.stdin.flush()
+        except (BrokenPipeError, ValueError, OSError):
+            pass
+
+    def stdin_close(self) -> None:
+        try:
+            self.proc.stdin.close()
+        except OSError:
+            pass
+
+    def read_output(self, timeout: float = 0.25) -> Optional[bytes]:
+        import queue as queue_mod
+
+        if self._eof:
+            return None
+        try:
+            chunk = self._q.get(timeout=timeout)
+        except queue_mod.Empty:
+            return b""
+        if chunk is None:
+            self._eof = True
+            try:
+                # stdout EOF usually means exit, but a task that closed
+                # its stdout while still running must not raise out of
+                # the websocket pump
+                self.proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001 — TimeoutExpired
+                pass
+            return None
+        return chunk
+
+    def exit_code(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+
 
 _REGISTRY: Dict[str, Callable[[], Driver]] = {}
 

@@ -100,6 +100,47 @@ class MockDriver(Driver):
             raise DriverError(f"unknown task {task_id}")
         return t
 
+    def exec_task_streaming(self, task_id: str, cmd):
+        """Echo session: every stdin write comes back as output; EOF
+        exits 0 (interactive-exec plumbing tests without real processes)."""
+        import queue as queue_mod
+
+        self._get(task_id)
+
+        class _EchoSession:
+            def __init__(self) -> None:
+                self._q: "queue_mod.Queue" = queue_mod.Queue()
+                self._code = None
+                self._eof = False
+
+            def stdin_write(self, data: bytes) -> None:
+                self._q.put(data)
+
+            def stdin_close(self) -> None:
+                self._code = 0
+                self._q.put(None)
+
+            def read_output(self, timeout: float = 0.25):
+                if self._eof:
+                    return None
+                try:
+                    chunk = self._q.get(timeout=timeout)
+                except queue_mod.Empty:
+                    return b""
+                if chunk is None:
+                    self._eof = True
+                    return None
+                return chunk
+
+            def exit_code(self):
+                return self._code
+
+            def kill(self) -> None:
+                self._code = 137
+                self._q.put(None)
+
+        return _EchoSession()
+
     def wait_task(self, task_id: str, timeout: Optional[float] = None) -> Optional[ExitResult]:
         t = self._get(task_id)
         if not t.done.wait(timeout=timeout):

@@ -166,6 +166,16 @@ class RawExecDriver(Driver):
             return (e.stdout or b""), 124
         return out.stdout + out.stderr, out.returncode
 
+    def exec_task_streaming(self, task_id: str, cmd: List[str]):
+        from .base import SubprocessExecSession
+
+        t = self._get(task_id)
+        cwd = None
+        td = t.cfg.task_dir
+        if td is not None:
+            cwd = getattr(td, "local_dir", None) or getattr(td, "dir", None)
+        return SubprocessExecSession(cmd, env=t.cfg.env, cwd=cwd)
+
     def recover_task(self, handle: TaskHandle) -> None:
         """Re-attach to a live pid after client restart (RecoverTask)."""
         pid = handle.driver_state.get("pid")

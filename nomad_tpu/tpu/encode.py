@@ -17,14 +17,19 @@ import numpy as np
 from ..structs.structs import (
     CONSTRAINT_DISTINCT_HOSTS,
     CONSTRAINT_DISTINCT_PROPERTY,
+    DeviceIdTuple,
     Job,
     Node,
     TaskGroup,
 )
 
-# Capacity dimensions tracked on device.
+# Capacity dimensions tracked on device. Dims 4..5 are DEVICE dims: each
+# distinct device-ask id in the job claims one (totals = free matching
+# instances per node at eval start); unused device dims have zero ask and
+# zero totals, so they are inert.
 DIM_CPU, DIM_MEM, DIM_DISK, DIM_MBITS = 0, 1, 2, 3
-NUM_DIMS = 4
+DEVICE_DIMS = 2
+NUM_DIMS = 4 + DEVICE_DIMS
 
 # Max penalty nodes encoded per placement (failed node + reschedule history).
 MAX_PENALTY_NODES = 6
@@ -91,25 +96,78 @@ def _net_ask(tg: TaskGroup) -> Tuple[int, bool]:
     return mbits, has_reserved_ports
 
 
-def check_supported(job: Job, tg: TaskGroup) -> None:
-    """Gate on features the round-1 engine doesn't model on device."""
+def _tg_reserved_ports(tg: TaskGroup) -> set:
+    ports = set()
+    for net in tg.networks:
+        ports.update(p.value for p in net.reserved_ports)
     for task in tg.tasks:
-        if task.resources.devices:
-            raise UnsupportedByEngine("device asks")
-    _, has_reserved_ports = _net_ask(tg)
-    if has_reserved_ports:
-        raise UnsupportedByEngine("reserved port asks")
+        for net in task.resources.networks:
+            ports.update(p.value for p in net.reserved_ports)
+    return ports
+
+
+def job_device_dims(job: Job) -> Dict[tuple, int]:
+    """Map each distinct device-ask id in the job to a capacity dim
+    (4..4+DEVICE_DIMS-1). Raises UnsupportedByEngine when the job's device
+    shapes exceed what the conservative tensor model covers exactly."""
+    dims: Dict[tuple, int] = {}
+    for tg in job.task_groups:
+        for task in tg.tasks:
+            for ask in task.resources.devices:
+                if ask.constraints or ask.affinities:
+                    # constraints/affinities change feasibility/scoring per
+                    # instance — host pipeline handles those
+                    raise UnsupportedByEngine("device ask with constraints/affinities")
+                if ask.count <= 0:
+                    raise UnsupportedByEngine("device ask with zero count")
+                key = ask.id()  # DeviceIdTuple (frozen, hashable)
+                if key not in dims:
+                    if len(dims) >= DEVICE_DIMS:
+                        raise UnsupportedByEngine(
+                            f"more than {DEVICE_DIMS} distinct device asks"
+                        )
+                    dims[key] = 4 + len(dims)
+    return dims
+
+
+def check_supported(job: Job, tg: TaskGroup) -> None:
+    """Gate on features the engine doesn't model on device.
+
+    Reserved ports and plain count-based device asks ARE modeled
+    (port-feasibility masks + same-TG-per-node exclusion; device capacity
+    dims). Remaining fallbacks: cross-TG reserved-port overlap (two TGs
+    competing for one port need the host's sequential port book-keeping),
+    device asks with constraints/affinities or more distinct ids than the
+    spare dims, and distinct_property."""
+    job_device_dims(job)  # raises on unsupported device shapes
+    mine = _tg_reserved_ports(tg)
+    if mine:
+        for other in job.task_groups:
+            if other.name == tg.name:
+                continue
+            if mine & _tg_reserved_ports(other):
+                raise UnsupportedByEngine("cross-TG reserved port overlap")
     for c in list(job.constraints) + list(tg.constraints):
         if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
             raise UnsupportedByEngine("distinct_property")
 
 
 def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
-    """Encode nodes + proposed allocs into dense arrays."""
+    """Encode nodes + proposed allocs into dense arrays.
+
+    Device dims: totals[4+k] = free instances of the job's k-th distinct
+    device-ask id at eval start (capacity already net of existing usage —
+    computed through the same DeviceAccounter the host pipeline uses). A
+    node where the ask matches MORE than one device group falls back: a
+    pooled count could admit a node whose single-group assignment fails.
+    """
+    from ..structs.devices import DeviceAccounter
+
     n = len(nodes)
     g = len(job.task_groups)
     node_index = {node.id: i for i, node in enumerate(nodes)}
     tg_index = {tg.name: gi for gi, tg in enumerate(job.task_groups)}
+    device_dims = job_device_dims(job)
 
     totals = np.zeros((n, NUM_DIMS), dtype=np.float64)
     reserved = np.zeros((n, NUM_DIMS), dtype=np.float64)
@@ -129,7 +187,8 @@ def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
             reserved[i, DIM_MEM] = rr.memory_mb
             reserved[i, DIM_DISK] = rr.disk_mb
 
-        for alloc in ctx.proposed_allocs(node.id):
+        proposed = ctx.proposed_allocs(node.id)
+        for alloc in proposed:
             if alloc.terminal_status():
                 continue
             cr = alloc.comparable_resources()
@@ -147,6 +206,30 @@ def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
                 gi = tg_index.get(alloc.task_group)
                 if gi is not None:
                     tg_counts[gi, i] += 1
+
+        if device_dims and node.node_resources.devices:
+            accounter = DeviceAccounter(node)
+            accounter.add_allocs(proposed)
+            groups_claimed: Dict[DeviceIdTuple, int] = {}
+            for ask_id, dim in device_dims.items():
+                matching = [
+                    (dev_id, inst) for dev_id, inst in accounter.devices.items()
+                    if dev_id.matches(ask_id)
+                ]
+                if len(matching) > 1:
+                    raise UnsupportedByEngine(
+                        "device ask matches multiple groups on a node"
+                    )
+                if matching:
+                    dev_id, inst = matching[0]
+                    if dev_id in groups_claimed:
+                        # two dims drawing from one pool would each see the
+                        # full free count — double-counted capacity
+                        raise UnsupportedByEngine(
+                            "overlapping device asks share one device group"
+                        )
+                    groups_claimed[dev_id] = dim
+                    totals[i, dim] = inst.free_count()
 
     return NodeTable(
         nodes=nodes,
@@ -302,19 +385,97 @@ def _spread_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node]):
     return vids, desired, weights, counts0, has_targets, sum_weights
 
 
-def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool) -> TGSpec:
+def _alloc_used_ports(alloc) -> set:
+    ports = set()
+    ar = alloc.allocated_resources
+    if ar is None:
+        return ports
+    for net in ar.shared.networks:
+        ports.update(p.value for p in net.reserved_ports)
+        ports.update(p.value for p in net.dynamic_ports)
+    for tr in ar.tasks.values():
+        for net in tr.networks:
+            ports.update(p.value for p in net.reserved_ports)
+            ports.update(p.value for p in net.dynamic_ports)
+    return ports
+
+
+def _port_feasibility(ctx, job: Job, tg: TaskGroup, nodes: List[Node],
+                      port_cache: Optional[Dict[str, object]]) -> np.ndarray:
+    """Per-node mask: are ALL of the TG's reserved ports free given the
+    proposed allocs (the host's NetworkIndex reserved-port check, hoisted
+    into a static mask)?
+
+    Ports held by THIS job's SAME task group are excluded: same-TG
+    occupancy is enforced dynamically by the scan (tg_counts + the
+    port-self-exclusion dh flag), so a destructive update whose eviction
+    frees the port still places on the same node — exactly the host's
+    sequential behavior. Duplicate port values within the TG's own asks
+    can never co-assign — all-False, as the host sequentially fails."""
+    from ..structs.network import NetworkIndex
+
+    mask = np.ones(len(nodes), dtype=bool)
+    wanted: set = set()
+    dupes = 0
+    for net in tg.networks:
+        wanted.update(p.value for p in net.reserved_ports)
+        dupes += len(net.reserved_ports)
+    for task in tg.tasks:
+        for net in task.resources.networks:
+            wanted.update(p.value for p in net.reserved_ports)
+            dupes += len(net.reserved_ports)
+    if not wanted:
+        return mask
+    if dupes != len(wanted):
+        return np.zeros(len(nodes), dtype=bool)
+    for i, node in enumerate(nodes):
+        used = None if port_cache is None else port_cache.get(node.id)
+        if used is None:
+            # node-level reserved host ports
+            ni = NetworkIndex(deterministic=ctx.deterministic)
+            ni.set_node(node)
+            base = set()
+            for ports in ni.used_ports.values():
+                base.update(ports)
+            # per-(job, tg) alloc port usage
+            by_owner: Dict[tuple, set] = {}
+            for alloc in ctx.proposed_allocs(node.id):
+                if alloc.terminal_status():
+                    continue
+                by_owner.setdefault(
+                    (alloc.job_id, alloc.task_group), set()
+                ).update(_alloc_used_ports(alloc))
+            used = (base, by_owner)
+            if port_cache is not None:
+                port_cache[node.id] = used
+        base, by_owner = used
+        blocking = set(base)
+        for owner, ports in by_owner.items():
+            if owner != (job.id, tg.name):
+                blocking.update(ports)
+        if blocking.intersection(wanted):
+            mask[i] = False
+    return mask
+
+
+def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
+                  port_cache: Optional[Dict[str, object]] = None) -> TGSpec:
     import math
 
     check_supported(job, tg)
+    device_dims = job_device_dims(job)
 
     ask = np.zeros(NUM_DIMS, dtype=np.float64)
     for task in tg.tasks:
         ask[DIM_CPU] += task.resources.cpu
         ask[DIM_MEM] += task.resources.memory_mb
+        for dev in task.resources.devices:
+            ask[device_dims[dev.id()]] += dev.count
     ask[DIM_DISK] = tg.ephemeral_disk.size_mb
     ask[DIM_MBITS], _ = _net_ask(tg)
 
     feasible = _class_feasibility(ctx, job, tg, nodes)
+    feasible &= _port_feasibility(ctx, job, tg, nodes, port_cache)
     affinity_score, affinity_present = _affinity_arrays(ctx, job, tg, nodes)
     vids, desired, weights, counts0, has_targets, sum_weights = _spread_arrays(
         ctx, job, tg, nodes
@@ -337,7 +498,12 @@ def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool) 
     gi = next(i for i, g in enumerate(job.task_groups) if g.name == tg.name)
 
     dh_job = any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in job.constraints)
-    dh_tg = any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints)
+    # reserved ports make the TG self-exclusive per node: a second instance
+    # would collide on the same port, exactly the dh_tg blocking shape
+    dh_tg = (
+        any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints)
+        or bool(_tg_reserved_ports(tg))
+    )
 
     return TGSpec(
         index=gi,

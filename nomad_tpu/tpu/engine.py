@@ -52,6 +52,7 @@ from .encode import (
     UnsupportedByEngine,
     build_node_table,
     build_tg_spec,
+    job_device_dims,
 )
 
 logger = logging.getLogger("nomad_tpu.tpu.engine")
@@ -507,30 +508,41 @@ class TpuPlacementEngine:
         if not missing_list:
             return True
 
+        from ..utils import metrics as _metrics
+
+        def fallback(reason: str):
+            logger.debug("tpu engine fallback: %s", reason)
+            _metrics.incr_counter("nomad.tpu_engine.fallback")
+            return NotImplemented
+
         # Sticky-disk preferred nodes use a different two-phase select; punt.
         for missing in missing_list:
             prev = missing.get_previous_allocation()
             if prev is not None and missing.get_task_group().ephemeral_disk.sticky:
-                return NotImplemented
+                return fallback("sticky ephemeral disk")
 
         # The capacity model tracks one aggregate bandwidth dimension; the
         # host checks per NIC. Gate multi-NIC nodes to keep parity.
         for node in nodes:
             if len({net.device for net in node.node_resources.networks if net.device}) > 1:
-                return NotImplemented
+                return fallback("multi-NIC node")
 
-        # Build TG specs (may refuse).
+        # Build TG specs (may refuse). The per-node NetworkIndex cache is
+        # shared across this eval's TGs (port-feasibility masks).
         tg_specs: Dict[str, TGSpec] = {}
+        port_cache: Dict[str, object] = {}
         try:
             for missing in missing_list:
                 tg = missing.get_task_group()
                 if tg.name not in tg_specs:
-                    tg_specs[tg.name] = build_tg_spec(ctx, job, tg, nodes, sched.batch)
+                    tg_specs[tg.name] = build_tg_spec(
+                        ctx, job, tg, nodes, sched.batch, port_cache
+                    )
+            table = build_node_table(ctx, job, nodes)
         except UnsupportedByEngine as e:
-            logger.debug("tpu engine fallback: %s", e)
-            return NotImplemented
-
-        table = build_node_table(ctx, job, nodes)
+            return fallback(str(e))
+        _metrics.incr_counter("nomad.tpu_engine.handled")
+        device_dims = job_device_dims(job)  # validated above; never raises here
         start = _time.monotonic_ns()
 
         # float64 for exact host parity; float32 for throughput (MXU-friendly)
@@ -661,6 +673,14 @@ class TpuPlacementEngine:
                         for tr in prev.allocated_resources.tasks.values():
                             for net in tr.networks:
                                 mb += net.mbits
+                        # devices the eviction frees, on the job's dims
+                        if device_dims:
+                            for tr in prev.allocated_resources.tasks.values():
+                                for dev in tr.devices:
+                                    for ask_id, dim in device_dims.items():
+                                        if dev.id().matches(ask_id):
+                                            evict_res[pi, dim] += len(dev.device_ids)
+                                            break
                     evict_res[pi, DIM_MBITS] = mb
                     if prev.job_id == job.id:
                         evict_tg[pi] = tg_name_to_gi.get(prev.task_group, -1)
@@ -720,8 +740,11 @@ class TpuPlacementEngine:
             deployment_id = sched.deployment.id
         now = _time.time_ns()
 
-        # Lazy per-node NetworkIndex mirrors for port assignment.
+        # Lazy per-node NetworkIndex / DeviceAllocator mirrors for port and
+        # device-instance assignment (the discrete half the capacity dims
+        # pre-checked on device).
         net_indexes: Dict[int, NetworkIndex] = {}
+        dev_allocators: Dict[int, object] = {}
 
         def node_net_index(idx: int) -> NetworkIndex:
             ni = net_indexes.get(idx)
@@ -731,6 +754,16 @@ class TpuPlacementEngine:
                 ni.add_allocs(ctx.proposed_allocs(nodes[idx].id))
                 net_indexes[idx] = ni
             return ni
+
+        def node_dev_allocator(idx: int):
+            da = dev_allocators.get(idx)
+            if da is None:
+                from ..scheduler.device import DeviceAllocator
+
+                da = DeviceAllocator(ctx, nodes[idx])
+                da.add_allocs(ctx.proposed_allocs(nodes[idx].id))
+                dev_allocators[idx] = da
+            return da
 
         for pi, missing in enumerate(missing_list):
             tg = missing.get_task_group()
@@ -783,11 +816,21 @@ class TpuPlacementEngine:
                         break
                     ni.add_reserved(offer)
                     tr.networks = [offer]
+                for req in task.resources.devices:
+                    da = node_dev_allocator(node_idx)
+                    offer, _aff, err = da.assign_device(req)
+                    if offer is None:
+                        ok = False
+                        break
+                    da.add_reserved(offer)
+                    tr.devices.append(offer)
+                if not ok:
+                    break
                 task_resources[task.name] = tr
             if not ok:
-                # Port-level collision the capacity model missed: extremely
-                # rare; record as failed placement (plan applier would have
-                # rejected it anyway).
+                # Port/device-instance collision the capacity model missed:
+                # extremely rare; record as failed placement (plan applier
+                # would have rejected it anyway).
                 if sched.failed_tg_allocs is None:
                     sched.failed_tg_allocs = {}
                 sched.failed_tg_allocs[tg.name] = metrics

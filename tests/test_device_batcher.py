@@ -118,7 +118,9 @@ class TestBatchedScanParity:
             enc, n_pad=32, g_pad=4, s_pad=2, v_pad=8, p_pad=8,
             dtype=np.float64,
         )
-        assert static[0].shape == (32, 4)          # totals
+        from nomad_tpu.tpu.encode import NUM_DIMS
+
+        assert static[0].shape == (32, NUM_DIMS)   # totals
         assert static[3].shape == (4, 32)          # feas
         assert static[10].shape == (4, 2, 32)      # spread_vids
         assert static[11].shape == (4, 2, 8)       # spread_desired

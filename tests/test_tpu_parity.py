@@ -235,17 +235,155 @@ def test_parity_fuzz():
         assert host == tpu, f"seed {seed}: parity diverged"
 
 
-def test_engine_fallback_for_devices():
-    """Device asks fall back to the host path transparently."""
+class _CounterSpy:
+    """Record engine path counters event-wise (the in-mem sink's interval
+    retention makes before/after count comparisons flaky)."""
+
+    def __init__(self, monkeypatch):
+        from nomad_tpu.utils import metrics
+
+        self.calls = []
+        orig = metrics.incr_counter
+
+        def spy(name, value=1.0):
+            self.calls.append(name)
+            orig(name, value)
+
+        monkeypatch.setattr(metrics, "incr_counter", spy)
+
+
+def test_parity_device_counts_on_engine(monkeypatch):
+    """Plain count-based device asks take the DEVICE path (capacity dims +
+    host-side instance assignment) with plan parity."""
+    spy = _CounterSpy(monkeypatch)
     nodes = [mock.nvidia_node() for _ in range(3)]
     job = mock.job()
-    job.task_groups[0].count = 2
+    job.task_groups[0].count = 4
     from nomad_tpu.structs.structs import RequestedDevice
 
     job.task_groups[0].tasks[0].resources.devices = [RequestedDevice(name="gpu", count=1)]
     plans = run_pair(nodes, [job], lambda j: "service")
-    # both paths place both allocs (fallback produces valid placements)
-    assert len(plan_assignments(plans["tpu_binpack"][0])) == 2
+    assert "nomad.tpu_engine.handled" in spy.calls, (
+        "device-count job should take the engine path"
+    )
+    assert len(plan_assignments(plans["tpu_binpack"][0])) == 4
+    assert plan_assignments(plans["binpack"][0]) == plan_assignments(plans["tpu_binpack"][0])
+    # every placed alloc carries concrete device instances
+    for plan in plans["tpu_binpack"][0]:
+        for allocs in plan.node_allocation.values():
+            for a in allocs:
+                devs = [d for tr in a.allocated_resources.tasks.values() for d in tr.devices]
+                assert devs and all(d.device_ids for d in devs)
+
+
+def test_parity_device_exhaustion():
+    """More GPU asks than instances: failures must match the host path."""
+    nodes = [mock.nvidia_node() for _ in range(2)]  # 2 nodes x 2 instances
+    job = mock.job()
+    job.task_groups[0].count = 6  # asks 6 GPUs, only 4 exist
+    from nomad_tpu.structs.structs import RequestedDevice
+
+    job.task_groups[0].tasks[0].resources.devices = [RequestedDevice(name="gpu", count=1)]
+    assert_parity(run_pair(nodes, [job], lambda j: "service"))
+
+
+def test_parity_reserved_ports_on_engine(monkeypatch):
+    """Reserved-port jobs take the device path: static port-feasibility
+    mask + same-TG-per-node exclusion, identical plans to the host."""
+    from nomad_tpu.structs.structs import Port
+
+    spy = _CounterSpy(monkeypatch)
+    nodes = make_nodes(8, seed=21)
+    job = mock.job()
+    job.task_groups[0].count = 5
+    job.task_groups[0].tasks[0].resources.networks[0].reserved_ports = [
+        Port(label="http", value=8080)
+    ]
+    plans = run_pair(nodes, [job], lambda j: "service")
+    assert "nomad.tpu_engine.handled" in spy.calls, (
+        "reserved-port job should take the engine path"
+    )
+    assert_parity(plans)
+    # self-exclusion: no node hosts two instances (they'd collide on 8080)
+    for plan in plans["tpu_binpack"][0]:
+        for node_id, allocs in plan.node_allocation.items():
+            assert len(allocs) <= 1
+
+
+def test_parity_reserved_ports_competing_jobs():
+    """Two jobs fighting for the same static port: the second job must
+    avoid nodes the first claimed — identically on both paths."""
+    from nomad_tpu.structs.structs import Port
+
+    nodes = make_nodes(10, seed=22)
+    jobs = []
+    for i in range(2):
+        job = mock.job()
+        job.id = f"port-fight-{i}"
+        job.task_groups[0].count = 4
+        job.task_groups[0].tasks[0].resources.networks[0].reserved_ports = [
+            Port(label="svc", value=9999)
+        ]
+        jobs.append(job)
+    plans = run_pair(nodes, jobs, lambda j: "service")
+    assert_parity(plans)
+    # across BOTH jobs, port 9999 is claimed at most once per node
+    node_claims = {}
+    for plan in plans["tpu_binpack"][0]:
+        for node_id, allocs in plan.node_allocation.items():
+            node_claims[node_id] = node_claims.get(node_id, 0) + len(allocs)
+    assert all(v <= 1 for v in node_claims.values())
+
+
+def test_parity_reserved_ports_destructive_update():
+    """Destructive update of a reserved-port job: the replacement may land
+    on the SAME node because the eviction frees the port first."""
+    from nomad_tpu.structs.structs import Port
+
+    nodes = make_nodes(6, seed=23)
+    results = {}
+    for alg in ("binpack", "tpu_binpack"):
+        h = Harness()
+        h.state.scheduler_set_config(
+            h.next_index(), SchedulerConfiguration(scheduler_algorithm=alg)
+        )
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), copy.deepcopy(n))
+        job = mock.job()
+        job.id = "port-update"
+        job.task_groups[0].count = 3
+        job.task_groups[0].tasks[0].resources.networks[0].reserved_ports = [
+            Port(label="http", value=7070)
+        ]
+        h.state.upsert_job(h.next_index(), copy.deepcopy(job))
+        ev = Evaluation(priority=50, type="service",
+                        triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                        job_id=job.id, namespace="default")
+        h.process("service", ev)
+        # apply the plan into state, then bump the job (destructive change)
+        job2 = copy.deepcopy(job)
+        job2.version = 1
+        job2.job_modify_index = h.next_index()
+        job2.task_groups[0].tasks[0].env = {"V": "2"}
+        h.state.upsert_job(h.next_index(), copy.deepcopy(job2))
+        ev2 = Evaluation(priority=50, type="service",
+                         triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                         job_id=job.id, namespace="default")
+        h.process("service", ev2)
+        results[alg] = (h.plans, h.evals, h.create_evals)
+    assert_parity(results)
+
+
+def test_fallback_metrics_for_unsupported(monkeypatch):
+    """Unsupported shapes still fall back — and the fallback is counted."""
+    spy = _CounterSpy(monkeypatch)
+    nodes = make_nodes(5, seed=24)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.constraints.append(Constraint(operand="distinct_property",
+                                      ltarget="${attr.rack}"))
+    plans = run_pair(nodes, [job], lambda j: "service")
+    assert "nomad.tpu_engine.fallback" in spy.calls
     assert plan_assignments(plans["binpack"][0]) == plan_assignments(plans["tpu_binpack"][0])
 
 
