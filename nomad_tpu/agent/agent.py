@@ -41,6 +41,11 @@ class AgentConfig:
     num_schedulers: int = 2
     scheduler_algorithm: str = "tpu_binpack"
     acl_enabled: bool = False
+    # federation: non-authoritative regions mirror ACL policies + global
+    # tokens from here (reference authoritative_region + replication_token)
+    authoritative_region: str = ""
+    replication_token: str = ""
+    acl_replication_interval: float = 30.0
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
     # multi-process consensus: real raft over the RPC transport instead of
@@ -209,6 +214,10 @@ class Agent:
                 ServerConfig(
                     num_schedulers=self.config.num_schedulers,
                     scheduler_algorithm=self.config.scheduler_algorithm,
+                    region=self.config.region,
+                    authoritative_region=self.config.authoritative_region,
+                    replication_token=self.config.replication_token,
+                    replication_interval=self.config.acl_replication_interval,
                 ),
                 raft=raft,
                 name=self.config.name,
@@ -221,8 +230,7 @@ class Agent:
                     # the in-process server; wrap with leader-RPC failover
                     proxy = _LeaderFailoverProxy(self, proxy)
             elif self.config.servers:
-                from ..rpc.endpoints import RemoteServerProxy
-                from ..rpc.transport import RPCClient, RPCError
+                from ..client.servers import FailoverServerProxy, ServersManager
 
                 addrs = []
                 for a in self.config.servers:
@@ -232,20 +240,10 @@ class Agent:
                             f"server address {a!r} must be host:port"
                         )
                     addrs.append((host, int(port)))
-                # first answering server wins (client/servers round-robin
-                # failover is per-call in the reference; this picks at boot)
-                chosen = addrs[0]
-                for addr in addrs:
-                    probe = RPCClient(*addr, timeout=3.0, tls=self.tls)
-                    try:
-                        probe.call("Status.ping")
-                        chosen = addr
-                        break
-                    except (RPCError, OSError):
-                        continue
-                    finally:
-                        probe.close()
-                proxy = RemoteServerProxy(*chosen, tls=self.tls)
+                # per-call failover over the full candidate list (the
+                # reference's client/servers manager): every RPC uses the
+                # current best server; a failed call rotates and retries
+                proxy = FailoverServerProxy(ServersManager(addrs), tls=self.tls)
             else:
                 raise ValueError(
                     "client-only agents need -servers addresses or a server"
@@ -320,6 +318,12 @@ class Agent:
                 self.rpc.region_servers = lambda region: [
                     s.rpc_addr for s in self.membership.servers_in_region(region)
                 ]
+                # cross-region RPC for the server's leader loops (ACL
+                # replication): rides the transport's region forwarding
+                self.server.region_rpc = (
+                    lambda method, region, *args:
+                    self.rpc._forward_region(region, method, args)
+                )
                 self.membership.on_server_change = self._on_server_change
                 self.server.raft.leadership_observers.append(self._on_raft_leadership)
         # monitor + autopilot (reference command/agent/monitor, autopilot.go)

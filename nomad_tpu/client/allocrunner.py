@@ -99,6 +99,20 @@ class AllocRunner:
                 self.logger.info("task %s not recoverable; starting fresh", task.name)
         for tr in self.task_runners.values():
             tr.run()
+        # GROUP services (incl. Connect sidecar proxy services) register
+        # once per alloc (reference allocrunner groupServiceHook)
+        self._group_consul_ids = []
+        if self.consul is not None and getattr(self.task_group, "services", None):
+            address = (
+                self.node.attributes.get("unique.network.ip-address", "")
+                if self.node is not None else ""
+            )
+            try:
+                self._group_consul_ids = self.consul.register_group_services(
+                    self.alloc, self.task_group, address=address
+                )
+            except Exception as e:  # noqa: BLE001 — consul outage isn't fatal
+                self.logger.warning("group consul registration failed: %s", e)
         if self.alloc.deployment_id:
             self._health_thread = threading.Thread(
                 target=self._watch_health, daemon=True,
@@ -107,6 +121,19 @@ class AllocRunner:
             self._health_thread.start()
 
     def _notify(self) -> None:
+        # group Consul services deregister as soon as EVERY task is done —
+        # a batch alloc that finishes on its own must not leave its group
+        # service/sidecar-proxy routing to a dead endpoint until GC
+        if (
+            getattr(self, "_group_consul_ids", None)
+            and self.task_runners
+            and all(tr.done.is_set() for tr in self.task_runners.values())
+        ):
+            ids, self._group_consul_ids = self._group_consul_ids, []
+            try:
+                self.consul.deregister_ids(ids)
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("group consul deregistration failed: %s", e)
         if self.on_update is not None:
             self.on_update(self)
 
@@ -218,6 +245,12 @@ class AllocRunner:
         return tr.driver.exec_task_streaming(tr.task_id, list(cmd))
 
     def stop(self) -> None:
+        if self.consul is not None and getattr(self, "_group_consul_ids", None):
+            try:
+                self.consul.deregister_ids(self._group_consul_ids)
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("group consul deregistration failed: %s", e)
+            self._group_consul_ids = []
         for tr in self.task_runners.values():
             tr.kill_requested.set()
         for tr in self.task_runners.values():

@@ -71,6 +71,8 @@ class ConsulClient:
         port: int = 0,
         tags: Optional[List[str]] = None,
         checks: Optional[List[dict]] = None,
+        kind: str = "",
+        proxy: Optional[dict] = None,
     ) -> None:
         body = {
             "ID": service_id,
@@ -81,6 +83,10 @@ class ConsulClient:
         }
         if checks:
             body["Checks"] = checks
+        if kind:
+            body["Kind"] = kind  # "connect-proxy" for Connect sidecars
+        if proxy:
+            body["Proxy"] = proxy
         self._call("PUT", "/v1/agent/service/register", body)
 
     def deregister_service(self, service_id: str) -> None:
@@ -139,6 +145,66 @@ class ConsulClient:
                 ids.append(sid)
             except ConsulError as e:
                 logger.warning("registering %s failed: %s", sid, e)
+        return ids
+
+    def register_group_services(self, alloc, tg, address: str = "") -> List[str]:
+        """Register GROUP-level services; a service with a Connect sidecar
+        also registers its proxy service (Kind=connect-proxy, the
+        reference's groupServiceHook + sidecar registration)."""
+        from ..structs.structs import CONNECT_PROXY_PREFIX
+
+        def group_port(label: str) -> int:
+            ar = alloc.allocated_resources
+            if ar is None or not label:
+                return 0
+            for net in ar.shared.networks:
+                for p in list(net.dynamic_ports) + list(net.reserved_ports):
+                    if p.label == label:
+                        return p.value
+            # group asks may have landed on a task's offer
+            for tr in ar.tasks.values():
+                for net in tr.networks:
+                    for p in list(net.dynamic_ports) + list(net.reserved_ports):
+                        if p.label == label:
+                            return p.value
+            return 0
+
+        ids: List[str] = []
+        for svc in getattr(tg, "services", []) or []:
+            sid = f"_nomad-group-{alloc.id}-{svc.name}"
+            checks = [
+                self._check_body(svc.name, c)
+                for c in getattr(svc, "checks", []) or []
+            ]
+            try:
+                self.register_service(
+                    sid, svc.name, address=address,
+                    port=group_port(svc.port_label),
+                    tags=svc.tags, checks=checks or None,
+                )
+                ids.append(sid)
+            except ConsulError as e:
+                logger.warning("registering %s failed: %s", sid, e)
+                continue
+            if getattr(svc, "has_sidecar", lambda: False)():
+                proxy_label = f"{CONNECT_PROXY_PREFIX}-{svc.name}"
+                proxy_id = f"{sid}-sidecar-proxy"
+                sidecar = (svc.connect or {}).get("sidecar_service") or {}
+                proxy_cfg = dict(sidecar.get("proxy") or {})
+                proxy_cfg.setdefault("DestinationServiceName", svc.name)
+                proxy_cfg.setdefault("DestinationServiceID", sid)
+                try:
+                    self.register_service(
+                        proxy_id, f"{svc.name}-sidecar-proxy",
+                        address=address,
+                        port=group_port(proxy_label),
+                        tags=svc.tags,
+                        kind="connect-proxy",
+                        proxy=proxy_cfg,
+                    )
+                    ids.append(proxy_id)
+                except ConsulError as e:
+                    logger.warning("registering %s failed: %s", proxy_id, e)
         return ids
 
     def deregister_ids(self, ids: List[str]) -> None:

@@ -129,6 +129,9 @@ def bind_server(server, rpc: RPCServer) -> None:
     # -- Periodic ------------------------------------------------------
     rpc.register("Periodic.Force", server.periodic_dispatcher.force_launch)
 
+    # -- ACL federation (leader.go:997/:1138 replication source) -------
+    rpc.register("ACL.ListReplication", server.list_acl_for_replication)
+
     # -- Operator ------------------------------------------------------
     def scheduler_get_config():
         index, config = state.scheduler_config()

@@ -72,6 +72,14 @@ class ServerConfig:
     # shard the eval batch over an ("evals", "nodes") jax device mesh when
     # multiple accelerator devices are visible (multi-chip)
     device_mesh: bool = False
+    # federation (reference leader.go:997/:1138): non-authoritative
+    # regions' leaders mirror ACL policies and GLOBAL tokens from the
+    # authoritative region. Empty authoritative_region (or equal to our
+    # own region) disables replication.
+    region: str = "global"
+    authoritative_region: str = ""
+    replication_token: str = ""
+    replication_interval: float = 30.0
 
 
 class Server:
@@ -152,6 +160,10 @@ class Server:
                 mesh=mesh,
             )
 
+        # Cross-region RPC hook (set by the agent): callable
+        # (method, region, *args) routed through the gossip region map.
+        self.region_rpc = None
+
         # Join before observing: the join-time election fires observers, and
         # start() handles the initial-leadership case explicitly.
         self.peer = self.raft.join(self.fsm)
@@ -227,6 +239,14 @@ class Server:
         self._schedule_leader_task(gen, 10.0, self._emit_stats)
         if self.vault is not None:
             self._schedule_leader_task(gen, 60.0, self._sweep_vault_accessors)
+        if (self.config.authoritative_region
+                and self.config.authoritative_region != self.config.region):
+            # non-authoritative leader: mirror ACL state from the
+            # authoritative region (leader.go:997 replicateACLPolicies,
+            # :1138 replicateACLTokens)
+            self._schedule_leader_task(
+                gen, self.config.replication_interval, self._replicate_acl
+            )
 
     def _emit_stats(self) -> None:
         """Publish broker/blocked/plan-queue gauges (reference
@@ -445,6 +465,12 @@ class Server:
 
     def register_job(self, job: Job) -> str:
         """Job.Register: upsert + create an eval (job_endpoint.go:73)."""
+        # Consul Connect admission mutator: group services with a connect
+        # stanza get their sidecar task + proxy port injected BEFORE the
+        # job hits raft (job_endpoint_hook_connect.go:99)
+        from .job_hooks import job_connect_hook
+
+        job_connect_hook(job)
         # Vault admission check (job_endpoint.go:175 validateJob): a job
         # asking for Vault tokens needs a Vault-enabled server
         if self.vault is None:
@@ -699,6 +725,94 @@ class Server:
 
     def delete_acl_tokens(self, accessors) -> None:
         self.raft_apply("acl-token-delete", list(accessors))
+
+    # -- cross-region ACL replication (leader.go:997/:1138) ---------------
+
+    def list_acl_for_replication(self, secret: str = ""):
+        """RPC: the authoritative region's full policy set + GLOBAL tokens
+        for a replica region's mirror sweep. Token secrets cross the wire
+        here, so the caller must present the replication token or a
+        management token once ACLs are bootstrapped."""
+        self._check_replication_auth(secret)
+        state = self.fsm.state
+        policies = list(state.acl_policies_table.values())
+        tokens = [t for t in state.acl_tokens_table.values() if t.global_]
+        return [policies, tokens]
+
+    def _check_replication_auth(self, secret: str) -> None:
+        state = self.fsm.state
+        if not state.acl_tokens_table:
+            return  # ACLs not bootstrapped: nothing secret to protect
+        if self.config.replication_token and secret == self.config.replication_token:
+            return
+        tok = state.acl_token_by_secret(secret) if secret else None
+        if tok is not None and tok.is_management():
+            return
+        raise PermissionError(
+            "ACL replication requires the replication token or a management token"
+        )
+
+    def _replicate_acl(self) -> None:
+        if self.region_rpc is None:
+            return
+        try:
+            policies, tokens = self.region_rpc(
+                "ACL.ListReplication",
+                self.config.authoritative_region,
+                self.config.replication_token,
+            )
+        except Exception as e:  # noqa: BLE001 — authoritative region away
+            # misconfigured credentials never self-heal: surface them;
+            # transient unreachability stays at debug
+            if "PermissionError" in str(e):
+                self.logger.warning(
+                    "ACL replication rejected by %s: %s (check "
+                    "replication_token)", self.config.authoritative_region, e,
+                )
+            else:
+                self.logger.debug("ACL replication fetch failed: %s", e)
+            return
+        from .fsm import (
+            ACL_POLICY_DELETE,
+            ACL_POLICY_UPSERT,
+            ACL_TOKEN_DELETE,
+            ACL_TOKEN_UPSERT,
+        )
+
+        state = self.fsm.state
+        # policies: content-compare (raft restamps indexes locally, so
+        # index equality would re-upsert forever)
+        remote_p = {p.name: p for p in policies}
+        local_p = dict(state.acl_policies_table)
+        deletes = [n for n in local_p if n not in remote_p]
+        upserts = [
+            p for n, p in remote_p.items()
+            if n not in local_p
+            or (local_p[n].rules, local_p[n].description)
+            != (p.rules, p.description)
+        ]
+        if deletes:
+            self.raft_apply(ACL_POLICY_DELETE, deletes)
+        if upserts:
+            self.raft_apply(ACL_POLICY_UPSERT, upserts)
+        # tokens: only GLOBAL tokens mirror; local tokens stay local
+        remote_t = {t.accessor_id: t for t in tokens}
+        local_t = {
+            a: t for a, t in state.acl_tokens_table.items() if t.global_
+        }
+        t_deletes = [a for a in local_t if a not in remote_t]
+
+        def token_key(t):
+            return (t.name, t.type, tuple(t.policies), t.secret_id)
+
+        t_upserts = [
+            t for a, t in remote_t.items()
+            if a not in local_t or token_key(local_t[a]) != token_key(t)
+        ]
+        if t_deletes:
+            self.raft_apply(ACL_TOKEN_DELETE, t_deletes)
+        if t_upserts:
+            self.raft_apply(ACL_TOKEN_UPSERT, t_upserts)
 
     # -- vault (nomad/vault.go + node_endpoint.go DeriveVaultToken) ------
 

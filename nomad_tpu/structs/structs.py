@@ -557,6 +557,19 @@ class Service:
     # check stanzas as plain dicts: {"name", "type", "ttl", "http",
     # "interval", ...} (reference structs.go ServiceCheck)
     checks: List[Dict[str, Any]] = field(default_factory=list)
+    # Consul Connect stanza as a plain dict (reference structs.go
+    # ConsulConnect): {"sidecar_service": {"port": ..., "proxy": {...}},
+    # "sidecar_task": {"driver": ..., "config": {...}, ...}}
+    connect: Optional[Dict[str, Any]] = None
+
+    def has_sidecar(self) -> bool:
+        return bool(self.connect and "sidecar_service" in self.connect)
+
+
+#: Connect sidecar naming (reference structs.go ConnectProxyPrefix) —
+#: shared by the server's injection hook and the client's Consul
+#: registration (proxy port label / task kind).
+CONNECT_PROXY_PREFIX = "connect-proxy"
 
 
 @dataclass
@@ -584,6 +597,9 @@ class Task:
     templates: List[Dict[str, Any]] = field(default_factory=list)
     vault: Optional[Dict[str, Any]] = None
     leader: bool = False
+    # task role marker (reference structs.go TaskKind), e.g.
+    # "connect-proxy:<service>" for injected sidecars
+    kind: str = ""
     kill_timeout_ns: int = 5 * 10**9
     kill_signal: str = "SIGTERM"
     restart_policy: Optional[RestartPolicy] = None
@@ -607,6 +623,9 @@ class TaskGroup:
     networks: List[NetworkResource] = field(default_factory=list)
     volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
     meta: Dict[str, str] = field(default_factory=dict)
+    # GROUP-level services (reference structs.go TaskGroup.Services) —
+    # where Consul Connect stanzas live
+    services: List[Service] = field(default_factory=list)
 
     def lookup_task(self, name: str) -> Optional[Task]:
         for t in self.tasks:

@@ -192,6 +192,129 @@ class TestServerVaultLifecycle:
             server.stop()
 
 
+class TestConsulConnect:
+    def test_sidecar_injection_hook(self):
+        """Registering a job with a connect stanza injects the sidecar
+        task + proxy port (job_endpoint_hook_connect.go:99)."""
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.structs import NetworkResource, Service
+
+        server = Server(ServerConfig(num_schedulers=0))
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.networks = [NetworkResource(mbits=10)]
+            tg.services = [Service(
+                name="web-api", port_label="http",
+                connect={"sidecar_service": {}},
+            )]
+            server.register_job(job)
+            stored = server.fsm.state.job_by_id("default", job.id)
+            tg2 = stored.task_groups[0]
+            sidecars = [t for t in tg2.tasks if t.kind == "connect-proxy:web-api"]
+            assert len(sidecars) == 1
+            assert sidecars[0].name == "connect-proxy-web-api"
+            assert sidecars[0].driver == "docker"
+            labels = [p.label for p in tg2.networks[0].dynamic_ports]
+            assert "connect-proxy-web-api" in labels
+            # re-registering must not double-inject
+            server.register_job(stored)
+            stored2 = server.fsm.state.job_by_id("default", job.id)
+            again = [t for t in stored2.task_groups[0].tasks
+                     if t.kind == "connect-proxy:web-api"]
+            assert len(again) == 1
+        finally:
+            server.stop()
+
+    def test_connect_requires_single_network(self):
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.structs import Service
+
+        server = Server(ServerConfig(num_schedulers=0))
+        try:
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.networks = []  # no group network
+            tg.services = [Service(name="api", connect={"sidecar_service": {}})]
+            with pytest.raises(ValueError, match="exactly 1 network"):
+                server.register_job(job)
+        finally:
+            server.stop()
+
+    def test_sidecar_and_proxy_registered_in_consul(self, consul):
+        """End-to-end: a connect job's group service AND its sidecar proxy
+        service (Kind=connect-proxy, DestinationServiceName) land in the
+        mock Consul; the injected sidecar task actually runs."""
+        from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+        from nomad_tpu.integrations.consul import ConsulConfig
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.structs import NetworkResource, Service
+
+        server = Server(ServerConfig(
+            num_schedulers=1, heartbeat_min_ttl=60, heartbeat_max_ttl=60,
+        ))
+        server.start()
+        client = Client(ServerProxy(server), ClientConfig(
+            consul=ConsulConfig(address=consul.address),
+        ))
+        try:
+            client.start()
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.networks = [NetworkResource(mbits=10)]
+            task = tg.tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": "/bin/sh", "args": ["-c", "sleep 60"]}
+            task.resources.networks = []
+            tg.services = [Service(
+                name="countdash", port_label="connect-proxy-countdash",
+                connect={
+                    "sidecar_service": {},
+                    # non-docker environment: run a stand-in proxy
+                    "sidecar_task": {
+                        "driver": "raw_exec",
+                        "config": {"command": "/bin/sh",
+                                   "args": ["-c", "sleep 60"]},
+                    },
+                },
+            )]
+            server.register_job(job)
+
+            def running():
+                allocs = server.fsm.state.allocs_by_job("default", job.id, True)
+                return [a for a in allocs if a.client_status == "running"]
+
+            wait_until(lambda: running(), msg="connect alloc running")
+            alloc = running()[0]
+            # both tasks (app + injected sidecar) run
+            ar = client.allocrunners[alloc.id]
+            assert set(ar.task_runners) == {"web", "connect-proxy-countdash"}
+
+            wait_until(
+                lambda: any("sidecar-proxy" in sid for sid in consul.services),
+                msg="proxy service registered",
+            )
+            group_svcs = {s["Name"]: s for s in consul.services.values()}
+            assert "countdash" in group_svcs
+            proxy = group_svcs["countdash-sidecar-proxy"]
+            assert proxy["Kind"] == "connect-proxy"
+            assert proxy["Proxy"]["DestinationServiceName"] == "countdash"
+            # the proxy advertises the injected dynamic port
+            assert proxy["Port"] > 0
+
+            # stop -> deregistered
+            server.stop_alloc(alloc.id)
+            wait_until(
+                lambda: not any("countdash" in s["Name"]
+                                for s in consul.services.values()),
+                msg="group services deregistered",
+            )
+        finally:
+            client.shutdown()
+            server.stop()
+
+
 class TestTaskServiceRegistration:
     def test_services_follow_task_lifecycle(self, consul):
         from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
