@@ -368,8 +368,21 @@ def _parse_service(o: HCLObject, task_name: str) -> Service:
         name = f"${{JOB}}-{task_name}" if task_name else ""
     tags = [_str(t) for t in (o.get("tags") or [])]
     checks = [_plain(body) for body in o.get_all("check")]
+    connect = None
+    for body in o.get_all("connect"):
+        # Consul Connect stanza (reference parse_service.go parseConnect):
+        # kept as plain dicts — sidecar_service {port, proxy{...}} and
+        # sidecar_task {driver, config{...}, resources{...}}
+        connect = {}
+        for sidecar in body.get_all("sidecar_service"):
+            connect["sidecar_service"] = _plain(sidecar)
+        for st in body.get_all("sidecar_task"):
+            connect["sidecar_task"] = _plain(st)
+        if _bool(body.get("native", False), "connect.native"):
+            connect["native"] = True
     return Service(
-        name=name, port_label=_str(o.get("port", "")), tags=tags, checks=checks
+        name=name, port_label=_str(o.get("port", "")), tags=tags, checks=checks,
+        connect=connect,
     )
 
 
@@ -461,6 +474,10 @@ def _parse_group(name: str, o: HCLObject, job_type: str) -> TaskGroup:
         )
     for body in o.get_all("meta"):
         g.meta.update(_strmap(body, "meta"))
+    # GROUP-level services — where Consul Connect stanzas live
+    # (reference parse_group.go service blocks)
+    for body in o.get_all("service"):
+        g.services.append(_parse_service(body, ""))
     for label, body in _labelled_blocks(o, "task", "task"):
         g.tasks.append(_parse_task(label, body))
     if not g.tasks:

@@ -440,3 +440,50 @@ def test_hcl_lists_and_objects():
     assert obj.get("mixed") == ["a", True, 1.5]
     inner = obj.get("obj")
     assert inner.get("a") == 1 and inner.get("b") == "two"
+
+
+def test_group_service_connect_stanza():
+    """Group-level service with a Consul Connect stanza parses into
+    Service.connect (parse_service.go parseConnect) and survives the
+    register-time sidecar injection hook."""
+    job = parse_job('''
+job "countdash" {
+  datacenters = ["dc1"]
+  group "api" {
+    network { mbits = 10 }
+    service {
+      name = "count-api"
+      port = "connect-proxy-count-api"
+      connect {
+        sidecar_service {
+          proxy {
+            local_service_port = 9001
+          }
+        }
+        sidecar_task {
+          driver = "raw_exec"
+        }
+      }
+    }
+    task "web" {
+      driver = "mock"
+      config { run_for = "10s" }
+    }
+  }
+}
+''')
+    tg = job.task_groups[0]
+    assert len(tg.services) == 1
+    svc = tg.services[0]
+    assert svc.name == "count-api"
+    assert svc.has_sidecar()
+    assert svc.connect["sidecar_service"]["proxy"]["local_service_port"] == 9001
+    assert svc.connect["sidecar_task"]["driver"] == "raw_exec"
+
+    from nomad_tpu.server.job_hooks import job_connect_hook
+
+    job_connect_hook(job)
+    kinds = [t.kind for t in tg.tasks]
+    assert "connect-proxy:count-api" in kinds
+    labels = [p.label for p in tg.networks[0].dynamic_ports]
+    assert "connect-proxy-count-api" in labels
