@@ -104,7 +104,7 @@ def bench_batched_parity_c1m(total=1_000_000, n_nodes=5000, batch=512,
             break
     elapsed = time.perf_counter() - t0
     rate = done / elapsed
-    eta_1m = total / rate
+    eta_1m = 1_000_000 / rate
     log(
         f"C1M eval-batched PARITY: {done:,} placements / {n_nodes} nodes in "
         f"{elapsed:.2f}s -> {rate:,.0f} placements/s on ONE chip "
@@ -120,13 +120,13 @@ def bench_batched_parity_c1m(total=1_000_000, n_nodes=5000, batch=512,
 # ---------------------------------------------------------------------------
 
 def c1m_inputs(n_nodes=5000, n_tgs=8, seed=0):
-    from nomad_tpu.tpu.engine import DIM_CPU, DIM_MEM, NUM_DIMS, example_scan_inputs
+    from nomad_tpu.tpu.engine import DIM_CPU, DIM_MEM, example_scan_inputs
 
     n_pad, static, carry, _ = example_scan_inputs(
         n_nodes=n_nodes, n_tgs=n_tgs, n_placements=64, seed=seed
     )
     static = list(static)
-    asks = np.zeros((n_tgs, NUM_DIMS), static[2].dtype)
+    asks = np.zeros_like(static[2])  # same capacity dims as the encode
     asks[:, DIM_CPU] = 15
     asks[:, DIM_MEM] = 30
     static[2] = asks

@@ -264,6 +264,18 @@ class HTTPServer:
         class _Server(ThreadingHTTPServer):
             daemon_threads = True
 
+            def handle_error(self, request, client_address):
+                # disconnects mid-stream (follow-mode consumers hitting
+                # Ctrl-C) and malformed requests are peer-side events: no
+                # traceback spray on stderr
+                import logging as logging_mod
+                import sys as sys_mod
+
+                logging_mod.getLogger("nomad_tpu.http").debug(
+                    "connection from %s errored: %s",
+                    client_address, sys_mod.exc_info()[1],
+                )
+
             def finish_request(self, request, client_address):
                 # handshake in the per-connection thread: wrapping the
                 # LISTENER would run handshakes in the accept loop, where

@@ -475,9 +475,13 @@ def _parse_group(name: str, o: HCLObject, job_type: str) -> TaskGroup:
     for body in o.get_all("meta"):
         g.meta.update(_strmap(body, "meta"))
     # GROUP-level services — where Consul Connect stanzas live
-    # (reference parse_group.go service blocks)
+    # (reference parse_group.go service blocks; unnamed group services
+    # default to "<job>-<group>")
     for body in o.get_all("service"):
-        g.services.append(_parse_service(body, ""))
+        svc = _parse_service(body, "")
+        if not svc.name:
+            svc.name = f"${{JOB}}-{name}"
+        g.services.append(svc)
     for label, body in _labelled_blocks(o, "task", "task"):
         g.tasks.append(_parse_task(label, body))
     if not g.tasks:

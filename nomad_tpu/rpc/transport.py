@@ -172,6 +172,17 @@ class RPCServer:
             daemon_threads = True
             allow_reuse_address = True
 
+            def handle_error(self, request, client_address):
+                # a malformed frame or a connection torn mid-decode is a
+                # peer problem, not a server crash: log, don't spray the
+                # default traceback onto stderr
+                import sys
+
+                exc = sys.exc_info()[1]
+                outer.logger.debug(
+                    "connection from %s errored: %s", client_address, exc
+                )
+
         self._tcp = Server((host, port), Handler)
         self.addr: Tuple[str, int] = self._tcp.server_address
         self._thread: Optional[threading.Thread] = None

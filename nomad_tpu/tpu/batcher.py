@@ -38,7 +38,8 @@ def _pow2ceil(x: int) -> int:
 
 
 def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
-                v_pad: int, p_pad: int, dtype) -> Tuple[tuple, tuple, tuple]:
+                v_pad: int, p_pad: int, dtype,
+                d_pad: int = 0) -> Tuple[tuple, tuple, tuple]:
     """Pad one eval's arrays to the batch's shared bucketed dims.
 
     Padding is semantically inert by construction:
@@ -48,6 +49,8 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         the scan body skips them (skip_step) and mutates nothing
       - spread rows beyond s are inactive; the invalid vocab bucket is
         remapped from v-1 to v_pad-1
+      - capacity dims beyond the eval's own (device dims of co-batched
+        device jobs) pad zero ask against zero totals: 0 <= 0 fits
     """
     (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
      dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
@@ -58,9 +61,13 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
      limit_p, sum_sw_p) = enc.xs
 
     n0, g0, s0, v0, p0 = enc.n_pad, enc.g, enc.s, enc.v, enc.p
+    d0 = totals.shape[1]
+    if d_pad <= 0:
+        d_pad = d0
     dn, dg, ds, dv, dp = (n_pad - n0, g_pad - g0, s_pad - s0,
                           v_pad - v0, p_pad - p0)
-    assert min(dn, dg, ds, dv, dp) >= 0
+    dd = d_pad - d0
+    assert min(dn, dg, ds, dv, dp, dd) >= 0
     assert dp == 0 or g_pad > g0  # padded steps need a pre-failed TG slot
 
     def pad(arr, widths, fill=0):
@@ -76,9 +83,9 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
     vids = pad(vids, ((0, dg), (0, ds), (0, dn)), v_pad - 1)
 
     static = (
-        pad(f(totals), ((0, dn), (0, 0))),
-        pad(f(reserved), ((0, dn), (0, 0))),
-        pad(f(asks), ((0, dg), (0, 0))),
+        pad(f(totals), ((0, dn), (0, dd))),
+        pad(f(reserved), ((0, dn), (0, dd))),
+        pad(f(asks), ((0, dg), (0, dd))),
         pad(feas, ((0, dg), (0, dn)), False),
         pad(f(aff_score), ((0, dg), (0, dn))),
         pad(aff_present, ((0, dg), (0, dn)), False),
@@ -95,7 +102,7 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         np.int32(n_real),
     )
     carry = (
-        pad(f(used0), ((0, dn), (0, 0))),
+        pad(f(used0), ((0, dn), (0, dd))),
         pad(tg_counts0, ((0, dg), (0, dn)), 0),
         pad(job_counts0, ((0, dn),), 0),
         pad(f(spread_counts0), ((0, dg), (0, ds), (0, dv))),
@@ -108,7 +115,7 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         pad(tg_idx, ((0, dp),), g0),  # g0 = first padded (pre-failed) slot
         pad(penalty_idx, ((0, dp), (0, 0)), -1),
         pad(evict_node, ((0, dp),), -1),
-        pad(f(evict_res), ((0, dp), (0, 0))),
+        pad(f(evict_res), ((0, dp), (0, dd))),
         pad(evict_tg, ((0, dp),), -1),
         pad(limit_p, ((0, dp),), 0),
         pad(f(sum_sw_p), ((0, dp),), 1.0),
@@ -273,10 +280,11 @@ class DeviceBatcher:
         s_pad = _pow2ceil(max(max(e.s for e in encs), 1))
         v_pad = _pow2ceil(max(max(e.v for e in encs), 2))
         p_pad = _pow2ceil(max(e.p for e in encs))
+        d_pad = max(e.static[0].shape[1] for e in encs)
         dtype = encs[0].dtype  # dispatch loop groups by dtype
 
         padded = [
-            pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype)
+            pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad)
             for e in encs
         ]
 
@@ -289,7 +297,7 @@ class DeviceBatcher:
             n_pad2 = ((n_pad + nn - 1) // nn) * nn
             if n_pad2 != n_pad:
                 padded = [
-                    pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype)
+                    pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype, d_pad)
                     for e in encs
                 ]
                 n_pad = n_pad2

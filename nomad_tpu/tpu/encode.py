@@ -23,13 +23,18 @@ from ..structs.structs import (
     TaskGroup,
 )
 
-# Capacity dimensions tracked on device. Dims 4..5 are DEVICE dims: each
+# Capacity dimensions tracked on device. Dims 4.. are DEVICE dims: each
 # distinct device-ask id in the job claims one (totals = free matching
-# instances per node at eval start); unused device dims have zero ask and
-# zero totals, so they are inert.
+# instances per node at eval start). The per-eval dimensionality is
+# 4 + the job's device-dim count, so deviceless jobs — the common case —
+# pay nothing for the device model; the batcher pads D across a batch.
 DIM_CPU, DIM_MEM, DIM_DISK, DIM_MBITS = 0, 1, 2, 3
-DEVICE_DIMS = 2
-NUM_DIMS = 4 + DEVICE_DIMS
+DEVICE_DIMS = 2  # max distinct device asks per job on the engine path
+NUM_DIMS = 4 + DEVICE_DIMS  # maximum
+
+
+def job_num_dims(device_dims) -> int:
+    return 4 + len(device_dims)
 
 # Max penalty nodes encoded per placement (failed node + reschedule history).
 MAX_PENALTY_NODES = 6
@@ -168,10 +173,11 @@ def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
     node_index = {node.id: i for i, node in enumerate(nodes)}
     tg_index = {tg.name: gi for gi, tg in enumerate(job.task_groups)}
     device_dims = job_device_dims(job)
+    num_dims = job_num_dims(device_dims)
 
-    totals = np.zeros((n, NUM_DIMS), dtype=np.float64)
-    reserved = np.zeros((n, NUM_DIMS), dtype=np.float64)
-    used = np.zeros((n, NUM_DIMS), dtype=np.float64)
+    totals = np.zeros((n, num_dims), dtype=np.float64)
+    reserved = np.zeros((n, num_dims), dtype=np.float64)
+    used = np.zeros((n, num_dims), dtype=np.float64)
     job_counts = np.zeros(n, dtype=np.int32)
     tg_counts = np.zeros((g, n), dtype=np.int32)
 
@@ -465,7 +471,7 @@ def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
     check_supported(job, tg)
     device_dims = job_device_dims(job)
 
-    ask = np.zeros(NUM_DIMS, dtype=np.float64)
+    ask = np.zeros(job_num_dims(device_dims), dtype=np.float64)
     for task in tg.tasks:
         ask[DIM_CPU] += task.resources.cpu
         ask[DIM_MEM] += task.resources.memory_mb

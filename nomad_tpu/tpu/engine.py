@@ -543,6 +543,7 @@ class TpuPlacementEngine:
             return fallback(str(e))
         _metrics.incr_counter("nomad.tpu_engine.handled")
         device_dims = job_device_dims(job)  # validated above; never raises here
+        num_dims = table.totals.shape[1]    # 4 + the job's device dims
         start = _time.monotonic_ns()
 
         # float64 for exact host parity; float32 for throughput (MXU-friendly)
@@ -560,18 +561,18 @@ class TpuPlacementEngine:
             pad_width = [(0, 0)] * (arr.ndim - 1) + [(0, n_pad - arr.shape[-1])]
             return np.pad(arr, pad_width, constant_values=fill)
 
-        totals = np.zeros((n_pad, NUM_DIMS), fdtype)
+        totals = np.zeros((n_pad, num_dims), fdtype)
         totals[:n_real] = table.totals
-        reserved = np.zeros((n_pad, NUM_DIMS), fdtype)
+        reserved = np.zeros((n_pad, num_dims), fdtype)
         reserved[:n_real] = table.reserved
-        used0 = np.zeros((n_pad, NUM_DIMS), fdtype)
+        used0 = np.zeros((n_pad, num_dims), fdtype)
         used0[:n_real] = table.used
         tg_counts0 = np.zeros((g_count, n_pad), np.int32)
         tg_counts0[:, :n_real] = table.tg_counts
         job_counts0 = np.zeros(n_pad, np.int32)
         job_counts0[:n_real] = table.job_counts
 
-        asks = np.zeros((g_count, NUM_DIMS), fdtype)
+        asks = np.zeros((g_count, num_dims), fdtype)
         feas = np.zeros((g_count, n_pad), bool)
         aff_score = np.zeros((g_count, n_pad), fdtype)
         aff_present = np.zeros((g_count, n_pad), bool)
@@ -619,7 +620,7 @@ class TpuPlacementEngine:
         tg_idx = np.zeros(p, np.int32)
         penalty_idx = np.full((p, MAX_PENALTY_NODES), -1, np.int32)
         evict_node = np.full(p, -1, np.int32)
-        evict_res = np.zeros((p, NUM_DIMS), fdtype)
+        evict_res = np.zeros((p, num_dims), fdtype)
         evict_tg = np.full(p, -1, np.int32)
         limit_p = np.zeros(p, np.int32)
         sum_sw_p = np.zeros(p, fdtype)
@@ -891,7 +892,7 @@ class TpuPlacementEngine:
 
 def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 16,
                         n_spreads: int = 1, vocab: int = 4,
-                        dtype=np.float32, seed: int = 0):
+                        dtype=np.float32, seed: int = 0, num_dims: int = 4):
     """Build plausible dense scan inputs directly (no scheduler objects).
 
     Returns (n_pad, static, init_carry, xs) as numpy arrays, shaped exactly
@@ -901,17 +902,17 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
     n_pad = _round_up(n_nodes)
     g, s, v = n_tgs, max(n_spreads, 1), vocab + 1
 
-    totals = np.zeros((n_pad, NUM_DIMS), dtype)
+    totals = np.zeros((n_pad, num_dims), dtype)
     totals[:n_nodes, DIM_CPU] = rng.choice([2000, 4000, 8000], n_nodes)
     totals[:n_nodes, DIM_MEM] = rng.choice([4096, 8192, 16384], n_nodes)
     totals[:n_nodes, 2] = 100 * 1024
     totals[:n_nodes, DIM_MBITS] = 1000
-    reserved = np.zeros((n_pad, NUM_DIMS), dtype)
+    reserved = np.zeros((n_pad, num_dims), dtype)
     reserved[:n_nodes, DIM_CPU] = 100
     reserved[:n_nodes, DIM_MEM] = 256
-    used0 = np.zeros((n_pad, NUM_DIMS), dtype)
+    used0 = np.zeros((n_pad, num_dims), dtype)
 
-    asks = np.zeros((g, NUM_DIMS), dtype)
+    asks = np.zeros((g, num_dims), dtype)
     asks[:, DIM_CPU] = rng.choice([100, 250, 500], g)
     asks[:, DIM_MEM] = rng.choice([128, 256, 512], g)
     asks[:, 2] = 150
@@ -948,7 +949,7 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
     xs = (rng.integers(0, g, n_placements).astype(np.int32),
           np.full((n_placements, MAX_PENALTY_NODES), -1, np.int32),
           np.full(n_placements, -1, np.int32),
-          np.zeros((n_placements, NUM_DIMS), dtype),
+          np.zeros((n_placements, num_dims), dtype),
           np.full(n_placements, -1, np.int32),
           np.full(n_placements, 2**31 - 1 if n_spreads else limit_val, np.int32),
           np.full(n_placements, 50.0 * max(n_spreads, 1), dtype))

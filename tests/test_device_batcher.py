@@ -118,9 +118,8 @@ class TestBatchedScanParity:
             enc, n_pad=32, g_pad=4, s_pad=2, v_pad=8, p_pad=8,
             dtype=np.float64,
         )
-        from nomad_tpu.tpu.encode import NUM_DIMS
-
-        assert static[0].shape == (32, NUM_DIMS)   # totals
+        d = enc.static[0].shape[1]  # per-job capacity dims (4 + devices)
+        assert static[0].shape == (32, d)          # totals
         assert static[3].shape == (4, 32)          # feas
         assert static[10].shape == (4, 2, 32)      # spread_vids
         assert static[11].shape == (4, 2, 8)       # spread_desired
@@ -131,6 +130,41 @@ class TestBatchedScanParity:
         # remapped invalid vocab bucket
         assert (static[10] <= 7).all()
         assert (static[10][:, :, enc.n_pad:] == 7).all()
+
+    def test_mixed_capacity_dims_batch(self):
+        """A device job (6 capacity dims) co-batched with deviceless jobs
+        (4 dims): D pads across the batch and results stay identical to
+        the single-eval scans."""
+        import numpy as np
+
+        engine = TpuPlacementEngine.shared()
+        lean = synthetic_enc(24, 2, 5, seed=31)
+        assert lean.static[0].shape[1] == 4
+        # widen one eval to 6 dims manually (as a device job encodes)
+        from nomad_tpu.tpu.engine import example_scan_inputs
+
+        n_pad, st, ca, xs = example_scan_inputs(
+            n_nodes=24, n_tgs=2, n_placements=5, seed=32,
+            dtype=np.float64, num_dims=6,
+        )
+        st = list(st)
+        st[0][:, 4] = 2.0  # 2 free devices per node on dim 4
+        st[2][:, 4] = 1.0  # each placement takes one
+        wide = EncodedEval(
+            n_real=24, n_pad=n_pad, g=2, s=st[10].shape[1],
+            v=st[11].shape[2], p=5, dtype=np.float64,
+            static=tuple(st), carry=ca, xs=xs,
+            missing_list=[], nodes=[], table=None, start_ns=0,
+        )
+        singles = [engine.run_scan_single(e) for e in (lean, wide)]
+        batcher = DeviceBatcher(max_batch=2, window_ms=200.0)
+        try:
+            batched = run_concurrent(batcher, [lean, wide])
+        finally:
+            batcher.stop()
+        for single, batch_r in zip(singles, batched):
+            np.testing.assert_array_equal(single[0], batch_r[0])
+            np.testing.assert_array_equal(single[1], batch_r[1])
 
     def test_mesh_sharded_batch_matches_single(self):
         """The mesh-sharded dispatch (production multi-chip path) is
