@@ -266,15 +266,20 @@ class HTTPServer:
 
             def handle_error(self, request, client_address):
                 # disconnects mid-stream (follow-mode consumers hitting
-                # Ctrl-C) and malformed requests are peer-side events: no
-                # traceback spray on stderr
+                # Ctrl-C) are peer-side events; anything else escaped the
+                # route wrapper and keeps its traceback via logging
                 import logging as logging_mod
                 import sys as sys_mod
 
-                logging_mod.getLogger("nomad_tpu.http").debug(
-                    "connection from %s errored: %s",
-                    client_address, sys_mod.exc_info()[1],
-                )
+                exc = sys_mod.exc_info()[1]
+                log = logging_mod.getLogger("nomad_tpu.http")
+                if isinstance(exc, (ConnectionError, TimeoutError,
+                                    BrokenPipeError)):
+                    log.debug("connection from %s dropped: %s",
+                              client_address, exc)
+                else:
+                    log.warning("request from %s crashed", client_address,
+                                exc_info=True)
 
             def finish_request(self, request, client_address):
                 # handshake in the per-connection thread: wrapping the

@@ -51,6 +51,21 @@ button.risk{background:var(--bad)}
 .crumb{color:var(--mut);margin-bottom:6px}.crumb a{color:var(--brand)}
 .err{background:#fbe6e0;color:var(--bad);padding:10px;border-radius:6px;margin:10px 0}
 .mut{color:var(--mut)}
+.meters{display:grid;grid-template-columns:repeat(auto-fill,minmax(240px,1fr));
+gap:10px;margin:8px 0}
+.meter{background:var(--panel);border:1px solid var(--line);border-radius:6px;
+padding:10px 12px}
+.meter .lbl{font-size:12px;color:var(--mut);margin-bottom:4px}
+.meter .val{font:600 14px/1.2 system-ui;color:var(--ink);margin-bottom:6px}
+.meter .bar{height:6px;background:var(--line);border-radius:3px;overflow:hidden}
+.meter .bar i{display:block;height:100%;background:var(--brand);border-radius:3px}
+.logbar{display:flex;gap:8px;align-items:center;margin:8px 0}
+.logbar select,.logbar input[type=text]{font:12px var(--mono);padding:4px 6px;
+border:1px solid var(--line);border-radius:4px}
+.term{background:#101418;color:#d6dde6;padding:12px;border-radius:6px;
+overflow:auto;font:12px/1.5 var(--mono);height:260px;white-space:pre-wrap}
+.termin{width:100%;font:12px var(--mono);padding:6px 8px;margin-top:6px;
+border:1px solid var(--line);border-radius:4px;background:#101418;color:#d6dde6}
 </style>
 </head>
 <body>
@@ -146,25 +161,40 @@ const pages = {
     const a = await api("/v1/allocation/" + encodeURIComponent(id));
     const states = a.TaskStates || {};
     const tasks = Object.keys(states);
-    let logs = "";
-    if (tasks.length) {
-      const t = tasks[0];
-      try {
-        logs = await api(`/v1/client/fs/logs/${encodeURIComponent(id)}?task=${encodeURIComponent(t)}&type=stdout`);
-      } catch (e) { logs = "(logs unavailable: " + e.message + ")"; }
-    }
-    return `<div class="crumb"><a href="#/allocs">allocations</a> / ${short(id)}</div>
+    const opts = tasks.map(t => `<option>${esc(t)}</option>`).join("");
+    const html = `<div class="crumb"><a href="#/allocs">allocations</a> / ${short(id)}</div>
       <h2>${esc(a.Name || id)} ${tag(a.ClientStatus)}</h2>
       <div class="kv"><div>Job</div><div><a href="#/jobs/${esc(a.JobID)}">${esc(a.JobID)}</a></div>
         <div>Node</div><div><a href="#/nodes/${esc(a.NodeID)}">${short(a.NodeID)}</a></div>
         <div>Desired</div><div>${esc(a.DesiredStatus)}</div>
         <div>Previous alloc</div><div>${short(a.PreviousAllocation) || "—"}</div></div>
+      <h3>Resource usage</h3><div class="meters" id="meters">
+        <div class="meter"><div class="lbl">loading…</div></div></div>
       ${tasks.map(t => `<h3>Task ${esc(t)} ${tag(states[t].State)}</h3>` + table(
         ["Time","Type","Message"],
         (states[t].Events||[]).map(e => ({cells: [
           when(e.Time), esc(e.Type), esc(e.DisplayMessage || e.Message || "")]}))
       )).join("")}
-      <h3>Logs (stdout)</h3><pre>${esc(logs) || "(empty)"}</pre>`;
+      <h3>Logs</h3>
+      <div class="logbar">
+        <select id="log-task">${opts}</select>
+        <select id="log-type"><option>stdout</option><option>stderr</option></select>
+        <label><input type="checkbox" id="log-follow" checked> follow</label>
+      </div>
+      <pre id="log-view">(loading…)</pre>
+      <h3>Exec</h3>
+      <div class="logbar">
+        <select id="exec-task">${opts}</select>
+        <input type="text" id="exec-cmd" size="40" value="/bin/sh" title="command">
+        <button id="exec-run">Run</button>
+        <button id="exec-stop" class="risk" disabled>Stop</button>
+      </div>
+      <div class="term" id="term">(no session — Run starts an interactive
+websocket exec against the task)</div>
+      <input class="termin" id="term-in" placeholder="stdin — Enter sends a line" disabled>`;
+    // the hook travels WITH the page result, so a stale fetch that lost
+    // the navigation race can never install its wiring on another page
+    return {html, after: () => wireAllocExtras(id, tasks)};
   },
   async nodes() {
     const nodes = await api("/v1/nodes");
@@ -238,24 +268,159 @@ document.addEventListener("click", e => {
   if (btn) stopJob(btn.dataset.stopJob);
 });
 
+// -- alloc-page live extras: meters, server-push logs, exec terminal -----
+let pageCleanup = null;     // torn down on navigation (streams, sockets)
+const b64encode = s => btoa(String.fromCharCode(...new TextEncoder().encode(s)));
+const b64decode = b => new TextDecoder().decode(
+  Uint8Array.from(atob(b), c => c.charCodeAt(0)));
+
+function meter(label, pct, detail) {
+  const w = Math.max(0, Math.min(100, pct || 0));
+  return `<div class="meter"><div class="lbl">${esc(label)}</div>` +
+    `<div class="val">${esc(detail)}</div>` +
+    `<div class="bar"><i style="width:${w.toFixed(1)}%"></i></div></div>`;
+}
+
+function wireAllocExtras(id, tasks) {
+  const cleanups = [];
+  pageCleanup = () => cleanups.forEach(fn => { try { fn(); } catch (e) {} });
+
+  // utilization meters: one hue, values in ink — refreshed while visible
+  async function refreshMeters() {
+    try {
+      const s = await api(`/v1/client/allocation/${encodeURIComponent(id)}/stats`);
+      const parts = [];
+      for (const [t, ts] of Object.entries(s.Tasks || {})) {
+        const cpu = ts.ResourceUsage?.CpuStats?.Percent || 0;
+        const rss = ts.ResourceUsage?.MemoryStats?.RSS || 0;
+        parts.push(meter(`${t} · CPU`, cpu, cpu.toFixed(1) + " %"));
+        parts.push(meter(`${t} · memory`, 0, (rss/1048576).toFixed(1) + " MiB"));
+      }
+      if (parts.length) $("#meters").innerHTML = parts.join("");
+      else $("#meters").innerHTML = `<div class="meter"><div class="lbl">no running tasks</div></div>`;
+    } catch (e) {
+      $("#meters").innerHTML = `<div class="meter"><div class="lbl">stats unavailable</div><div class="val mut">${esc(e.message)}</div></div>`;
+    }
+  }
+  refreshMeters();
+  const mt = setInterval(refreshMeters, 3000);
+  cleanups.push(() => clearInterval(mt));
+
+  // logs: server-push follow stream (fetch + ReadableStream) or one-shot
+  let logAbort = null;
+  async function startLogs() {
+    if (logAbort) { logAbort.abort(); logAbort = null; }
+    const t = $("#log-task").value, kind = $("#log-type").value;
+    const follow = $("#log-follow").checked;
+    const view = $("#log-view");
+    view.textContent = "";
+    const tok = localStorage.getItem("nomad_token");
+    const headers = tok ? {"X-Nomad-Token": tok} : {};
+    const url = `/v1/client/fs/logs/${encodeURIComponent(id)}?task=` +
+      `${encodeURIComponent(t)}&type=${kind}` + (follow ? "&follow=true&origin=end&offset=4096" : "");
+    const ctl = new AbortController();
+    logAbort = ctl;
+    cleanups.push(() => ctl.abort());
+    try {
+      const r = await fetch(url, {headers, signal: ctl.signal});
+      if (!r.ok) { view.textContent = "(logs unavailable: " + r.status + ")"; return; }
+      const reader = r.body.getReader();
+      const dec = new TextDecoder();
+      for (;;) {
+        const {done, value} = await reader.read();
+        if (done) break;
+        view.textContent += dec.decode(value, {stream: true});
+        if (view.textContent.length > 200000)
+          view.textContent = view.textContent.slice(-150000);
+        view.scrollTop = view.scrollHeight;
+      }
+    } catch (e) { /* aborted on navigation / toggle */ }
+  }
+  ["log-task","log-type","log-follow"].forEach(x =>
+    $("#"+x).addEventListener("change", startLogs));
+  startLogs();
+
+  // exec: interactive websocket terminal (the agent's RFC6455 endpoint)
+  let sock = null;
+  function execStop() {
+    if (sock) { try { sock.close(); } catch (e) {} sock = null; }
+    $("#exec-run").disabled = false;
+    $("#exec-stop").disabled = true;
+    $("#term-in").disabled = true;
+  }
+  cleanups.push(execStop);
+  $("#exec-run").addEventListener("click", () => {
+    execStop();
+    const t = $("#exec-task").value;
+    const cmd = $("#exec-cmd").value.trim();
+    if (!cmd) return;
+    const term = $("#term");
+    term.textContent = "$ " + cmd + "\n";
+    const proto = location.protocol === "https:" ? "wss" : "ws";
+    // browsers cannot set headers on WebSockets: the ACL token rides the
+    // token query param the agent accepts alongside X-Nomad-Token
+    const tok = localStorage.getItem("nomad_token");
+    const url = `${proto}://${location.host}/v1/client/allocation/` +
+      `${encodeURIComponent(id)}/exec?task=${encodeURIComponent(t)}` +
+      `&command=${encodeURIComponent(JSON.stringify(cmd.split(/\s+/)))}` +
+      (tok ? `&token=${encodeURIComponent(tok)}` : "");
+    sock = new WebSocket(url);
+    sock.onopen = () => {
+      $("#exec-run").disabled = true;
+      $("#exec-stop").disabled = false;
+      const inp = $("#term-in");
+      inp.disabled = false; inp.focus();
+    };
+    sock.onmessage = ev => {
+      try {
+        const frame = JSON.parse(ev.data);
+        if (frame.stdout?.data) {
+          term.textContent += b64decode(frame.stdout.data);
+          term.scrollTop = term.scrollHeight;
+        }
+        if ("exit_code" in frame) {
+          term.textContent += `\n(exit ${frame.exit_code})\n`;
+          execStop();
+        }
+      } catch (e) {}
+    };
+    sock.onclose = execStop;
+    sock.onerror = execStop;
+  });
+  $("#exec-stop").addEventListener("click", () => {
+    if (sock) sock.send(JSON.stringify({stdin: {close: true}}));
+    execStop();
+  });
+  $("#term-in").addEventListener("keydown", ev => {
+    if (ev.key !== "Enter" || !sock) return;
+    const line = ev.target.value + "\n";
+    ev.target.value = "";
+    $("#term").textContent += line;
+    sock.send(JSON.stringify({stdin: {data: b64encode(line)}}));
+  });
+}
+
 let timer = null;
 let renderSeq = 0;
 async function render() {
   const seq = ++renderSeq;  // stale async completions must not clobber
+  if (pageCleanup) { pageCleanup(); pageCleanup = null; }
   const hash = location.hash.replace(/^#\//, "") || "jobs";
   const [page, id] = hash.split("/");
   $("#nav").innerHTML = NAV.map(([k, label]) =>
     `<a href="#/${k}" class="${page===k?"on":""}">${label}</a>`).join("");
   const fn = id && pages[page.replace(/s$/, "")] ? pages[page.replace(/s$/, "")]
            : pages[page] || pages.jobs;
-  let html;
+  let result;
   try {
-    html = await fn(id ? decodeURIComponent(id) : undefined);
+    result = await fn(id ? decodeURIComponent(id) : undefined);
   } catch (e) {
-    html = `<div class="err">${esc(e.message)}</div>`;
+    result = `<div class="err">${esc(e.message)}</div>`;
   }
   if (seq !== renderSeq) return;  // navigation happened mid-fetch
+  const html = typeof result === "string" ? result : result.html;
   $("#main").innerHTML = html;
+  if (typeof result === "object" && result.after) result.after();
   clearTimeout(timer);
   if (!id) timer = setTimeout(render, 4000);  // auto-refresh list pages
 }

@@ -556,6 +556,15 @@ def parse_job(src: str) -> Job:
     names = [g.name for g in job.task_groups]
     if len(names) != len(set(names)):
         raise HCLError("duplicate task group names", 0)
+    # Service-name ${JOB} interpolation happens at parse time (the
+    # reference interpolates in taskenv; nothing downstream here resolves
+    # it, so defaulted "<job>-<group>" names must be concrete)
+    for tg in job.task_groups:
+        for svc in tg.services:
+            svc.name = svc.name.replace("${JOB}", job.name)
+        for task in tg.tasks:
+            for svc in task.services:
+                svc.name = svc.name.replace("${JOB}", job.name)
     return job
 
 

@@ -173,15 +173,23 @@ class RPCServer:
             allow_reuse_address = True
 
             def handle_error(self, request, client_address):
-                # a malformed frame or a connection torn mid-decode is a
-                # peer problem, not a server crash: log, don't spray the
-                # default traceback onto stderr
+                # peer-side tear-downs stay quiet; anything else reaching
+                # here escaped the handler's own guards and is a genuine
+                # server bug — keep its full traceback, just via logging
+                import ssl as ssl_mod
                 import sys
 
                 exc = sys.exc_info()[1]
-                outer.logger.debug(
-                    "connection from %s errored: %s", client_address, exc
-                )
+                if isinstance(exc, (ConnectionError, ssl_mod.SSLError,
+                                    TimeoutError, BrokenPipeError)):
+                    outer.logger.debug(
+                        "connection from %s dropped: %s", client_address, exc
+                    )
+                else:
+                    outer.logger.warning(
+                        "request from %s crashed", client_address,
+                        exc_info=True,
+                    )
 
         self._tcp = Server((host, port), Handler)
         self.addr: Tuple[str, int] = self._tcp.server_address

@@ -46,7 +46,6 @@ from .encode import (
     DIM_MBITS,
     DIM_MEM,
     MAX_PENALTY_NODES,
-    NUM_DIMS,
     NodeTable,
     TGSpec,
     UnsupportedByEngine,
