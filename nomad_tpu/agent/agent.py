@@ -41,6 +41,9 @@ class AgentConfig:
     num_schedulers: int = 2
     scheduler_algorithm: str = "tpu_binpack"
     acl_enabled: bool = False
+    # gossip encryption key (reference agent `encrypt` option): base64 of
+    # 16/24/32 bytes; all servers must share it — plaintext packets drop
+    encrypt: str = ""
     # federation: non-authoritative regions mirror ACL policies + global
     # tokens from here (reference authoritative_region + replication_token)
     authoritative_region: str = ""
@@ -314,6 +317,8 @@ class Agent:
                     bind_port=self.config.serf_port,
                     advertise_host=self.config.advertise_addr,
                     expect=self.config.bootstrap_expect,
+                    encrypt_key=self.config.encrypt.encode()
+                    if self.config.encrypt else b"",
                 )
                 self.rpc.region_servers = lambda region: [
                     s.rpc_addr for s in self.membership.servers_in_region(region)

@@ -87,6 +87,11 @@ class MemberlistConfig:
     push_pull_interval: float = 2.0
     retransmit_mult: int = 3
     dead_reclaim_time: float = 30.0  # forget dead/left members after this
+    # Serf keyring slot (reference agent `encrypt` option, memberlist
+    # SecretKey): base64 or raw 16/24/32-byte key. When set, every
+    # datagram is AES-GCM sealed; plaintext (or wrong-key) packets are
+    # dropped. All members must share the key.
+    encrypt_key: bytes = b""
 
 
 class Memberlist:
@@ -101,6 +106,19 @@ class Memberlist:
         bound: Tuple[str, int] = self._sock.getsockname()
         advertise_host = resolve_advertise_host(config.advertise_host or bound[0])
         self.addr: Tuple[str, int] = (advertise_host, bound[1])
+
+        self._aead = None
+        if config.encrypt_key:
+            key = config.encrypt_key
+            if len(key) not in (16, 24, 32):
+                import base64 as b64_mod
+
+                key = b64_mod.b64decode(key)
+                if len(key) not in (16, 24, 32):
+                    raise ValueError("encrypt_key must be 16/24/32 bytes (raw or base64)")
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+            self._aead = AESGCM(key)
 
         self._lock = threading.RLock()
         self.incarnation = 1
@@ -197,9 +215,29 @@ class Memberlist:
 
     # -- wire helpers ----------------------------------------------------
 
+    def _seal(self, data: bytes) -> bytes:
+        """AES-GCM with a fresh 12-byte nonce per datagram (the serf
+        encrypted-gossip wire: [version byte][nonce][ciphertext+tag])."""
+        if self._aead is None:
+            return data
+        import os as os_mod
+
+        nonce = os_mod.urandom(12)
+        return b"\x01" + nonce + self._aead.encrypt(nonce, data, b"")
+
+    def _unseal(self, data: bytes) -> Optional[bytes]:
+        if self._aead is None:
+            return data
+        if len(data) < 13 or data[0:1] != b"\x01":
+            return None  # plaintext or foreign traffic: drop
+        try:
+            return self._aead.decrypt(data[1:13], data[13:], b"")
+        except Exception:  # noqa: BLE001 — wrong key / tampered
+            return None
+
     def _send(self, addr: Tuple[str, int], msg: dict) -> None:
         try:
-            data = msgpack.packb(msg, use_bin_type=True)
+            data = self._seal(msgpack.packb(msg, use_bin_type=True))
             if len(data) > MAX_DATAGRAM:
                 self.logger.warning("dropping oversized gossip msg (%d bytes)", len(data))
                 return
@@ -245,6 +283,10 @@ class Memberlist:
                 data, src = self._sock.recvfrom(65536)
             except OSError:
                 return
+            data = self._unseal(data)
+            if data is None:
+                self.logger.debug("dropping unauthenticated gossip from %s", src)
+                continue
             try:
                 msg = msgpack.unpackb(data, raw=False)
                 self._handle(msg, src)

@@ -73,6 +73,7 @@ class ServerMembership:
         advertise_host: str = "",
         expect: int = 1,
         config: Optional[MemberlistConfig] = None,
+        encrypt_key: bytes = b"",
     ) -> None:
         self.region = region
         self.logger = logging.getLogger(f"nomad_tpu.membership.{name}")
@@ -92,6 +93,8 @@ class ServerMembership:
         cfg.bind_host = bind_host
         cfg.bind_port = bind_port
         cfg.advertise_host = advertise_host
+        if encrypt_key:
+            cfg.encrypt_key = encrypt_key
         self.memberlist = Memberlist(cfg, self._tags)
         self.memberlist.on_join = self._on_change
         self.memberlist.on_update = self._on_change

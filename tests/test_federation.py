@@ -159,8 +159,10 @@ class TestServerFailover:
                 ],
             ))
             client_agent.start()
+            # generous: suite-context CPU contention (jax compiles on all
+            # cores) can starve the register/retry threads for a while
             wait_until(lambda: len(s1.fsm.state.nodes()) == 1,
-                       msg="node registered")
+                       timeout=90, msg="node registered")
             node_id = client_agent.client.node.id
 
             # pin the client to the FOLLOWER (a2), then kill it: the next

@@ -273,3 +273,59 @@ class TestFederatedAgents:
         finally:
             a_west.shutdown()
             a_east.shutdown()
+
+
+class TestGossipEncryption:
+    def test_encrypted_cluster_converges(self):
+        """Members sharing an encrypt key form a cluster; their datagrams
+        on the wire are AES-GCM sealed (serf keyring slot)."""
+        import base64
+        import os
+
+        key = base64.b64encode(os.urandom(32)).decode().encode()
+        lists = []
+        try:
+            for name in ("enc-a", "enc-b"):
+                cfg = fast_config(name)
+                cfg.encrypt_key = key
+                lists.append(Memberlist(cfg).start())
+            lists[1].join([lists[0].addr])
+            for m in lists:
+                wait_until(lambda m=m: m.num_alive() == 2,
+                           msg="encrypted cluster convergence")
+            # wire format check: sealed frames carry the version byte and
+            # never the msgpack map marker a plaintext message starts with
+            sealed = lists[0]._seal(b"probe")
+            assert sealed[0:1] == b"\x01" and sealed != b"probe"
+            assert lists[1]._unseal(sealed) == b"probe"
+        finally:
+            for m in lists:
+                m.shutdown()
+
+    def test_plaintext_and_wrong_key_dropped(self):
+        """A member without the key (or with a different key) cannot join
+        or poison an encrypted cluster."""
+        import base64
+        import os
+
+        key = base64.b64encode(os.urandom(32)).decode().encode()
+        cfg = fast_config("enc-secure")
+        cfg.encrypt_key = key
+        secure = Memberlist(cfg).start()
+
+        plain = Memberlist(fast_config("enc-plain")).start()
+        wrong_cfg = fast_config("enc-wrong")
+        wrong_cfg.encrypt_key = base64.b64encode(os.urandom(32)).decode().encode()
+        wrong = Memberlist(wrong_cfg).start()
+        try:
+            plain.join([secure.addr])
+            wrong.join([secure.addr])
+            time.sleep(1.0)
+            assert secure.num_alive() == 1, "unauthenticated members must not join"
+            # and the secure node's unseal drops both foreign wire formats
+            assert secure._unseal(b"\x81\xa1t\xa4ping") is None  # plaintext msgpack
+            assert secure._unseal(wrong._seal(b"x")) is None     # wrong key
+        finally:
+            secure.shutdown()
+            plain.shutdown()
+            wrong.shutdown()
