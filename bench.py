@@ -251,9 +251,19 @@ def bench_system(name, n_nodes, jobs, workers=4, device_batch=8,
             while time.perf_counter() < deadline and not warm_done():
                 time.sleep(0.05)
             server.deregister_job("default", wjob.id, purge=False)
-            time.sleep(0.5)
+            # wait until the stop eval actually lands: lingering warmup
+            # allocs would both hold capacity and pollute placed()
+            deadline = time.perf_counter() + 60
+            def warm_stopped():
+                allocs = server.fsm.state.allocs_by_job("default", wjob.id, True)
+                return all(a.desired_status != "run" for a in allocs)
+            while time.perf_counter() < deadline and not warm_stopped():
+                time.sleep(0.05)
             for w in server.workers:
                 w.stats["evals_processed"] = 0
+            if server.device_batcher is not None:
+                for k in server.device_batcher.stats:
+                    server.device_batcher.stats[k] = 0
 
         t0 = time.perf_counter()
         for job in jobs:

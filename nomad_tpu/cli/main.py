@@ -91,7 +91,7 @@ _VALUE_FLAGS = {
     "per-page", "node-class", "datacenter", "task", "dc", "s",
     "ca-file", "cert-file", "key-file", "n",
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
-    "servers",
+    "servers", "encrypt", "authoritative-region", "replication-token",
 }
 
 
@@ -149,6 +149,9 @@ def cmd_agent(ctx: Ctx, args: List[str]) -> int:
         tls_cert_file=flags.get("cert-file", ""),
         tls_key_file=flags.get("key-file", ""),
         tls_http=_truthy(flags, "tls-http"),
+        encrypt=flags.get("encrypt", ""),
+        authoritative_region=flags.get("authoritative-region", ""),
+        replication_token=flags.get("replication-token", ""),
     )
     agent = Agent(cfg)
     agent.start()

@@ -13,8 +13,10 @@ incarnation number — the standard SWIM+inc protocol memberlist implements.
 Intentional deltas from memberlist: push-pull state sync rides UDP (server
 gossip pools are small — a handful of servers per region, never the
 thousands of client nodes, which don't gossip in the reference either:
-clients poll servers over RPC), and there is no message encryption — the
-reference's serf keyring slot is TLS on DCN, out of scope here.
+clients poll servers over RPC). Message encryption fills the serf keyring
+slot: with ``MemberlistConfig.encrypt_key`` set, every datagram is
+AES-GCM sealed and unauthenticated packets are dropped (single static
+key; no key rotation protocol).
 """
 from __future__ import annotations
 
@@ -109,13 +111,25 @@ class Memberlist:
 
         self._aead = None
         if config.encrypt_key:
+            # Base64 is the canonical textual form (serf keygen output) and
+            # takes PRECEDENCE: base64 of a 16-byte key is exactly 24
+            # chars, so "len in (16,24,32) -> raw" would silently use the
+            # ASCII text as the key and split the cluster against nodes
+            # configured with the decoded bytes.
             key = config.encrypt_key
-            if len(key) not in (16, 24, 32):
+            decoded = None
+            try:
                 import base64 as b64_mod
 
-                key = b64_mod.b64decode(key)
-                if len(key) not in (16, 24, 32):
-                    raise ValueError("encrypt_key must be 16/24/32 bytes (raw or base64)")
+                decoded = b64_mod.b64decode(key, validate=True)
+            except Exception:  # noqa: BLE001 — not base64: try raw
+                decoded = None
+            if decoded is not None and len(decoded) in (16, 24, 32):
+                key = decoded
+            elif len(key) not in (16, 24, 32):
+                raise ValueError(
+                    "encrypt_key must be 16/24/32 bytes raw, or their base64"
+                )
             from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
             self._aead = AESGCM(key)
