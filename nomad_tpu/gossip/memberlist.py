@@ -125,6 +125,16 @@ class Memberlist:
             except Exception:  # noqa: BLE001 — not base64: try raw
                 decoded = None
             if decoded is not None and len(decoded) in (16, 24, 32):
+                if len(key) in (16, 24, 32):
+                    # ambiguous: a 32-char ASCII string is both a valid raw
+                    # key and valid base64 of 24 bytes — be loud about
+                    # which reading wins so mixed fleets can't silently
+                    # partition on interpretation
+                    self.logger.warning(
+                        "encrypt_key is both raw-sized and base64-decodable; "
+                        "using the BASE64 interpretation (%d bytes)",
+                        len(decoded),
+                    )
                 key = decoded
             elif len(key) not in (16, 24, 32):
                 raise ValueError(

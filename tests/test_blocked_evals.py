@@ -1,0 +1,165 @@
+"""Blocked-evals tracker tests, mirroring reference
+nomad/blocked_evals_test.go: class-keyed unblocking (captured vs escaped),
+per-job dedup (latest wins), missed-unblock protection via snapshot
+indexes, system (node-keyed) blocks, the failed (max-plans) queue, and
+untracking.
+"""
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.server.blocked_evals import BlockedEvals
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.structs.structs import EVAL_TRIGGER_MAX_PLANS
+
+
+def make_blocked(job_id=None, classes=None, escaped=False, snapshot=0,
+                 node_id="", create_index=1):
+    ev = mock.eval()
+    if job_id:
+        ev.job_id = job_id
+    ev.status = "blocked"
+    ev.class_eligibility = dict(classes or {})
+    ev.escaped_computed_class = escaped
+    ev.snapshot_index = snapshot
+    ev.node_id = node_id
+    ev.create_index = create_index
+    return ev
+
+
+def harness():
+    broker = EvalBroker()
+    broker.set_enabled(True)
+    b = BlockedEvals(broker)
+    b.set_enabled(True)
+    return broker, b
+
+
+def drain(broker, timeout=1.0):
+    out = []
+    while True:
+        ev, tok = broker.dequeue(
+            ["service", "batch", "system", "_failed"], timeout=timeout
+        )
+        if ev is None:
+            return out
+        broker.ack(ev.id, tok)
+        out.append(ev)
+        timeout = 0.1
+
+
+class TestClassUnblock:
+    def test_unblock_on_eligible_class(self):
+        broker, b = harness()
+        ev = make_blocked(classes={"web": True, "gpu": False})
+        b.block(ev)
+        assert b.stats()["total_blocked"] == 1
+        b.unblock("web", index=10)
+        got = drain(broker)
+        assert [e.id for e in got] == [ev.id]
+        assert got[0].status == "pending"
+
+    def test_no_unblock_on_ineligible_class(self):
+        broker, b = harness()
+        ev = make_blocked(classes={"gpu": False})
+        b.block(ev)
+        b.unblock("gpu", index=10)
+        assert drain(broker, timeout=0.2) == []
+        assert b.stats()["total_blocked"] == 1
+
+    def test_unseen_class_unblocks(self):
+        """Capacity in a class the eval never evaluated is new capacity
+        (blocked_evals_test.go TestBlockedEvals_UnblockUnknown)."""
+        broker, b = harness()
+        ev = make_blocked(classes={"web": False})
+        b.block(ev)
+        b.unblock("brand-new-class", index=10)
+        assert len(drain(broker)) == 1
+
+    def test_escaped_unblocks_on_any_class(self):
+        broker, b = harness()
+        ev = make_blocked(escaped=True)
+        b.block(ev)
+        assert b.stats()["total_escaped"] == 1
+        b.unblock("anything", index=10)
+        assert len(drain(broker)) == 1
+
+
+class TestMissedUnblock:
+    def test_capacity_after_snapshot_reenqueues_immediately(self):
+        """A block whose snapshot predates a seen unblock never parks
+        (blocked_evals.go:202 missed-unblock window)."""
+        broker, b = harness()
+        b.unblock("web", index=50)
+        drain(broker, timeout=0.1)
+        ev = make_blocked(classes={"web": True}, snapshot=40)
+        b.block(ev)
+        got = drain(broker)
+        assert [e.id for e in got] == [ev.id], "must re-enqueue, not block"
+        assert b.stats()["total_blocked"] == 0
+
+    def test_capacity_before_snapshot_blocks(self):
+        broker, b = harness()
+        b.unblock("web", index=50)
+        drain(broker, timeout=0.1)
+        ev = make_blocked(classes={"web": True}, snapshot=60)
+        b.block(ev)
+        assert b.stats()["total_blocked"] == 1
+
+
+class TestJobDedup:
+    def test_latest_eval_per_job_wins(self):
+        broker, b = harness()
+        old = make_blocked(job_id="dup", classes={"web": True}, create_index=5)
+        new = make_blocked(job_id="dup", classes={"web": True}, create_index=9)
+        b.block(old)
+        b.block(new)
+        assert b.stats()["total_blocked"] == 1
+        b.unblock("web", index=10)
+        got = drain(broker)
+        assert [e.id for e in got] == [new.id]
+
+    def test_older_eval_dropped(self):
+        broker, b = harness()
+        new = make_blocked(job_id="dup2", create_index=9, classes={"web": True})
+        old = make_blocked(job_id="dup2", create_index=5, classes={"web": True})
+        b.block(new)
+        b.block(old)
+        b.unblock("web", index=10)
+        got = drain(broker)
+        assert [e.id for e in got] == [new.id]
+
+    def test_untrack_removes_jobs_blocks(self):
+        broker, b = harness()
+        ev = make_blocked(job_id="gone", classes={"web": True})
+        b.block(ev)
+        b.untrack("default", "gone")
+        b.unblock("web", index=10)
+        assert drain(broker, timeout=0.2) == []
+
+
+class TestSystemAndFailed:
+    def test_node_keyed_system_block(self):
+        """System evals block per node and release via unblock_node."""
+        broker, b = harness()
+        ev = make_blocked(node_id="node-1", classes={})
+        ev.type = "system"
+        b.block(ev)
+        b.unblock_node("node-2", index=5)
+        assert drain(broker, timeout=0.2) == []
+        b.unblock_node("node-1", index=6)
+        got = drain(broker)
+        assert [e.id for e in got] == [ev.id]
+
+    def test_max_plans_failed_queue(self):
+        """Plan-rejection storms park in the failed set until
+        unblock_failed sweeps them back (the safety valve)."""
+        broker, b = harness()
+        ev = make_blocked(classes={"web": True})
+        ev.triggered_by = EVAL_TRIGGER_MAX_PLANS
+        b.block(ev)
+        # class capacity does NOT release failed evals
+        b.unblock("web", index=10)
+        assert drain(broker, timeout=0.2) == []
+        b.unblock_failed()
+        got = drain(broker)
+        assert [e.id for e in got] == [ev.id]

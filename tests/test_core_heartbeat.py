@@ -1,0 +1,186 @@
+"""Core-scheduler GC + heartbeat TTL tests (reference nomad/core_sched_test.go
+and nomad/heartbeat_test.go): force/threshold GC of terminal evals+allocs,
+dead jobs, down nodes and terminal deployments; heartbeat expiry marking
+nodes down with node-update evals, TTL re-arm, and clear-on-deregister.
+"""
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.server.core_sched import CoreScheduler
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs.structs import (
+    CORE_JOB_FORCE_GC,
+    EVAL_TRIGGER_NODE_UPDATE,
+    Deployment,
+    Evaluation,
+)
+
+
+def wait_until(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_server(**kw):
+    kw.setdefault("num_schedulers", 0)
+    kw.setdefault("heartbeat_min_ttl", 3600)
+    kw.setdefault("heartbeat_max_ttl", 7200)
+    s = Server(ServerConfig(**kw))
+    s.start()
+    return s
+
+
+def force_gc(server):
+    ev = Evaluation(job_id=f"{CORE_JOB_FORCE_GC}:all", type="_core")
+    CoreScheduler(server, server.fsm.state.snapshot()).process(ev)
+
+
+class TestCoreGC:
+    def test_terminal_eval_and_allocs_gc(self):
+        server = make_server()
+        try:
+            ev = mock.eval()
+            ev.status = "complete"
+            server.raft_apply("eval-update", [ev])
+            alloc = mock.alloc()
+            alloc.eval_id = ev.id
+            alloc.desired_status = "stop"
+            alloc.client_status = "complete"
+            server.raft_apply("alloc-update", [alloc])
+            force_gc(server)
+            assert server.fsm.state.eval_by_id(ev.id) is None
+            assert server.fsm.state.alloc_by_id(alloc.id) is None
+        finally:
+            server.stop()
+
+    def test_running_alloc_blocks_eval_gc(self):
+        """An eval with a live alloc survives GC (core_sched_test.go
+        TestCoreScheduler_EvalGC_Partial semantics)."""
+        server = make_server()
+        try:
+            ev = mock.eval()
+            ev.status = "complete"
+            server.raft_apply("eval-update", [ev])
+            alloc = mock.alloc()
+            alloc.eval_id = ev.id
+            alloc.client_status = "running"
+            server.raft_apply("alloc-update", [alloc])
+            force_gc(server)
+            assert server.fsm.state.eval_by_id(ev.id) is not None
+            assert server.fsm.state.alloc_by_id(alloc.id) is not None
+        finally:
+            server.stop()
+
+    def test_dead_job_gc(self):
+        server = make_server()
+        try:
+            job = mock.job()
+            job.stop = True
+            server.raft_apply("job-register", job)
+            # terminal eval so the job has no blocking work
+            ev = mock.eval()
+            ev.job_id = job.id
+            ev.status = "complete"
+            server.raft_apply("eval-update", [ev])
+            force_gc(server)
+            assert server.fsm.state.job_by_id("default", job.id) is None
+        finally:
+            server.stop()
+
+    def test_running_job_survives_gc(self):
+        server = make_server()
+        try:
+            job = mock.job()
+            server.raft_apply("job-register", job)
+            force_gc(server)
+            assert server.fsm.state.job_by_id("default", job.id) is not None
+        finally:
+            server.stop()
+
+    def test_down_node_gc(self):
+        server = make_server()
+        try:
+            node = mock.node()
+            server.raft_apply("node-register", node)
+            server.raft_apply("node-status-update", (node.id, "down"))
+            force_gc(server)
+            assert server.fsm.state.node_by_id(node.id) is None
+        finally:
+            server.stop()
+
+    def test_node_with_non_terminal_allocs_survives(self):
+        server = make_server()
+        try:
+            node = mock.node()
+            server.raft_apply("node-register", node)
+            alloc = mock.alloc()
+            alloc.node_id = node.id
+            alloc.client_status = "running"
+            server.raft_apply("alloc-update", [alloc])
+            server.raft_apply("node-status-update", (node.id, "down"))
+            force_gc(server)
+            assert server.fsm.state.node_by_id(node.id) is not None
+        finally:
+            server.stop()
+
+    def test_terminal_deployment_gc(self):
+        server = make_server()
+        try:
+            d = Deployment(namespace="default", job_id="gone-job",
+                           status="successful")
+            server.fsm.state.upsert_deployment(1000, d)
+            force_gc(server)
+            assert server.fsm.state.deployment_by_id(d.id) is None
+        finally:
+            server.stop()
+
+
+class TestHeartbeats:
+    def test_ttl_expiry_marks_node_down_and_creates_evals(self):
+        server = make_server(heartbeat_min_ttl=0.2, heartbeat_max_ttl=0.3)
+        try:
+            node = mock.node()
+            server.register_node(node)
+            job = mock.job()
+            alloc = mock.alloc()
+            alloc.node_id = node.id
+            alloc.job = job
+            alloc.job_id = job.id
+            alloc.client_status = "running"
+            server.raft_apply("job-register", job)
+            server.raft_apply("alloc-update", [alloc])
+            wait_until(
+                lambda: server.fsm.state.node_by_id(node.id).status == "down",
+                msg="node marked down on missed heartbeat",
+            )
+            evs = server.fsm.state.evals_by_job("default", job.id)
+            assert any(e.triggered_by == EVAL_TRIGGER_NODE_UPDATE for e in evs)
+        finally:
+            server.stop()
+
+    def test_heartbeat_rearms_ttl(self):
+        server = make_server(heartbeat_min_ttl=0.4, heartbeat_max_ttl=0.5)
+        try:
+            node = mock.node()
+            server.register_node(node)
+            for _ in range(4):
+                time.sleep(0.2)
+                server.heartbeat(node.id)
+            assert server.fsm.state.node_by_id(node.id).status == "ready"
+        finally:
+            server.stop()
+
+    def test_deregister_clears_timer(self):
+        server = make_server(heartbeat_min_ttl=0.2, heartbeat_max_ttl=0.3)
+        try:
+            node = mock.node()
+            server.register_node(node)
+            assert server.heartbeaters.num_active() == 1
+            server.deregister_node(node.id)
+            assert server.heartbeaters.num_active() == 0
+        finally:
+            server.stop()

@@ -1,0 +1,217 @@
+"""Eval broker tests, mirroring reference nomad/eval_broker_test.go:
+priority ordering, per-job serialization, nack redelivery with delays,
+the delivery limit → _failed queue, wait/wait_until timers, outstanding
+token validation, pause/resume of nack timers, and disable-flush.
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.eval_broker import (
+    EvalBroker,
+    NotOutstandingError,
+    TokenMismatchError,
+)
+
+
+def make_eval(priority=50, job_id=None, typ="service", namespace="default"):
+    ev = mock.eval()
+    ev.priority = priority
+    ev.type = typ
+    ev.namespace = namespace
+    if job_id:
+        ev.job_id = job_id
+    return ev
+
+
+def broker(**kw):
+    kw.setdefault("nack_timeout", 5.0)
+    kw.setdefault("initial_nack_delay", 0.01)
+    kw.setdefault("subsequent_nack_delay", 0.02)
+    b = EvalBroker(**kw)
+    b.set_enabled(True)
+    return b
+
+
+class TestOrdering:
+    def test_priority_order(self):
+        """Higher priority dequeues first (eval_broker_test.go TestEvalBroker_Enqueue_Dequeue_Priority)."""
+        b = broker()
+        evs = [make_eval(priority=p) for p in (30, 90, 50)]
+        for ev in evs:
+            b.enqueue(ev)
+        got = [b.dequeue(["service"], timeout=1)[0].priority for _ in range(3)]
+        assert got == [90, 50, 30]
+
+    def test_scheduler_type_routing(self):
+        b = broker()
+        svc = make_eval(typ="service")
+        bat = make_eval(typ="batch")
+        b.enqueue(svc)
+        b.enqueue(bat)
+        ev, _ = b.dequeue(["batch"], timeout=1)
+        assert ev.id == bat.id
+        ev, _ = b.dequeue(["service", "batch"], timeout=1)
+        assert ev.id == svc.id
+
+    def test_dequeue_timeout_empty(self):
+        b = broker()
+        t0 = time.monotonic()
+        ev, token = b.dequeue(["service"], timeout=0.2)
+        assert ev is None and (token or "") == ""
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_disabled_broker_drops(self):
+        b = EvalBroker()
+        b.enqueue(make_eval())
+        assert b.stats()["total_ready"] == 0
+
+
+class TestJobSerialization:
+    def test_one_outstanding_eval_per_job(self):
+        """A job's second eval blocks until the first acks
+        (TestEvalBroker_Serialize_DuplicateJobID)."""
+        b = broker()
+        e1 = make_eval(job_id="job-x")
+        e2 = make_eval(job_id="job-x")
+        other = make_eval(job_id="job-y")
+        b.enqueue(e1)
+        b.enqueue(e2)
+        b.enqueue(other)
+        got1, tok1 = b.dequeue(["service"], timeout=1)
+        got2, tok2 = b.dequeue(["service"], timeout=1)
+        assert {got1.id, got2.id} == {e1.id, other.id}, "e2 must be blocked"
+        # acking job-x's first eval releases the second
+        tok = tok1 if got1.id == e1.id else tok2
+        b.ack(e1.id, tok)
+        got3, _ = b.dequeue(["service"], timeout=1)
+        assert got3.id == e2.id
+
+    def test_nack_releases_job_for_redelivery(self):
+        b = broker()
+        e1 = make_eval(job_id="job-n")
+        b.enqueue(e1)
+        ev, tok = b.dequeue(["service"], timeout=1)
+        b.nack(ev.id, tok)
+        ev2, tok2 = b.dequeue(["service"], timeout=2)
+        assert ev2.id == e1.id and tok2 != tok
+
+
+class TestNackSemantics:
+    def test_delivery_limit_routes_to_failed_queue(self):
+        """After delivery_limit nacks the eval lands on the _failed queue
+        (TestEvalBroker_DeliveryLimit)."""
+        b = broker(delivery_limit=2)
+        ev = make_eval()
+        b.enqueue(ev)
+        for _ in range(2):
+            got, tok = b.dequeue(["service"], timeout=2)
+            assert got.id == ev.id
+            b.nack(got.id, tok)
+        got, tok = b.dequeue(["_failed"], timeout=2)
+        assert got.id == ev.id
+        b.ack(got.id, tok)
+
+    def test_nack_timeout_auto_redelivers(self):
+        """An unacked eval returns to ready when its nack timer fires
+        (TestEvalBroker_Dequeue_Timeout)."""
+        b = broker(nack_timeout=0.15)
+        ev = make_eval()
+        b.enqueue(ev)
+        got, tok = b.dequeue(["service"], timeout=1)
+        # don't ack: the timer must requeue it
+        got2, tok2 = b.dequeue(["service"], timeout=3)
+        assert got2.id == ev.id and tok2 != tok
+
+    def test_pause_nack_timeout_survives_slow_plan(self):
+        """pause_nack_timeout holds the timer while a plan sits in the
+        queue (worker.go:277)."""
+        b = broker(nack_timeout=0.2)
+        ev = make_eval()
+        b.enqueue(ev)
+        got, tok = b.dequeue(["service"], timeout=1)
+        b.pause_nack_timeout(ev.id, tok)
+        time.sleep(0.4)  # would have expired
+        b.resume_nack_timeout(ev.id, tok)
+        b.ack(ev.id, tok)  # still outstanding: ack succeeds
+        assert b.stats()["total_unacked"] == 0
+
+    def test_ack_token_validation(self):
+        b = broker()
+        ev = make_eval()
+        b.enqueue(ev)
+        got, tok = b.dequeue(["service"], timeout=1)
+        with pytest.raises(TokenMismatchError):
+            b.ack(ev.id, "bogus-token")
+        with pytest.raises(NotOutstandingError):
+            b.ack("no-such-eval", tok)
+        b.ack(ev.id, tok)
+
+    def test_outstanding(self):
+        b = broker()
+        ev = make_eval()
+        b.enqueue(ev)
+        assert b.outstanding(ev.id) is None
+        _, tok = b.dequeue(["service"], timeout=1)
+        assert b.outstanding(ev.id) == tok
+
+
+class TestWaitTimers:
+    def test_wait_ns_delays_readiness(self):
+        """An eval with wait_ns only becomes ready after the delay
+        (TestEvalBroker_Enqueue_Disable / Wait semantics)."""
+        b = broker()
+        ev = make_eval()
+        ev.wait_ns = int(0.3 * 1e9)
+        b.enqueue(ev)
+        got, _ = b.dequeue(["service"], timeout=0.1)
+        assert got is None, "not ready during the wait"
+        got, tok = b.dequeue(["service"], timeout=2)
+        assert got.id == ev.id
+        b.ack(ev.id, tok)
+
+    def test_wait_until_delays_readiness(self):
+        b = broker()
+        ev = make_eval()
+        ev.wait_until_ns = time.time_ns() + int(0.3 * 1e9)
+        b.enqueue(ev)
+        got, _ = b.dequeue(["service"], timeout=0.1)
+        assert got is None
+        got, tok = b.dequeue(["service"], timeout=2)
+        assert got.id == ev.id
+
+    def test_disable_flushes_everything(self):
+        b = broker()
+        b.enqueue(make_eval())
+        waiting = make_eval()
+        waiting.wait_ns = int(5e9)
+        b.enqueue(waiting)
+        outst = make_eval()
+        b.enqueue(outst)
+        b.dequeue(["service"], timeout=1)
+        b.set_enabled(False)
+        s = b.stats()
+        assert s["total_ready"] == 0 and s["total_unacked"] == 0
+        assert s.get("total_waiting", 0) == 0
+
+
+class TestRequeueOnUpdate:
+    def test_updating_outstanding_eval_requeues_after_ack(self):
+        """Enqueueing a NEWER version of an outstanding eval (token set)
+        requeues it when the current delivery acks (enqueue_all with
+        token — reference EnqueueAll/requeue semantics)."""
+        b = broker()
+        ev = make_eval(job_id="job-r")
+        b.enqueue(ev)
+        got, tok = b.dequeue(["service"], timeout=1)
+        newer = got.copy() if hasattr(got, "copy") else got
+        import copy as _copy
+
+        newer = _copy.deepcopy(got)
+        newer.modify_index = 99
+        b.enqueue_all({newer.id: (newer, tok)})
+        b.ack(got.id, tok)
+        got2, tok2 = b.dequeue(["service"], timeout=2)
+        assert got2.id == ev.id and got2.modify_index == 99
+        b.ack(got2.id, tok2)

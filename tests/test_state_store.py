@@ -1,0 +1,189 @@
+"""State store tests, mirroring reference nomad/state/state_store_test.go
+core behaviors: index stamping, snapshot isolation, blocking queries, job
+versioning, secondary indexes, client-owned field preservation, plan
+result application (deployment counters), and periodic launches.
+"""
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_RUNNING,
+    AllocDeploymentStatus,
+    Deployment,
+    DeploymentState,
+)
+
+
+class TestIndexes:
+    def test_upserts_stamp_indexes(self):
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(10, n)
+        stored = s.node_by_id(n.id)
+        assert stored.create_index == 10 and stored.modify_index == 10
+        n2 = stored.copy()
+        n2.name = "renamed"
+        s.upsert_node(11, n2)
+        stored = s.node_by_id(n.id)
+        assert stored.create_index == 10 and stored.modify_index == 11
+        assert s.latest_index == 11
+
+    def test_latest_index_monotonic(self):
+        s = StateStore()
+        s.upsert_node(50, mock.node())
+        s.upsert_node(20, mock.node())  # lower index must not regress
+        assert s.latest_index == 50
+
+
+class TestSnapshotIsolation:
+    def test_writes_invisible_to_snapshot(self):
+        s = StateStore()
+        n1 = mock.node()
+        s.upsert_node(1, n1)
+        snap = s.snapshot()
+        n2 = mock.node()
+        s.upsert_node(2, n2)
+        assert snap.node_by_id(n2.id) is None
+        assert len(snap.nodes()) == 1
+        assert len(s.nodes()) == 2
+
+    def test_snapshot_min_index_waits(self):
+        s = StateStore()
+        s.upsert_node(1, mock.node())
+
+        def writer():
+            time.sleep(0.15)
+            s.upsert_node(5, mock.node())
+
+        t = threading.Thread(target=writer)
+        t.start()
+        snap = s.snapshot_min_index(5, timeout=5)
+        t.join()
+        assert snap.latest_index >= 5
+
+    def test_blocking_query_wakes_on_write(self):
+        s = StateStore()
+        s.upsert_node(1, mock.node())
+
+        def writer():
+            time.sleep(0.1)
+            s.upsert_node(2, mock.node())
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t0 = time.monotonic()
+        nodes, index = s.blocking_query(lambda st: st.nodes(), min_index=1,
+                                        timeout=5)
+        t.join()
+        assert index >= 2 and len(nodes) == 2
+        assert time.monotonic() - t0 < 4, "must wake on write, not timeout"
+
+
+class TestJobs:
+    def test_job_versions_retained(self):
+        s = StateStore()
+        job = mock.job()
+        s.upsert_job(1, job)
+        j2 = job.copy()
+        j2.version = 0  # store assigns versions
+        j2.meta = {"rev": "2"}
+        s.upsert_job(2, j2)
+        versions = s.job_versions.get(("default", job.id), [])
+        assert len(versions) >= 2
+        current = s.job_by_id("default", job.id)
+        old = s.job_by_id_and_version("default", job.id, current.version - 1)
+        assert old is not None
+
+    def test_jobs_by_parent_index(self):
+        s = StateStore()
+        parent = mock.job()
+        s.upsert_job(1, parent)
+        child = mock.job()
+        child.parent_id = parent.id
+        s.upsert_job(2, child)
+        kids = s.jobs_by_parent("default", parent.id)
+        assert [j.id for j in kids] == [child.id]
+        s.delete_job(3, "default", child.id)
+        assert s.jobs_by_parent("default", parent.id) == []
+
+
+class TestAllocs:
+    def test_secondary_indexes(self):
+        s = StateStore()
+        job = mock.job()
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        s.upsert_allocs(5, [a])
+        assert [x.id for x in s.allocs_by_node(a.node_id)] == [a.id]
+        assert [x.id for x in s.allocs_by_job("default", job.id, True)] == [a.id]
+        assert [x.id for x in s.allocs_by_eval(a.eval_id)] == [a.id]
+
+    def test_client_fields_preserved_on_server_update(self):
+        """A server-side upsert with empty client_status must not clobber
+        the client's reported status (state_store.go UpsertAllocs COMPAT)."""
+        s = StateStore()
+        a = mock.alloc()
+        s.upsert_allocs(1, [a])
+        client_view = a.copy_skip_job()
+        client_view.client_status = ALLOC_CLIENT_RUNNING
+        s.update_allocs_from_client(2, [client_view])
+        server_view = s.alloc_by_id(a.id).copy_skip_job()
+        server_view.client_status = ""
+        s.upsert_allocs(3, [server_view])
+        assert s.alloc_by_id(a.id).client_status == ALLOC_CLIENT_RUNNING
+
+
+class TestPlanResults:
+    def test_deployment_counters_on_plan_apply(self):
+        """upsert_plan_results counts NEW deployment placements once —
+        in-place updates of already-counted allocs must not inflate
+        (state_store.go updateDeploymentWithAlloc)."""
+        s = StateStore()
+        job = mock.job()
+        d = Deployment(namespace="default", job_id=job.id, status="running")
+        d.task_groups["web"] = DeploymentState(desired_total=2)
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        a.deployment_id = d.id
+        s.upsert_plan_results(
+            10, alloc_updates=[a], allocs_stopped=[], allocs_preempted=[],
+            deployment=d,
+        )
+        assert s.deployment_by_id(d.id).task_groups["web"].placed_allocs == 1
+        # re-upsert the SAME alloc (in-place update): no double count
+        a2 = s.alloc_by_id(a.id).copy_skip_job()
+        s.upsert_plan_results(
+            11, alloc_updates=[a2], allocs_stopped=[], allocs_preempted=[],
+        )
+        assert s.deployment_by_id(d.id).task_groups["web"].placed_allocs == 1
+
+    def test_update_deployment_alloc_health(self):
+        s = StateStore()
+        job = mock.job()
+        d = Deployment(namespace="default", job_id=job.id, status="running")
+        d.task_groups["web"] = DeploymentState(desired_total=1)
+        a = mock.alloc()
+        a.job = job
+        a.job_id = job.id
+        a.deployment_id = d.id
+        s.upsert_plan_results(
+            10, alloc_updates=[a], allocs_stopped=[], allocs_preempted=[],
+            deployment=d,
+        )
+        s.update_deployment_alloc_health(11, d.id, [a.id], [], 123)
+        assert s.deployment_by_id(d.id).task_groups["web"].healthy_allocs == 1
+        stored = s.alloc_by_id(a.id)
+        assert stored.deployment_status.healthy is True
+
+
+class TestPeriodic:
+    def test_periodic_launch_table(self):
+        s = StateStore()
+        s.upsert_periodic_launch(5, "default", "cron-job", 999)
+        assert s.periodic_launch_table[("default", "cron-job")] == 999

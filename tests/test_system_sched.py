@@ -1,0 +1,202 @@
+"""System scheduler tests, mirroring reference scheduler/system_sched_test.go
+core cases beyond the two in test_generic_sched: new-node fill-in, node
+deregistration/drain/down stops, job updates (in-place vs destructive),
+job deregistration, terminal-alloc handling and annotations.
+"""
+import copy
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.structs import (
+    ALLOC_CLIENT_RUNNING,
+    ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_UPDATE,
+    Evaluation,
+    SchedulerConfiguration,
+)
+
+
+def harness(alg="binpack"):
+    h = Harness()
+    h.state.scheduler_set_config(
+        h.next_index(), SchedulerConfiguration(scheduler_algorithm=alg)
+    )
+    return h
+
+
+def add_nodes(h, n, seed=0):
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.name = f"sys-{i}"
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+        nodes.append(node)
+    return nodes
+
+
+def sys_eval(job, trigger=EVAL_TRIGGER_JOB_REGISTER, node_id=""):
+    return Evaluation(
+        priority=job.priority, type=job.type, triggered_by=trigger,
+        job_id=job.id, namespace=job.namespace, node_id=node_id,
+    )
+
+
+def place_system_job(h, job):
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", sys_eval(job))
+    plan = h.plans[-1]
+    allocs = [a for allocs in plan.node_allocation.values() for a in allocs]
+    # feed the plan back as running state
+    for a in allocs:
+        a.client_status = ALLOC_CLIENT_RUNNING
+    h.state.upsert_allocs(h.next_index(), allocs)
+    return allocs
+
+
+def test_new_node_gets_filled_in():
+    """A node added after the job exists receives its system alloc on the
+    node-update eval (system_sched_test.go TestSystemSched_NewNode)."""
+    h = harness()
+    nodes = add_nodes(h, 3)
+    job = mock.system_job()
+    place_system_job(h, job)
+    assert sum(len(v) for v in h.plans[-1].node_allocation.values()) == 3
+
+    late = mock.node()
+    late.name = "late-node"
+    late.compute_class()
+    h.state.upsert_node(h.next_index(), late)
+    h.process("system", sys_eval(job, EVAL_TRIGGER_NODE_UPDATE, late.id))
+    plan = h.plans[-1]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 1 and placed[0].node_id == late.id
+
+
+def test_down_node_allocs_stopped():
+    """System allocs on a down node are lost/stopped
+    (TestSystemSched_NodeDown)."""
+    h = harness()
+    nodes = add_nodes(h, 2)
+    job = mock.system_job()
+    allocs = place_system_job(h, job)
+    victim = nodes[0]
+    downed = victim.copy()
+    downed.status = "down"
+    h.state.upsert_node(h.next_index(), downed)
+    h.process("system", sys_eval(job, EVAL_TRIGGER_NODE_UPDATE, victim.id))
+    plan = h.plans[-1]
+    stopped = [a for v in plan.node_update.values() for a in v]
+    assert any(a.node_id == victim.id for a in stopped)
+
+
+def test_drained_node_allocs_stopped():
+    """Draining stops system allocs once the drainer marks the migrate
+    transition (diffSystemAllocsForNode's ShouldMigrate gate)."""
+    h = harness()
+    nodes = add_nodes(h, 2)
+    job = mock.system_job()
+    allocs = place_system_job(h, job)
+    victim = nodes[1]
+    drained = victim.copy()
+    drained.drain = True
+    h.state.upsert_node(h.next_index(), drained)
+    for a in allocs:
+        if a.node_id == victim.id:
+            marked = a.copy_skip_job()
+            marked.desired_transition.migrate = True
+            h.state.upsert_allocs(h.next_index(), [marked])
+    h.process("system", sys_eval(job, EVAL_TRIGGER_NODE_UPDATE, victim.id))
+    plan = h.plans[-1]
+    stopped = [a for v in plan.node_update.values() for a in v]
+    assert any(a.node_id == victim.id for a in stopped)
+
+
+def test_job_deregister_stops_everything():
+    """A stopped system job stops all its allocs
+    (TestSystemSched_JobDeregister)."""
+    h = harness()
+    add_nodes(h, 3)
+    job = mock.system_job()
+    place_system_job(h, job)
+    stopped_job = copy.deepcopy(job)
+    stopped_job.stop = True
+    h.state.upsert_job(h.next_index(), stopped_job)
+    h.process("system", sys_eval(job))
+    plan = h.plans[-1]
+    stopped = [a for v in plan.node_update.values() for a in v]
+    assert len(stopped) == 3
+
+
+def test_job_update_destructive():
+    """A changed job destructively replaces allocs in place
+    (TestSystemSched_JobModify)."""
+    h = harness()
+    add_nodes(h, 3)
+    job = mock.system_job()
+    place_system_job(h, job)
+    job2 = copy.deepcopy(job)
+    job2.version = 1
+    job2.job_modify_index = h.next_index()
+    job2.task_groups[0].tasks[0].env = {"NEW": "yes"}
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("system", sys_eval(job2))
+    plan = h.plans[-1]
+    placed = [a for v in plan.node_allocation.values() for a in v]
+    stopped = [a for v in plan.node_update.values() for a in v]
+    assert len(placed) == 3 and len(stopped) == 3
+
+
+def test_idempotent_when_in_sync():
+    """Re-evaluating an unchanged, fully-placed system job is a no-op
+    (TestSystemSched_JobRegister_EphemeralDiskConstraint spirit)."""
+    h = harness()
+    add_nodes(h, 3)
+    job = mock.system_job()
+    place_system_job(h, job)
+    before = len(h.plans)
+    h.process("system", sys_eval(job))
+    # either no new plan, or an empty one
+    if len(h.plans) > before:
+        plan = h.plans[-1]
+        assert not plan.node_allocation and not plan.node_update
+
+
+def test_infeasible_nodes_annotated_not_blocking():
+    """Nodes failing constraints are skipped; feasible ones still place
+    (TestSystemSched_JobRegister_AddNode_Dead spirit)."""
+    h = harness()
+    nodes = add_nodes(h, 3)
+    windows = mock.node()
+    windows.attributes["kernel.name"] = "windows"
+    windows.compute_class()
+    h.state.upsert_node(h.next_index(), windows)
+    job = mock.system_job()  # constrained to linux
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", sys_eval(job))
+    plan = h.plans[-1]
+    placed_nodes = set(plan.node_allocation)
+    assert windows.id not in placed_nodes
+    assert len(placed_nodes) == 3
+
+
+def test_parity_system_tpu_vs_host():
+    """System scheduling under tpu_binpack matches the host pipeline."""
+    nodes_spec = []
+    for alg in ("binpack", "tpu_binpack"):
+        h = harness(alg)
+        for i in range(4):
+            node = mock.node()
+            node.id = f"fixed-node-{i}"
+            node.name = f"sys-{i}"
+            node.compute_class()
+            h.state.upsert_node(h.next_index(), node)
+        job = mock.system_job()
+        job.id = "sys-parity"
+        h.state.upsert_job(h.next_index(), job)
+        h.process("system", sys_eval(job))
+        plan = h.plans[-1]
+        nodes_spec.append(sorted(plan.node_allocation))
+    assert nodes_spec[0] == nodes_spec[1]
