@@ -39,7 +39,8 @@ def _pow2ceil(x: int) -> int:
 
 def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
                 v_pad: int, p_pad: int, dtype,
-                d_pad: int = 0) -> Tuple[tuple, tuple, tuple]:
+                d_pad: int = 0, k_pad: Optional[int] = None,
+                aff_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
     """Pad one eval's arrays to the batch's shared bucketed dims.
 
     Padding is semantically inert by construction:
@@ -64,10 +65,15 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
     d0 = totals.shape[1]
     if d_pad <= 0:
         d_pad = d0
+    if k_pad is None:
+        k_pad = penalty_idx.shape[1]
+    if aff_pad is None:
+        aff_pad = aff_score.shape[0]
     dn, dg, ds, dv, dp = (n_pad - n0, g_pad - g0, s_pad - s0,
                           v_pad - v0, p_pad - p0)
     dd = d_pad - d0
     assert min(dn, dg, ds, dv, dp, dd) >= 0
+    assert k_pad >= penalty_idx.shape[1] and aff_pad >= aff_score.shape[0]
     assert dp == 0 or g_pad > g0  # padded steps need a pre-failed TG slot
 
     def pad(arr, widths, fill=0):
@@ -87,8 +93,12 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         pad(f(reserved), ((0, dn), (0, dd))),
         pad(f(asks), ((0, dg), (0, dd))),
         pad(feas, ((0, dg), (0, dn)), False),
-        pad(f(aff_score), ((0, dg), (0, dn))),
-        pad(aff_present, ((0, dg), (0, dn)), False),
+        # aff arrays may have a ZERO G axis (shape-specialized absent
+        # affinities): the batch target is 0 when every co-batched eval
+        # lacks affinities (keeping the specialization), else g_pad —
+        # padded zero rows are inert either way
+        pad(f(aff_score), ((0, aff_pad - aff_score.shape[0]), (0, dn))),
+        pad(aff_present, ((0, aff_pad - aff_present.shape[0]), (0, dn)), False),
         pad(desired_counts, ((0, dg),), 1),
         pad(dh_job, ((0, dg),), False),
         pad(dh_tg, ((0, dg),), False),
@@ -113,7 +123,9 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
     )
     xs = (
         pad(tg_idx, ((0, dp),), g0),  # g0 = first padded (pre-failed) slot
-        pad(penalty_idx, ((0, dp), (0, 0)), -1),
+        # K axis may be zero (no reschedule history) — pad to the batch's
+        # K with -1 sentinels, which match nothing
+        pad(penalty_idx, ((0, dp), (0, k_pad - penalty_idx.shape[1])), -1),
         pad(evict_node, ((0, dp),), -1),
         pad(f(evict_res), ((0, dp), (0, dd))),
         pad(evict_tg, ((0, dp),), -1),
@@ -281,10 +293,16 @@ class DeviceBatcher:
         v_pad = _pow2ceil(max(max(e.v for e in encs), 2))
         p_pad = _pow2ceil(max(e.p for e in encs))
         d_pad = max(e.static[0].shape[1] for e in encs)
+        # absent-feature axes stay ZERO when the whole batch lacks them
+        # (the compiled step skips those ops); mixed batches widen
+        k_pad = max(e.xs[1].shape[1] for e in encs)
+        aff_raw = max(e.static[4].shape[0] for e in encs)
+        aff_pad = g_pad if aff_raw else 0
         dtype = encs[0].dtype  # dispatch loop groups by dtype
 
         padded = [
-            pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad)
+            pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad,
+                        k_pad, aff_pad)
             for e in encs
         ]
 
@@ -297,7 +315,8 @@ class DeviceBatcher:
             n_pad2 = ((n_pad + nn - 1) // nn) * nn
             if n_pad2 != n_pad:
                 padded = [
-                    pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype, d_pad)
+                    pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype,
+                                d_pad, k_pad, aff_pad)
                     for e in encs
                 ]
                 n_pad = n_pad2

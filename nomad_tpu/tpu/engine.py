@@ -189,8 +189,15 @@ def _make_step():
         desired_g = pick_g(desired_counts).astype(fdt)
         dh_job_g = jnp.any(sel_g & dh_job)
         dh_tg_g = jnp.any(sel_g & dh_tg)
-        aff = pick_g(aff_score)
-        aff_p = pick_g(aff_present, False)
+        # shape specialization (compile-time): a job without affinities
+        # encodes aff arrays with a ZERO G axis, so the f64 pick and the
+        # score term vanish from the compiled step entirely
+        if aff_score.shape[0] == 0:
+            aff = jnp.zeros(n_pad, fdt)
+            aff_p = jnp.zeros(n_pad, bool)
+        else:
+            aff = pick_g(aff_score)
+            aff_p = pick_g(aff_present, False)
 
         # -- feasibility ---------------------------------------------------
         util = used + reserved + ask[None, :]  # [N, D]
@@ -218,8 +225,14 @@ def _make_step():
         anti_present = collisions > 0
         anti = jnp.where(anti_present, -(collisions + 1.0) / desired_g, 0.0)
 
-        pmask = jnp.any(iota[:, None] == penalty_idx[None, :], axis=-1)
-        resched = jnp.where(pmask, -1.0, 0.0)
+        # same specialization: no reschedule history -> penalty_idx has a
+        # zero K axis and the [N, K] compare disappears
+        if penalty_idx.shape[-1] == 0:
+            pmask = jnp.zeros(n_pad, bool)
+            resched = jnp.zeros(n_pad, fdt)
+        else:
+            pmask = jnp.any(iota[:, None] == penalty_idx[None, :], axis=-1)
+            resched = jnp.where(pmask, -1.0, 0.0)
 
         # spread scoring — value-id lookups as one-hot sums over V
         vids = pick_g(spread_vids)                       # [S, N]
@@ -685,6 +698,14 @@ class TpuPlacementEngine:
                     if prev.job_id == job.id:
                         evict_tg[pi] = tg_name_to_gi.get(prev.task_group, -1)
 
+        # shape specialization: absent features collapse to zero axes so
+        # the step compiles without their ops (see _make_step)
+        if not aff_present.any():
+            aff_score = aff_score[:0]
+            aff_present = aff_present[:0]
+        if (penalty_idx == -1).all():
+            penalty_idx = penalty_idx[:, :0]
+
         static = (
             totals, reserved, asks, feas, aff_score, aff_present,
             desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
@@ -919,8 +940,10 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
 
     feas = np.zeros((g, n_pad), bool)
     feas[:, :n_nodes] = rng.random((g, n_nodes)) < 0.9
-    aff_score = np.zeros((g, n_pad), dtype)
-    aff_present = np.zeros((g, n_pad), bool)
+    # no affinities in the synthetic workload: zero G axis (the step
+    # compiles the affinity term away — matching production encode)
+    aff_score = np.zeros((0, n_pad), dtype)
+    aff_present = np.zeros((0, n_pad), bool)
     desired_counts = np.full(g, max(n_placements // g, 1), np.int32)
     dh_job = np.zeros(g, bool)
     dh_tg = np.zeros(g, bool)
@@ -946,7 +969,7 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
                   spread_counts0, spread_entry0, np.int32(0), np.zeros(g, bool))
     limit_val = max(2, int(np.ceil(np.log2(max(n_nodes, 2)))))
     xs = (rng.integers(0, g, n_placements).astype(np.int32),
-          np.full((n_placements, MAX_PENALTY_NODES), -1, np.int32),
+          np.full((n_placements, 0), -1, np.int32),  # no reschedule history
           np.full(n_placements, -1, np.int32),
           np.zeros((n_placements, num_dims), dtype),
           np.full(n_placements, -1, np.int32),
