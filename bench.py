@@ -325,7 +325,9 @@ def system_benches():
         j.task_groups[0].tasks[0].resources.memory_mb = 128
         return j
 
-    results.append(bench_system("service-100x50", 50, jobs, warmup=_svc_warm))
+    r = _diagnostic(bench_system, "service-100x50", 50, jobs, warmup=_svc_warm)
+    if r:
+        results.append(r)
 
     # config 2: batch scheduler, bin-pack only, 1K nodes, 10K short tasks
     jobs = []
@@ -344,8 +346,10 @@ def system_benches():
         j.task_groups[0].tasks[0].resources.memory_mb = 32
         return j
 
-    results.append(bench_system("batch-10Kx1K", 1000, jobs, timeout=300.0,
-                                warmup=_batch_warm))
+    r = _diagnostic(bench_system, "batch-10Kx1K", 1000, jobs, timeout=300.0,
+                    warmup=_batch_warm)
+    if r:
+        results.append(r)
 
     # config 3: service + spread stanzas at 5K nodes
     jobs = []
@@ -372,28 +376,37 @@ def system_benches():
         )]
         return j
 
-    results.append(bench_system("service-spread-5K", 5000, jobs, timeout=300.0,
-                                warmup=_spread_warm))
+    r = _diagnostic(bench_system, "service-spread-5K", 5000, jobs, timeout=300.0,
+                    warmup=_spread_warm)
+    if r:
+        results.append(r)
 
     return results
 
 
-def main():
-    rate = bench_batched_parity_c1m()
+def _diagnostic(fn, *args, **kwargs):
+    """Run one diagnostic bench in isolation: a failure is reported but
+    never skips later diagnostics or breaks the headline JSON line."""
     try:
-        bench_c1m_chunked()
-        bench_parity_scan_single()
-        sys_results = system_benches()
-        if sys_results:
-            kernel_vs_system = rate / max(
-                r["placements_per_s"] for r in sys_results if r["placements_per_s"]
-            )
-            log(f"kernel-rate / best-system-rate gap: {kernel_vs_system:,.0f}x")
-    except Exception as e:  # diagnostics only; never break the headline line
+        return fn(*args, **kwargs)
+    except Exception as e:
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        log(f"diagnostic bench failed: {e}")
+        log(f"diagnostic bench {fn.__name__} failed: {e}")
+        return None
+
+
+def main():
+    rate = bench_batched_parity_c1m()
+    _diagnostic(bench_c1m_chunked)
+    _diagnostic(bench_parity_scan_single)
+    sys_results = _diagnostic(system_benches)
+    sys_rates = [
+        r["placements_per_s"] for r in (sys_results or []) if r["placements_per_s"]
+    ]
+    if sys_rates:
+        log(f"kernel-rate / best-system-rate gap: {rate / max(sys_rates):,.0f}x")
 
     # The BASELINE bar (1M in <10s = 100K placements/s) is stated for TPU
     # v5e-8; this bench runs on ONE chip, so compare against the per-chip

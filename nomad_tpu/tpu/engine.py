@@ -1009,6 +1009,11 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
     CHUNK = int(chunk_k)
 
     def step(static, carry_and_deficit, x):
+        # Gather-free like the parity step (_make_step): dynamic row-selects
+        # become one-hot where+sum picks; the top-K scatter-adds become
+        # one-hot [K, N] membership sums. Exact (single non-zero term per
+        # select; top_k indices are distinct) and ~10x faster on this
+        # backend than dynamic-index gathers/scatters in a scan body.
         carry, deficit = carry_and_deficit
         (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
          dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
@@ -1017,19 +1022,33 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
         tg_idx, want = x
 
         n_pad = totals.shape[0]
+        g_count = asks.shape[0]
         g = tg_idx
         fdt = totals.dtype
 
-        ask = asks[g]
+        iota_g = jnp.arange(g_count, dtype=jnp.int32)
+        sel_g = (iota_g == g)  # [G] one-hot of the TG
+        iota = jnp.arange(n_pad, dtype=jnp.int32)
+
+        def pick_g(arr, fill=0):
+            shape = (g_count,) + (1,) * (arr.ndim - 1)
+            return jnp.sum(jnp.where(sel_g.reshape(shape), arr, fill), axis=0)
+
+        ask = pick_g(asks)                               # [D]
+        feas_g = pick_g(feas, False)                     # [N]
+        tg_counts_g = pick_g(tg_counts)                  # [N]
+        dh_job_g = jnp.any(sel_g & dh_job)
+        dh_tg_g = jnp.any(sel_g & dh_tg)
+        desired_g = pick_g(desired_counts).astype(fdt)
+
         util = used + reserved + ask[None, :]
         fits = jnp.all(util <= totals, axis=-1)
         dh_mask = jnp.where(
-            dh_job[g],
+            dh_job_g,
             job_counts == 0,
-            jnp.where(dh_tg[g], ~((tg_counts[g] > 0) & (job_counts > 0)), True),
+            jnp.where(dh_tg_g, ~((tg_counts_g > 0) & (job_counts > 0)), True),
         )
-        iota = jnp.arange(n_pad, dtype=jnp.int32)
-        feasible = feas[g] & fits & dh_mask & (iota < n_real)
+        feasible = feas_g & fits & dh_mask & (iota < n_real)
 
         node_cpu = totals[:, DIM_CPU] - reserved[:, DIM_CPU]
         node_mem = totals[:, DIM_MEM] - reserved[:, DIM_MEM]
@@ -1037,28 +1056,42 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
         free_mem = 1.0 - util[:, DIM_MEM] / jnp.maximum(node_mem, 1e-9)
         binpack = jnp.clip(20.0 - (jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem)), 0.0, 18.0) / 18.0
 
-        collisions = tg_counts[g].astype(fdt)
+        collisions = tg_counts_g.astype(fdt)
         anti_present = collisions > 0
-        anti = jnp.where(anti_present, -(collisions + 1.0) / desired_counts[g].astype(fdt), 0.0)
+        anti = jnp.where(anti_present, -(collisions + 1.0) / desired_g, 0.0)
 
-        aff = aff_score[g]
-        aff_p = aff_present[g]
+        # shape specialization (compile-time): affinity-free workloads
+        # encode a ZERO G axis (engine.encode_eval / example_scan_inputs)
+        # and the term vanishes from the compiled step
+        if aff_score.shape[0] == 0:
+            aff = jnp.zeros(n_pad, fdt)
+            aff_p = jnp.zeros(n_pad, bool)
+        else:
+            aff = pick_g(aff_score)
+            aff_p = pick_g(aff_present, False)
 
-        vids = spread_vids[g]
-        s_counts = spread_counts[g]
+        vids = pick_g(spread_vids)                       # [S, N]
+        s_counts = pick_g(spread_counts)                 # [S, V]
+        desired_sv = pick_g(spread_desired)              # [S, V]
+        weights_s = pick_g(spread_weights)               # [S]
+        active_s = pick_g(spread_active, False)          # [S]
+        sum_sw_g = pick_g(sum_spread_weights)
         v_plus = s_counts.shape[-1]
+        iota_v = jnp.arange(v_plus, dtype=jnp.int32)
         big = jnp.finfo(fdt).max / 16.0
-        used_count = jnp.take_along_axis(s_counts, vids, axis=1) + 1.0
-        d = jnp.take_along_axis(spread_desired[g], vids, axis=1)
+        # value-id lookups as one-hot sums over V (no take_along_axis)
+        oh_vids = vids[:, None, :] == iota_v[None, :, None]  # [S, V, N]
+        used_count = jnp.sum(jnp.where(oh_vids, s_counts[:, :, None], 0.0), axis=1) + 1.0
+        d = jnp.sum(jnp.where(oh_vids, desired_sv[:, :, None], 0.0), axis=1)
         missing = vids == v_plus - 1
-        weight_frac = spread_weights[g][:, None] / jnp.maximum(sum_spread_weights[g], 1e-9)
+        weight_frac = weights_s[:, None] / jnp.maximum(sum_sw_g, 1e-9)
         targeted = jnp.where(
             d > 0.0,
             (d - used_count) / jnp.where(d > 0.0, d, 1.0) * weight_frac,
             jnp.where(d == 0.0, -big, -1.0),
         )
         per_spread = jnp.where(missing, -1.0, targeted)
-        per_spread = jnp.where(spread_active[g][:, None], per_spread, 0.0)
+        per_spread = jnp.where(active_s[:, None], per_spread, 0.0)
         spread_total = jnp.sum(per_spread, axis=0)
         spread_p = spread_total != 0.0
 
@@ -1068,20 +1101,29 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
         neg_inf = -jnp.inf
         masked = jnp.where(feasible, final, neg_inf)
         top_scores, top_idx = jax.lax.top_k(masked, CHUNK)
-        want_total = want + deficit[g]
+        # int sums promote to int64 under x64 — cast back to keep the
+        # carry dtypes fixed
+        want_total = (want + pick_g(deficit)).astype(jnp.int32)
         want_eff = jnp.minimum(want_total, CHUNK)
         valid = (jnp.arange(CHUNK, dtype=jnp.int32) < want_eff) & (top_scores > neg_inf)
-        placed = jnp.sum(valid.astype(jnp.int32))
-        deficit = deficit.at[g].set(want_total - placed)
+        placed = jnp.sum(valid.astype(jnp.int32)).astype(jnp.int32)
+        deficit = jnp.where(sel_g, want_total - placed, deficit).astype(jnp.int32)
 
-        vi = valid.astype(fdt)
-        used = used.at[top_idx].add(ask[None, :] * vi[:, None])
-        tg_counts = tg_counts.at[g, top_idx].add(valid.astype(jnp.int32))
-        job_counts = job_counts.at[top_idx].add(valid.astype(jnp.int32))
-        ch_vids = vids[:, top_idx]  # [S, K]
-        s_idx = jnp.arange(vids.shape[0])[:, None]
-        inc = (vi[None, :] * spread_active[g][:, None].astype(fdt))
-        spread_counts = spread_counts.at[g, s_idx, ch_vids].add(inc)
+        # one-hot membership of the chosen nodes: top_k indices are
+        # distinct, so sel_nodes is 0/1 and the adds are exact
+        oh_sel = (iota[None, :] == top_idx[:, None]) & valid[:, None]  # [K, N]
+        sel_nodes = jnp.sum(oh_sel.astype(jnp.int32), axis=0).astype(jnp.int32)  # [N]
+        sel_nodes_f = sel_nodes.astype(fdt)
+        used = used + sel_nodes_f[:, None] * ask[None, :]
+        tg_counts = tg_counts + sel_g[:, None] * sel_nodes[None, :]
+        job_counts = job_counts + sel_nodes
+        # spread count add: per (s, v), how many chosen nodes carry value v
+        add_sv = jnp.sum(
+            jnp.where(oh_vids, sel_nodes_f[None, None, :], 0.0), axis=2
+        ) * active_s[:, None].astype(fdt)                              # [S, V]
+        spread_counts = spread_counts + jnp.where(
+            sel_g[:, None, None], add_sv[None, :, :], 0.0
+        )
 
         new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry, offset, failed)
         out = (top_idx, jnp.where(valid, top_scores, 0.0), valid, placed)
