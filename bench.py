@@ -38,8 +38,9 @@ def bench_batched_parity_c1m(total=1_000_000, n_nodes=5000, batch=512,
                              per_eval=200, budget_s=75.0):
     """C1M as independent evals: ``batch`` evals x ``per_eval`` placements
     per device dispatch, exact sequential parity semantics inside each
-    eval (float64 scoring, ring-ordered limit iterator emulation). Jobs
-    are C1M-shaped (1-2 task groups per job — the challenge scheduled
+    eval (exact INTEGER scoring — tpu/intscore.py — and the ring-ordered
+    limit iterator emulation; bit-identical selections on any backend).
+    Jobs are C1M-shaped (1-2 task groups per job — the challenge scheduled
     simple single-container jobs) with a spread stanza active so the full
     rank stack runs."""
     import jax
@@ -53,7 +54,7 @@ def bench_batched_parity_c1m(total=1_000_000, n_nodes=5000, batch=512,
     evals = [
         example_scan_inputs(
             n_nodes=n_nodes, n_tgs=2, n_placements=per_eval, seed=s % 16,
-            dtype=np.float64,
+            dtype=np.int32,  # exact-integer parity spec (tpu/intscore.py)
         )
         for s in range(batch)
     ]
@@ -187,7 +188,7 @@ def bench_parity_scan_single(n_nodes=5000, n_placements=10_000):
     scan = _build_place_scan()
     n_pad, static, carry, xs = example_scan_inputs(
         n_nodes=n_nodes, n_tgs=8, n_placements=n_placements, seed=0,
-        dtype=np.float64,
+        dtype=np.int32,
     )
     np.asarray(scan(n_pad, static, carry, xs)[1][0])  # warm
     t0 = time.perf_counter()

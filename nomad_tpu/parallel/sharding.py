@@ -69,6 +69,7 @@ def batched_scan_shardings(mesh):
         ns(e, None, None),           # spread_active [B, G, S]
         ns(e, None),                 # sum_spread_weights [B, G]
         ns(e),                       # n_real [B]
+        ns(e, None, "nodes", None),  # e_ask [B, G, N, 2]
     )
     carry = (
         ns(e, "nodes", None),        # used [B, N, D]
@@ -78,6 +79,7 @@ def batched_scan_shardings(mesh):
         ns(e, None, None, None),     # spread_entry [B, G, S, V]
         ns(e),                       # offset [B]
         ns(e, None),                 # failed [B, G]
+        ns(e, "nodes", None),        # e_base [B, N, 2]
     )
     xs = (
         ns(e, None),                 # tg_idx [B, P]
@@ -87,6 +89,8 @@ def batched_scan_shardings(mesh):
         ns(e, None),                 # evict_tg [B, P]
         ns(e, None),                 # limit_p [B, P]
         ns(e, None),                 # sum_sw_p [B, P]
+        ns(e, None, None),           # ev_factor [B, P, 2]
+        ns(e, None, None),           # rev_factor [B, P, 2]
     )
     return static, carry, xs
 

@@ -26,6 +26,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .engine import EncodedEval, _build_batched_scan, _round_up
+from .intscore import E27_ONE as _E27_NEUTRAL
 
 logger = logging.getLogger("nomad_tpu.tpu.batcher")
 
@@ -40,7 +41,9 @@ def _pow2ceil(x: int) -> int:
 def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
                 v_pad: int, p_pad: int, dtype,
                 d_pad: int = 0, k_pad: Optional[int] = None,
-                aff_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
+                aff_pad: Optional[int] = None,
+                evd_pad: Optional[int] = None,
+                fac_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
     """Pad one eval's arrays to the batch's shared bucketed dims.
 
     Padding is semantically inert by construction:
@@ -55,11 +58,12 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
     """
     (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
      dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
-     spread_has_targets, spread_active, sum_spread_weights, n_real) = enc.static
+     spread_has_targets, spread_active, sum_spread_weights, n_real,
+     e_ask) = enc.static
     (used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
-     offset0, failed0) = enc.carry
+     offset0, failed0, e_base0) = enc.carry
     (tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
-     limit_p, sum_sw_p) = enc.xs
+     limit_p, sum_sw_p, ev_factor, rev_factor) = enc.xs
 
     n0, g0, s0, v0, p0 = enc.n_pad, enc.g, enc.s, enc.v, enc.p
     d0 = totals.shape[1]
@@ -69,6 +73,10 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         k_pad = penalty_idx.shape[1]
     if aff_pad is None:
         aff_pad = aff_score.shape[0]
+    if evd_pad is None:
+        evd_pad = evict_res.shape[1]
+    if fac_pad is None:
+        fac_pad = ev_factor.shape[1]
     dn, dg, ds, dv, dp = (n_pad - n0, g_pad - g0, s_pad - s0,
                           v_pad - v0, p_pad - p0)
     dd = d_pad - d0
@@ -110,6 +118,12 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         pad(spread_active, ((0, dg), (0, ds)), False),
         pad(f(sum_spread_weights), ((0, dg),)),
         np.int32(n_real),
+        # Q27 exponential ask factors (int mode; zero-sized in float
+        # batches). Padded cells get the neutral factor — padded nodes
+        # are infeasible and padded TG slots pre-failed anyway.
+        pad(e_ask, ((0, (g_pad - e_ask.shape[0]) if e_ask.shape[0] else 0),
+                    (0, (n_pad - e_ask.shape[1]) if e_ask.shape[0] else 0),
+                    (0, 0)), _E27_NEUTRAL),
     )
     carry = (
         pad(f(used0), ((0, dn), (0, dd))),
@@ -120,6 +134,8 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         np.int32(offset0),
         # padded TG slots are pre-failed -> padded steps are no-ops
         pad(failed0, ((0, dg),), True),
+        pad(e_base0, ((0, dn if e_base0.shape[0] else 0), (0, 0)),
+            _E27_NEUTRAL),
     )
     xs = (
         pad(tg_idx, ((0, dp),), g0),  # g0 = first padded (pre-failed) slot
@@ -127,10 +143,15 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         # K with -1 sentinels, which match nothing
         pad(penalty_idx, ((0, dp), (0, k_pad - penalty_idx.shape[1])), -1),
         pad(evict_node, ((0, dp),), -1),
-        pad(f(evict_res), ((0, dp), (0, dd))),
+        # eviction axes may be ZERO-width (no destructive updates in the
+        # whole batch — the step's evict path compiles away); a mixed
+        # batch widens with inert fills (evict_node stays -1)
+        pad(f(evict_res), ((0, dp), (0, evd_pad - evict_res.shape[1]))),
         pad(evict_tg, ((0, dp),), -1),
         pad(limit_p, ((0, dp),), 0),
         pad(f(sum_sw_p), ((0, dp),), 1.0),
+        pad(ev_factor, ((0, dp), (0, fac_pad - ev_factor.shape[1])), _E27_NEUTRAL),
+        pad(rev_factor, ((0, dp), (0, fac_pad - rev_factor.shape[1])), _E27_NEUTRAL),
     )
     return static, carry, xs
 
@@ -246,9 +267,10 @@ class DeviceBatcher:
                     except queue.Empty:
                         break
             # dtype-homogeneous sub-batches: co-batching must never change
-            # an eval's arithmetic (f32 evals upcast to f64 could select
-            # differently than they would alone)
-            for dtype in (np.float64, np.float32):
+            # an eval's arithmetic (f32 evals upcast could select
+            # differently than they would alone). int32 = the exact
+            # integer parity spec; floats = throughput modes.
+            for dtype in (np.int32, np.float64, np.float32):
                 group = [r for r in batch if r.enc.dtype == dtype]
                 if group:
                     self._run_batch_safe(group)
@@ -298,11 +320,14 @@ class DeviceBatcher:
         k_pad = max(e.xs[1].shape[1] for e in encs)
         aff_raw = max(e.static[4].shape[0] for e in encs)
         aff_pad = g_pad if aff_raw else 0
+        evd_raw = max(e.xs[3].shape[1] for e in encs)
+        evd_pad = d_pad if evd_raw else 0
+        fac_pad = max(e.xs[7].shape[1] for e in encs)
         dtype = encs[0].dtype  # dispatch loop groups by dtype
 
         padded = [
             pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad,
-                        k_pad, aff_pad)
+                        k_pad, aff_pad, evd_pad, fac_pad)
             for e in encs
         ]
 
@@ -316,7 +341,7 @@ class DeviceBatcher:
             if n_pad2 != n_pad:
                 padded = [
                     pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype,
-                                d_pad, k_pad, aff_pad)
+                                d_pad, k_pad, aff_pad, evd_pad, fac_pad)
                     for e in encs
                 ]
                 n_pad = n_pad2

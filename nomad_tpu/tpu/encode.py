@@ -289,7 +289,13 @@ def _class_feasibility(ctx, job: Job, tg: TaskGroup, nodes: List[Node]) -> np.nd
     return mask
 
 
-def _affinity_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node]) -> Tuple[np.ndarray, np.ndarray]:
+def _affinity_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node],
+                     int_mode: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-node normalized affinity scores (rank.go:640 semantics).
+
+    ``int_mode``: Q30 fixed-point integers, computed EXACTLY from the
+    integer weights ((total << 30) // sum_abs — the intscore.py spec);
+    otherwise float64 as the host pipeline computes them."""
     from ..scheduler.feasible import matches_affinity
 
     affinities = list(job.affinities) + list(tg.affinities)
@@ -297,9 +303,25 @@ def _affinity_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node]) -> Tuple[n
         affinities.extend(task.affinities)
 
     n = len(nodes)
-    score = np.zeros(n, dtype=np.float64)
+    score = np.zeros(n, dtype=np.int64 if int_mode else np.float64)
     present = np.zeros(n, dtype=bool)
     if not affinities:
+        return score, present
+
+    if int_mode:
+        if any(float(a.weight) != int(a.weight) for a in affinities):
+            raise UnsupportedByEngine("non-integer affinity weight")
+        from .intscore import aff_fp_py
+
+        sum_weight_i = sum(abs(int(a.weight)) for a in affinities)
+        for i, node in enumerate(nodes):
+            total = sum(
+                int(aff.weight) for aff in affinities
+                if matches_affinity(ctx, aff, node)
+            )
+            if total != 0 and sum_weight_i != 0:
+                score[i] = aff_fp_py(total, sum_weight_i)
+                present[i] = True
         return score, present
 
     sum_weight = sum(abs(float(a.weight)) for a in affinities)
@@ -314,23 +336,41 @@ def _affinity_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node]) -> Tuple[n
     return score, present
 
 
-def _spread_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node]):
+def _spread_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node],
+                   int_mode: bool = False):
     """Encode spreads: value-id per node per spread, desired counts, and the
-    existing+proposed usage counts (from the propertyset at eval start)."""
+    existing+proposed usage counts (from the propertyset at eval start).
+
+    ``int_mode``: desired counts as EXACT integer hundredths
+    (percent * total_count — the intscore.py spec; -1 = no target),
+    integer weights/counts; otherwise float64."""
     from ..scheduler.propertyset import PropertySet, get_property
 
     spreads = list(tg.spreads) + list(job.spreads)
     s = len(spreads)
     n = len(nodes)
+    ddt = np.int32 if int_mode else np.float64
     if s == 0:
         return (
             np.zeros((0, n), dtype=np.int32),
-            np.zeros((0, 1), dtype=np.float64),
-            np.zeros((0,), dtype=np.float64),
-            np.zeros((0, 1), dtype=np.float64),
+            np.zeros((0, 1), dtype=ddt),
+            np.zeros((0,), dtype=ddt),
+            np.zeros((0, 1), dtype=ddt),
             np.zeros((0,), dtype=bool),
-            0.0,
+            0 if int_mode else 0.0,
         )
+    if int_mode:
+        for spread in spreads:
+            w = spread.weight
+            # magnitude gates keep the fused targeted-spread numerator
+            # (d - 100u) * w * 2**30 within int64 (intscore.py module doc)
+            if float(w) != int(w) or not (0 <= int(w) <= 256):
+                raise UnsupportedByEngine("spread weight outside int-spec range")
+            for st in spread.spread_target:
+                if float(st.percent) != int(st.percent) or not (0 <= int(st.percent) <= 100):
+                    raise UnsupportedByEngine("spread percent outside int-spec range")
+        if sum(int(sp.weight) for sp in spreads) <= 0:
+            raise UnsupportedByEngine("zero spread weight sum")
 
     # Build vocab per spread: values seen on nodes + declared targets.
     vids = np.zeros((s, n), dtype=np.int32)
@@ -354,33 +394,49 @@ def _spread_arrays(ctx, job: Job, tg: TaskGroup, nodes: List[Node]):
         vocab_sizes.append(max(len(vocab), 1))
     v = max(vocab_sizes)
 
-    desired = np.full((s, v + 1), -1.0, dtype=np.float64)  # -1 = no target
-    weights = np.zeros(s, dtype=np.float64)
-    counts0 = np.zeros((s, v + 1), dtype=np.float64)
+    desired = np.full((s, v + 1), -1, dtype=ddt) if int_mode else \
+        np.full((s, v + 1), -1.0, dtype=ddt)  # -1 = no target
+    weights = np.zeros(s, dtype=ddt)
+    counts0 = np.zeros((s, v + 1), dtype=ddt)
     has_targets = np.zeros(s, dtype=bool)
 
     total_count = tg.count
-    sum_weights = 0.0
+    sum_weights = 0 if int_mode else 0.0
     for si, spread in enumerate(spreads):
         weights[si] = spread.weight
-        sum_weights += spread.weight
+        sum_weights += int(spread.weight) if int_mode else spread.weight
         vocab = vocabs[si]
         # node value ids (missing property -> v, the "invalid" bucket)
         for i in range(n):
             val = node_values[si][i]
             vids[si, i] = vocab[val] if val is not None else v
-        sum_desired = 0.0
-        for st in spread.spread_target:
-            d = (float(st.percent) / 100.0) * float(total_count)
-            desired[si, vocab[st.value]] = d
-            sum_desired += d
-            has_targets[si] = True
-        # implicit remainder bucket
-        if 0 < sum_desired < float(total_count):
-            remainder = float(total_count) - sum_desired
-            for val, vid in vocab.items():
-                if desired[si, vid] < 0:
-                    desired[si, vid] = remainder
+        if int_mode:
+            # hundredths: d = percent * count (exact); the host's float
+            # d = percent/100 * count is this value / 100
+            sum_desired_h = 0
+            for st in spread.spread_target:
+                d_h = int(st.percent) * int(total_count)
+                desired[si, vocab[st.value]] = d_h
+                sum_desired_h += d_h
+                has_targets[si] = True
+            if 0 < sum_desired_h < 100 * int(total_count):
+                remainder_h = 100 * int(total_count) - sum_desired_h
+                for val, vid in vocab.items():
+                    if desired[si, vid] < 0:
+                        desired[si, vid] = remainder_h
+        else:
+            sum_desired = 0.0
+            for st in spread.spread_target:
+                d = (float(st.percent) / 100.0) * float(total_count)
+                desired[si, vocab[st.value]] = d
+                sum_desired += d
+                has_targets[si] = True
+            # implicit remainder bucket
+            if 0 < sum_desired < float(total_count):
+                remainder = float(total_count) - sum_desired
+                for val, vid in vocab.items():
+                    if desired[si, vid] < 0:
+                        desired[si, vid] = remainder
         # existing + proposed usage counts via the propertyset
         pset = PropertySet(ctx, job)
         pset.set_target_attribute(spread.attribute, tg.name)
@@ -470,8 +526,12 @@ def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
 
     check_supported(job, tg)
     device_dims = job_device_dims(job)
+    # deterministic mode scores on the exact integer spec (intscore.py):
+    # int32 capacity arrays, Q30 affinity ints, hundredths spread targets
+    int_mode = bool(getattr(ctx, "deterministic", False))
 
-    ask = np.zeros(job_num_dims(device_dims), dtype=np.float64)
+    ask = np.zeros(job_num_dims(device_dims),
+                   dtype=np.int32 if int_mode else np.float64)
     for task in tg.tasks:
         ask[DIM_CPU] += task.resources.cpu
         ask[DIM_MEM] += task.resources.memory_mb
@@ -482,9 +542,11 @@ def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
 
     feasible = _class_feasibility(ctx, job, tg, nodes)
     feasible &= _port_feasibility(ctx, job, tg, nodes, port_cache)
-    affinity_score, affinity_present = _affinity_arrays(ctx, job, tg, nodes)
+    affinity_score, affinity_present = _affinity_arrays(
+        ctx, job, tg, nodes, int_mode=int_mode
+    )
     vids, desired, weights, counts0, has_targets, sum_weights = _spread_arrays(
-        ctx, job, tg, nodes
+        ctx, job, tg, nodes, int_mode=int_mode
     )
 
     # Base candidate limit (reference stack.go:74-86). The MaxInt32 widening

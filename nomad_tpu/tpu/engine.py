@@ -57,7 +57,6 @@ from .encode import (
 logger = logging.getLogger("nomad_tpu.tpu.engine")
 
 MAX_SKIP = 3
-SKIP_SCORE_THRESHOLD = 0.0
 
 
 class EncodedEval:
@@ -131,14 +130,22 @@ def _make_step():
     def step(static, carry, x):
         (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
          dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
-         spread_has_targets, spread_active, sum_spread_weights, n_real) = static
-        used, tg_counts, job_counts, spread_counts, spread_entry, offset, failed = carry
-        tg_idx, penalty_idx, evict_node, evict_res, evict_tg, limit_p, sum_sw_p = x
+         spread_has_targets, spread_active, sum_spread_weights, n_real,
+         e_ask) = static
+        (used, tg_counts, job_counts, spread_counts, spread_entry, offset,
+         failed, e_base) = carry
+        (tg_idx, penalty_idx, evict_node, evict_res, evict_tg, limit_p,
+         sum_sw_p, ev_factor, rev_factor) = x
 
         n_pad = totals.shape[0]
         g_count = asks.shape[0]
         v_plus = spread_desired.shape[-1]
         fdt = totals.dtype
+        # int mode (deterministic/parity): the exact integer spec of
+        # tpu/intscore.py. e_base/e_ask carry the Q27 incremental
+        # exponentials; float (throughput) mode passes them zero-sized.
+        int_mode = jnp.issubdtype(fdt, jnp.integer)
+        i64 = jnp.int64
         g = tg_idx
 
         iota_g = jnp.arange(g_count, dtype=jnp.int32)
@@ -148,39 +155,59 @@ def _make_step():
 
         def pick_g(arr, fill=0):
             # arr[g] without gather/dot: one-hot mask + sum (exactly one
-            # non-zero term, so float results are exact)
+            # non-zero term, so float results are exact). Sum-promotion
+            # (int32 -> int64 under x64) is cast back so carries keep
+            # their dtypes.
             shape = (g_count,) + (1,) * (arr.ndim - 1)
-            return jnp.sum(jnp.where(sel_g.reshape(shape), arr, fill), axis=0)
+            out = jnp.sum(jnp.where(sel_g.reshape(shape), arr, fill), axis=0)
+            return out.astype(arr.dtype)
 
         skip_step = jnp.any(sel_g & failed)
 
         # -- eviction of the previous alloc (one-hot adds) -----------------
-        do_evict = (evict_node >= 0) & (~skip_step)
-        ev_node = jnp.maximum(evict_node, 0)
-        ev_tg = jnp.maximum(evict_tg, 0)
-        oh_ev_node = (iota == ev_node)              # [N]
-        oh_ev_nodef = oh_ev_node.astype(fdt)
-        sel_evg = (iota_g == ev_tg)                 # [G]
+        # shape specialization: an eval with NO destructive updates (the
+        # common case — every fresh placement) encodes evict_res with a
+        # ZERO trailing axis, and the entire eviction/revert machinery
+        # (~15 array passes per step) compiles away.
+        has_evict = evict_res.shape[-1] > 0
+        if has_evict:
+            do_evict = (evict_node >= 0) & (~skip_step)
+            ev_node = jnp.maximum(evict_node, 0)
+            ev_tg = jnp.maximum(evict_tg, 0)
+            oh_ev_node = (iota == ev_node)              # [N]
+            oh_ev_nodef = oh_ev_node.astype(fdt)
+            sel_evg = (iota_g == ev_tg)                 # [G]
 
-        def pick_evg(arr, fill=0):
-            shape = (g_count,) + (1,) * (arr.ndim - 1)
-            return jnp.sum(jnp.where(sel_evg.reshape(shape), arr, fill), axis=0)
+            def pick_evg(arr, fill=0):
+                shape = (g_count,) + (1,) * (arr.ndim - 1)
+                out = jnp.sum(jnp.where(sel_evg.reshape(shape), arr, fill), axis=0)
+                return out.astype(arr.dtype)
 
-        evict_vec = jnp.where(do_evict, evict_res, 0.0)  # [D]
-        used = used - oh_ev_nodef[:, None] * evict_vec[None, :]
-        dec_tg = jnp.where(do_evict & (evict_tg >= 0), 1, 0)
-        tg_counts = tg_counts - (sel_evg[:, None] & oh_ev_node[None, :]) * dec_tg
-        job_counts = job_counts - oh_ev_node * jnp.where(do_evict, 1, 0)
-        # The evicted alloc's spread usage clears too (host: propertyset
-        # cleared_values from plan.node_update; floor-at-zero at read).
-        ev_active = pick_evg(spread_active, False)       # [S]
-        ev_dec = jnp.where(do_evict & (evict_tg >= 0) & ev_active, 1.0, 0.0)
-        vids_evg = pick_evg(spread_vids)                 # [S, N]
-        ev_vid = jnp.sum(jnp.where(oh_ev_node[None, :], vids_evg, 0), axis=1)
-        oh_ev_vid = (iota_v[None, :] == ev_vid[:, None]).astype(fdt)  # [S, V]
-        spread_counts = spread_counts - jnp.where(
-            sel_evg[:, None, None], (oh_ev_vid * ev_dec[:, None])[None, :, :], 0.0
-        )
+            evict_vec = jnp.where(do_evict, evict_res, 0)  # [D]
+            used = used - oh_ev_nodef[:, None] * evict_vec[None, :]
+            dec_tg = jnp.where(do_evict & (evict_tg >= 0), 1, 0)
+            tg_counts = tg_counts - (sel_evg[:, None] & oh_ev_node[None, :]) * dec_tg
+            job_counts = job_counts - oh_ev_node * jnp.where(do_evict, 1, 0)
+            # The evicted alloc's spread usage clears too (host: propertyset
+            # cleared_values from plan.node_update; floor-at-zero at read).
+            ev_active = pick_evg(spread_active, False)       # [S]
+            ev_dec = jnp.where(do_evict & (evict_tg >= 0) & ev_active, 1, 0).astype(fdt)
+            vids_evg = pick_evg(spread_vids)                 # [S, N]
+            ev_vid = jnp.sum(jnp.where(oh_ev_node[None, :], vids_evg, 0), axis=1)
+            oh_ev_vid = (iota_v[None, :] == ev_vid[:, None]).astype(fdt)  # [S, V]
+            spread_counts = spread_counts - jnp.where(
+                sel_evg[:, None, None], (oh_ev_vid * ev_dec[:, None])[None, :, :], 0
+            )
+            # eviction frees capacity -> multiply the node's Q27
+            # exponential by the precomputed per-placement factor
+            if e_base.shape[0]:
+                from .intscore import E27_BITS, E27_ONE
+
+                ev_f = jnp.where(do_evict, ev_factor, E27_ONE).astype(i64)  # [2]
+                eb_ev = (e_base.astype(i64) * ev_f[None, :]) >> E27_BITS
+                e_base = jnp.where(
+                    oh_ev_node[:, None], eb_ev, e_base.astype(i64)
+                ).astype(jnp.int32)
 
         # -- row selects ---------------------------------------------------
         ask = pick_g(asks)                               # [D]
@@ -214,30 +241,27 @@ def _make_step():
         feasible = feas_g & fits & dh_mask  # [N]
 
         # -- score terms ---------------------------------------------------
-        node_cpu = totals[:, DIM_CPU] - reserved[:, DIM_CPU]
-        node_mem = totals[:, DIM_MEM] - reserved[:, DIM_MEM]
-        free_cpu = 1.0 - util[:, DIM_CPU] / jnp.maximum(node_cpu, 1e-9)
-        free_mem = 1.0 - util[:, DIM_MEM] / jnp.maximum(node_mem, 1e-9)
-        fitness = 20.0 - (jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem))
-        binpack = jnp.clip(fitness, 0.0, 18.0) / 18.0
-
-        collisions = tg_counts_g.astype(fdt)
-        anti_present = collisions > 0
-        anti = jnp.where(anti_present, -(collisions + 1.0) / desired_g, 0.0)
-
+        # Two compile-time modes sharing one structure:
+        #   int  (deterministic/parity): the exact integer spec of
+        #        tpu/intscore.py — Q30 terms, Q27 incremental-multiplicative
+        #        exponentials, score60 selection. Bit-identical on every
+        #        backend, so plan parity holds ON the real TPU.
+        #   float (throughput): f32 arithmetic, non-parity.
         # same specialization: no reschedule history -> penalty_idx has a
         # zero K axis and the [N, K] compare disappears
         if penalty_idx.shape[-1] == 0:
             pmask = jnp.zeros(n_pad, bool)
-            resched = jnp.zeros(n_pad, fdt)
         else:
             pmask = jnp.any(iota[:, None] == penalty_idx[None, :], axis=-1)
-            resched = jnp.where(pmask, -1.0, 0.0)
 
-        # spread scoring — value-id lookups as one-hot sums over V
+        node_cpu = totals[:, DIM_CPU] - reserved[:, DIM_CPU]
+        node_mem = totals[:, DIM_MEM] - reserved[:, DIM_MEM]
+        anti_present = tg_counts_g > 0
+
+        # spread row selects (shared) — value-id lookups as one-hot sums
         vids = pick_g(spread_vids)                       # [S, N]
         # floor-at-zero matches the host's cleared-value clamping
-        s_counts = jnp.maximum(pick_g(spread_counts), 0.0)  # [S, V]
+        s_counts = jnp.maximum(pick_g(spread_counts), 0)    # [S, V]
         s_entry = pick_g(spread_entry, False)            # [S, V]
         desired_sv = pick_g(spread_desired)              # [S, V]
         weights_s = pick_g(spread_weights)
@@ -245,60 +269,182 @@ def _make_step():
         active_s = pick_g(spread_active, False)
 
         invalid_bucket = v_plus - 1
-        big = jnp.finfo(fdt).max / 16.0
         oh_vids = vids[:, None, :] == iota_v[None, :, None]  # [S, V, N]
-        current = jnp.sum(jnp.where(oh_vids, s_counts[:, :, None], 0.0), axis=1)
-        used_count = current + 1.0                       # [S, N]
-        d = jnp.sum(jnp.where(oh_vids, desired_sv[:, :, None], 0.0), axis=1)
+        current = jnp.sum(jnp.where(oh_vids, s_counts[:, :, None], 0), axis=1)
+        d = jnp.sum(jnp.where(oh_vids, desired_sv[:, :, None], 0), axis=1)
         missing = vids == invalid_bucket
-        # divisor: the host SpreadIterator's weight sum accumulates across
-        # visited task groups in the eval -> passed per placement (sum_sw_p)
-        weight_frac = weights_s[:, None] / jnp.maximum(sum_sw_p, 1e-9)
-        # Go float semantics: d == 0 -> -Inf boost (clamped large negative)
-        targeted_raw = jnp.where(
-            d > 0.0,
-            (d - used_count) / jnp.where(d > 0.0, d, 1.0) * weight_frac,
-            jnp.where(d == 0.0, -big, -1.0),  # d<0 means no target -> -1
-        )
-
-        # even-spread boost
-        entry_counts = jnp.where(s_entry[:, :invalid_bucket], s_counts[:, :invalid_bucket], jnp.inf)
         has_entries = jnp.any(s_entry[:, :invalid_bucket], axis=-1)  # [S]
-        min_c = jnp.where(has_entries, jnp.min(entry_counts, axis=-1), 0.0)  # [S]
-        max_counts = jnp.where(s_entry[:, :invalid_bucket], s_counts[:, :invalid_bucket], -jnp.inf)
-        max_c = jnp.where(has_entries, jnp.max(max_counts, axis=-1), 0.0)
-        delta_boost = jnp.where(
-            min_c[:, None] == 0.0, -1.0, (min_c[:, None] - current) / jnp.maximum(min_c[:, None], 1e-9)
-        )
-        even = jnp.where(
-            current != min_c[:, None],
-            delta_boost,
-            jnp.where(
-                min_c[:, None] == max_c[:, None],
-                -1.0,
+
+        if int_mode:
+            from .intscore import (
+                BIG_FP,
+                E27_BITS,
+                E27_ONE,
+                RECIP_BITS,
+                TERM_BITS,
+                TERM_ONE,
+            )
+
+            # selection-time exponentials: e_base (running product in the
+            # carry) times the static per-TG ask factor — 10**(free - ask/cap)
+            ea = pick_g(e_ask)                                 # [N, 2] int32
+            e_sel = (e_base.astype(i64) * ea.astype(i64)) >> E27_BITS
+            e_sel_i32 = e_sel.astype(jnp.int32)                # placement update
+            fit = i64(20 * E27_ONE) - e_sel[:, 0] - e_sel[:, 1]
+            fit = jnp.clip(fit, 0, 18 * E27_ONE)
+            # Q30 = fit * 2**30 / (18 * 2**27) = (fit*4)//9 (const divisor)
+            binpack = (fit * 4) // 9
+
+            rsh = RECIP_BITS - TERM_BITS
+            # -(c+1)/desired via the Q45 reciprocal of the (small, per-step
+            # scalar) desired count — error < 4 Q30-ulp
+            q_d = jnp.floor_divide(
+                i64(1 << RECIP_BITS), jnp.maximum(desired_g.astype(i64), 1)
+            )
+            anti = jnp.where(
+                anti_present,
+                -(((tg_counts_g.astype(i64) + 1) * q_d) >> rsh),
+                0,
+            )
+            resched = jnp.where(pmask, i64(-TERM_ONE), i64(0))
+
+            d64 = d.astype(i64)
+            u64 = current.astype(i64) + 1
+            w64 = weights_s.astype(i64)[:, None]
+            sw64 = jnp.maximum(sum_sw_p.astype(i64), 1)
+            # targeted boost: ((d - u)/d)*(w/sum_w) as ONE fused Q30
+            # rational, floor-rounded (d in hundredths: d = pct*count)
+            t_num = (d64 - 100 * u64) * w64 * TERM_ONE
+            t_den = jnp.maximum(d64, 1) * sw64
+            targeted_raw = jnp.where(
+                d64 > 0,
+                jnp.floor_divide(t_num, t_den),
+                jnp.where(d64 == 0, i64(-BIG_FP), i64(-TERM_ONE)),
+            )
+
+            # even-spread boost (same branch structure as the host);
+            # divisions by min_c (a count) via its Q45 reciprocal — [S]-
+            # shaped, so the division is off the hot [N] axis
+            LARGE = i64(1) << 40
+            sc64 = s_counts.astype(i64)[:, :invalid_bucket]
+            se = s_entry[:, :invalid_bucket]
+            min_c = jnp.where(
+                has_entries, jnp.min(jnp.where(se, sc64, LARGE), axis=-1), 0
+            )  # [S]
+            max_c = jnp.where(
+                has_entries, jnp.max(jnp.where(se, sc64, -LARGE), axis=-1), 0
+            )
+            r_min = jnp.floor_divide(
+                i64(1 << RECIP_BITS), jnp.maximum(min_c, 1)
+            )  # [S]
+            min_cn = min_c[:, None]
+            cur64 = current.astype(i64)
+            delta_boost = jnp.where(
+                min_cn == 0,
+                i64(-TERM_ONE),
+                ((min_cn - cur64) * r_min[:, None]) >> rsh,
+            )
+            even = jnp.where(
+                cur64 != min_cn,
+                delta_boost,
                 jnp.where(
-                    min_c[:, None] == 0.0,
-                    1.0,
-                    (max_c[:, None] - min_c[:, None]) / jnp.maximum(min_c[:, None], 1e-9),
+                    min_cn == max_c[:, None],
+                    i64(-TERM_ONE),
+                    jnp.where(
+                        min_cn == 0,
+                        i64(TERM_ONE),
+                        ((max_c[:, None] - min_cn) * r_min[:, None]) >> rsh,
+                    ),
                 ),
-            ),
-        )
-        even = jnp.where(has_entries[:, None], even, 0.0)
+            )
+            even = jnp.where(has_entries[:, None], even, 0)
 
-        per_spread = jnp.where(has_targets_s[:, None], targeted_raw, even)
-        per_spread = jnp.where(missing, -1.0, per_spread)
-        per_spread = jnp.where(active_s[:, None], per_spread, 0.0)
-        spread_total = jnp.sum(per_spread, axis=0)  # [N]
-        spread_p = spread_total != 0.0
+            per_spread = jnp.where(has_targets_s[:, None], targeted_raw, even)
+            per_spread = jnp.where(missing, i64(-TERM_ONE), per_spread)
+            per_spread = jnp.where(active_s[:, None], per_spread, 0)
+            spread_total = jnp.sum(per_spread, axis=0)  # [N] int64
+            spread_p = spread_total != 0
 
-        num_terms = (
-            1.0
-            + anti_present.astype(fdt)
-            + pmask.astype(fdt)
-            + aff_p.astype(fdt)
-            + spread_p.astype(fdt)
-        )
-        final = (binpack + anti + resched + jnp.where(aff_p, aff, 0.0) + spread_total) / num_terms
+            num_terms = (
+                1
+                + anti_present.astype(jnp.int32)
+                + pmask.astype(jnp.int32)
+                + aff_p.astype(jnp.int32)
+                + spread_p.astype(jnp.int32)
+            )
+            # mean of terms via EXACT scale-by-60 (all of 1..5 divide 60)
+            factor = jnp.floor_divide(60, num_terms).astype(i64)
+            final = (
+                binpack + anti + resched
+                + jnp.where(aff_p, aff.astype(i64), 0) + spread_total
+            ) * factor
+            neg_inf = jnp.iinfo(jnp.int64).min // 4
+            score_zero = i64(0)
+        else:
+            free_cpu = 1.0 - util[:, DIM_CPU] / jnp.maximum(node_cpu, 1e-9)
+            free_mem = 1.0 - util[:, DIM_MEM] / jnp.maximum(node_mem, 1e-9)
+            fitness = 20.0 - (jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem))
+            binpack = jnp.clip(fitness, 0.0, 18.0) / 18.0
+
+            collisions = tg_counts_g.astype(fdt)
+            anti = jnp.where(anti_present, -(collisions + 1.0) / desired_g.astype(fdt), 0.0)
+            resched = jnp.where(pmask, -1.0, 0.0)
+
+            big = jnp.finfo(fdt).max / 16.0
+            used_count = current.astype(fdt) + 1.0           # [S, N]
+            df = d.astype(fdt)
+            # divisor: the host SpreadIterator's weight sum accumulates
+            # across visited task groups -> passed per placement (sum_sw_p)
+            weight_frac = weights_s[:, None] / jnp.maximum(sum_sw_p, 1e-9)
+            # Go float semantics: d == 0 -> -Inf boost (clamped large neg)
+            targeted_raw = jnp.where(
+                df > 0.0,
+                (df - used_count) / jnp.where(df > 0.0, df, 1.0) * weight_frac,
+                jnp.where(df == 0.0, -big, -1.0),  # d<0: no target -> -1
+            )
+
+            # even-spread boost
+            scf = s_counts.astype(fdt)[:, :invalid_bucket]
+            entry_counts = jnp.where(s_entry[:, :invalid_bucket], scf, jnp.inf)
+            min_c = jnp.where(has_entries, jnp.min(entry_counts, axis=-1), 0.0)  # [S]
+            max_counts = jnp.where(s_entry[:, :invalid_bucket], scf, -jnp.inf)
+            max_c = jnp.where(has_entries, jnp.max(max_counts, axis=-1), 0.0)
+            currentf = current.astype(fdt)
+            delta_boost = jnp.where(
+                min_c[:, None] == 0.0, -1.0,
+                (min_c[:, None] - currentf) / jnp.maximum(min_c[:, None], 1e-9)
+            )
+            even = jnp.where(
+                currentf != min_c[:, None],
+                delta_boost,
+                jnp.where(
+                    min_c[:, None] == max_c[:, None],
+                    -1.0,
+                    jnp.where(
+                        min_c[:, None] == 0.0,
+                        1.0,
+                        (max_c[:, None] - min_c[:, None]) / jnp.maximum(min_c[:, None], 1e-9),
+                    ),
+                ),
+            )
+            even = jnp.where(has_entries[:, None], even, 0.0)
+
+            per_spread = jnp.where(has_targets_s[:, None], targeted_raw, even)
+            per_spread = jnp.where(missing, -1.0, per_spread)
+            per_spread = jnp.where(active_s[:, None], per_spread, 0.0)
+            spread_total = jnp.sum(per_spread, axis=0)  # [N]
+            spread_p = spread_total != 0.0
+
+            num_terms = (
+                1.0
+                + anti_present.astype(fdt)
+                + pmask.astype(fdt)
+                + aff_p.astype(fdt)
+                + spread_p.astype(fdt)
+            )
+            final = (binpack + anti + resched + jnp.where(aff_p, aff, 0.0) + spread_total) / num_terms
+            neg_inf = -jnp.inf
+            score_zero = jnp.asarray(0.0, fdt)
 
         # -- ring-ordered limit + max-score selection (no permutation) -----
         # Ring prefix sums at natural index i: with S = natural inclusive
@@ -317,7 +463,9 @@ def _make_step():
             )
 
         feas_v = feasible & valid
-        low = feas_v & (final <= SKIP_SCORE_THRESHOLD)
+        # threshold 0 is exact in both modes (int: score60 <= 0 iff the
+        # rational score <= 0; float: the host's 0.0 skip threshold)
+        low = feas_v & (final <= 0)
         low_i = low.astype(jnp.int32)
         low_cum = ring_cumsum(low_i)
         skipped = low & (low_cum <= MAX_SKIP)
@@ -341,7 +489,6 @@ def _make_step():
         # backlog ranks), so (max score, min rank) names one node exactly
         rank = jnp.where(src_cand, ret_excl, ret_total + skip_excl)
 
-        neg_inf = -jnp.inf
         cand_scores = jnp.where(cand, final, neg_inf)
         best_score = jnp.max(cand_scores)
         winners = cand & (cand_scores == best_score)
@@ -362,7 +509,7 @@ def _make_step():
         ch = jnp.maximum(chosen, 0)
         oh_ch = (iota == ch)
         oh_chf = oh_ch.astype(fdt)
-        add_vec = jnp.where(success, ask, 0.0)
+        add_vec = jnp.where(success, ask, 0)
         used = used + oh_chf[:, None] * add_vec[None, :]
         inc_i = jnp.where(success, 1, 0)
         tg_counts = tg_counts + (sel_g[:, None] & oh_ch[None, :]) * inc_i
@@ -370,28 +517,43 @@ def _make_step():
 
         ch_vid = jnp.sum(jnp.where(oh_ch[None, :], vids, 0), axis=1)  # [S]
         oh_ch_vid = (iota_v[None, :] == ch_vid[:, None])              # [S, V]
-        inc = jnp.where(success & active_s, 1.0, 0.0)
+        inc = jnp.where(success & active_s, 1, 0).astype(fdt)
         spread_counts = spread_counts + jnp.where(
-            sel_g[:, None, None], (oh_ch_vid.astype(fdt) * inc[:, None])[None, :, :], 0.0
+            sel_g[:, None, None], (oh_ch_vid.astype(fdt) * inc[:, None])[None, :, :], 0
         )
         entry_set = sel_g[:, None, None] & (oh_ch_vid & (inc > 0)[:, None])[None, :, :]
         spread_entry = spread_entry | entry_set
 
+        # placement commits the chosen node's new exponential — EXACTLY the
+        # already-computed selection value (running-product spec)
+        if e_base.shape[0]:
+            e_base = jnp.where((oh_ch & success)[:, None], e_sel_i32, e_base)
+
         # failed placement: revert eviction, mark TG failed
-        revert = do_evict & (~success)
-        used = used + oh_ev_nodef[:, None] * jnp.where(revert, evict_res, 0.0)[None, :]
-        rev_i = jnp.where(revert & (evict_tg >= 0), 1, 0)
-        tg_counts = tg_counts + (sel_evg[:, None] & oh_ev_node[None, :]) * rev_i
-        job_counts = job_counts + oh_ev_node * jnp.where(revert, 1, 0)
-        spread_counts = spread_counts + jnp.where(
-            sel_evg[:, None, None],
-            (oh_ev_vid * jnp.where(revert, ev_dec, 0.0)[:, None])[None, :, :],
-            0.0,
-        )
+        if has_evict:
+            revert = do_evict & (~success)
+            used = used + oh_ev_nodef[:, None] * jnp.where(revert, evict_res, 0)[None, :]
+            rev_i = jnp.where(revert & (evict_tg >= 0), 1, 0)
+            tg_counts = tg_counts + (sel_evg[:, None] & oh_ev_node[None, :]) * rev_i
+            job_counts = job_counts + oh_ev_node * jnp.where(revert, 1, 0)
+            spread_counts = spread_counts + jnp.where(
+                sel_evg[:, None, None],
+                (oh_ev_vid * jnp.where(revert, ev_dec, 0).astype(fdt)[:, None])[None, :, :],
+                0,
+            )
+            if e_base.shape[0]:
+                from .intscore import E27_BITS as _E27B, E27_ONE as _E27O
+
+                rev_f = jnp.where(revert, rev_factor, _E27O).astype(i64)  # [2]
+                eb_rev = (e_base.astype(i64) * rev_f[None, :]) >> _E27B
+                e_base = jnp.where(
+                    oh_ev_node[:, None], eb_rev, e_base.astype(i64)
+                ).astype(jnp.int32)
         failed = failed | (sel_g & ((~success) & (~skip_step)))
 
-        new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry, offset, failed)
-        out = (chosen, jnp.where(success, best_score, 0.0), pulls, skip_step)
+        new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry,
+                     offset, failed, e_base)
+        out = (chosen, jnp.where(success, best_score, score_zero), pulls, skip_step)
         return new_carry, out
 
     return step
@@ -400,8 +562,9 @@ def _make_step():
 def _build_place_scan():
     import jax
 
-    # Parity mode scores in float64 (the host pipeline is float64; float32
-    # collapses sub-ULP score differences into ties and flips selections).
+    # x64 for the int64 score intermediates of the exact integer spec
+    # (intscore.py). Parity mode carries int32 arrays and compares int64
+    # score60s — bit-identical on every backend, including the real TPU.
     jax.config.update("jax_enable_x64", True)
     step = _make_step()
 
@@ -553,13 +716,36 @@ class TpuPlacementEngine:
             table = build_node_table(ctx, job, nodes)
         except UnsupportedByEngine as e:
             return fallback(str(e))
-        _metrics.incr_counter("nomad.tpu_engine.handled")
         device_dims = job_device_dims(job)  # validated above; never raises here
         num_dims = table.totals.shape[1]    # 4 + the job's device dims
         start = _time.monotonic_ns()
 
-        # float64 for exact host parity; float32 for throughput (MXU-friendly)
-        fdtype = np.float64 if ctx.deterministic else np.float32
+        # Deterministic (parity) mode: the exact INTEGER spec of
+        # intscore.py — int32 arrays, int64 score60 selection, bit-exact
+        # on every backend including the real TPU. Non-deterministic:
+        # float32 throughput mode.
+        int_mode = bool(ctx.deterministic)
+        fdtype = np.int32 if int_mode else np.float32
+        if int_mode:
+            from .intscore import MAX_TOTAL_COUNT
+
+            # magnitude gates keeping every int64 intermediate exact
+            # (see intscore.py module doc)
+            caps = table.totals[:, :2]
+            node_c = caps - table.reserved[:, :2]
+            if caps.size and (
+                caps.max() > (1 << 24)
+                or node_c.min() < 1
+                or (table.reserved[:, :2] > 2 * node_c).any()
+            ):
+                return fallback("int-spec cpu/mem magnitude gate")
+            if table.totals.size and table.totals.max() > (1 << 28):
+                return fallback("int-spec capacity magnitude gate")
+            if sum(g.count for g in job.task_groups) > MAX_TOTAL_COUNT:
+                return fallback("int-spec job count gate")
+            if any(spec.ask.max(initial=0) > (1 << 28) for spec in tg_specs.values()):
+                return fallback("int-spec ask magnitude gate")
+        _metrics.incr_counter("nomad.tpu_engine.handled")
 
         n_pad = _round_up(max(n_real, 1))
         g_count = len(job.task_groups)
@@ -579,6 +765,17 @@ class TpuPlacementEngine:
         reserved[:n_real] = table.reserved
         used0 = np.zeros((n_pad, num_dims), fdtype)
         used0[:n_real] = table.used
+
+        # Q27 incremental exponentials (int mode): e_base0 per node from
+        # the encode-time chain; e_ask static ask factors per TG
+        if int_mode:
+            from .intscore import E27_ONE, e27_np, xq_np
+
+            node_c2 = (totals[:, :2] - reserved[:, :2]).astype(np.int64)  # [N,2]
+            free0 = node_c2 - used0[:, :2] - reserved[:, :2]
+            e_base0 = e27_np(xq_np(free0, node_c2)).astype(np.int32)
+        else:
+            e_base0 = np.zeros((0, 2), np.int32)
         tg_counts0 = np.zeros((g_count, n_pad), np.int32)
         tg_counts0[:, :n_real] = table.tg_counts
         job_counts0 = np.zeros(n_pad, np.int32)
@@ -603,8 +800,19 @@ class TpuPlacementEngine:
         spread_counts0 = np.zeros((g_count, sv, vv), fdtype)
         spread_entry0 = np.zeros((g_count, sv, vv), bool)
 
+        if int_mode:
+            e_ask = np.full((g_count, n_pad, 2), E27_ONE, np.int32)
+        else:
+            e_ask = np.zeros((0, 0, 2), np.int32)
+
         for gi, spec in specs_by_gi.items():
             asks[gi] = spec.ask
+            if int_mode:
+                for d in (0, 1):
+                    e_ask[gi, :, d] = e27_np(
+                        xq_np(np.full(n_pad, -int(spec.ask[d]), np.int64),
+                              node_c2[:, d])
+                    ).astype(np.int32)
             feas[gi, :n_real] = spec.feasible
             aff_score[gi, :n_real] = spec.affinity_score
             aff_present[gi, :n_real] = spec.affinity_present
@@ -636,6 +844,11 @@ class TpuPlacementEngine:
         evict_tg = np.full(p, -1, np.int32)
         limit_p = np.zeros(p, np.int32)
         sum_sw_p = np.zeros(p, fdtype)
+        _e27one = 1
+        if int_mode:
+            from .intscore import E27_ONE as _e27one  # noqa: N811
+        ev_factor = np.full((p, 2), _e27one, np.int32)
+        rev_factor = np.full((p, 2), _e27one, np.int32)
 
         # Sticky limit widening + cross-TG spread-weight accumulation,
         # replicating the shared SpreadIterator/LimitIterator state in the
@@ -697,6 +910,16 @@ class TpuPlacementEngine:
                     evict_res[pi, DIM_MBITS] = mb
                     if prev.job_id == job.id:
                         evict_tg[pi] = tg_name_to_gi.get(prev.task_group, -1)
+                    if int_mode:
+                        # eviction/revert Q27 factors (evicted node known
+                        # at encode time; spec: e27(±evict_res/cap))
+                        from .intscore import e27_py, xq_py
+
+                        for d in (0, 1):
+                            er = int(evict_res[pi, d])
+                            nc = int(node_c2[idx, d])
+                            ev_factor[pi, d] = e27_py(xq_py(er, nc))
+                            rev_factor[pi, d] = e27_py(xq_py(-er, nc))
 
         # shape specialization: absent features collapse to zero axes so
         # the step compiles without their ops (see _make_step)
@@ -705,20 +928,26 @@ class TpuPlacementEngine:
             aff_present = aff_present[:0]
         if (penalty_idx == -1).all():
             penalty_idx = penalty_idx[:, :0]
+        if (evict_node == -1).all():
+            # no destructive updates: the step's eviction/revert machinery
+            # compiles away entirely
+            evict_res = evict_res[:, :0]
+            ev_factor = ev_factor[:, :0]
+            rev_factor = rev_factor[:, :0]
 
         static = (
             totals, reserved, asks, feas, aff_score, aff_present,
             desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
             spread_weights, spread_has_targets, spread_active,
-            sum_spread_weights, np.int32(n_real),
+            sum_spread_weights, np.int32(n_real), e_ask,
         )
         init_carry = (
             used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
-            np.int32(0), np.zeros(g_count, bool),
+            np.int32(0), np.zeros(g_count, bool), e_base0,
         )
         xs = (
             tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
-            limit_p, sum_sw_p,
+            limit_p, sum_sw_p, ev_factor, rev_factor,
         )
 
         return EncodedEval(
@@ -859,8 +1088,16 @@ class TpuPlacementEngine:
                     sched.plan.pop_update(prev_allocation)
                 continue
 
-            metrics.score_node(node, "binpack", float(scores[pi]))
-            metrics.score_node(node, "normalized-score", float(scores[pi]))
+            if scores.dtype.kind == "i":
+                # int-spec score60 -> display float (metrics only; never
+                # used in selection comparisons)
+                from .intscore import score60_to_float
+
+                score_f = score60_to_float(scores[pi])
+            else:
+                score_f = float(scores[pi])
+            metrics.score_node(node, "binpack", score_f)
+            metrics.score_node(node, "normalized-score", score_f)
             metrics.populate_score_meta_data()
 
             resources = AllocatedResources(
@@ -916,8 +1153,13 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
     """Build plausible dense scan inputs directly (no scheduler objects).
 
     Returns (n_pad, static, init_carry, xs) as numpy arrays, shaped exactly
-    like compute_placements builds them.
+    like compute_placements builds them. ``dtype=np.int32`` builds the
+    exact-integer parity encoding (spread targets in hundredths, Q30
+    affinity ints — the intscore.py spec); float dtypes build the
+    throughput encoding.
     """
+    dtype = np.dtype(dtype)
+    int_mode = dtype.kind == "i"
     rng = np.random.default_rng(seed)
     n_pad = _round_up(n_nodes)
     g, s, v = n_tgs, max(n_spreads, 1), vocab + 1
@@ -951,30 +1193,56 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
 
     spread_vids = np.full((g, s, n_pad), v - 1, np.int32)
     spread_vids[:, :, :n_nodes] = rng.integers(0, vocab, (g, s, n_nodes))
-    spread_desired = np.full((g, s, v), -1.0, dtype)
-    spread_desired[:, :, :vocab] = float(n_placements) / vocab
-    spread_weights = np.full((g, s), 50.0, dtype)
+    spread_desired = np.full((g, s, v), -1, dtype) if int_mode else \
+        np.full((g, s, v), -1.0, dtype)
+    if int_mode:
+        # hundredths (d = percent * count), evenly targeted
+        spread_desired[:, :, :vocab] = (100 * n_placements) // vocab
+    else:
+        spread_desired[:, :, :vocab] = float(n_placements) / vocab
+    spread_weights = np.full((g, s), 50, dtype)
     spread_has_targets = np.ones((g, s), bool)
     spread_active = np.zeros((g, s), bool)
     spread_active[:, :n_spreads] = True
-    sum_spread_weights = np.full(g, 50.0 * max(n_spreads, 1), dtype)
+    sum_spread_weights = np.full(g, 50 * max(n_spreads, 1), dtype)
     spread_counts0 = np.zeros((g, s, v), dtype)
     spread_entry0 = np.zeros((g, s, v), bool)
+
+    if int_mode:
+        from .intscore import E27_ONE, e27_np, xq_np
+
+        node_c2 = (totals[:, :2] - reserved[:, :2]).astype(np.int64)
+        e_base0 = e27_np(xq_np(node_c2 - used0[:, :2] - reserved[:, :2],
+                               node_c2)).astype(np.int32)
+        e_ask = np.full((g, n_pad, 2), E27_ONE, np.int32)
+        for gi in range(g):
+            for d in (0, 1):
+                e_ask[gi, :, d] = e27_np(
+                    xq_np(np.full(n_pad, -int(asks[gi, d]), np.int64),
+                          node_c2[:, d])
+                ).astype(np.int32)
+    else:
+        e_base0 = np.zeros((0, 2), np.int32)
+        e_ask = np.zeros((0, 0, 2), np.int32)
 
     static = (totals, reserved, asks, feas, aff_score, aff_present,
               desired_counts, dh_job, dh_tg, limits, spread_vids,
               spread_desired, spread_weights, spread_has_targets,
-              spread_active, sum_spread_weights, np.int32(n_nodes))
+              spread_active, sum_spread_weights, np.int32(n_nodes), e_ask)
     init_carry = (used0, np.zeros((g, n_pad), np.int32), np.zeros(n_pad, np.int32),
-                  spread_counts0, spread_entry0, np.int32(0), np.zeros(g, bool))
+                  spread_counts0, spread_entry0, np.int32(0), np.zeros(g, bool),
+                  e_base0)
     limit_val = max(2, int(np.ceil(np.log2(max(n_nodes, 2)))))
     xs = (rng.integers(0, g, n_placements).astype(np.int32),
           np.full((n_placements, 0), -1, np.int32),  # no reschedule history
           np.full(n_placements, -1, np.int32),
-          np.zeros((n_placements, num_dims), dtype),
+          # no evictions: zero-width axes compile the evict path away
+          np.zeros((n_placements, 0), dtype),
           np.full(n_placements, -1, np.int32),
           np.full(n_placements, 2**31 - 1 if n_spreads else limit_val, np.int32),
-          np.full(n_placements, 50.0 * max(n_spreads, 1), dtype))
+          np.full(n_placements, 50 * max(n_spreads, 1), dtype),
+          np.zeros((n_placements, 0), np.int32),
+          np.zeros((n_placements, 0), np.int32))
     return n_pad, static, init_carry, xs
 
 
@@ -1017,8 +1285,10 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
         carry, deficit = carry_and_deficit
         (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
          dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
-         spread_has_targets, spread_active, sum_spread_weights, n_real) = static
-        used, tg_counts, job_counts, spread_counts, spread_entry, offset, failed = carry
+         spread_has_targets, spread_active, sum_spread_weights, n_real,
+         *_extra) = static
+        (used, tg_counts, job_counts, spread_counts, spread_entry, offset,
+         failed, *_cextra) = carry
         tg_idx, want = x
 
         n_pad = totals.shape[0]
@@ -1125,7 +1395,8 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
             sel_g[:, None, None], add_sv[None, :, :], 0.0
         )
 
-        new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry, offset, failed)
+        new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry,
+                     offset, failed, *_cextra)
         out = (top_idx, jnp.where(valid, top_scores, 0.0), valid, placed)
         return (new_carry, deficit), out
 

@@ -303,11 +303,13 @@ class TestConsulConnect:
             # the proxy advertises the injected dynamic port
             assert proxy["Port"] > 0
 
-            # stop -> deregistered
+            # stop -> THIS alloc's service instances deregister. Assert by
+            # service ID (which embeds the alloc id): the scheduler may
+            # already have placed a replacement alloc that re-registers
+            # the same service NAMES, so name-based checks race.
             server.stop_alloc(alloc.id)
             wait_until(
-                lambda: not any("countdash" in s["Name"]
-                                for s in consul.services.values()),
+                lambda: not any(alloc.id in sid for sid in consul.services),
                 msg="group services deregistered",
             )
         finally:
