@@ -205,13 +205,15 @@ def bench_parity_scan_single(n_nodes=5000, n_placements=10_000):
 # queue -> raft/FSM (BASELINE benchmark configs, scaled for wall time)
 # ---------------------------------------------------------------------------
 
-def bench_system(name, n_nodes, jobs, workers=4, device_batch=8,
+def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                  timeout=180.0, node_seed=0, warmup=None):
     """Run ``jobs`` through a real in-proc server; returns metrics dict.
 
-    ``warmup`` (a job factory) runs one throwaway job through the full
-    path first so jit compiles for this cluster's shape buckets land
-    outside the timed wall."""
+    ``workers`` is 2x the device batch so the next wave encodes while the
+    current batch is on the device. ``warmup`` (a job factory) runs one
+    throwaway job through the full path first so jit compiles for this
+    cluster's shape buckets land outside the timed wall (and the
+    persistent XLA cache makes repeat runs cheap)."""
     from nomad_tpu import mock
     from nomad_tpu.server.fsm import NODE_REGISTER
     from nomad_tpu.server.server import Server, ServerConfig
@@ -219,7 +221,7 @@ def bench_system(name, n_nodes, jobs, workers=4, device_batch=8,
     rng = np.random.default_rng(node_seed)
     server = Server(ServerConfig(
         num_schedulers=0, device_batch=device_batch,
-        device_batch_window_ms=2.0,
+        device_batch_window_ms=25.0,
         heartbeat_min_ttl=3600, heartbeat_max_ttl=7200,
     ))
     server.start()

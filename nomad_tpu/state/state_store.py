@@ -59,6 +59,13 @@ class StateStore:
         # vault_accessors table)
         self.vault_accessors_table: Dict[str, list] = {}
 
+        # Incremental per-node usage mirror: node_id -> (cpu, mem, disk,
+        # mbits) summed over NON-terminal allocs, updated on every alloc
+        # write. Rows are immutable tuples, so snapshots share them via a
+        # shallow dict copy. Consumed by the TPU encode layer, replacing
+        # O(nodes) per-eval queries with an O(1) lookup per node.
+        self._node_usage: Dict[str, tuple] = {}
+
         # secondary indexes
         self._allocs_by_node: Dict[str, set] = {}
         self._allocs_by_job: Dict[Tuple[str, str], set] = {}
@@ -79,6 +86,21 @@ class StateStore:
         self.__dict__.update(d)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
+        # Pickles from pre-mirror builds lack the usage mirror: rebuild it
+        # from the alloc table so writes and snapshots keep working.
+        if "_node_usage" not in self.__dict__:
+            from ..structs.funcs import alloc_usage_vec
+
+            usage: Dict[str, tuple] = {}
+            for alloc in self.allocs_table.values():
+                if alloc.terminal_status():
+                    continue
+                u = alloc_usage_vec(alloc)
+                row = usage.get(alloc.node_id, (0.0, 0.0, 0.0, 0.0))
+                usage[alloc.node_id] = (
+                    row[0] + u[0], row[1] + u[1], row[2] + u[2], row[3] + u[3]
+                )
+            self._node_usage = usage
 
     # ------------------------------------------------------------------
     # snapshots / blocking
@@ -108,6 +130,7 @@ class StateStore:
             snap.vault_accessors_table = {
                 k: list(v) for k, v in self.vault_accessors_table.items()
             }
+            snap._node_usage = dict(self._node_usage)  # rows are immutable
             snap._allocs_by_node = {k: set(v) for k, v in self._allocs_by_node.items()}
             snap._allocs_by_job = {k: set(v) for k, v in self._allocs_by_job.items()}
             snap._allocs_by_eval = {k: set(v) for k, v in self._allocs_by_eval.items()}
@@ -340,10 +363,25 @@ class StateStore:
     # allocs
     # ------------------------------------------------------------------
 
+    def _usage_delta(self, alloc: Allocation, sign: float) -> None:
+        if alloc.terminal_status():
+            return
+        from ..structs.funcs import alloc_usage_vec
+
+        u = alloc_usage_vec(alloc)
+        row = self._node_usage.get(alloc.node_id)
+        if row is None:
+            row = (0.0, 0.0, 0.0, 0.0)
+        self._node_usage[alloc.node_id] = (
+            row[0] + sign * u[0], row[1] + sign * u[1],
+            row[2] + sign * u[2], row[3] + sign * u[3],
+        )
+
     def _index_alloc(self, alloc: Allocation) -> None:
         self._allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
         self._allocs_by_job.setdefault((alloc.namespace, alloc.job_id), set()).add(alloc.id)
         self._allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
+        self._usage_delta(alloc, +1.0)
 
     def _remove_alloc_index(self, alloc_id: str) -> None:
         alloc = self.allocs_table.get(alloc_id)
@@ -352,6 +390,7 @@ class StateStore:
         self._allocs_by_node.get(alloc.node_id, set()).discard(alloc_id)
         self._allocs_by_job.get((alloc.namespace, alloc.job_id), set()).discard(alloc_id)
         self._allocs_by_eval.get(alloc.eval_id, set()).discard(alloc_id)
+        self._usage_delta(alloc, -1.0)
 
     def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
         with self._lock:
@@ -459,6 +498,18 @@ class StateStore:
             # reference's "all" flag includes allocs of all job create indexes;
             # for scheduling purposes all=True is used.
             pass
+        return out
+
+    def allocs_by_job_id(self, job_id: str) -> List[Allocation]:
+        """Allocs with this job id across ALL namespaces — the scheduler's
+        job anti-affinity matches job_id alone (rank.go:509), so its dense
+        encoding must too."""
+        out = []
+        for (_ns, jid), ids in self._allocs_by_job.items():
+            if jid == job_id:
+                out.extend(
+                    self.allocs_table[a] for a in ids if a in self.allocs_table
+                )
         return out
 
     def allocs_by_eval(self, eval_id: str) -> List[Allocation]:

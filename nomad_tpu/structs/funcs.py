@@ -18,6 +18,28 @@ from .structs import Allocation, ComparableResources, Node
 BIN_PACKING_MAX_FIT_SCORE = 18.0
 
 
+def alloc_usage_vec(alloc: Allocation) -> tuple:
+    """(cpu, mem, disk, mbits) consumed by one alloc; memoized on the
+    (immutable — stores insert copies) alloc object. Shared by the state
+    store's incremental per-node usage mirror and the TPU encode layer."""
+    u = alloc.__dict__.get("_usage_vec")
+    if u is None:
+        cr = alloc.comparable_resources()
+        mb = 0
+        if alloc.allocated_resources is not None:
+            for net in alloc.allocated_resources.shared.networks:
+                mb += net.mbits
+            for tr in alloc.allocated_resources.tasks.values():
+                for net in tr.networks:
+                    mb += net.mbits
+        u = (
+            float(cr.flattened.cpu_shares), float(cr.flattened.memory_mb),
+            float(cr.shared.disk_mb), float(mb),
+        )
+        alloc.__dict__["_usage_vec"] = u
+    return u
+
+
 def remove_allocs(allocs: List[Allocation], remove: List[Allocation]) -> List[Allocation]:
     """Remove by alloc ID (order NOT preserved beyond filtering)."""
     remove_set = {a.id for a in remove}

@@ -207,6 +207,11 @@ class AllocatedDeviceResource:
     def id(self) -> DeviceIdTuple:
         return DeviceIdTuple(self.vendor, self.type, self.name)
 
+    def copy(self) -> "AllocatedDeviceResource":
+        return AllocatedDeviceResource(
+            self.vendor, self.type, self.name, list(self.device_ids)
+        )
+
 
 @dataclass
 class AllocatedTaskResources:
@@ -214,6 +219,13 @@ class AllocatedTaskResources:
     memory_mb: int = 0
     networks: List[NetworkResource] = field(default_factory=list)
     devices: List[AllocatedDeviceResource] = field(default_factory=list)
+
+    def copy(self) -> "AllocatedTaskResources":
+        return AllocatedTaskResources(
+            self.cpu_shares, self.memory_mb,
+            [n.copy() for n in self.networks],
+            [d.copy() for d in self.devices],
+        )
 
     def add(self, other: "AllocatedTaskResources") -> None:
         self.cpu_shares += other.cpu_shares
@@ -244,11 +256,21 @@ class AllocatedSharedResources:
     disk_mb: int = 0
     networks: List[NetworkResource] = field(default_factory=list)
 
+    def copy(self) -> "AllocatedSharedResources":
+        return AllocatedSharedResources(
+            self.disk_mb, [n.copy() for n in self.networks]
+        )
+
 
 @dataclass
 class AllocatedResources:
     tasks: Dict[str, AllocatedTaskResources] = field(default_factory=dict)
     shared: AllocatedSharedResources = field(default_factory=AllocatedSharedResources)
+
+    def copy(self) -> "AllocatedResources":
+        return AllocatedResources(
+            {k: v.copy() for k, v in self.tasks.items()}, self.shared.copy()
+        )
 
     def comparable(self) -> "ComparableResources":
         c = ComparableResources()
@@ -1033,14 +1055,35 @@ class Allocation:
         return _copy.deepcopy(self)
 
     def copy_skip_job(self) -> "Allocation":
-        """Deep copy sharing the (immutable) job. Must not mutate self —
-        concurrent snapshot readers share this object."""
+        """Copy sharing the (immutable) job. Must not mutate self —
+        concurrent snapshot readers share this object.
+
+        Field-wise rather than ``deepcopy``: this is the hottest copy in
+        the scheduling pipeline (every alloc is copied on state-store
+        insert and on the client sync path), and generic deepcopy's
+        reflection over the whole object graph costs ~0.6ms per alloc —
+        the dominant per-placement cost at C1M scale. Scalars/strings
+        share; every mutable container is copied."""
         import copy as _copy
 
-        shallow = _copy.copy(self)
-        shallow.job = None
-        c = _copy.deepcopy(shallow)
-        c.job = self.job
+        c = _copy.copy(self)
+        # memoized derived state must not leak onto a copy whose caller
+        # may replace resources (e.g. in-place updates)
+        c.__dict__.pop("_usage_vec", None)
+        if self.allocated_resources is not None:
+            c.allocated_resources = self.allocated_resources.copy()
+        c.desired_transition = _copy.copy(self.desired_transition)
+        c.task_states = (
+            {k: _copy.deepcopy(v) for k, v in self.task_states.items()}
+            if self.task_states else {}
+        )
+        if self.deployment_status is not None:
+            c.deployment_status = _copy.copy(self.deployment_status)
+        if self.reschedule_tracker is not None:
+            c.reschedule_tracker = _copy.deepcopy(self.reschedule_tracker)
+        c.preempted_allocations = list(self.preempted_allocations)
+        if self.metrics is not None:
+            c.metrics = self.metrics.copy()
         return c
 
 
@@ -1114,7 +1157,18 @@ class AllocMetric:
     def copy(self) -> "AllocMetric":
         import copy as _copy
 
-        return _copy.deepcopy(self)
+        c = _copy.copy(self)
+        c.nodes_available = dict(self.nodes_available)
+        c.class_filtered = dict(self.class_filtered)
+        c.constraint_filtered = dict(self.constraint_filtered)
+        c.class_exhausted = dict(self.class_exhausted)
+        c.dimension_exhausted = dict(self.dimension_exhausted)
+        c.quota_exhausted = list(self.quota_exhausted)
+        c.score_meta = [
+            NodeScoreMeta(m.node_id, dict(m.scores), m.norm_score)
+            for m in self.score_meta
+        ]
+        return c
 
 
 # ---------------------------------------------------------------------------

@@ -332,7 +332,12 @@ class DeviceBatcher:
         ]
 
         b = len(padded)
-        b_pad = _pow2ceil(b)
+        # Three batch buckets — 1, max/4, max. Unrestricted pow2 buckets
+        # each cost a tens-of-seconds XLA compile; but padding every small
+        # batch to max wastes real device time (per-step cost grows with
+        # the batch axis). Compiles are amortized by the persistent cache.
+        mid = max(1, self.max_batch // 4)
+        b_pad = 1 if b == 1 else (mid if b <= mid else self.max_batch)
         if self.mesh is not None:
             ep = self.mesh.shape.get("evals", 1)
             b_pad = ((b_pad + ep - 1) // ep) * ep

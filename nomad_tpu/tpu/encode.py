@@ -157,14 +157,41 @@ def check_supported(job: Job, tg: TaskGroup) -> None:
             raise UnsupportedByEngine("distinct_property")
 
 
+from ..structs.funcs import alloc_usage_vec as _alloc_usage_vec
+
+
+def _snapshot_usage(state) -> Dict[str, tuple]:
+    """Per-node (cpu, mem, disk, mbits) of NON-terminal allocs at this
+    snapshot. The state store maintains this incrementally on every alloc
+    write (state_store._usage_delta) and snapshots share it by shallow
+    copy; the fallback full scan covers stores restored from pre-mirror
+    pickles."""
+    nu = getattr(state, "_node_usage", None)
+    if nu is not None:
+        return nu
+    usage: Dict[str, tuple] = {}
+    for alloc in state.allocs():
+        if alloc.terminal_status():
+            continue
+        u = _alloc_usage_vec(alloc)
+        row = usage.get(alloc.node_id, (0.0, 0.0, 0.0, 0.0))
+        usage[alloc.node_id] = (
+            row[0] + u[0], row[1] + u[1], row[2] + u[2], row[3] + u[3]
+        )
+    return usage
+
+
 def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
     """Encode nodes + proposed allocs into dense arrays.
 
-    Device dims: totals[4+k] = free instances of the job's k-th distinct
-    device-ask id at eval start (capacity already net of existing usage —
-    computed through the same DeviceAccounter the host pipeline uses). A
-    node where the ask matches MORE than one device group falls back: a
-    pooled count could admit a node whose single-group assignment fails.
+    Usage comes from the snapshot-level cache plus per-plan adjustments
+    (evictions/preemptions subtract, planned placements add — the same
+    proposed-allocs algebra as context.go:120, applied as O(plan) deltas
+    instead of O(nodes) queries). Job/TG counts come from the job's own
+    alloc index. Device dims keep the per-node DeviceAccounter path
+    (totals[4+k] = free instances of the job's k-th device-ask id; a node
+    where the ask matches MORE than one device group falls back: a pooled
+    count could admit a node whose single-group assignment fails).
     """
     from ..structs.devices import DeviceAccounter
 
@@ -193,27 +220,85 @@ def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
             reserved[i, DIM_MEM] = rr.memory_mb
             reserved[i, DIM_DISK] = rr.disk_mb
 
-        proposed = ctx.proposed_allocs(node.id)
-        for alloc in proposed:
-            if alloc.terminal_status():
-                continue
-            cr = alloc.comparable_resources()
-            used[i, DIM_CPU] += cr.flattened.cpu_shares
-            used[i, DIM_MEM] += cr.flattened.memory_mb
-            used[i, DIM_DISK] += cr.shared.disk_mb
-            if alloc.allocated_resources is not None:
-                for net in alloc.allocated_resources.shared.networks:
-                    used[i, DIM_MBITS] += net.mbits
-                for tr in alloc.allocated_resources.tasks.values():
-                    for net in tr.networks:
-                        used[i, DIM_MBITS] += net.mbits
-            if alloc.job_id == job.id:
-                job_counts[i] += 1
-                gi = tg_index.get(alloc.task_group)
-                if gi is not None:
-                    tg_counts[gi, i] += 1
+    # -- base usage from the snapshot cache ------------------------------
+    base_usage = _snapshot_usage(ctx.state)
+    for node_id, row in base_usage.items():
+        i = node_index.get(node_id)
+        if i is not None:
+            used[i, DIM_CPU] += row[0]
+            used[i, DIM_MEM] += row[1]
+            used[i, DIM_DISK] += row[2]
+            used[i, DIM_MBITS] += row[3]
 
-        if device_dims and node.node_resources.devices:
+    def _base_nonterminal(alloc_id: str):
+        base = ctx.state.alloc_by_id(alloc_id)
+        if base is None or base.terminal_status():
+            return None
+        return base
+
+    def _adjust(alloc, sign: float, count_job: bool) -> None:
+        i = node_index.get(alloc.node_id)
+        if i is None:
+            return
+        u = _alloc_usage_vec(alloc)
+        used[i, DIM_CPU] += sign * u[0]
+        used[i, DIM_MEM] += sign * u[1]
+        used[i, DIM_DISK] += sign * u[2]
+        used[i, DIM_MBITS] += sign * u[3]
+        if count_job and alloc.job_id == job.id:
+            job_counts[i] += int(sign)
+            gi = tg_index.get(alloc.task_group)
+            if gi is not None:
+                tg_counts[gi, i] += int(sign)
+
+    # -- job/TG counts from the job's alloc index (job_id across ALL
+    #    namespaces — matching the host anti-affinity, rank.go:509) ------
+    for alloc in ctx.state.allocs_by_job_id(job.id):
+        if alloc.terminal_status():
+            continue
+        i = node_index.get(alloc.node_id)
+        if i is None:
+            continue
+        job_counts[i] += 1
+        gi = tg_index.get(alloc.task_group)
+        if gi is not None:
+            tg_counts[gi, i] += 1
+
+    # -- plan deltas (evictions / preemptions subtract; placements add,
+    #    overriding in-place-updated ids like proposed_allocs' by_id) ----
+    overridden = set()
+    for entries in ctx.plan.node_allocation.values():
+        for alloc in entries:
+            overridden.add(alloc.id)
+    for entries in ctx.plan.node_update.values():
+        for alloc in entries:
+            if alloc.id in overridden:
+                continue  # planned version wins; handled below
+            base = _base_nonterminal(alloc.id)
+            if base is not None:
+                _adjust(base, -1.0, count_job=True)
+    for entries in ctx.plan.node_preemptions.values():
+        for alloc in entries:
+            if alloc.id in overridden:
+                continue
+            base = _base_nonterminal(alloc.id)
+            if base is not None:
+                _adjust(base, -1.0, count_job=True)
+    for entries in ctx.plan.node_allocation.values():
+        for alloc in entries:
+            base = _base_nonterminal(alloc.id)
+            if base is not None:
+                # in-place update: planned version REPLACES the base one
+                _adjust(base, -1.0, count_job=True)
+            if not alloc.terminal_status():
+                _adjust(alloc, +1.0, count_job=True)
+
+    # -- device capacity dims (per-node accounter path; device jobs only) -
+    if device_dims:
+        for i, node in enumerate(nodes):
+            if not node.node_resources.devices:
+                continue
+            proposed = ctx.proposed_allocs(node.id)
             accounter = DeviceAccounter(node)
             accounter.add_allocs(proposed)
             groups_claimed: Dict[DeviceIdTuple, int] = {}

@@ -89,6 +89,37 @@ class EncodedEval:
         self.start_ns = start_ns
 
 
+_cache_enabled = False
+
+
+def _enable_persistent_compile_cache() -> None:
+    """Persistent XLA compilation cache: scan compiles are tens of seconds
+    per shape bucket, and the server process restarts far more often than
+    the bucket set changes. Opt out with NOMAD_TPU_XLA_CACHE=0 or point
+    NOMAD_TPU_XLA_CACHE at a directory."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    path = os.environ.get("NOMAD_TPU_XLA_CACHE")
+    if path == "0":
+        return
+    if not path:
+        path = os.path.join(
+            os.path.expanduser("~"), ".cache", "nomad_tpu", "xla"
+        )
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # cache is an optimization; never fail the engine
+        logger.debug("persistent compile cache unavailable", exc_info=True)
+
+
 def _round_up(n: int, multiple: int = 128) -> int:
     if n <= multiple:
         # small clusters: pad to next power of two to bound recompiles
@@ -566,6 +597,7 @@ def _build_place_scan():
     # (intscore.py). Parity mode carries int32 arrays and compares int64
     # score60s — bit-identical on every backend, including the real TPU.
     jax.config.update("jax_enable_x64", True)
+    _enable_persistent_compile_cache()
     step = _make_step()
 
     @partial(jax.jit, static_argnames=("n_pad",))
@@ -594,6 +626,7 @@ def _build_batched_scan(in_shardings=None):
     import jax
 
     jax.config.update("jax_enable_x64", True)
+    _enable_persistent_compile_cache()
     step = _make_step()
 
     def body(static_b, carry_b, xs_b):
@@ -1043,12 +1076,14 @@ class TpuPlacementEngine:
 
             node = nodes[node_idx]
 
-            # Build task resources host-side (ports assigned here).
+            # Build task resources host-side (ports assigned here). The
+            # NetworkIndex is built lazily: network-free task groups (the
+            # C1M-common case) skip the per-node alloc walk entirely.
             task_resources: Dict[str, AllocatedTaskResources] = {}
             shared_networks = []
-            ni = node_net_index(node_idx)
             ok = True
             if tg.networks:
+                ni = node_net_index(node_idx)
                 offer, err = ni.assign_network(tg.networks[0].copy())
                 if offer is None:
                     ok = False
@@ -1060,6 +1095,7 @@ class TpuPlacementEngine:
                     cpu_shares=task.resources.cpu, memory_mb=task.resources.memory_mb
                 )
                 if task.resources.networks:
+                    ni = node_net_index(node_idx)
                     offer, err = ni.assign_network(task.resources.networks[0].copy())
                     if offer is None:
                         ok = False
@@ -1274,6 +1310,7 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
     import jax.numpy as jnp
 
     jax.config.update("jax_enable_x64", True)
+    _enable_persistent_compile_cache()
     CHUNK = int(chunk_k)
 
     def step(static, carry_and_deficit, x):

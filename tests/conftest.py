@@ -7,12 +7,17 @@ is imported anywhere.
 import os
 import sys
 
-# Hard-set (not setdefault): parity tests require the CPU backend's exact
-# IEEE float64 — TPU emulated f64 (double-double) rounds differently and can
-# flip exact-tie orderings by <=2 ULP. Benchmarks run on the real chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Default to the virtual 8-device CPU platform (multi-chip sharding without
+# hardware). Since the engine's deterministic mode moved to the exact
+# INTEGER spec (tpu/intscore.py), its selections are bit-identical on every
+# backend — so the parity suite may also run on real hardware:
+#   NOMAD_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_tpu_parity.py
+# runs the device side on the chip while the host pipeline stays pure
+# Python float64, asserting plan parity ON the TPU.
+_platform = os.environ.get("NOMAD_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if _platform == "cpu" and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 # A sitecustomize may re-register the hardware TPU plugin regardless of the
@@ -20,7 +25,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 try:
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", _platform)
 except ImportError:  # host-only install: TPU tests will fall back/skip
     pass
 

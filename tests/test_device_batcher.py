@@ -107,7 +107,9 @@ class TestBatchedScanParity:
             batched = run_concurrent(batcher, encs)
         finally:
             batcher.stop()
-        assert batcher.stats["padded_evals"] == 1  # 3 -> pow2 4
+        # multi-eval batches pad to max_batch (two compile buckets total:
+        # b=1 and b=max — every intermediate pow2 was its own slow compile)
+        assert batcher.stats["padded_evals"] == 5  # 3 -> max_batch 8
         for single, batch_r in zip(singles, batched):
             np.testing.assert_array_equal(single[0], batch_r[0])
             np.testing.assert_array_equal(single[1], batch_r[1])
