@@ -206,14 +206,18 @@ def bench_parity_scan_single(n_nodes=5000, n_placements=10_000):
 # ---------------------------------------------------------------------------
 
 def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
-                 timeout=180.0, node_seed=0, warmup=None):
+                 timeout=180.0, node_seed=0, warmup=None,
+                 node_factory=None, expected=None, done=None):
     """Run ``jobs`` through a real in-proc server; returns metrics dict.
 
     ``workers`` is 2x the device batch so the next wave encodes while the
     current batch is on the device. ``warmup`` (a job factory) runs one
     throwaway job through the full path first so jit compiles for this
     cluster's shape buckets land outside the timed wall (and the
-    persistent XLA cache makes repeat runs cheap)."""
+    persistent XLA cache makes repeat runs cheap). ``node_factory`` and
+    ``done``/``expected`` override the default cluster and completion
+    check for shapes (system jobs, preemption) where per-TG counts don't
+    describe the goal."""
     from nomad_tpu import mock
     from nomad_tpu.server.fsm import NODE_REGISTER
     from nomad_tpu.server.server import Server, ServerConfig
@@ -226,15 +230,19 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
     ))
     server.start()
     try:
-        for i in range(n_nodes):
-            n = mock.node()
-            n.name = f"bench-{i}"
-            n.node_resources.cpu_shares = int(rng.choice([4000, 8000, 16000]))
-            n.node_resources.memory_mb = int(rng.choice([8192, 16384, 32768]))
-            n.compute_class()
-            server.raft_apply(NODE_REGISTER, n)
+        if node_factory is not None:
+            node_factory(server, n_nodes, rng)
+        else:
+            for i in range(n_nodes):
+                n = mock.node()
+                n.name = f"bench-{i}"
+                n.node_resources.cpu_shares = int(rng.choice([4000, 8000, 16000]))
+                n.node_resources.memory_mb = int(rng.choice([8192, 16384, 32768]))
+                n.compute_class()
+                server.raft_apply(NODE_REGISTER, n)
 
-        expected = sum(tg.count for job in jobs for tg in job.task_groups)
+        if expected is None:
+            expected = sum(tg.count for job in jobs for tg in job.task_groups)
 
         from nomad_tpu.server.worker import Worker
 
@@ -279,8 +287,11 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             )
 
         deadline = time.perf_counter() + timeout
+        finished = done if done is not None else (
+            lambda srv: placed() >= expected
+        )
         while time.perf_counter() < deadline:
-            if placed() >= expected and server.plan_queue.stats()["depth"] == 0:
+            if finished(server) and server.plan_queue.stats()["depth"] == 0:
                 break
             time.sleep(0.05)
         elapsed = time.perf_counter() - t0
@@ -381,6 +392,57 @@ def system_benches():
 
     r = _diagnostic(bench_system, "service-spread-5K", 5000, jobs, timeout=300.0,
                     warmup=_spread_warm)
+    if r:
+        results.append(r)
+
+    # config 4: system scheduler, one-per-node, device constraints +
+    # preemption (BASELINE.md list). A low-priority system job saturates
+    # the fleet first; the high-priority GPU job then preempts its way on
+    # (the engine's forced-node pass handles the clean placements; evals
+    # needing preemption fall back to the host stack by design).
+    jobs = []
+    low = mock.system_job()
+    low.id = "sys-low"
+    low.priority = 20
+    low.task_groups[0].tasks[0].resources.cpu = 900
+    low.task_groups[0].tasks[0].resources.memory_mb = 512
+    jobs.append(low)
+    high = mock.system_job()
+    high.id = "sys-high"
+    high.priority = 80
+    high.task_groups[0].tasks[0].resources.cpu = 600
+    high.task_groups[0].tasks[0].resources.memory_mb = 256
+    from nomad_tpu.structs.structs import RequestedDevice
+
+    high.task_groups[0].tasks[0].resources.devices = [
+        RequestedDevice(name="gpu", count=1)
+    ]
+    jobs.append(high)
+
+    def _sys_nodes(server, n_nodes, rng):
+        # every node dc1/linux so the system jobs cover the fleet; a
+        # quarter carry a GPU device group
+        from nomad_tpu.server.fsm import NODE_REGISTER
+
+        for i in range(n_nodes):
+            n = mock.nvidia_node() if i % 4 == 0 else mock.node()
+            n.name = f"sys-{i}"
+            n.datacenter = "dc1"
+            n.attributes["kernel.name"] = "linux"
+            n.node_resources.cpu_shares = 1200
+            n.node_resources.memory_mb = 2048
+            n.compute_class()
+            server.raft_apply(NODE_REGISTER, n)
+
+    def _sys_done(server):
+        # done when the high-priority GPU job covers every GPU node (its
+        # allocs preempted the low-priority ones there)
+        allocs = server.fsm.state.allocs_by_job("default", "sys-high", True)
+        return sum(1 for a in allocs if a.desired_status == "run") >= 250
+
+    r = _diagnostic(bench_system, "system-preempt-1K", 1000, jobs,
+                    timeout=300.0, node_factory=_sys_nodes,
+                    expected=1250, done=_sys_done)
     if r:
         results.append(r)
 

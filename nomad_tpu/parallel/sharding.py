@@ -91,6 +91,7 @@ def batched_scan_shardings(mesh):
         ns(e, None),                 # sum_sw_p [B, P]
         ns(e, None, None),           # ev_factor [B, P, 2]
         ns(e, None, None),           # rev_factor [B, P, 2]
+        ns(e, None),                 # forced_node [B, P]
     )
     return static, carry, xs
 

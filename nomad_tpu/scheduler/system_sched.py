@@ -207,6 +207,22 @@ class SystemScheduler:
         self._compute_placements(diff.place)
 
     def _compute_placements(self, place) -> None:
+        # tpu_binpack: one dense forced-node pass over the whole placement
+        # list (the system analog of the generic engine path). The host
+        # loop below remains the semantically complete fallback (and the
+        # preemption path).
+        from ..structs.structs import SCHED_ALG_TPU_BINPACK
+
+        _, sched_config = self.state.scheduler_config()
+        if (
+            sched_config is not None
+            and sched_config.scheduler_algorithm == SCHED_ALG_TPU_BINPACK
+        ):
+            from ..tpu.integration import compute_system_placements_with_engine
+
+            if compute_system_placements_with_engine(self, place, sched_config) is True:
+                return
+
         node_by_id = {node.id: node for node in self.nodes}
 
         for missing in place:

@@ -63,7 +63,7 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
     (used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
      offset0, failed0, e_base0) = enc.carry
     (tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
-     limit_p, sum_sw_p, ev_factor, rev_factor) = enc.xs
+     limit_p, sum_sw_p, ev_factor, rev_factor, forced_node) = enc.xs
 
     n0, g0, s0, v0, p0 = enc.n_pad, enc.g, enc.s, enc.v, enc.p
     d0 = totals.shape[1]
@@ -152,6 +152,7 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         pad(f(sum_sw_p), ((0, dp),), 1.0),
         pad(ev_factor, ((0, dp), (0, fac_pad - ev_factor.shape[1])), _E27_NEUTRAL),
         pad(rev_factor, ((0, dp), (0, fac_pad - rev_factor.shape[1])), _E27_NEUTRAL),
+        pad(forced_node, ((0, dp),), -1),
     )
     return static, carry, xs
 

@@ -63,7 +63,7 @@ class TGSpec:
     index: int
     name: str
     ask: np.ndarray  # [D]
-    feasible: np.ndarray  # [N] bool
+    feasible: np.ndarray  # [N] bool (constraints AND port availability)
     affinity_score: np.ndarray  # [N] float32
     affinity_present: np.ndarray  # [N] bool
     desired_count: int
@@ -78,6 +78,11 @@ class TGSpec:
     spread_has_targets: np.ndarray  # [S] bool — targeted vs even-spread scoring
     sum_spread_weights: float
     widens: bool = False  # affinity/spread stanzas -> MaxInt32 limit
+    # constraints only (drivers/constraints/volumes/devices), WITHOUT the
+    # port-availability mask — the system path needs the split: a
+    # port-occupied node is EXHAUSTED (failed + blocked eval, retried
+    # when the port frees), not constraint-filtered out of the domain
+    constraint_feasible: Optional[np.ndarray] = None  # [N] bool
 
 
 class UnsupportedByEngine(Exception):
@@ -625,8 +630,8 @@ def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
     ask[DIM_DISK] = tg.ephemeral_disk.size_mb
     ask[DIM_MBITS], _ = _net_ask(tg)
 
-    feasible = _class_feasibility(ctx, job, tg, nodes)
-    feasible &= _port_feasibility(ctx, job, tg, nodes, port_cache)
+    constraint_feasible = _class_feasibility(ctx, job, tg, nodes)
+    feasible = constraint_feasible & _port_feasibility(ctx, job, tg, nodes, port_cache)
     affinity_score, affinity_present = _affinity_arrays(
         ctx, job, tg, nodes, int_mode=int_mode
     )
@@ -663,6 +668,7 @@ def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
         name=tg.name,
         ask=ask,
         feasible=feasible,
+        constraint_feasible=constraint_feasible,
         affinity_score=affinity_score,
         affinity_present=affinity_present,
         desired_count=tg.count,

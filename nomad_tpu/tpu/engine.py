@@ -166,7 +166,7 @@ def _make_step():
         (used, tg_counts, job_counts, spread_counts, spread_entry, offset,
          failed, e_base) = carry
         (tg_idx, penalty_idx, evict_node, evict_res, evict_tg, limit_p,
-         sum_sw_p, ev_factor, rev_factor) = x
+         sum_sw_p, ev_factor, rev_factor, forced_node) = x
 
         n_pad = totals.shape[0]
         g_count = asks.shape[0]
@@ -270,6 +270,10 @@ def _make_step():
         )
 
         feasible = feas_g & fits & dh_mask  # [N]
+        # system-scheduler mode: the candidate node is FIXED per placement
+        # (one alloc per eligible node, system_sched.go:268-286); -1 means
+        # unrestricted (the generic scheduler's full candidate set)
+        feasible = feasible & ((forced_node < 0) | (iota == forced_node))
 
         # -- score terms ---------------------------------------------------
         # Two compile-time modes sharing one structure:
@@ -580,7 +584,9 @@ def _make_step():
                 e_base = jnp.where(
                     oh_ev_node[:, None], eb_rev, e_base.astype(i64)
                 ).astype(jnp.int32)
-        failed = failed | (sel_g & ((~success) & (~skip_step)))
+        # forced-node (system) placements are independent per-node
+        # decisions: a failure must NOT poison the TG for later nodes
+        failed = failed | (sel_g & ((~success) & (~skip_step) & (forced_node < 0)))
 
         new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry,
                      offset, failed, e_base)
@@ -640,6 +646,101 @@ def _build_batched_scan(in_shardings=None):
     if in_shardings is not None:
         return jax.jit(body, in_shardings=in_shardings)
     return jax.jit(body)
+
+
+class _ResourceAssigner:
+    """Host-side port and device-instance assignment for scan-chosen
+    placements — the discrete half of the capacity dims the device
+    pre-checked. NetworkIndex/DeviceAllocator mirrors are built lazily
+    per node: network- and device-free task groups (the C1M-common case)
+    never pay the per-node alloc walk."""
+
+    def __init__(self, ctx, nodes) -> None:
+        self.ctx = ctx
+        self.nodes = nodes
+        self._net: Dict[int, NetworkIndex] = {}
+        self._dev: Dict[int, object] = {}
+
+    def net_index(self, idx: int) -> NetworkIndex:
+        ni = self._net.get(idx)
+        if ni is None:
+            ni = NetworkIndex(deterministic=self.ctx.deterministic)
+            ni.set_node(self.nodes[idx])
+            ni.add_allocs(self.ctx.proposed_allocs(self.nodes[idx].id))
+            self._net[idx] = ni
+        return ni
+
+    def dev_allocator(self, idx: int):
+        da = self._dev.get(idx)
+        if da is None:
+            from ..scheduler.device import DeviceAllocator
+
+            da = DeviceAllocator(self.ctx, self.nodes[idx])
+            da.add_allocs(self.ctx.proposed_allocs(self.nodes[idx].id))
+            self._dev[idx] = da
+        return da
+
+    def build(self, node_idx: int, tg):
+        """(task_resources, shared_networks, ok) for placing ``tg`` on the
+        node; ok=False on a port/device-instance collision the dense
+        capacity model missed (rare — the plan applier would reject it)."""
+        task_resources: Dict[str, AllocatedTaskResources] = {}
+        shared_networks = []
+        ok = True
+        if tg.networks:
+            ni = self.net_index(node_idx)
+            offer, _err = ni.assign_network(tg.networks[0].copy())
+            if offer is None:
+                ok = False
+            else:
+                ni.add_reserved(offer)
+                shared_networks = [offer]
+        for task in tg.tasks:
+            tr = AllocatedTaskResources(
+                cpu_shares=task.resources.cpu, memory_mb=task.resources.memory_mb
+            )
+            if task.resources.networks:
+                ni = self.net_index(node_idx)
+                offer, _err = ni.assign_network(task.resources.networks[0].copy())
+                if offer is None:
+                    ok = False
+                    break
+                ni.add_reserved(offer)
+                tr.networks = [offer]
+            for req in task.resources.devices:
+                da = self.dev_allocator(node_idx)
+                offer, _aff, _err = da.assign_device(req)
+                if offer is None:
+                    ok = False
+                    break
+                da.add_reserved(offer)
+                tr.devices.append(offer)
+            if not ok:
+                break
+            task_resources[task.name] = tr
+        return task_resources, shared_networks, ok
+
+
+def _int_spec_gate_reason(table, tg_specs, job):
+    """Magnitude gates keeping every int64 intermediate of the integer
+    scoring spec exact (intscore.py module doc). None = all clear."""
+    from .intscore import MAX_TOTAL_COUNT
+
+    caps = table.totals[:, :2]
+    node_c = caps - table.reserved[:, :2]
+    if caps.size and (
+        caps.max() > (1 << 24)
+        or node_c.min() < 1
+        or (table.reserved[:, :2] > 2 * node_c).any()
+    ):
+        return "int-spec cpu/mem magnitude gate"
+    if table.totals.size and table.totals.max() > (1 << 28):
+        return "int-spec capacity magnitude gate"
+    if sum(g.count for g in job.task_groups) > MAX_TOTAL_COUNT:
+        return "int-spec job count gate"
+    if any(spec.ask.max(initial=0) > (1 << 28) for spec in tg_specs.values()):
+        return "int-spec ask magnitude gate"
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -760,24 +861,9 @@ class TpuPlacementEngine:
         int_mode = bool(ctx.deterministic)
         fdtype = np.int32 if int_mode else np.float32
         if int_mode:
-            from .intscore import MAX_TOTAL_COUNT
-
-            # magnitude gates keeping every int64 intermediate exact
-            # (see intscore.py module doc)
-            caps = table.totals[:, :2]
-            node_c = caps - table.reserved[:, :2]
-            if caps.size and (
-                caps.max() > (1 << 24)
-                or node_c.min() < 1
-                or (table.reserved[:, :2] > 2 * node_c).any()
-            ):
-                return fallback("int-spec cpu/mem magnitude gate")
-            if table.totals.size and table.totals.max() > (1 << 28):
-                return fallback("int-spec capacity magnitude gate")
-            if sum(g.count for g in job.task_groups) > MAX_TOTAL_COUNT:
-                return fallback("int-spec job count gate")
-            if any(spec.ask.max(initial=0) > (1 << 28) for spec in tg_specs.values()):
-                return fallback("int-spec ask magnitude gate")
+            reason = _int_spec_gate_reason(table, tg_specs, job)
+            if reason is not None:
+                return fallback(reason)
         _metrics.incr_counter("nomad.tpu_engine.handled")
 
         n_pad = _round_up(max(n_real, 1))
@@ -981,6 +1067,7 @@ class TpuPlacementEngine:
         xs = (
             tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
             limit_p, sum_sw_p, ev_factor, rev_factor,
+            np.full(p, -1, np.int32),  # forced_node: generic = unrestricted
         )
 
         return EncodedEval(
@@ -1010,6 +1097,285 @@ class TpuPlacementEngine:
         )
 
     # ------------------------------------------------------------------
+    # System scheduler path: one alloc per ELIGIBLE node — each placement
+    # names its node up front (system_sched.go:268-286), so the dense pass
+    # is the same scan with a per-placement forced_node restriction and no
+    # spread/affinity/limit machinery (SystemStack has none, stack.go:166).
+    # ------------------------------------------------------------------
+
+    def compute_system_placements(self, sched, place: List, sched_config=None):
+        """Batch a SystemScheduler eval's placements through one device
+        scan. True when handled; NotImplemented falls back to the host
+        per-node stack (which is semantically complete, incl. preemption).
+        ``sched_config`` is the SchedulerConfiguration the caller already
+        read when choosing this path.
+        """
+        try:
+            import jax  # noqa: F401
+        except ImportError:
+            return NotImplemented
+        if not place:
+            return True
+
+        job = sched.job
+        ctx = sched.ctx
+        nodes = list(sched.nodes)
+        n_real = len(nodes)
+
+        from ..utils import metrics as _metrics
+
+        def fallback(reason: str):
+            logger.debug("tpu system engine fallback: %s", reason)
+            _metrics.incr_counter("nomad.tpu_engine.fallback")
+            return NotImplemented
+
+        for node in nodes:
+            if len({net.device for net in node.node_resources.networks if net.device}) > 1:
+                return fallback("multi-NIC node")
+
+        tg_specs: Dict[str, TGSpec] = {}
+        port_cache: Dict[str, object] = {}
+        try:
+            for tup in place:
+                tg = tup.task_group
+                if tg.name not in tg_specs:
+                    tg_specs[tg.name] = build_tg_spec(ctx, job, tg, nodes, False, port_cache)
+            table = build_node_table(ctx, job, nodes)
+        except UnsupportedByEngine as e:
+            return fallback(str(e))
+        int_mode = bool(ctx.deterministic)
+        if int_mode:
+            reason = _int_spec_gate_reason(table, tg_specs, job)
+            if reason is not None:
+                return fallback(reason)
+        num_dims = table.totals.shape[1]
+        start = _time.monotonic_ns()
+        fdtype = np.int32 if int_mode else np.float32
+
+        n_pad = _round_up(max(n_real, 1))
+        g_count = len(job.task_groups)
+        specs_by_gi = {spec.index: spec for spec in tg_specs.values()}
+
+        totals = np.zeros((n_pad, num_dims), fdtype)
+        totals[:n_real] = table.totals
+        reserved = np.zeros((n_pad, num_dims), fdtype)
+        reserved[:n_real] = table.reserved
+        used0 = np.zeros((n_pad, num_dims), fdtype)
+        used0[:n_real] = table.used
+        tg_counts0 = np.zeros((g_count, n_pad), np.int32)
+        tg_counts0[:, :n_real] = table.tg_counts
+        job_counts0 = np.zeros(n_pad, np.int32)
+        job_counts0[:n_real] = table.job_counts
+
+        if int_mode:
+            from .intscore import E27_ONE, e27_np, xq_np
+
+            node_c2 = (totals[:, :2] - reserved[:, :2]).astype(np.int64)
+            free0 = node_c2 - used0[:, :2] - reserved[:, :2]
+            e_base0 = e27_np(xq_np(free0, node_c2)).astype(np.int32)
+            e_ask = np.full((g_count, n_pad, 2), E27_ONE, np.int32)
+        else:
+            e_base0 = np.zeros((0, 2), np.int32)
+            e_ask = np.zeros((0, 0, 2), np.int32)
+
+        asks = np.zeros((g_count, num_dims), fdtype)
+        feas = np.zeros((g_count, n_pad), bool)
+        for gi, spec in specs_by_gi.items():
+            asks[gi] = spec.ask
+            feas[gi, :n_real] = spec.feasible
+            if int_mode:
+                for d in (0, 1):
+                    e_ask[gi, :, d] = e27_np(
+                        xq_np(np.full(n_pad, -int(spec.ask[d]), np.int64),
+                              node_c2[:, d])
+                    ).astype(np.int32)
+
+        # SystemStack has no spread/affinity/limit/anti-affinity iterators:
+        # encode them inert (zero/absent) so those score terms vanish.
+        aff_score = np.zeros((0, n_pad), np.int64 if int_mode else fdtype)
+        aff_present = np.zeros((0, n_pad), bool)
+        desired_counts = np.ones(g_count, np.int32)
+        dh_job = np.zeros(g_count, bool)
+        dh_tg = np.zeros(g_count, bool)
+        limits = np.ones(g_count, np.int32)
+        spread_vids = np.full((g_count, 1, n_pad), 1, np.int32)
+        spread_desired = np.full((g_count, 1, 2), -1, fdtype)
+        spread_weights = np.zeros((g_count, 1), fdtype)
+        spread_has_targets = np.zeros((g_count, 1), bool)
+        spread_active = np.zeros((g_count, 1), bool)
+        sum_spread_weights = np.zeros(g_count, fdtype)
+        spread_counts0 = np.zeros((g_count, 1, 2), fdtype)
+        spread_entry0 = np.zeros((g_count, 1, 2), bool)
+
+        p = len(place)
+        tg_name_to_gi = {g.name: i for i, g in enumerate(job.task_groups)}
+        tg_idx = np.zeros(p, np.int32)
+        forced = np.zeros(p, np.int32)
+        for pi, tup in enumerate(place):
+            tg_idx[pi] = tg_name_to_gi[tup.task_group.name]
+            forced[pi] = table.node_index.get(tup.alloc.node_id, -1)
+        if (forced < 0).any():
+            return fallback("system placement on unknown node")
+
+        static = (
+            totals, reserved, asks, feas, aff_score, aff_present,
+            desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
+            spread_weights, spread_has_targets, spread_active,
+            sum_spread_weights, np.int32(n_real), e_ask,
+        )
+        init_carry = (
+            used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
+            np.int32(0), np.zeros(g_count, bool), e_base0,
+        )
+        xs = (
+            tg_idx,
+            np.full((p, 0), -1, np.int32),       # no reschedule penalties
+            np.full(p, -1, np.int32),            # no evictions
+            np.zeros((p, 0), fdtype),
+            np.full(p, -1, np.int32),
+            np.ones(p, np.int32),                # limit: the single node
+            np.zeros(p, fdtype),
+            np.zeros((p, 0), np.int32),
+            np.zeros((p, 0), np.int32),
+            forced,
+        )
+        enc = EncodedEval(
+            n_real=n_real, n_pad=n_pad, g=g_count, s=1, v=2, p=p,
+            dtype=fdtype, static=static, carry=init_carry, xs=xs,
+            missing_list=list(place), nodes=nodes, table=table,
+            start_ns=start,
+        )
+
+        batcher = getattr(sched.planner, "device_batcher", None)
+        if batcher is not None:
+            chosen, scores, pulls, skipped = batcher.run(enc)
+        else:
+            chosen, scores, pulls, skipped = self.run_scan_single(enc)
+
+        # Preemption is a host-side combinatorial search: when enabled and
+        # any forced node failed on CAPACITY (feasible by constraints but
+        # no fit — port occupancy included: the host preempts port
+        # holders), redo the WHOLE eval on the host stack so the
+        # sequential preemption semantics hold exactly. Constraint-
+        # filtered nodes never preempt, so they don't force the fallback.
+        preemption_on = True
+        if sched_config is not None:
+            preemption_on = sched_config.preemption_config.system_scheduler_enabled
+        if preemption_on:
+            for pi, tup in enumerate(place):
+                if int(chosen[pi]) >= 0:
+                    continue
+                spec = tg_specs[tup.task_group.name]
+                idx = int(forced[pi])
+                if idx < n_real and spec.constraint_feasible[idx]:
+                    return fallback("system capacity failure with preemption enabled")
+
+        _metrics.incr_counter("nomad.tpu_engine.handled")
+        self._apply_system_results(
+            sched, place, nodes, table, tg_specs, chosen, scores, start
+        )
+        return True
+
+    def _apply_system_results(self, sched, place, nodes, table, tg_specs,
+                              chosen, scores, start_ns) -> None:
+        """Materialize system-scan results: allocs for fits, queued-alloc
+        bookkeeping for constraint-filtered nodes, failed metrics +
+        per-node blocked evals for capacity failures (system_sched.py host
+        path semantics)."""
+        from ..structs.structs import AllocMetric
+
+        job = sched.job
+        ctx = sched.ctx
+        assigner = _ResourceAssigner(ctx, nodes)
+
+        for pi, tup in enumerate(place):
+            tg = tup.task_group
+            node_idx = int(chosen[pi])
+
+            if node_idx < 0:
+                idx = table.node_index.get(tup.alloc.node_id, -1)
+                spec = tg_specs[tg.name]
+                if idx < 0 or not spec.constraint_feasible[idx]:
+                    # constraint mismatch: the node just isn't in the
+                    # job's domain — not a failure. (Port-OCCUPIED nodes
+                    # are NOT this case: they're exhausted below, like
+                    # the host's rank-phase port exhaustion.)
+                    sched.queued_allocs[tg.name] -= 1
+                    if (
+                        sched.eval.annotate_plan
+                        and sched.plan.annotations is not None
+                        and tg.name in sched.plan.annotations.desired_tg_updates
+                    ):
+                        sched.plan.annotations.desired_tg_updates[tg.name].place -= 1
+                    continue
+                if sched.failed_tg_allocs and tg.name in sched.failed_tg_allocs:
+                    sched.failed_tg_allocs[tg.name].coalesced_failures += 1
+                    continue
+                metrics = AllocMetric()
+                metrics.nodes_evaluated = 1
+                metrics.nodes_exhausted = 1
+                metrics.nodes_available = sched.nodes_by_dc
+                if sched.failed_tg_allocs is None:
+                    sched.failed_tg_allocs = {}
+                sched.failed_tg_allocs[tg.name] = metrics
+                sched._add_blocked(nodes[idx])
+                continue
+
+            node = nodes[node_idx]
+            task_resources, shared_networks, ok = assigner.build(node_idx, tg)
+            if not ok:
+                if sched.failed_tg_allocs and tg.name in sched.failed_tg_allocs:
+                    sched.failed_tg_allocs[tg.name].coalesced_failures += 1
+                    continue
+                if sched.failed_tg_allocs is None:
+                    sched.failed_tg_allocs = {}
+                metrics = AllocMetric()
+                metrics.nodes_evaluated = 1
+                metrics.nodes_exhausted = 1
+                metrics.nodes_available = sched.nodes_by_dc
+                sched.failed_tg_allocs[tg.name] = metrics
+                sched._add_blocked(node)
+                continue
+
+            metrics = AllocMetric()
+            metrics.nodes_evaluated = 1
+            metrics.nodes_available = sched.nodes_by_dc
+            if scores.dtype.kind == "i":
+                from .intscore import score60_to_float
+
+                score_f = score60_to_float(scores[pi])
+            else:
+                score_f = float(scores[pi])
+            metrics.score_node(node, "binpack", score_f)
+            metrics.score_node(node, "normalized-score", score_f)
+            metrics.populate_score_meta_data()
+
+            resources = AllocatedResources(
+                tasks=task_resources,
+                shared=AllocatedSharedResources(
+                    disk_mb=tg.ephemeral_disk.size_mb, networks=shared_networks
+                ),
+            )
+            alloc = Allocation(
+                namespace=job.namespace,
+                eval_id=sched.eval.id,
+                name=tup.name,
+                job_id=job.id,
+                task_group=tg.name,
+                metrics=metrics,
+                node_id=node.id,
+                node_name=node.name,
+                allocated_resources=resources,
+                desired_status=ALLOC_DESIRED_RUN,
+                client_status=ALLOC_CLIENT_PENDING,
+            )
+            if tup.alloc is not None and tup.alloc.id:
+                alloc.previous_allocation = tup.alloc.id
+            sched.plan.append_alloc(alloc)
+
+        ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
+
+    # ------------------------------------------------------------------
 
     def _apply_results(self, sched, missing_list, nodes, table, chosen, scores,
                        pulls, skipped_steps, start_ns) -> None:
@@ -1026,27 +1392,7 @@ class TpuPlacementEngine:
         # Lazy per-node NetworkIndex / DeviceAllocator mirrors for port and
         # device-instance assignment (the discrete half the capacity dims
         # pre-checked on device).
-        net_indexes: Dict[int, NetworkIndex] = {}
-        dev_allocators: Dict[int, object] = {}
-
-        def node_net_index(idx: int) -> NetworkIndex:
-            ni = net_indexes.get(idx)
-            if ni is None:
-                ni = NetworkIndex(deterministic=ctx.deterministic)
-                ni.set_node(nodes[idx])
-                ni.add_allocs(ctx.proposed_allocs(nodes[idx].id))
-                net_indexes[idx] = ni
-            return ni
-
-        def node_dev_allocator(idx: int):
-            da = dev_allocators.get(idx)
-            if da is None:
-                from ..scheduler.device import DeviceAllocator
-
-                da = DeviceAllocator(ctx, nodes[idx])
-                da.add_allocs(ctx.proposed_allocs(nodes[idx].id))
-                dev_allocators[idx] = da
-            return da
+        assigner = _ResourceAssigner(ctx, nodes)
 
         for pi, missing in enumerate(missing_list):
             tg = missing.get_task_group()
@@ -1076,43 +1422,7 @@ class TpuPlacementEngine:
 
             node = nodes[node_idx]
 
-            # Build task resources host-side (ports assigned here). The
-            # NetworkIndex is built lazily: network-free task groups (the
-            # C1M-common case) skip the per-node alloc walk entirely.
-            task_resources: Dict[str, AllocatedTaskResources] = {}
-            shared_networks = []
-            ok = True
-            if tg.networks:
-                ni = node_net_index(node_idx)
-                offer, err = ni.assign_network(tg.networks[0].copy())
-                if offer is None:
-                    ok = False
-                else:
-                    ni.add_reserved(offer)
-                    shared_networks = [offer]
-            for task in tg.tasks:
-                tr = AllocatedTaskResources(
-                    cpu_shares=task.resources.cpu, memory_mb=task.resources.memory_mb
-                )
-                if task.resources.networks:
-                    ni = node_net_index(node_idx)
-                    offer, err = ni.assign_network(task.resources.networks[0].copy())
-                    if offer is None:
-                        ok = False
-                        break
-                    ni.add_reserved(offer)
-                    tr.networks = [offer]
-                for req in task.resources.devices:
-                    da = node_dev_allocator(node_idx)
-                    offer, _aff, err = da.assign_device(req)
-                    if offer is None:
-                        ok = False
-                        break
-                    da.add_reserved(offer)
-                    tr.devices.append(offer)
-                if not ok:
-                    break
-                task_resources[task.name] = tr
+            task_resources, shared_networks, ok = assigner.build(node_idx, tg)
             if not ok:
                 # Port/device-instance collision the capacity model missed:
                 # extremely rare; record as failed placement (plan applier
@@ -1278,7 +1588,8 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
           np.full(n_placements, 2**31 - 1 if n_spreads else limit_val, np.int32),
           np.full(n_placements, 50 * max(n_spreads, 1), dtype),
           np.zeros((n_placements, 0), np.int32),
-          np.zeros((n_placements, 0), np.int32))
+          np.zeros((n_placements, 0), np.int32),
+          np.full(n_placements, -1, np.int32))
     return n_pad, static, init_carry, xs
 
 

@@ -14,3 +14,14 @@ def compute_placements_with_engine(sched, destructive, place):
         return NotImplemented
     engine = TpuPlacementEngine.shared()
     return engine.compute_placements(sched, destructive, place)
+
+
+def compute_system_placements_with_engine(sched, place, sched_config=None):
+    """SystemScheduler device path (forced-node dense pass); True when
+    handled, NotImplemented to fall back to the host per-node stack."""
+    try:
+        from .engine import TpuPlacementEngine
+    except ImportError:
+        return NotImplemented
+    engine = TpuPlacementEngine.shared()
+    return engine.compute_system_placements(sched, place, sched_config)

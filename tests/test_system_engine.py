@@ -1,0 +1,246 @@
+"""SystemScheduler device path: host-vs-device plan parity.
+
+The system scheduler's placement loop is per-node select (one alloc per
+eligible node, system_sched.go:268-286); the device path replaces it
+with one dense forced-node scan (engine.compute_system_placements).
+These tests run the same workload under ``binpack`` (host stack) and
+``tpu_binpack`` (device) and assert identical plans, failures and
+blocked evals.
+"""
+import copy
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.structs import (
+    EVAL_TRIGGER_JOB_REGISTER,
+    Constraint,
+    Evaluation,
+    PreemptionConfig,
+    SchedulerConfiguration,
+)
+
+
+def make_nodes(num, seed, cpus=(2000, 4000, 8000)):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(num):
+        n = mock.node()
+        n.name = f"node-{i}"
+        n.node_resources.cpu_shares = rng.choice(list(cpus))
+        n.datacenter = rng.choice(["dc1", "dc2"])
+        n.attributes["rack"] = f"r{rng.randint(0, 3)}"
+        if rng.random() < 0.25:
+            n.attributes["kernel.name"] = "windows"
+        n.compute_class()
+        nodes.append(n)
+    return nodes
+
+
+def sys_eval(job):
+    return Evaluation(priority=job.priority, type=job.type,
+                      triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                      job_id=job.id, namespace=job.namespace)
+
+
+def run_pair(nodes, jobs, preemption=True):
+    plans = {}
+    for alg in ("binpack", "tpu_binpack"):
+        h = Harness()
+        h.state.scheduler_set_config(
+            h.next_index(),
+            SchedulerConfiguration(
+                scheduler_algorithm=alg,
+                preemption_config=PreemptionConfig(
+                    system_scheduler_enabled=preemption),
+            ),
+        )
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), copy.deepcopy(n))
+        for job in jobs:
+            h.state.upsert_job(h.next_index(), copy.deepcopy(job))
+        for job in jobs:
+            h.process("system", sys_eval(job))
+        plans[alg] = (h.plans, h.evals, h.create_evals)
+    return plans
+
+
+def plan_assignments(plans):
+    # system allocs share one name per TG across nodes — key by node too
+    out = set()
+    for i, plan in enumerate(plans):
+        for node_id, allocs in plan.node_allocation.items():
+            for a in allocs:
+                out.add((i, node_id, a.name))
+    return out
+
+
+def assert_parity(plans):
+    host_plans, host_evals, host_blocked = plans["binpack"]
+    tpu_plans, tpu_evals, tpu_blocked = plans["tpu_binpack"]
+    assert len(host_plans) == len(tpu_plans)
+    assert plan_assignments(host_plans) == plan_assignments(tpu_plans)
+    assert len(host_blocked) == len(tpu_blocked)
+    for he, te in zip(host_evals, tpu_evals):
+        assert he.status == te.status
+        assert set(he.failed_tg_allocs or {}) == set(te.failed_tg_allocs or {})
+
+
+class _CounterSpy:
+    def __init__(self, monkeypatch):
+        from nomad_tpu.utils import metrics
+
+        self.calls = []
+        orig = metrics.incr_counter
+
+        def spy(name, value=1.0):
+            self.calls.append(name)
+            orig(name, value)
+
+        monkeypatch.setattr(metrics, "incr_counter", spy)
+
+
+def test_system_engine_basic_parity(monkeypatch):
+    spy = _CounterSpy(monkeypatch)
+    nodes = make_nodes(12, seed=1)
+    job = mock.system_job()
+    plans = run_pair(nodes, [job])
+    assert "nomad.tpu_engine.handled" in spy.calls, (
+        "system job should take the engine path"
+    )
+    assert_parity(plans)
+    # every eligible (linux) node got exactly one alloc
+    got = plan_assignments(plans["tpu_binpack"][0])
+    eligible = [n for n in nodes
+                if n.attributes.get("kernel.name") != "windows"
+                and n.datacenter == "dc1"]  # system_job targets dc1
+    assert len(got) == len(eligible)
+
+
+def test_system_engine_constraint_filtering_parity():
+    # explicit constraint: only rack r1 nodes are in the job's domain;
+    # filtered nodes are NOT failures (queued bookkeeping must agree)
+    nodes = make_nodes(16, seed=2)
+    job = mock.system_job()
+    job.constraints.append(
+        Constraint(ltarget="${attr.rack}", rtarget="r1", operand="=")
+    )
+    plans = run_pair(nodes, [job])
+    assert_parity(plans)
+
+
+def test_system_engine_capacity_failure_parity_no_preemption():
+    # tiny nodes: the big ask fails on capacity -> failed_tg_allocs +
+    # per-node blocked evals, identical on both paths
+    nodes = make_nodes(6, seed=3, cpus=(600,))
+    job = mock.system_job()
+    job.task_groups[0].tasks[0].resources.cpu = 500
+    busy = mock.system_job()
+    busy.id = "busy"
+    busy.task_groups[0].tasks[0].resources.cpu = 300
+    plans = run_pair(nodes, [busy, job], preemption=False)
+    assert_parity(plans)
+
+
+def test_system_engine_preemption_falls_back_to_host(monkeypatch):
+    # capacity failure with preemption ENABLED: the engine must hand the
+    # whole eval back to the host stack (which preempts) — plans and
+    # preemption sets must match the host run exactly
+    spy = _CounterSpy(monkeypatch)
+    nodes = make_nodes(4, seed=4, cpus=(1000,))
+    for n in nodes:  # all eligible: dc1, linux
+        n.datacenter = "dc1"
+        n.attributes["kernel.name"] = "linux"
+        n.compute_class()
+    low = mock.system_job()
+    low.id = "low-prio"
+    low.priority = 20
+    low.task_groups[0].tasks[0].resources.cpu = 700
+    high = mock.system_job()
+    high.id = "high-prio"
+    high.priority = 80
+    high.task_groups[0].tasks[0].resources.cpu = 700
+    plans = run_pair(nodes, [low, high], preemption=True)
+    assert "nomad.tpu_engine.fallback" in spy.calls
+    assert_parity(plans)
+    # the high-priority job preempted: its plan carries preemptions
+    tpu_plans = plans["tpu_binpack"][0]
+    preempted = [
+        a for plan in tpu_plans
+        for entries in plan.node_preemptions.values() for a in entries
+    ]
+    assert preempted, "high-priority system job should preempt"
+
+
+def test_system_engine_destructive_update_parity():
+    nodes = make_nodes(8, seed=5)
+    results = {}
+    for alg in ("binpack", "tpu_binpack"):
+        h = Harness()
+        h.state.scheduler_set_config(
+            h.next_index(), SchedulerConfiguration(scheduler_algorithm=alg)
+        )
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), copy.deepcopy(n))
+        job = mock.system_job()
+        job.id = "sys-update"
+        h.state.upsert_job(h.next_index(), copy.deepcopy(job))
+        h.process("system", sys_eval(job))
+        job2 = copy.deepcopy(job)
+        job2.version = 1
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        h.state.upsert_job(h.next_index(), copy.deepcopy(job2))
+        h.process("system", sys_eval(job2))
+        results[alg] = (h.plans, h.evals, h.create_evals)
+    assert_parity(results)
+
+
+def test_system_engine_port_occupied_is_exhaustion_not_filtering():
+    """A node whose static port is held by ANOTHER job is EXHAUSTED
+    (failed_tg_allocs + blocked eval, retried when the port frees), not
+    constraint-filtered out of the domain — matching the host's
+    rank-phase port exhaustion."""
+    from nomad_tpu.structs.structs import Port
+
+    nodes = make_nodes(3, seed=9)
+    for n in nodes:
+        n.datacenter = "dc1"
+        n.attributes["kernel.name"] = "linux"
+        n.compute_class()
+    holder = mock.system_job()
+    holder.id = "port-holder"
+    from nomad_tpu.structs.structs import NetworkResource
+    holder.task_groups[0].tasks[0].resources.networks = [
+        NetworkResource(mbits=10, reserved_ports=[Port(label="svc", value=7777)])
+    ]
+    contender = mock.system_job()
+    contender.id = "port-contender"
+    contender.task_groups[0].tasks[0].resources.networks = [
+        NetworkResource(mbits=10, reserved_ports=[Port(label="svc", value=7777)])
+    ]
+    plans = run_pair(nodes, [holder, contender], preemption=False)
+    assert_parity(plans)
+    # the contender failed (ports held) and left a blocked eval
+    _, tpu_evals, tpu_blocked = plans["tpu_binpack"]
+    failed = [e for e in tpu_evals if e.failed_tg_allocs]
+    assert failed, "contender should record failed placements"
+    assert tpu_blocked, "contender should leave blocked evals"
+
+
+def test_system_engine_multi_tg_parity():
+    nodes = make_nodes(10, seed=6)
+    job = mock.system_job()
+    tg2 = copy.deepcopy(job.task_groups[0])
+    tg2.name = "second"
+    tg2.tasks[0].resources.cpu = 250
+    job.task_groups.append(tg2)
+    plans = run_pair(nodes, [job])
+    assert_parity(plans)
+    # both TGs landed on every eligible node
+    got = plan_assignments(plans["tpu_binpack"][0])
+    eligible = [n for n in nodes
+                if n.attributes.get("kernel.name") != "windows"
+                and n.datacenter == "dc1"]
+    assert len(got) == 2 * len(eligible)
