@@ -434,15 +434,18 @@ def system_benches():
             n.compute_class()
             server.raft_apply(NODE_REGISTER, n)
 
+    sys_nodes_n = 1000
+    gpu_nodes = (sys_nodes_n + 3) // 4  # _sys_nodes: every 4th node has GPUs
+
     def _sys_done(server):
         # done when the high-priority GPU job covers every GPU node (its
         # allocs preempted the low-priority ones there)
         allocs = server.fsm.state.allocs_by_job("default", "sys-high", True)
-        return sum(1 for a in allocs if a.desired_status == "run") >= 250
+        return sum(1 for a in allocs if a.desired_status == "run") >= gpu_nodes
 
-    r = _diagnostic(bench_system, "system-preempt-1K", 1000, jobs,
+    r = _diagnostic(bench_system, "system-preempt-1K", sys_nodes_n, jobs,
                     timeout=300.0, node_factory=_sys_nodes,
-                    expected=1250, done=_sys_done)
+                    expected=sys_nodes_n + gpu_nodes, done=_sys_done)
     if r:
         results.append(r)
 

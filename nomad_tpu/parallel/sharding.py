@@ -70,6 +70,9 @@ def batched_scan_shardings(mesh):
         ns(e, None),                 # sum_spread_weights [B, G]
         ns(e),                       # n_real [B]
         ns(e, None, "nodes", None),  # e_ask [B, G, N, 2]
+        ns(e, None, "nodes"),        # dp_vids [B, D, N]
+        ns(e, None),                 # dp_limit [B, D]
+        ns(e, None, None),           # dp_applies [B, G, D]
     )
     carry = (
         ns(e, "nodes", None),        # used [B, N, D]
@@ -80,6 +83,7 @@ def batched_scan_shardings(mesh):
         ns(e),                       # offset [B]
         ns(e, None),                 # failed [B, G]
         ns(e, "nodes", None),        # e_base [B, N, 2]
+        ns(e, None, None),           # dp_counts [B, D, V]
     )
     xs = (
         ns(e, None),                 # tg_idx [B, P]

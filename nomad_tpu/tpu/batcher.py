@@ -43,7 +43,9 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
                 d_pad: int = 0, k_pad: Optional[int] = None,
                 aff_pad: Optional[int] = None,
                 evd_pad: Optional[int] = None,
-                fac_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
+                fac_pad: Optional[int] = None,
+                dpd_pad: Optional[int] = None,
+                dpv_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
     """Pad one eval's arrays to the batch's shared bucketed dims.
 
     Padding is semantically inert by construction:
@@ -59,9 +61,9 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
     (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
      dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
      spread_has_targets, spread_active, sum_spread_weights, n_real,
-     e_ask) = enc.static
+     e_ask, dp_vids, dp_limit, dp_applies) = enc.static
     (used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
-     offset0, failed0, e_base0) = enc.carry
+     offset0, failed0, e_base0, dp_counts0) = enc.carry
     (tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
      limit_p, sum_sw_p, ev_factor, rev_factor, forced_node) = enc.xs
 
@@ -77,6 +79,10 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         evd_pad = evict_res.shape[1]
     if fac_pad is None:
         fac_pad = ev_factor.shape[1]
+    if dpd_pad is None:
+        dpd_pad = dp_vids.shape[0]
+    if dpv_pad is None:
+        dpv_pad = dp_counts0.shape[1]
     dn, dg, ds, dv, dp = (n_pad - n0, g_pad - g0, s_pad - s0,
                           v_pad - v0, p_pad - p0)
     dd = d_pad - d0
@@ -124,6 +130,16 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         pad(e_ask, ((0, (g_pad - e_ask.shape[0]) if e_ask.shape[0] else 0),
                     (0, (n_pad - e_ask.shape[1]) if e_ask.shape[0] else 0),
                     (0, 0)), _E27_NEUTRAL),
+        # distinct_property: remap this eval's MISSING bucket onto the
+        # batch's (dpv_pad-1) before padding; padded constraint rows
+        # apply to no TG
+        pad(
+            np.where(dp_vids >= dp_counts0.shape[1] - 1, dpv_pad - 1, dp_vids)
+            if dp_vids.shape[0] else dp_vids.reshape(0, n0),
+            ((0, dpd_pad - dp_vids.shape[0]), (0, dn)), dpv_pad - 1,
+        ),
+        pad(dp_limit, ((0, dpd_pad - dp_limit.shape[0]),), 1),
+        pad(dp_applies, ((0, dg), (0, dpd_pad - dp_applies.shape[1])), False),
     )
     carry = (
         pad(f(used0), ((0, dn), (0, dd))),
@@ -136,6 +152,8 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         pad(failed0, ((0, dg),), True),
         pad(e_base0, ((0, dn if e_base0.shape[0] else 0), (0, 0)),
             _E27_NEUTRAL),
+        pad(dp_counts0, ((0, dpd_pad - dp_counts0.shape[0]),
+                         (0, dpv_pad - dp_counts0.shape[1])), 0),
     )
     xs = (
         pad(tg_idx, ((0, dp),), g0),  # g0 = first padded (pre-failed) slot
@@ -324,11 +342,13 @@ class DeviceBatcher:
         evd_raw = max(e.xs[3].shape[1] for e in encs)
         evd_pad = d_pad if evd_raw else 0
         fac_pad = max(e.xs[7].shape[1] for e in encs)
+        dpd_pad = max(e.static[18].shape[0] for e in encs)
+        dpv_pad = max(e.carry[8].shape[1] for e in encs)
         dtype = encs[0].dtype  # dispatch loop groups by dtype
 
         padded = [
             pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad,
-                        k_pad, aff_pad, evd_pad, fac_pad)
+                        k_pad, aff_pad, evd_pad, fac_pad, dpd_pad, dpv_pad)
             for e in encs
         ]
 
@@ -347,7 +367,8 @@ class DeviceBatcher:
             if n_pad2 != n_pad:
                 padded = [
                     pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype,
-                                d_pad, k_pad, aff_pad, evd_pad, fac_pad)
+                                d_pad, k_pad, aff_pad, evd_pad, fac_pad,
+                                dpd_pad, dpv_pad)
                     for e in encs
                 ]
                 n_pad = n_pad2

@@ -143,12 +143,13 @@ def job_device_dims(job: Job) -> Dict[tuple, int]:
 def check_supported(job: Job, tg: TaskGroup) -> None:
     """Gate on features the engine doesn't model on device.
 
-    Reserved ports and plain count-based device asks ARE modeled
-    (port-feasibility masks + same-TG-per-node exclusion; device capacity
-    dims). Remaining fallbacks: cross-TG reserved-port overlap (two TGs
-    competing for one port need the host's sequential port book-keeping),
-    device asks with constraints/affinities or more distinct ids than the
-    spare dims, and distinct_property."""
+    Reserved ports, plain count-based device asks AND distinct_property
+    ARE modeled (port-feasibility masks + same-TG-per-node exclusion;
+    device capacity dims; value-count feasibility carry). Remaining
+    fallbacks: cross-TG reserved-port overlap (two TGs competing for one
+    port need the host's sequential port book-keeping) and device asks
+    with constraints/affinities or more distinct ids than the spare dims.
+    """
     job_device_dims(job)  # raises on unsupported device shapes
     mine = _tg_reserved_ports(tg)
     if mine:
@@ -157,9 +158,88 @@ def check_supported(job: Job, tg: TaskGroup) -> None:
                 continue
             if mine & _tg_reserved_ports(other):
                 raise UnsupportedByEngine("cross-TG reserved port overlap")
-    for c in list(job.constraints) + list(tg.constraints):
+
+
+def _distinct_property_arrays(ctx, job: Job, nodes: List[Node]):
+    """Dense encoding of distinct_property constraints (feasible.go:353
+    DistinctPropertyIterator): per constraint, a value id per node, an
+    allowed count, the set of task groups it applies to, and the
+    existing+proposed-cleared base counts from the property set. The
+    scan threads count mutation through its carry (same mechanism as
+    spread counts) and filters nodes whose value is at the limit.
+
+    Returns (dp_vids [D, N+1-bucketed], dp_limit [D], dp_applies [G, D],
+    dp_counts0 [D, V]); D == 0 when the job has no distinct_property
+    constraints (the step compiles the machinery away). Raises
+    UnsupportedByEngine on an unparsable rtarget (the host path keeps
+    its error messaging)."""
+    from ..scheduler.propertyset import PropertySet, get_property
+
+    entries = []  # (constraint, tg_name or "")
+    for c in job.constraints:
         if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
-            raise UnsupportedByEngine("distinct_property")
+            entries.append((c, ""))
+    for tg in job.task_groups:
+        for c in tg.constraints:
+            if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
+                entries.append((c, tg.name))
+
+    n = len(nodes)
+    g = len(job.task_groups)
+    d_count = len(entries)
+    if d_count == 0:
+        return (
+            np.zeros((0, n), np.int32), np.zeros(0, np.int32),
+            np.zeros((g, 0), bool), np.zeros((0, 1), np.int32),
+        )
+
+    tg_index = {tg.name: gi for gi, tg in enumerate(job.task_groups)}
+    vocabs: List[Dict[str, int]] = []
+    node_vals: List[List[Optional[str]]] = []
+    limits = np.ones(d_count, np.int32)
+    applies = np.zeros((g, d_count), bool)
+    for di, (c, tg_name) in enumerate(entries):
+        if c.rtarget:
+            try:
+                limits[di] = int(c.rtarget)
+            except ValueError:
+                raise UnsupportedByEngine("distinct_property bad rtarget")
+        if tg_name:
+            applies[tg_index[tg_name], di] = True
+        else:
+            applies[:, di] = True
+        vocab: Dict[str, int] = {}
+        vals: List[Optional[str]] = []
+        for node in nodes:
+            val, ok = get_property(node, c.ltarget)
+            if not ok:
+                vals.append(None)
+                continue
+            vocab.setdefault(val, len(vocab))
+            vals.append(val)
+        vocabs.append(vocab)
+        node_vals.append(vals)
+
+    v = max((len(vb) for vb in vocabs), default=0) + 1  # +1 missing bucket
+    vids = np.full((d_count, n), v - 1, np.int32)
+    counts0 = np.zeros((d_count, v), np.int32)
+    for di, (c, tg_name) in enumerate(entries):
+        vocab = vocabs[di]
+        for i in range(n):
+            val = node_vals[di][i]
+            if val is not None:
+                vids[di, i] = vocab[val]
+        pset = PropertySet(ctx, job)
+        # set_*_constraint populates existing AND proposed/cleared from
+        # the plan as-encoded (stops + in-place updates)
+        if tg_name:
+            pset.set_tg_constraint(c, tg_name)
+        else:
+            pset.set_job_constraint(c)
+        for val, count in pset.get_combined_use_map().items():
+            if val in vocab:
+                counts0[di, vocab[val]] = count
+    return vids, limits, applies, counts0
 
 
 from ..structs.funcs import alloc_usage_vec as _alloc_usage_vec

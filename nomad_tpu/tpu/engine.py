@@ -49,6 +49,7 @@ from .encode import (
     NodeTable,
     TGSpec,
     UnsupportedByEngine,
+    _distinct_property_arrays,
     build_node_table,
     build_tg_spec,
     job_device_dims,
@@ -162,9 +163,9 @@ def _make_step():
         (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
          dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
          spread_has_targets, spread_active, sum_spread_weights, n_real,
-         e_ask) = static
+         e_ask, dp_vids, dp_limit, dp_applies) = static
         (used, tg_counts, job_counts, spread_counts, spread_entry, offset,
-         failed, e_base) = carry
+         failed, e_base, dp_counts) = carry
         (tg_idx, penalty_idx, evict_node, evict_res, evict_tg, limit_p,
          sum_sw_p, ev_factor, rev_factor, forced_node) = x
 
@@ -239,6 +240,9 @@ def _make_step():
                 e_base = jnp.where(
                     oh_ev_node[:, None], eb_ev, e_base.astype(i64)
                 ).astype(jnp.int32)
+            # (distinct_property + in-eval evictions never encode together
+            # — the host PropertySet cleared-refund quirk can't be
+            # replayed by exact counters; encode gates that combination)
 
         # -- row selects ---------------------------------------------------
         ask = pick_g(asks)                               # [D]
@@ -274,6 +278,25 @@ def _make_step():
         # (one alloc per eligible node, system_sched.go:268-286); -1 means
         # unrestricted (the generic scheduler's full candidate set)
         feasible = feasible & ((forced_node < 0) | (iota == forced_node))
+
+        # distinct_property (feasible.go:353): per-constraint value-count
+        # carry, same mechanism as spread counts but FILTERING — a node is
+        # infeasible when its value's count reached the allowed limit or
+        # the property is missing. D == 0 compiles all of this away.
+        if dp_vids.shape[0]:
+            v2 = dp_counts.shape[-1]
+            iota_v2 = jnp.arange(v2, dtype=jnp.int32)
+            oh_dpv = dp_vids[:, None, :] == iota_v2[None, :, None]  # [D, V2, N]
+            dp_cnts = jnp.maximum(dp_counts, 0)  # cleared-value floor
+            dp_cnt_n = jnp.sum(
+                jnp.where(oh_dpv, dp_cnts[:, :, None], 0), axis=1
+            )  # [D, N]
+            dp_applies_g = pick_g(dp_applies, False)  # [D]
+            dp_missing = dp_vids == (v2 - 1)
+            dp_ok = (~dp_applies_g[:, None]) | (
+                (~dp_missing) & (dp_cnt_n < dp_limit[:, None])
+            )
+            feasible = feasible & jnp.all(dp_ok, axis=0)
 
         # -- score terms ---------------------------------------------------
         # Two compile-time modes sharing one structure:
@@ -563,6 +586,12 @@ def _make_step():
         # already-computed selection value (running-product spec)
         if e_base.shape[0]:
             e_base = jnp.where((oh_ch & success)[:, None], e_sel_i32, e_base)
+        if dp_vids.shape[0]:
+            ch_vid_dp = jnp.sum(jnp.where(oh_ch[None, :], dp_vids, 0), axis=1)  # [D]
+            inc_dp = dp_applies_g & success
+            dp_counts = dp_counts + (
+                (iota_v2[None, :] == ch_vid_dp[:, None]) & inc_dp[:, None]
+            ).astype(jnp.int32)
 
         # failed placement: revert eviction, mark TG failed
         if has_evict:
@@ -589,7 +618,7 @@ def _make_step():
         failed = failed | (sel_g & ((~success) & (~skip_step) & (forced_node < 0)))
 
         new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry,
-                     offset, failed, e_base)
+                     offset, failed, e_base, dp_counts)
         out = (chosen, jnp.where(success, best_score, score_zero), pulls, skip_step)
         return new_carry, out
 
@@ -1054,15 +1083,37 @@ class TpuPlacementEngine:
             ev_factor = ev_factor[:, :0]
             rev_factor = rev_factor[:, :0]
 
+        # distinct_property encoding (zero-D when absent). Pad the node
+        # axis: padded nodes keep the MISSING bucket (v-1) and are
+        # infeasible anyway.
+        try:
+            dp_vids_r, dp_limit, dp_applies, dp_counts0 = (
+                _distinct_property_arrays(ctx, job, nodes)
+            )
+        except UnsupportedByEngine as e:
+            return fallback(str(e))
+        if dp_vids_r.shape[0] and (evict_node >= 0).any():
+            # in-eval evictions interact with the host PropertySet's
+            # cleared-value refund quirk (propertyset.py:97-105: at most
+            # one refund per distinct re-used value) — the scan's exact
+            # counters would diverge; host fallback keeps plan parity
+            return fallback("distinct_property with in-eval evictions")
+        d_dp = dp_vids_r.shape[0]
+        v_dp = dp_counts0.shape[1] if d_dp else 1
+        dp_vids = np.full((d_dp, n_pad), v_dp - 1, np.int32)
+        if d_dp:
+            dp_vids[:, :n_real] = dp_vids_r
+
         static = (
             totals, reserved, asks, feas, aff_score, aff_present,
             desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
             spread_weights, spread_has_targets, spread_active,
             sum_spread_weights, np.int32(n_real), e_ask,
+            dp_vids, dp_limit, dp_applies,
         )
         init_carry = (
             used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
-            np.int32(0), np.zeros(g_count, bool), e_base0,
+            np.int32(0), np.zeros(g_count, bool), e_base0, dp_counts0,
         )
         xs = (
             tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
@@ -1217,15 +1268,34 @@ class TpuPlacementEngine:
         if (forced < 0).any():
             return fallback("system placement on unknown node")
 
+        from ..structs.structs import CONSTRAINT_DISTINCT_PROPERTY
+
+        has_dp = any(
+            c.operand == CONSTRAINT_DISTINCT_PROPERTY
+            for c in list(job.constraints)
+            + [c for tg in job.task_groups for c in tg.constraints]
+        )
+        if has_dp:
+            # host DistinctPropertyIterator counts DP blocks as FILTERED
+            # (not exhausted); the dense pass can't split that per forced
+            # node without replaying counts — host fallback keeps the
+            # bookkeeping identical. (The generic path vectorizes DP.)
+            return fallback("system distinct_property")
+        dp_vids = np.zeros((0, n_pad), np.int32)
+        dp_limit = np.zeros(0, np.int32)
+        dp_applies = np.zeros((g_count, 0), bool)
+        dp_counts0 = np.zeros((0, 1), np.int32)
+
         static = (
             totals, reserved, asks, feas, aff_score, aff_present,
             desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
             spread_weights, spread_has_targets, spread_active,
             sum_spread_weights, np.int32(n_real), e_ask,
+            dp_vids, dp_limit, dp_applies,
         )
         init_carry = (
             used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
-            np.int32(0), np.zeros(g_count, bool), e_base0,
+            np.int32(0), np.zeros(g_count, bool), e_base0, dp_counts0,
         )
         xs = (
             tg_idx,
@@ -1574,10 +1644,13 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
     static = (totals, reserved, asks, feas, aff_score, aff_present,
               desired_counts, dh_job, dh_tg, limits, spread_vids,
               spread_desired, spread_weights, spread_has_targets,
-              spread_active, sum_spread_weights, np.int32(n_nodes), e_ask)
+              spread_active, sum_spread_weights, np.int32(n_nodes), e_ask,
+              np.zeros((0, n_pad), np.int32),   # dp_vids: no distinct_property
+              np.zeros(0, np.int32),
+              np.zeros((g, 0), bool))
     init_carry = (used0, np.zeros((g, n_pad), np.int32), np.zeros(n_pad, np.int32),
                   spread_counts0, spread_entry0, np.int32(0), np.zeros(g, bool),
-                  e_base0)
+                  e_base0, np.zeros((0, 1), np.int32))
     limit_val = max(2, int(np.ceil(np.log2(max(n_nodes, 2)))))
     xs = (rng.integers(0, g, n_placements).astype(np.int32),
           np.full((n_placements, 0), -1, np.int32),  # no reschedule history

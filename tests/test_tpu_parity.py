@@ -380,11 +380,105 @@ def test_fallback_metrics_for_unsupported(monkeypatch):
     nodes = make_nodes(5, seed=24)
     job = mock.job()
     job.task_groups[0].count = 2
-    job.constraints.append(Constraint(operand="distinct_property",
-                                      ltarget="${attr.rack}"))
+    # cross-TG reserved-port overlap is a host-only shape
+    from nomad_tpu.structs.structs import Port
+
+    tg2 = copy.deepcopy(job.task_groups[0])
+    tg2.name = "other"
+    tg2.count = 1
+    job.task_groups.append(tg2)
+    for tg in job.task_groups:
+        tg.tasks[0].resources.networks[0].reserved_ports = [
+            Port(label="shared", value=12345)
+        ]
     plans = run_pair(nodes, [job], lambda j: "service")
     assert "nomad.tpu_engine.fallback" in spy.calls
     assert plan_assignments(plans["binpack"][0]) == plan_assignments(plans["tpu_binpack"][0])
+
+
+def test_parity_distinct_property_on_engine(monkeypatch):
+    """distinct_property rides the engine (value-count feasibility carry):
+    the fallback counter stays untouched and plans match the host."""
+    spy = _CounterSpy(monkeypatch)
+    nodes = make_nodes(12, seed=25)
+    job = mock.job()
+    job.task_groups[0].count = 6
+    job.constraints.append(Constraint(operand="distinct_property",
+                                      ltarget="${attr.rack}", rtarget="2"))
+    plans = run_pair(nodes, [job], lambda j: "service")
+    assert "nomad.tpu_engine.handled" in spy.calls
+    assert "nomad.tpu_engine.fallback" not in spy.calls
+    assert_parity(plans)
+    # at most 2 allocs per rack value
+    node_rack = {n.id: n.attributes["rack"] for n in nodes}
+    rack_counts = {}
+    for (_, _name), nid in plan_assignments(plans["tpu_binpack"][0]).items():
+        r = node_rack[nid]
+        rack_counts[r] = rack_counts.get(r, 0) + 1
+    assert all(v <= 2 for v in rack_counts.values())
+
+
+def test_parity_distinct_property_tg_level():
+    """TG-level distinct_property counts only that TG's allocs."""
+    nodes = make_nodes(16, seed=26)
+    job = mock.job()
+    tg0 = job.task_groups[0]
+    job.task_groups = []
+    for t in range(2):
+        tg = copy.deepcopy(tg0)
+        tg.name = f"tg{t}"
+        tg.count = 3
+        tg.constraints.append(Constraint(operand="distinct_property",
+                                         ltarget="${attr.rack}"))
+        job.task_groups.append(tg)
+    assert_parity(run_pair(nodes, [job], lambda j: "service"))
+
+
+def test_parity_distinct_property_destructive_update(monkeypatch):
+    """DP + in-eval evictions: the host PropertySet's cleared-value refund
+    quirk can't be replayed by exact counters, so the engine must fall
+    back — and the plans must still match."""
+    spy = _CounterSpy(monkeypatch)
+    nodes = make_nodes(12, seed=28)
+    results = {}
+    for alg in ("binpack", "tpu_binpack"):
+        h = Harness()
+        h.state.scheduler_set_config(
+            h.next_index(), SchedulerConfiguration(scheduler_algorithm=alg)
+        )
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), copy.deepcopy(n))
+        job = mock.job()
+        job.id = "dp-update"
+        job.task_groups[0].count = 5
+        job.constraints.append(Constraint(operand="distinct_property",
+                                          ltarget="${attr.rack}", rtarget="3"))
+        h.state.upsert_job(h.next_index(), copy.deepcopy(job))
+        ev = Evaluation(priority=50, type="service",
+                        triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                        job_id=job.id, namespace="default")
+        h.process("service", ev)
+        job2 = copy.deepcopy(job)
+        job2.version = 1
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        h.state.upsert_job(h.next_index(), copy.deepcopy(job2))
+        ev2 = Evaluation(priority=50, type="service",
+                         triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                         job_id=job.id, namespace="default")
+        h.process("service", ev2)
+        results[alg] = (h.plans, h.evals, h.create_evals)
+    assert "nomad.tpu_engine.fallback" in spy.calls
+    assert plan_assignments(results["binpack"][0]) == plan_assignments(results["tpu_binpack"][0])
+
+
+def test_parity_distinct_property_overcommit():
+    """More instances than distinct values: failures/blocked must match."""
+    nodes = make_nodes(8, seed=27)
+    job = mock.job()
+    job.task_groups[0].count = 7
+    job.constraints.append(Constraint(operand="distinct_property",
+                                      ltarget="${node.datacenter}"))
+    assert_parity(run_pair(nodes, [job], lambda j: "service"))
 
 
 def test_parity_destructive_update_with_spread():
