@@ -40,6 +40,7 @@ class AllocRunner:
         driver_factory=None,
         consul=None,
         vault_fn=None,
+        vault_addr: str = "",
     ) -> None:
         self.alloc = alloc
         self.node = node
@@ -49,6 +50,7 @@ class AllocRunner:
         self.driver_factory = driver_factory
         self.consul = consul
         self.vault_fn = vault_fn
+        self.vault_addr = vault_addr
         self.logger = logging.getLogger(f"nomad_tpu.allocrunner.{alloc.id[:8]}")
 
         self.alloc_dir = AllocDir(base_dir, alloc.id)
@@ -92,6 +94,7 @@ class AllocRunner:
                 driver_factory=self.driver_factory,
                 consul=self.consul,
                 vault_fn=self.vault_fn,
+                vault_addr=self.vault_addr,
             )
             self.task_runners[task.name] = tr
             handle = (recover_handles or {}).get(task.name)

@@ -43,6 +43,9 @@ class ClientConfig:
     # Consul agent address for task service registration (command/agent/
     # consul ServiceClient); empty = disabled
     consul: Optional[object] = None  # integrations.consul.ConsulConfig
+    # Vault address for the template hook's {{ secret }} reads (the token
+    # is the TASK's derived token, never the server's)
+    vault_addr: str = ""
     # external plugins (reference client config plugin_dir + plugin stanzas):
     # plugin_dir is scanned for nomad-driver-*/nomad-device-* executables;
     # external_drivers forces built-in drivers out-of-process (the
@@ -288,6 +291,7 @@ class Client:
                 alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update,
                 device_manager=self.device_manager, driver_factory=self.resolve_driver,
                 consul=self.consul, vault_fn=self._vault_fn(),
+                vault_addr=self.config.vault_addr,
                 prev_alloc_watcher=watcher,
             )
             # re-attach live tasks BEFORE the runners start, so a recovered
@@ -389,6 +393,7 @@ class Client:
             alloc, self.alloc_dir_base, node=self.node, on_update=self._on_ar_update,
             device_manager=self.device_manager, driver_factory=self.resolve_driver,
             consul=self.consul, vault_fn=self._vault_fn(),
+            vault_addr=self.config.vault_addr,
             prev_alloc_watcher=watcher,
         )
         with self._lock:

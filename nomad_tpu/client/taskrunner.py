@@ -4,8 +4,9 @@ Fills the role of reference ``client/allocrunner/taskrunner/`` —
 ``task_runner.go:243 TaskRunner``, the prestart/poststart/exited/stop hook
 chain (task_runner_hooks.go:61), and the restart tracker
 (restarts/restarts.go). The hook set here is the subset with in-scope
-backends: validate, taskDir, env builder, dispatch payload, template-lite
-(env interpolation), artifacts (local file copy); logmon is folded into the
+backends: validate, taskDir, env builder, dispatch payload, templates
+(Consul KV/Vault rendering + change modes, client/template.py), artifacts
+(http(s)/file + checksum + unpack, client/artifacts.py); logmon is folded into the
 drivers (stdout/stderr straight to the task log dir, reference logmon.go).
 """
 from __future__ import annotations
@@ -96,6 +97,7 @@ class TaskRunner:
         driver_factory=None,
         consul=None,
         vault_fn=None,
+        vault_addr: str = "",
     ) -> None:
         self.alloc = alloc
         self.task = task
@@ -106,7 +108,9 @@ class TaskRunner:
         self.driver_factory = driver_factory or new_driver
         self.consul = consul
         self.vault_fn = vault_fn
+        self.vault_addr = vault_addr
         self._vault_token = ""
+        self._template_hook = None
         self._consul_ids = []
         self.update_interval = update_interval
         self.logger = logging.getLogger(f"nomad_tpu.taskrunner.{task.name}")
@@ -191,6 +195,8 @@ class TaskRunner:
             self._set_state(STATE_RUNNING)
             self._emit(TaskEvent(EV_STARTED))
             self._register_services()
+            if self._template_hook is not None and self._template_hook._thread is None:
+                self._template_hook.start_watcher()
             result = self._wait_exit()
             self._deregister_services()
             if result is None:  # killed
@@ -216,7 +222,25 @@ class TaskRunner:
                 break
         else:
             self._set_state(STATE_DEAD)
+        if self._template_hook is not None:
+            self._template_hook.stop()
         self.done.set()
+
+    def _signal_task(self, signal: str) -> None:
+        """Template change_mode=signal application."""
+        try:
+            self.driver.signal_task(self.task_id, signal)
+        except DriverError as e:
+            self.logger.warning("template change signal failed: %s", e)
+
+    def _template_restart(self) -> None:
+        """Template change_mode=restart: restart only a RUNNING task. A
+        task that's already dead or in restart backoff picks the
+        re-rendered file up on its next start — latching the
+        user-restart flag there would later override the restart policy
+        (e.g. rerunning a completed batch task)."""
+        if self.state.state == STATE_RUNNING and self.handle is not None:
+            self.restart()
 
     def _sleep(self, seconds: float) -> bool:
         """False if the kill arrived during the sleep."""
@@ -238,13 +262,16 @@ class TaskRunner:
             os.makedirs(os.path.dirname(dest), exist_ok=True)
             with open(dest, "wb") as f:
                 f.write(payload)
-        # artifacts hook: local files only (go-getter's local protocol)
-        for art in self.task.artifacts or []:
-            src = art.get("source", "")
-            if src.startswith("file://"):
-                import shutil
+        # artifacts hook (artifact_hook.go + go-getter core): http(s) and
+        # file sources, checksum verification, archive unpacking
+        if self.task.artifacts:
+            from .artifacts import fetch_artifact
 
-                shutil.copy(src[len("file://"):], self.task_dir.local_dir)
+            builder = TaskEnvBuilder(self.node, self.alloc, self.task) \
+                .set_task_dirs(self.task_dir)
+            self._emit(TaskEvent(EV_TASK_SETUP, "downloading artifacts"))
+            for art in self.task.artifacts:
+                fetch_artifact(art, self.task_dir.dir, interp=builder.interpolate)
         # vault hook (task_runner_hooks.go vault hook): derive the task's
         # token and drop it in the secrets dir. Derivation goes over RPC,
         # so transient failures (leader election, blip) retry with backoff
@@ -269,6 +296,36 @@ class TaskRunner:
             with open(token_path, "w") as f:
                 f.write(self._vault_token)
             os.chmod(token_path, 0o600)
+        # template hook (task_runner_hooks.go template hook /
+        # consul-template): initial render blocks on missing dependencies;
+        # the change watcher starts after the task is up
+        if self.task.templates:
+            from .template import TemplateHook
+
+            builder = TaskEnvBuilder(self.node, self.alloc, self.task) \
+                .set_task_dirs(self.task_dir)
+            vault_read = None
+            if self.vault_addr:
+                from ..integrations.vault import VaultClient, VaultConfig
+
+                vc = VaultClient(VaultConfig(
+                    enabled=True, address=self.vault_addr,
+                    token=self._vault_token,
+                ))
+                vault_read = vc.read_secret
+            self._template_hook = TemplateHook(
+                self.task.templates, self.task_dir.dir,
+                consul=self.consul, vault_read=vault_read,
+                env_fn=lambda: builder.build(),
+                interp=builder.interpolate,
+                restart_cb=self._template_restart,
+                signal_cb=self._signal_task,
+                # share the kill event: a task kill interrupts the
+                # dependency wait instead of riding out block_timeout
+                stop_event=self.kill_requested,
+            )
+            self._emit(TaskEvent(EV_TASK_SETUP, "rendering templates"))
+            self._template_hook.prestart()
 
     def _register_services(self) -> None:
         """Consul services hook (task_runner_hooks.go services hook)."""

@@ -45,11 +45,17 @@ class ConsulClient:
     def enabled(self) -> bool:
         return bool(self.config.address)
 
-    def _call(self, method: str, path: str, body: Optional[dict] = None):
+    def _call(self, method: str, path: str, body: Optional[dict] = None,
+              raw_body: Optional[str] = None):
+        data = None
+        if raw_body is not None:
+            data = raw_body.encode()
+        elif body is not None:
+            data = json.dumps(body).encode()
         req = urllib.request.Request(
             self.config.address + path,
             method=method,
-            data=json.dumps(body).encode() if body is not None else None,
+            data=data,
             headers={"X-Consul-Token": self.config.token} if self.config.token else {},
         )
         try:
@@ -91,6 +97,24 @@ class ConsulClient:
 
     def deregister_service(self, service_id: str) -> None:
         self._call("PUT", f"/v1/agent/service/deregister/{service_id}")
+
+    # -- KV store (the template hook's {{ key }} source) ----------------
+
+    def kv_get(self, key: str) -> Optional[str]:
+        """Value at ``key`` or None (Consul /v1/kv API, base64 values)."""
+        import base64
+
+        try:
+            entries = self._call("GET", f"/v1/kv/{key.lstrip('/')}")
+        except ConsulError:
+            return None
+        if not entries:
+            return None
+        raw = entries[0].get("Value") or ""
+        return base64.b64decode(raw).decode() if raw else ""
+
+    def kv_put(self, key: str, value: str) -> None:
+        self._call("PUT", f"/v1/kv/{key.lstrip('/')}", raw_body=value)
 
     def services(self) -> Dict[str, dict]:
         return self._call("GET", "/v1/agent/services") or {}
@@ -228,6 +252,7 @@ class MockConsulServer:
         import socketserver
 
         self.services: Dict[str, dict] = {}
+        self.kv: Dict[str, str] = {}
         self._lock = threading.Lock()
         outer = self
 
@@ -245,7 +270,13 @@ class MockConsulServer:
 
             def do_PUT(self):
                 length = int(self.headers.get("Content-Length") or 0)
-                body = json.loads(self.rfile.read(length) or b"{}")
+                raw = self.rfile.read(length)
+                if self.path.startswith("/v1/kv/"):
+                    key = self.path[len("/v1/kv/"):]
+                    with outer._lock:
+                        outer.kv[key] = raw.decode()
+                    return self._reply(200, True)
+                body = json.loads(raw or b"{}")
                 if self.path == "/v1/agent/service/register":
                     with outer._lock:
                         outer.services[body["ID"]] = body
@@ -261,6 +292,18 @@ class MockConsulServer:
                 if self.path == "/v1/agent/services":
                     with outer._lock:
                         return self._reply(200, dict(outer.services))
+                if self.path.startswith("/v1/kv/"):
+                    import base64
+
+                    key = self.path[len("/v1/kv/"):]
+                    with outer._lock:
+                        val = outer.kv.get(key)
+                    if val is None:
+                        return self._reply(404, [])
+                    return self._reply(200, [{
+                        "Key": key,
+                        "Value": base64.b64encode(val.encode()).decode(),
+                    }])
                 return self._reply(404, {"error": "no handler"})
 
         class Server(socketserver.ThreadingTCPServer):

@@ -47,12 +47,13 @@ class VaultClient:
     def enabled(self) -> bool:
         return self.config.enabled and bool(self.config.address)
 
-    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+    def _call(self, method: str, path: str, body: Optional[dict] = None,
+              token: Optional[str] = None) -> dict:
         req = urllib.request.Request(
             self.config.address + path,
             method=method,
             data=json.dumps(body).encode() if body is not None else None,
-            headers={"X-Vault-Token": self.config.token},
+            headers={"X-Vault-Token": token or self.config.token},
         )
         try:
             with urllib.request.urlopen(req, timeout=10) as resp:
@@ -97,6 +98,12 @@ class VaultClient:
                 failed.append(acc)
         return failed
 
+    def read_secret(self, path: str, token: Optional[str] = None) -> dict:
+        """KV-v1 style secret read (the template hook's {{ secret }}
+        source): GET /v1/<path> → the response's ``data`` map."""
+        out = self._call("GET", "/v1/" + path.lstrip("/"), token=token)
+        return out.get("data") or {}
+
     def lookup_self(self) -> dict:
         return self._call("GET", "/v1/auth/token/lookup-self")
 
@@ -125,6 +132,9 @@ class MockVaultServer:
 
         self.root_token = root_token
         self.tokens: Dict[str, MockToken] = {}
+        # path -> data map served at GET /v1/<path> (KV-v1 style; the
+        # template hook's {{ secret }} source)
+        self.secrets: Dict[str, dict] = {}
         self.by_accessor: Dict[str, MockToken] = {}
         self._lock = threading.Lock()
         outer = self
@@ -180,6 +190,15 @@ class MockVaultServer:
                     if t is None or t.revoked:
                         return self._reply(403, {"errors": ["permission denied"]})
                     return self._reply(200, {"data": {"policies": t.policies}})
+                if self.path.startswith("/v1/secret/"):
+                    if not outer._valid(auth):
+                        return self._reply(403, {"errors": ["permission denied"]})
+                    key = self.path[len("/v1/"):]
+                    with outer._lock:
+                        data = outer.secrets.get(key)
+                    if data is None:
+                        return self._reply(404, {"errors": ["not found"]})
+                    return self._reply(200, {"data": data})
                 return self._reply(404, {"errors": ["no handler"]})
 
         class Server(socketserver.ThreadingTCPServer):
