@@ -112,6 +112,7 @@ class TaskRunner:
         self._vault_token = ""
         self._template_hook = None
         self._consul_ids = []
+        self._script_checks = []
         self.update_interval = update_interval
         self.logger = logging.getLogger(f"nomad_tpu.taskrunner.{task.name}")
 
@@ -226,6 +227,61 @@ class TaskRunner:
             self._template_hook.stop()
         self.done.set()
 
+    def _write_envoy_bootstrap(self, service_name: str) -> None:
+        """Generate the sidecar's Envoy bootstrap into
+        secrets/envoy_bootstrap.json (the reference shells out to
+        ``consul connect envoy -bootstrap``; this runtime generates the
+        equivalent static bootstrap: admin listener, node identity for
+        the proxy service, and Consul's agent as the config source)."""
+        import json as _json
+
+        proxy_id = f"_nomad-group-{self.alloc.id}-{service_name}-sidecar-proxy"
+        # ADS rides Consul's agent gRPC xDS endpoint (port 8502), NOT the
+        # HTTP API — derive the host from the configured HTTP address
+        grpc_host = "127.0.0.1"
+        if self.consul is not None:
+            from urllib.parse import urlparse
+
+            http_addr = getattr(self.consul.config, "address", "")
+            if http_addr:
+                grpc_host = urlparse(http_addr).hostname or "127.0.0.1"
+        bootstrap = {
+            "admin": {
+                "access_log_path": "/dev/null",
+                "address": {"socket_address": {
+                    "address": "127.0.0.1", "port_value": 19001}},
+            },
+            "node": {
+                "cluster": service_name,
+                "id": proxy_id,
+                "metadata": {
+                    "namespace": self.alloc.namespace or "default",
+                    "envoy_version": "1.11.2",
+                },
+            },
+            "static_resources": {
+                "clusters": [{
+                    "name": "local_agent",
+                    "connect_timeout": "1s",
+                    "type": "STATIC",
+                    "hosts": [{"url": f"tcp://{grpc_host}:8502"}],
+                }],
+            },
+            "dynamic_resources": {
+                "lds_config": {"ads": {}},
+                "cds_config": {"ads": {}},
+                "ads_config": {
+                    "api_type": "GRPC",
+                    "grpc_services": {"envoy_grpc": {
+                        "cluster_name": "local_agent"}},
+                },
+            },
+        }
+        dest = os.path.join(self.task_dir.secrets_dir, "envoy_bootstrap.json")
+        with open(dest, "w") as f:
+            _json.dump(bootstrap, f, indent=2)
+        os.chmod(dest, 0o600)
+
     def _signal_task(self, signal: str) -> None:
         """Template change_mode=signal application."""
         try:
@@ -296,6 +352,13 @@ class TaskRunner:
             with open(token_path, "w") as f:
                 f.write(self._vault_token)
             os.chmod(token_path, 0o600)
+        # envoy bootstrap hook (task_runner_hooks.go:112-116,
+        # envoybootstrap_hook.go): a Connect sidecar task gets its Envoy
+        # bootstrap config written into its secrets dir before start
+        # (the stanza's default args point at it)
+        kind = getattr(self.task, "kind", "") or ""
+        if kind.startswith("connect-proxy:"):
+            self._write_envoy_bootstrap(kind.split(":", 1)[1])
         # template hook (task_runner_hooks.go template hook /
         # consul-template): initial render blocks on missing dependencies;
         # the change watcher starts after the task is up
@@ -328,7 +391,9 @@ class TaskRunner:
             self._template_hook.prestart()
 
     def _register_services(self) -> None:
-        """Consul services hook (task_runner_hooks.go services hook)."""
+        """Consul services hook (task_runner_hooks.go services hook) +
+        script checks (command/agent/consul/script.go: the command runs
+        through the driver exec API and heartbeats a TTL check)."""
         if self.consul is None or not self.task.services:
             return
         try:
@@ -339,8 +404,47 @@ class TaskRunner:
             )
         except Exception as e:  # noqa: BLE001 — consul outage isn't fatal
             self.logger.warning("consul registration failed: %s", e)
+            return
+        from ..integrations.consul import task_service_id
+        from .script_checks import ScriptCheckRunner, parse_duration_s
+
+        for svc in self.task.services or []:
+            sid = task_service_id(self.alloc.id, self.task.name, svc.name)
+            for k, chk in enumerate(getattr(svc, "checks", []) or []):
+                if not self.consul.is_script_check(chk):
+                    continue
+                interval = parse_duration_s(chk.get("interval"), 10.0)
+                timeout = parse_duration_s(chk.get("timeout"), 5.0)
+                check_id = f"{sid}-script-{k}"
+                try:
+                    # TTL = interval + timeout + slack: a heartbeat cycle
+                    # is one (possibly timeout-long) run plus the sleep,
+                    # so anything shorter flaps a slow-but-passing script
+                    # (script.go registers interval+timeout the same way);
+                    # a wedged script still turns critical on its own
+                    self.consul.register_ttl_check(
+                        check_id, chk.get("name", f"script check {k}"),
+                        sid, f"{max(interval + timeout + 1.0, 2.0):.0f}s",
+                    )
+                except Exception as e:  # noqa: BLE001
+                    self.logger.warning("script check register failed: %s", e)
+                    continue
+                runner = ScriptCheckRunner(
+                    self.consul, check_id, chk.get("command", ""),
+                    chk.get("args") or [], interval, timeout,
+                    exec_fn=lambda cmd, t: self.driver.exec_task(self.task_id, cmd, t),
+                )
+                runner.start()
+                self._script_checks.append(runner)
 
     def _deregister_services(self) -> None:
+        for runner in self._script_checks:
+            runner.stop()
+            try:
+                self.consul.deregister_check(runner.check_id)
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("script check deregister failed: %s", e)
+        self._script_checks = []
         if self.consul is None or not self._consul_ids:
             return
         try:

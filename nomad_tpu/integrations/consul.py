@@ -119,11 +119,37 @@ class ConsulClient:
     def services(self) -> Dict[str, dict]:
         return self._call("GET", "/v1/agent/services") or {}
 
+    # -- TTL checks (the script-check slot, command/agent/consul/script.go:
+    # Nomad registers script checks as TTL checks and heartbeats them
+    # itself after running the command through the driver exec API) -----
+
+    def register_ttl_check(self, check_id: str, name: str, service_id: str,
+                           ttl: str) -> None:
+        self._call("PUT", "/v1/agent/check/register", {
+            "ID": check_id, "Name": name, "ServiceID": service_id, "TTL": ttl,
+        })
+
+    def update_ttl(self, check_id: str, status: str, output: str = "") -> None:
+        self._call("PUT", f"/v1/agent/check/update/{check_id}", {
+            "Status": status, "Output": output,
+        })
+
+    def deregister_check(self, check_id: str) -> None:
+        self._call("PUT", f"/v1/agent/check/deregister/{check_id}")
+
     # -- task lifecycle hooks (consul/client.go RegisterWorkload) --------
 
     @staticmethod
-    def _check_body(svc_name: str, c: dict) -> dict:
-        """Consul rejects TTL+Interval together; shape per check kind."""
+    def is_script_check(c: dict) -> bool:
+        return c.get("type") == "script" or bool(c.get("command"))
+
+    @staticmethod
+    def _check_body(svc_name: str, c: dict) -> Optional[dict]:
+        """Consul rejects TTL+Interval together; shape per check kind.
+        Script checks return None — they register separately as TTL
+        checks the client heartbeats (script.go semantics)."""
+        if ConsulClient.is_script_check(c):
+            return None
         body = {"Name": c.get("name", f"service: {svc_name} check")}
         if c.get("ttl"):
             body["TTL"] = c["ttl"]
@@ -157,8 +183,10 @@ class ConsulClient:
         for svc in task.services or []:
             sid = task_service_id(alloc.id, task.name, svc.name)
             checks = [
-                self._check_body(svc.name, c)
-                for c in getattr(svc, "checks", []) or []
+                b for b in (
+                    self._check_body(svc.name, c)
+                    for c in getattr(svc, "checks", []) or []
+                ) if b is not None
             ]
             try:
                 self.register_service(
@@ -196,9 +224,20 @@ class ConsulClient:
         ids: List[str] = []
         for svc in getattr(tg, "services", []) or []:
             sid = f"_nomad-group-{alloc.id}-{svc.name}"
+            for c in getattr(svc, "checks", []) or []:
+                if self.is_script_check(c):
+                    # group-level script checks need a task to exec in
+                    # (reference check.task field) — not wired here yet
+                    logger.warning(
+                        "group service %s: script checks on group services "
+                        "are not supported; check %r skipped",
+                        svc.name, c.get("name", ""),
+                    )
             checks = [
-                self._check_body(svc.name, c)
-                for c in getattr(svc, "checks", []) or []
+                b for b in (
+                    self._check_body(svc.name, c)
+                    for c in getattr(svc, "checks", []) or []
+                ) if b is not None
             ]
             try:
                 self.register_service(
@@ -252,6 +291,7 @@ class MockConsulServer:
         import socketserver
 
         self.services: Dict[str, dict] = {}
+        self.checks: Dict[str, dict] = {}
         self.kv: Dict[str, str] = {}
         self._lock = threading.Lock()
         outer = self
@@ -277,6 +317,30 @@ class MockConsulServer:
                         outer.kv[key] = raw.decode()
                     return self._reply(200, True)
                 body = json.loads(raw or b"{}")
+                if self.path == "/v1/agent/check/register":
+                    with outer._lock:
+                        outer.checks[body["ID"]] = {
+                            "Name": body.get("Name", ""),
+                            "ServiceID": body.get("ServiceID", ""),
+                            "TTL": body.get("TTL", ""),
+                            "Status": "critical",
+                            "Output": "",
+                        }
+                    return self._reply(200)
+                if self.path.startswith("/v1/agent/check/update/"):
+                    cid = self.path.rsplit("/", 1)[1]
+                    with outer._lock:
+                        chk = outer.checks.get(cid)
+                        if chk is None:
+                            return self._reply(404, {"error": "unknown check"})
+                        chk["Status"] = body.get("Status", "")
+                        chk["Output"] = body.get("Output", "")
+                    return self._reply(200)
+                if self.path.startswith("/v1/agent/check/deregister/"):
+                    cid = self.path.rsplit("/", 1)[1]
+                    with outer._lock:
+                        outer.checks.pop(cid, None)
+                    return self._reply(200)
                 if self.path == "/v1/agent/service/register":
                     with outer._lock:
                         outer.services[body["ID"]] = body

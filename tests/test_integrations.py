@@ -291,6 +291,20 @@ class TestConsulConnect:
             ar = client.allocrunners[alloc.id]
             assert set(ar.task_runners) == {"web", "connect-proxy-countdash"}
 
+            # envoy bootstrap hook: the sidecar task's secrets dir holds
+            # the generated bootstrap config (envoybootstrap_hook.go)
+            import json as _json
+            import os as _os
+
+            sidecar_tr = ar.task_runners["connect-proxy-countdash"]
+            bs_path = _os.path.join(sidecar_tr.task_dir.secrets_dir,
+                                    "envoy_bootstrap.json")
+            assert _os.path.exists(bs_path)
+            bs = _json.load(open(bs_path))
+            assert bs["node"]["cluster"] == "countdash"
+            assert bs["node"]["id"].endswith("-countdash-sidecar-proxy")
+            assert alloc.id in bs["node"]["id"]
+
             wait_until(
                 lambda: any("sidecar-proxy" in sid for sid in consul.services),
                 msg="proxy service registered",
@@ -312,6 +326,72 @@ class TestConsulConnect:
                 lambda: not any(alloc.id in sid for sid in consul.services),
                 msg="group services deregistered",
             )
+        finally:
+            client.shutdown()
+            server.stop()
+
+
+class TestScriptChecks:
+    def test_script_check_heartbeats_ttl(self, consul):
+        """Script checks run through the driver exec API and heartbeat a
+        TTL check in Consul (command/agent/consul/script.go): a passing
+        command reports passing; a failing one reports critical; the
+        check deregisters with the task."""
+        from nomad_tpu.client.client import Client, ClientConfig, ServerProxy
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.structs.structs import Service
+
+        server = Server(ServerConfig(num_schedulers=1, heartbeat_min_ttl=60,
+                                     heartbeat_max_ttl=60))
+        server.start()
+        client = Client(
+            ServerProxy(server),
+            ClientConfig(consul=ConsulConfig(address=consul.address)),
+        )
+        try:
+            client.start()
+            job = mock.job()
+            job.task_groups[0].count = 1
+            task = job.task_groups[0].tasks[0]
+            task.driver = "raw_exec"
+            task.config = {"command": "/bin/sh", "args": ["-c", "sleep 60"]}
+            task.resources.networks = []
+            task.services = [Service(name="scripted", checks=[
+                {"name": "ok-check", "type": "script",
+                 "command": "/bin/sh", "args": ["-c", "echo healthy; exit 0"],
+                 "interval": "1s", "timeout": "5s"},
+                {"name": "bad-check", "type": "script",
+                 "command": "/bin/sh", "args": ["-c", "echo broken; exit 2"],
+                 "interval": "1s", "timeout": "5s"},
+            ])]
+            server.register_job(job)
+
+            def check(name):
+                for cid, c in consul.checks.items():
+                    if c["Name"] == name:
+                        return c
+                return None
+
+            wait_until(lambda: check("ok-check") is not None
+                       and check("ok-check")["Status"] == "passing",
+                       msg="passing script check")
+            assert "healthy" in check("ok-check")["Output"]
+            wait_until(lambda: check("bad-check") is not None
+                       and check("bad-check")["Status"] == "critical",
+                       msg="critical script check")
+            assert "broken" in check("bad-check")["Output"]
+            # script checks registered against the service, TTL-style
+            cid = next(c for c, v in consul.checks.items()
+                       if v["Name"] == "ok-check")
+            assert consul.checks[cid]["ServiceID"].startswith("_nomad-task-")
+            assert consul.checks[cid]["TTL"]
+
+            # stop -> checks deregister
+            allocs = server.fsm.state.allocs_by_job("default", job.id, True)
+            server.stop_alloc(allocs[0].id)
+            wait_until(lambda: not any(alloc_chk["Name"] == "ok-check"
+                                       for alloc_chk in consul.checks.values()),
+                       msg="script checks deregistered")
         finally:
             client.shutdown()
             server.stop()
