@@ -461,6 +461,14 @@ class Agent:
         if self.server is not None and peer == self.server.peer:
             if self.membership is not None:
                 self.membership.set_leader(is_leader)
+        # a NEW leader reconciles gossip membership into the replicated
+        # configuration (leader.go:836 reconcile): members that joined
+        # while there was no leader (or during a partition) get their
+        # staged add now
+        if is_leader and self.wire_raft is not None and self.membership is not None:
+            for meta in self.membership.servers_in_region():
+                if meta.name != self.config.name:
+                    self.wire_raft.add_peer_staged(meta.name, meta.rpc_addr)
 
     def _on_server_change(self, meta, status: str) -> None:
         """Track the local region's leader for RPC forwarding
@@ -486,7 +494,18 @@ class Agent:
             if alive:
                 with self._raft_boot_lock:
                     if self._raft_started:
-                        self.wire_raft.add_peer(meta.name, meta.rpc_addr)
+                        # post-bootstrap additions are LOG-REPLICATED: the
+                        # leader stages the peer nonvoter -> voter; other
+                        # nodes only retarget addresses of known peers and
+                        # learn new ones from the committed config entries
+                        # — a minority partition can never grow its own
+                        # voter set
+                        if not self.wire_raft.add_peer_staged(
+                            meta.name, meta.rpc_addr
+                        ):
+                            self.wire_raft.note_peer_address(
+                                meta.name, meta.rpc_addr
+                            )
                     else:
                         self._maybe_bootstrap_raft_locked()
             elif status == "left":

@@ -67,7 +67,9 @@ class ScriptCheckRunner:
         while not self._stop.is_set():
             try:
                 out, code = self.exec_fn(self.cmd, self.timeout_s)
-                status = "passing" if code == 0 else "critical"
+                # Consul's script convention (script.go): 0 passing,
+                # 1 warning (degraded but discoverable), else critical
+                status = {0: "passing", 1: "warning"}.get(code, "critical")
                 output = out.decode(errors="replace") if isinstance(out, bytes) else str(out)
             except Exception as e:  # noqa: BLE001 — exec failure = critical
                 status, output = "critical", str(e)

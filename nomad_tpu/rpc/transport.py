@@ -146,6 +146,9 @@ class RPCServer:
         self._region_pools_lock = threading.Lock()
 
         outer = self
+        self._active_conns: set = set()
+        self._active_lock = threading.Lock()
+        self._stopping = False
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
@@ -159,6 +162,16 @@ class RPCServer:
                     except (OSError, ssl.SSLError) as e:
                         outer.logger.debug("TLS handshake failed: %s", e)
                         return
+                with outer._active_lock:
+                    if outer._stopping:
+                        # raced a stop(): close instead of serving — a
+                        # dead server must not keep answering
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        return
+                    outer._active_conns.add(sock)
                 try:
                     while True:
                         frame = _recv_frame(sock)
@@ -167,6 +180,9 @@ class RPCServer:
                         _send_frame(sock, encode(resp))
                 except (ConnectionError, OSError, ssl.SSLError):
                     pass
+                finally:
+                    with outer._active_lock:
+                        outer._active_conns.discard(sock)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -268,6 +284,22 @@ class RPCServer:
     def stop(self) -> None:
         self._tcp.shutdown()
         self._tcp.server_close()
+        # a stopped server must stop ANSWERING, not just accepting: close
+        # established connections too, or clients pinned to a dead server
+        # never observe the death (and never fail over)
+        with self._active_lock:
+            self._stopping = True
+            conns = list(self._active_conns)
+            self._active_conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
         if self._forward_pool is not None:
             self._forward_pool.close()
         with self._region_pools_lock:

@@ -97,6 +97,14 @@ class WireRaft:
         self.logger = logging.getLogger(f"nomad_tpu.raft.{self.node_id}")
         self.rpc = rpc
         self.peers: Dict[str, Tuple[str, int]] = dict(peers or {})
+        # staged (log-replicated) membership: peers added through the log
+        # start as NONVOTERS — replicated to but outside quorum/election
+        # math — and promote to voters once caught up (the reference gets
+        # this from hashicorp/raft's staged configuration changes,
+        # leader.go:859)
+        self.nonvoters: set = set()
+        self._self_nonvoter = False
+        self._staged: Dict[str, int] = {}  # peer -> catch-up target index
         self._clients: Dict[str, RPCClient] = {}
 
         self._lock = threading.RLock()
@@ -303,17 +311,80 @@ class WireRaft:
             self.peers.pop(peer_id, None)
             self.next_index.pop(peer_id, None)
             self.match_index.pop(peer_id, None)
+            if peer_id in self.nonvoters:
+                self.nonvoters.discard(peer_id)
+                self._persist_meta_locked()
+            self._staged.pop(peer_id, None)
             client = self._clients.pop(peer_id, None)
         if client is not None:
             client.close()
 
     PEER_REMOVE = "_raft-peer-remove"
+    PEER_ADD = "_raft-peer-add"
 
     def remove_peer_replicated(self, peer_id: str) -> None:
         """Leader-only: commit the removal through the log so every
         replica shrinks its configuration at the same log position (the
         single-server membership-change protocol)."""
         self.apply(0, self.PEER_REMOVE, peer_id)
+
+    def note_peer_address(self, peer_id: str, addr: Tuple[str, int]) -> None:
+        """Gossip address retarget for an ALREADY-CONFIGURED peer (restart
+        with an ephemeral port). Never grows the configuration — adds go
+        through the log (add_peer_staged)."""
+        with self._lock:
+            if peer_id not in self.peers:
+                return
+        self.add_peer(peer_id, addr)
+
+    def add_peer_staged(self, peer_id: str, addr: Tuple[str, int]) -> bool:
+        """Leader-only log-replicated peer addition: the peer enters the
+        configuration as a NONVOTER (replicated to, excluded from quorum
+        and elections) and is promoted to voter once its match index
+        reaches the staging point — so a minority partition can never
+        grow its own voter set, and an add during a partition commits on
+        exactly one side. Returns False when not leader (the caller
+        retries after the next leadership change)."""
+        addr = tuple(addr)
+        with self._lock:
+            if peer_id == self.node_id:
+                return True
+            if self.state != LEADER:
+                return False
+            if peer_id in self.peers and peer_id not in self.nonvoters:
+                existing = self.peers.get(peer_id)
+                if existing != addr:
+                    pass  # retarget below, outside the lock
+                else:
+                    return True
+                retarget = True
+            else:
+                retarget = False
+                if peer_id in self._staged or peer_id in self.nonvoters:
+                    return True  # staging already in flight
+        if retarget:
+            self.add_peer(peer_id, addr)
+            return True
+        self._apply_async(
+            self.PEER_ADD, {"id": peer_id, "addr": list(addr), "voter": False}
+        )
+        return True
+
+    def _apply_async(self, entry_type: str, payload) -> None:
+        """Leader-side append WITHOUT waiting for commit (safe from
+        replicator threads, which must not block on their own quorum)."""
+        with self._lock:
+            if self.state != LEADER:
+                return
+            index = self._last_index() + 1
+            self._append_locked(index, self.current_term, entry_type, payload)
+            self.match_index[self.node_id] = index
+            self._repl_cv.notify_all()
+            if not self._voter_peers():
+                self._advance_commit_locked()
+
+    def _voter_peers(self):
+        return [p for p in self.peers if p not in self.nonvoters]
 
     # -- persistence -----------------------------------------------------
 
@@ -323,6 +394,12 @@ class WireRaft:
                 meta = json.load(f)
             self.current_term = meta.get("term", 0)
             self.voted_for = meta.get("voted_for")
+            # voter/nonvoter overlay survives restarts (the replicated
+            # config entries are replay-skipped behind the boundary, so
+            # without this a restarted node would forget who is staged
+            # — and a restarted nonvoter would campaign)
+            self.nonvoters = set(meta.get("nonvoters", []))
+            self._self_nonvoter = bool(meta.get("self_nonvoter", False))
         if self._snapshot_path and os.path.exists(self._snapshot_path):
             with open(self._snapshot_path, "rb") as f:
                 index, term, state_blob = _decode_disk_blob(f.read())
@@ -351,7 +428,11 @@ class WireRaft:
             return
         tmp = self._meta_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": self.current_term, "voted_for": self.voted_for}, f)
+            json.dump({
+                "term": self.current_term, "voted_for": self.voted_for,
+                "nonvoters": sorted(self.nonvoters),
+                "self_nonvoter": self._self_nonvoter,
+            }, f)
         os.replace(tmp, self._meta_path)
 
     def _append_locked(self, index: int, term: int, entry_type: str, payload) -> None:
@@ -425,6 +506,11 @@ class WireRaft:
             self.next_index[peer_id] = last + 1
             self.match_index[peer_id] = 0
         self.match_index[self.node_id] = last
+        # staging bookkeeping is leader-local: a new leader re-stages any
+        # nonvoters it inherited from the replicated config so their
+        # promotion still happens
+        for peer_id in self.nonvoters:
+            self._staged[peer_id] = last
         self._persist_meta_locked()
         self._was_leader = True
         # a no-op barrier entry lets the new leader commit entries from
@@ -446,6 +532,11 @@ class WireRaft:
                     continue
                 if time.monotonic() < self._election_deadline:
                     continue
+                if self._self_nonvoter:
+                    # staged nonvoters never campaign; the leader promotes
+                    # them once caught up
+                    self._election_deadline = self._random_deadline()
+                    continue
                 # start an election
                 self.state = CANDIDATE
                 self.current_term += 1
@@ -455,9 +546,10 @@ class WireRaft:
                 self._election_deadline = self._random_deadline()
                 last_index = self._last_index()
                 last_term = self._last_term()
+                voters = self._voter_peers()
             votes = 1
-            needed = (len(self.peers) + 1) // 2 + 1
-            for peer_id in list(self.peers):
+            needed = (len(voters) + 1) // 2 + 1
+            for peer_id in list(voters):
                 if self._shutdown.is_set():
                     return
                 try:
@@ -574,6 +666,16 @@ class WireRaft:
                 )
                 self.next_index[peer_id] = self.match_index[peer_id] + 1
                 self._advance_commit_locked()
+                # staged nonvoter caught up -> promote to voter through
+                # the log (async append; RLock makes this re-entrant)
+                target = self._staged.get(peer_id)
+                if target is not None and self.match_index[peer_id] >= target:
+                    self._staged.pop(peer_id, None)
+                    addr = self.peers.get(peer_id)
+                    if addr is not None:
+                        self._apply_async(self.PEER_ADD, {
+                            "id": peer_id, "addr": list(addr), "voter": True,
+                        })
                 if self.next_index[peer_id] <= self._last_index():
                     self._repl_cv.notify_all()  # more to send
             else:
@@ -587,14 +689,18 @@ class WireRaft:
                 self._repl_cv.notify_all()
 
     def _advance_commit_locked(self) -> None:
-        """Commit = highest index replicated on a quorum, current term only."""
-        cluster = len(self.peers) + 1
+        """Commit = highest index replicated on a VOTER quorum, current
+        term only (nonvoters receive entries but never count)."""
+        voters = self._voter_peers()
+        cluster = len(voters) + 1
         needed = cluster // 2 + 1
+        voter_set = set(voters) | {self.node_id}
         for index in range(self._last_index(), self.commit_index, -1):
             if self._term_at(index) != self.current_term:
                 break
             count = sum(
-                1 for m in self.match_index.values() if m >= index
+                1 for p, m in self.match_index.items()
+                if m >= index and p in voter_set
             )
             if count >= needed:
                 self.commit_index = index
@@ -617,6 +723,28 @@ class WireRaft:
                 if payload != self.node_id and index > boundary:
                     # RLock: safe to re-enter remove_peer while applying
                     self.remove_peer(payload)
+                if self.state == LEADER:
+                    self._apply_results[index] = None
+                continue
+            if entry_type == self.PEER_ADD:
+                boundary = getattr(self, "_config_replay_boundary", 0)
+                if index > boundary:
+                    pid = payload["id"]
+                    voter = bool(payload.get("voter"))
+                    if pid == self.node_id:
+                        # we're the subject: learn our own voter status
+                        self._self_nonvoter = not voter
+                    else:
+                        self.add_peer(pid, tuple(payload.get("addr") or ()))
+                        if voter:
+                            self.nonvoters.discard(pid)
+                            self._staged.pop(pid, None)
+                        else:
+                            self.nonvoters.add(pid)
+                            if self.state == LEADER:
+                                # promote once the peer catches up to HERE
+                                self._staged[pid] = index
+                    self._persist_meta_locked()
                 if self.state == LEADER:
                     self._apply_results[index] = None
                 continue

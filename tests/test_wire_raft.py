@@ -393,3 +393,109 @@ class TestReplicatedPeerRemoval:
         leader.raft.apply(0, NODE_REGISTER, n)
         wait_until(lambda: survivor.fsm.state.node_by_id(n.id) is not None,
                    msg="post-removal commit")
+
+
+class TestStagedMembership:
+    """Log-replicated peer ADDITION (the reference gets staged
+    nonvoter->voter configuration changes from vendored hashicorp/raft,
+    used at leader.go:859): adds commit through the log, so every
+    replica grows its configuration at the same position and a minority
+    partition can never grow its own voter set."""
+
+    @staticmethod
+    def _sever(node, peer_id):
+        """Cut node's OUTBOUND RPC to peer_id; returns a restore fn."""
+        from nomad_tpu.rpc.transport import RPCError
+
+        orig = node.raft._client
+
+        def gated(pid, _orig=orig):
+            if pid == peer_id:
+                raise RPCError("partitioned")
+            return _orig(pid)
+
+        node.raft._client = gated
+        return lambda: setattr(node.raft, "_client", orig)
+
+    def test_staged_add_promotes_to_voter(self, cluster):
+        nodes = cluster(3)
+        wait_until(lambda: leader_of(nodes) is not None, msg="leader")
+        leader = leader_of(nodes)
+        leader.raft.apply(0, NODE_REGISTER, mock.node())
+
+        # a fourth server appears (gossip handed it the current peer map)
+        d = Node("n3")
+        nodes.append(d)  # fixture cleanup
+        d.wire(nodes[:3] + [d])
+        assert leader.raft.add_peer_staged("n3", d.rpc.addr)
+
+        # every replica (the new one included) converges on a 4-server
+        # VOTER configuration
+        wait_until(
+            lambda: all(
+                len(n.raft.peers) == 3
+                and not n.raft.nonvoters
+                and not n.raft._self_nonvoter
+                for n in nodes
+            ),
+            timeout=12, msg="staged add promoted everywhere",
+        )
+        # the new voter has the replicated state
+        wait_until(lambda: len(d.fsm.state.nodes()) == 1, msg="catch-up")
+
+    def test_add_during_partition_heals_to_single_config(self, cluster):
+        nodes = cluster(3)
+        wait_until(lambda: leader_of(nodes) is not None, msg="leader")
+        leader = leader_of(nodes)
+        victim = next(n for n in nodes if n.raft.state != LEADER)
+        others = [n for n in nodes if n is not victim]
+
+        # full partition: victim <-/-> {others}
+        restores = []
+        for other in others:
+            restores.append(self._sever(other, victim.node_id))
+            restores.append(self._sever(victim, other.node_id))
+
+        # add a fourth server while partitioned: commits on the majority
+        d = Node("n3")
+        nodes.append(d)
+        d.wire(nodes[:3] + [d])
+        restores.append(self._sever(victim, "n3"))
+        restores.append(self._sever(d, victim.node_id))
+        assert leader.raft.add_peer_staged("n3", d.rpc.addr)
+        majority = others + [d]
+        wait_until(
+            lambda: all(
+                "n3" in (set(n.raft.peers) | {n.node_id})
+                and not n.raft.nonvoters
+                for n in majority
+            ),
+            timeout=12, msg="add committed on the majority side",
+        )
+        # the minority never learned the add, and CANNOT stage one itself
+        assert "n3" not in victim.raft.peers
+        assert victim.raft.add_peer_staged("n3", d.rpc.addr) is False
+        assert "n3" not in victim.raft.peers
+
+        # heal: the victim converges onto the SAME single configuration
+        for restore in restores:
+            restore()
+        wait_until(
+            lambda: set(victim.raft.peers) | {victim.node_id}
+            == {"n0", "n1", "n2", "n3"}
+            and not victim.raft.nonvoters,
+            timeout=12, msg="healed minority adopts the replicated config",
+        )
+        # exactly one leader across the healed 4-voter cluster, and writes
+        # replicate everywhere (no split quorum)
+        wait_until(lambda: leader_of(nodes) is not None, timeout=12,
+                   msg="single leader after heal")
+        final_leader = leader_of(nodes)
+        marker = mock.node()
+        final_leader.raft.apply(0, NODE_REGISTER, marker)
+        wait_until(
+            lambda: all(
+                n.fsm.state.node_by_id(marker.id) is not None for n in nodes
+            ),
+            timeout=12, msg="post-heal replication to all four",
+        )
