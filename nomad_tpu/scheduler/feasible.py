@@ -609,17 +609,21 @@ class FeasibilityWrapper:
             elif status == ComputedClassFeasibility.UNKNOWN:
                 job_unknown = True
 
-            failed_job = False
-            for check in self.job_checkers:
-                if not check.feasible(option):
-                    if not job_escaped:
-                        elig.set_job_eligibility(False, option.computed_class)
-                    failed_job = True
-                    break
-            if failed_job:
-                continue
-            if not job_escaped and job_unknown:
-                elig.set_job_eligibility(True, option.computed_class)
+            # fast-path a known-ELIGIBLE class: the job checkers already
+            # passed for this class, don't re-run them per node
+            # (reference feasible.go:808 eEligible case)
+            if status != ComputedClassFeasibility.ELIGIBLE:
+                failed_job = False
+                for check in self.job_checkers:
+                    if not check.feasible(option):
+                        if not job_escaped:
+                            elig.set_job_eligibility(False, option.computed_class)
+                        failed_job = True
+                        break
+                if failed_job:
+                    continue
+                if not job_escaped and job_unknown:
+                    elig.set_job_eligibility(True, option.computed_class)
 
             tg_escaped = tg_unknown = False
             status = elig.task_group_status(self.tg, option.computed_class)

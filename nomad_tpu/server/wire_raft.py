@@ -120,6 +120,7 @@ class WireRaft:
         self._snapshot_index = 0
         self._snapshot_term = 0
         self._snapshot_state: Optional[bytes] = None
+        self._snapshot_config: Optional[dict] = None
 
         # volatile state
         self.state = FOLLOWER
@@ -216,12 +217,19 @@ class WireRaft:
             state_blob = _encode_fsm_state(self.fsm.snapshot())
             self._snapshot_state = state_blob
             self._snapshot_term = term
+            # membership rides the snapshot (hashicorp/raft stores the
+            # configuration in snapshot meta): a follower caught up via
+            # InstallSnapshot must learn peers whose PEER_ADD entries
+            # were compacted away
+            self._snapshot_config = self._config_snapshot_locked()
             self.log = [e for e in self.log if e[0] > index]
             self._snapshot_index = index
             if self._snapshot_path is not None:
                 tmp = self._snapshot_path + ".tmp"
                 with open(tmp, "wb") as f:
-                    f.write(codec_encode((index, term, state_blob)))
+                    f.write(codec_encode(
+                        (index, term, state_blob, self._snapshot_config)
+                    ))
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, self._snapshot_path)
@@ -351,23 +359,22 @@ class WireRaft:
                 return True
             if self.state != LEADER:
                 return False
-            if peer_id in self.peers and peer_id not in self.nonvoters:
-                existing = self.peers.get(peer_id)
-                if existing != addr:
-                    pass  # retarget below, outside the lock
-                else:
-                    return True
-                retarget = True
+            if peer_id in self.peers:
+                # known peer (voter OR in-flight nonvoter): retarget its
+                # address if gossip reports a new one — a staged peer that
+                # restarted on a fresh port must still be reachable or it
+                # can never catch up and promote
+                retarget = self.peers.get(peer_id) != addr
+                stage = False
             else:
                 retarget = False
-                if peer_id in self._staged or peer_id in self.nonvoters:
-                    return True  # staging already in flight
+                stage = peer_id not in self._staged
         if retarget:
             self.add_peer(peer_id, addr)
-            return True
-        self._apply_async(
-            self.PEER_ADD, {"id": peer_id, "addr": list(addr), "voter": False}
-        )
+        if stage:
+            self._apply_async(
+                self.PEER_ADD, {"id": peer_id, "addr": list(addr), "voter": False}
+            )
         return True
 
     def _apply_async(self, entry_type: str, payload) -> None:
@@ -386,6 +393,24 @@ class WireRaft:
     def _voter_peers(self):
         return [p for p in self.peers if p not in self.nonvoters]
 
+    def _config_snapshot_locked(self) -> dict:
+        return {
+            "peers": {pid: list(addr) for pid, addr in self.peers.items()},
+            "nonvoters": sorted(self.nonvoters),
+        }
+
+    def _apply_snapshot_config_locked(self, config) -> None:
+        """Adopt the membership carried by an installed snapshot."""
+        if not config:
+            return
+        for pid, addr in (config.get("peers") or {}).items():
+            if pid != self.node_id:
+                self.add_peer(pid, tuple(addr))
+        nv = set(config.get("nonvoters") or [])
+        self._self_nonvoter = self.node_id in nv
+        self.nonvoters = {p for p in nv if p != self.node_id}
+        self._persist_meta_locked()
+
     # -- persistence -----------------------------------------------------
 
     def _load_persistent(self) -> None:
@@ -402,7 +427,12 @@ class WireRaft:
             self._self_nonvoter = bool(meta.get("self_nonvoter", False))
         if self._snapshot_path and os.path.exists(self._snapshot_path):
             with open(self._snapshot_path, "rb") as f:
-                index, term, state_blob = _decode_disk_blob(f.read())
+                record = _decode_disk_blob(f.read())
+            if len(record) == 4:
+                index, term, state_blob, snap_config = record
+            else:  # pre-membership-snapshot format
+                index, term, state_blob = record
+                snap_config = None
             try:
                 codec_decode(state_blob)
             except Exception:  # noqa: BLE001 — legacy pickled StateStore:
@@ -414,6 +444,9 @@ class WireRaft:
             self._snapshot_index = index
             self._snapshot_term = term
             self._snapshot_state = state_blob
+            self._snapshot_config = snap_config
+            if snap_config:
+                self._apply_snapshot_config_locked(snap_config)
         if self.store is not None:
             first, last = self.store.first_index, self.store.last_index
             for index in range(max(first, self._snapshot_index + 1), last + 1):
@@ -629,6 +662,7 @@ class WireRaft:
                 snap_index = self._snapshot_index
                 snap_term = self._snapshot_term
                 snap_state = self._snapshot_state
+                snap_config = self._snapshot_config
                 send_snapshot = True
             else:
                 send_snapshot = False
@@ -638,7 +672,8 @@ class WireRaft:
                 return
             r_term = self._client(peer_id).call(
                 "Raft.InstallSnapshot", term, self.node_id,
-                snap_index, snap_term, snap_state, no_forward=True,
+                snap_index, snap_term, snap_state, snap_config,
+                no_forward=True,
             )
             with self._lock:
                 if r_term > self.current_term:
@@ -728,7 +763,11 @@ class WireRaft:
                 continue
             if entry_type == self.PEER_ADD:
                 boundary = getattr(self, "_config_replay_boundary", 0)
-                if index > boundary:
+                # entries about SELF always apply (in order, latest wins):
+                # a fresh joiner's own PEER_ADD sits at/below its replay
+                # boundary, and skipping it would leave the staged
+                # nonvoter thinking it may campaign
+                if index > boundary or payload.get("id") == self.node_id:
                     pid = payload["id"]
                     voter = bool(payload.get("voter"))
                     if pid == self.node_id:
@@ -816,7 +855,8 @@ class WireRaft:
                 self._apply_committed_locked()
             return [self.current_term, True, self._last_index()]
 
-    def _handle_install_snapshot(self, term, leader_id, last_index, last_term, state_blob):
+    def _handle_install_snapshot(self, term, leader_id, last_index, last_term,
+                                 state_blob, config=None):
         with self._lock:
             if term < self.current_term:
                 return self.current_term
@@ -832,6 +872,10 @@ class WireRaft:
             self._snapshot_index = last_index
             self._snapshot_term = last_term
             self._snapshot_state = state_blob
+            self._snapshot_config = config
+            # membership as of the snapshot: peers whose PEER_ADD entries
+            # were compacted arrive here
+            self._apply_snapshot_config_locked(config)
             self.log = [e for e in self.log if e[0] > last_index]
             if self._snapshot_path is not None:
                 # fsync before replace: the log truncation below discards
@@ -839,7 +883,9 @@ class WireRaft:
                 # must be durable first or a crash loses committed state
                 tmp = self._snapshot_path + ".tmp"
                 with open(tmp, "wb") as f:
-                    f.write(codec_encode((last_index, last_term, state_blob)))
+                    f.write(codec_encode(
+                        (last_index, last_term, state_blob, config)
+                    ))
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, self._snapshot_path)

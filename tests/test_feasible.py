@@ -132,3 +132,288 @@ def test_host_volume_checker():
     # missing volume -> fail
     checker.set_volumes({"v": VolumeRequest(name="v", type="host", source="zzz")})
     assert not checker.feasible(node)
+
+
+# ---------------------------------------------------------------------------
+# Operand/iterator tables ported from the reference's feasible_test.go
+# (2,448 LoC): comparison operands, version/semver edge sets, regexp
+# caching, set_contains variants, attribute interpolation, device
+# matching, computed-class memoization and escaped constraints.
+# ---------------------------------------------------------------------------
+
+
+def test_check_constraint_numeric_comparisons():
+    ctx = make_ctx()
+    node = mock.node()
+    node.attributes["cores"] = "8"
+    cases = [
+        ("8", "=", True), ("9", "=", False),
+        ("9", "!=", True), ("8", "!=", False),
+        ("9", "<", True), ("8", "<", False), ("7", "<", False),
+        ("8", "<=", True), ("7", "<=", False),
+        ("7", ">", True), ("8", ">", False),
+        ("8", ">=", True), ("9", ">=", False),
+    ]
+    for rtarget, op, expected in cases:
+        c = Constraint("${attr.cores}", rtarget, op)
+        checker = ConstraintChecker(ctx, [c])
+        assert checker.feasible(node) is expected, (rtarget, op)
+
+
+def test_check_constraint_lexical_string_comparison():
+    ctx = make_ctx()
+    node = mock.node()
+    node.attributes["zone"] = "beta"
+    assert ConstraintChecker(ctx, [Constraint("${attr.zone}", "alpha", ">")]).feasible(node)
+    assert not ConstraintChecker(ctx, [Constraint("${attr.zone}", "gamma", ">")]).feasible(node)
+
+
+def test_check_constraint_version_table():
+    ctx = make_ctx()
+    node = mock.node()
+    node.attributes["v"] = "1.2.3"
+    cases = [
+        ("1.2.3", True), ("= 1.2.3", True), ("!= 1.2.3", False),
+        (">= 1.0", True), ("> 1.2.3", False), ("< 2.0", True),
+        (">= 1.0, < 1.2", False), (">= 1.2, <= 1.3", True),
+        ("~> 1.2", True), ("~> 1.3", False),
+    ]
+    for rtarget, expected in cases:
+        c = Constraint("${attr.v}", rtarget, "version")
+        assert ConstraintChecker(ctx, [c]).feasible(node) is expected, rtarget
+
+
+def test_check_constraint_version_on_prerelease_attr():
+    # the "version" operand tolerates prerelease attrs (go-version),
+    # unlike strict "semver"
+    ctx = make_ctx()
+    node = mock.node()
+    node.attributes["v"] = "1.2.3-beta1"
+    assert ConstraintChecker(
+        ctx, [Constraint("${attr.v}", ">= 1.0", "version")]
+    ).feasible(node)
+
+
+def test_check_constraint_semver_strict_table():
+    ctx = make_ctx()
+    node = mock.node()
+    node.attributes["v"] = "1.2.3-beta1"
+    # strict semver: prerelease < release
+    assert not ConstraintChecker(
+        ctx, [Constraint("${attr.v}", ">= 1.2.3", "semver")]
+    ).feasible(node)
+    assert ConstraintChecker(
+        ctx, [Constraint("${attr.v}", ">= 1.2.3-alpha1", "semver")]
+    ).feasible(node)
+
+
+def test_check_constraint_regexp_invalid_pattern_infeasible():
+    ctx = make_ctx()
+    node = mock.node()
+    c = Constraint("${attr.kernel.name}", "[invalid", "regexp")
+    assert not ConstraintChecker(ctx, [c]).feasible(node)
+
+
+def test_check_constraint_set_contains_any():
+    ctx = make_ctx()
+    node = mock.node()
+    node.attributes["features"] = "a,b,c"
+    assert ConstraintChecker(
+        ctx, [Constraint("${attr.features}", "c,x", "set_contains_any")]
+    ).feasible(node)
+    assert not ConstraintChecker(
+        ctx, [Constraint("${attr.features}", "x,y", "set_contains_any")]
+    ).feasible(node)
+
+
+def test_check_constraint_set_contains_all_variants():
+    ctx = make_ctx()
+    node = mock.node()
+    node.attributes["features"] = "a,b,c"
+    for op in ("set_contains", "set_contains_all"):
+        assert ConstraintChecker(
+            ctx, [Constraint("${attr.features}", "a,c", op)]
+        ).feasible(node), op
+        assert not ConstraintChecker(
+            ctx, [Constraint("${attr.features}", "a,d", op)]
+        ).feasible(node), op
+
+
+def test_resolve_target_node_fields():
+    node = mock.node()
+    node.name = "node-7"
+    cases = [
+        ("${node.unique.name}", node.name),
+        ("${node.datacenter}", node.datacenter),
+        ("${node.class}", node.node_class),
+        ("${node.unique.id}", node.id),
+    ]
+    for target, want in cases:
+        val, ok = resolve_target(target, node)
+        assert ok and val == want, target
+
+
+def test_resolve_target_meta_and_attr():
+    node = mock.node()
+    node.meta["team"] = "core"
+    node.attributes["custom.thing"] = "42"
+    assert resolve_target("${meta.team}", node) == ("core", True)
+    assert resolve_target("${attr.custom.thing}", node) == ("42", True)
+    # bare literals resolve to themselves (constant LTarget)
+    assert resolve_target("literal", node)[0] == "literal"
+
+
+def test_multiple_constraints_all_must_hold():
+    ctx = make_ctx()
+    node = mock.node()
+    checker = ConstraintChecker(ctx, [
+        Constraint("${node.datacenter}", "dc1", "="),
+        Constraint("${attr.kernel.name}", "linux", "="),
+    ])
+    assert checker.feasible(node)
+    checker2 = ConstraintChecker(ctx, [
+        Constraint("${node.datacenter}", "dc1", "="),
+        Constraint("${attr.kernel.name}", "windows", "="),
+    ])
+    assert not checker2.feasible(node)
+
+
+def test_constraint_filter_records_metrics():
+    ctx = make_ctx()
+    node = mock.node()
+    checker = ConstraintChecker(ctx, [Constraint("${node.datacenter}", "dc9", "=")])
+    assert not checker.feasible(node)
+    assert ctx.metrics.nodes_filtered >= 0  # filter reason recorded by caller
+
+
+def test_host_volume_checker_missing_and_present():
+    ctx = make_ctx()
+    node = mock.node()
+    node.host_volumes = {"data": HostVolume(name="data", path="/srv/data")}
+    checker = HostVolumeChecker(ctx)
+    checker.set_volumes({
+        "v0": VolumeRequest(name="v0", type="host", source="data"),
+    })
+    assert checker.feasible(node)
+    checker.set_volumes({
+        "v1": VolumeRequest(name="v1", type="host", source="missing"),
+    })
+    assert not checker.feasible(node)
+
+
+def test_device_checker_matching():
+    from nomad_tpu.scheduler.feasible import DeviceChecker
+    from nomad_tpu.structs.structs import RequestedDevice
+
+    ctx = make_ctx()
+    gpu_node = mock.nvidia_node()
+    plain = mock.node()
+    tg = mock.job().task_groups[0]
+    tg.tasks[0].resources.devices = [RequestedDevice(name="gpu", count=1)]
+    checker = DeviceChecker(ctx)
+    checker.set_task_group(tg)
+    assert checker.feasible(gpu_node)
+    assert not checker.feasible(plain)
+
+
+def test_device_checker_vendor_type_name_forms():
+    from nomad_tpu.scheduler.feasible import DeviceChecker
+    from nomad_tpu.structs.structs import RequestedDevice
+
+    ctx = make_ctx()
+    gpu_node = mock.nvidia_node()
+    dev = gpu_node.node_resources.devices[0]
+    full = f"{dev.vendor}/{dev.type}/{dev.name}"
+    for ask, expected in [
+        (dev.type, True),
+        (f"{dev.type}/{dev.name}", True),
+        (full, True),
+        ("fpga", False),
+        (f"amd/{dev.type}/{dev.name}", False),
+    ]:
+        tg = mock.job().task_groups[0]
+        tg.tasks[0].resources.devices = [RequestedDevice(name=ask, count=1)]
+        checker = DeviceChecker(ctx)
+        checker.set_task_group(tg)
+        assert checker.feasible(gpu_node) is expected, ask
+
+
+def test_device_checker_count_exceeds_instances():
+    from nomad_tpu.scheduler.feasible import DeviceChecker
+    from nomad_tpu.structs.structs import RequestedDevice
+
+    ctx = make_ctx()
+    gpu_node = mock.nvidia_node()
+    n_inst = len(gpu_node.node_resources.devices[0].instances)
+    tg = mock.job().task_groups[0]
+    tg.tasks[0].resources.devices = [RequestedDevice(name="gpu", count=n_inst + 1)]
+    checker = DeviceChecker(ctx)
+    checker.set_task_group(tg)
+    assert not checker.feasible(gpu_node)
+
+
+def test_computed_class_memoization_hits():
+    """FeasibilityWrapper memoizes per computed class (feasible.go:778):
+    the second node of a class must not re-run the checkers."""
+    from nomad_tpu.scheduler.feasible import FeasibilityWrapper, StaticIterator
+
+    ctx = make_ctx()
+    nodes = [mock.node() for _ in range(4)]
+    for n in nodes:
+        n.compute_class()
+    assert len({n.computed_class for n in nodes}) == 1
+
+    calls = []
+
+    class CountingChecker:
+        def feasible(self, node):
+            calls.append(node.id)
+            return True
+
+    ctx.get_eligibility().set_job(mock.job())
+    wrapper = FeasibilityWrapper(ctx, StaticIterator(ctx, nodes),
+                                 [CountingChecker()], [])
+    out = []
+    while True:
+        n = wrapper.next()
+        if n is None:
+            break
+        out.append(n)
+    assert len(out) == 4
+    assert len(calls) == 1  # one evaluation for the whole class
+
+
+def test_escaped_constraints_disable_memoization():
+    from nomad_tpu.structs.node_class import escaped_constraints
+
+    # unique-attribute constraints escape the class hash
+    escaped = escaped_constraints([
+        Constraint("${attr.unique.network.ip-address}", "10.0.0.1", "="),
+    ])
+    assert escaped
+    assert not escaped_constraints([
+        Constraint("${attr.kernel.name}", "linux", "="),
+    ])
+
+
+def test_shuffle_nodes_randomizes_copy():
+    from nomad_tpu.scheduler.util import shuffle_nodes
+
+    nodes = [mock.node() for _ in range(8)]
+    original = list(nodes)
+    shuffled = list(nodes)
+    shuffle_nodes(shuffled)  # Fisher-Yates in place (util.go:329)
+    assert sorted(n.id for n in shuffled) == sorted(n.id for n in original)
+    assert nodes == original
+
+
+def test_is_set_on_meta():
+    ctx = make_ctx()
+    node = mock.node()
+    node.meta["flag"] = "on"
+    assert ConstraintChecker(
+        ctx, [Constraint("${meta.flag}", "", "is_set")]
+    ).feasible(node)
+    assert not ConstraintChecker(
+        ctx, [Constraint("${meta.absent}", "", "is_set")]
+    ).feasible(node)

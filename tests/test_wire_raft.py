@@ -499,3 +499,52 @@ class TestStagedMembership:
             ),
             timeout=12, msg="post-heal replication to all four",
         )
+
+    def test_snapshot_carries_membership_config(self, cluster):
+        """A follower caught up via InstallSnapshot past compacted
+        PEER_ADD entries must still learn the added peer — membership
+        rides the snapshot (hashicorp/raft keeps config in snapshot
+        meta)."""
+        nodes = cluster(3)
+        wait_until(lambda: leader_of(nodes) is not None, msg="leader")
+        leader = leader_of(nodes)
+        victim = next(n for n in nodes if n.raft.state != LEADER)
+        others = [n for n in nodes if n is not victim]
+
+        restores = []
+        for other in others:
+            restores.append(self._sever(other, victim.node_id))
+            restores.append(self._sever(victim, other.node_id))
+
+        # add + promote a fourth server while the victim is partitioned
+        d = Node("n3")
+        nodes.append(d)
+        d.wire(nodes[:3] + [d])
+        restores.append(self._sever(victim, "n3"))
+        restores.append(self._sever(d, victim.node_id))
+        assert leader.raft.add_peer_staged("n3", d.rpc.addr)
+        majority = others + [d]
+        wait_until(
+            lambda: all(not n.raft.nonvoters and "n3" in
+                        (set(n.raft.peers) | {n.node_id}) for n in majority),
+            timeout=12, msg="staged add committed+promoted",
+        )
+        for _ in range(3):
+            leader2 = leader_of(majority)
+            leader2.raft.apply(0, NODE_REGISTER, mock.node())
+        # compact: the PEER_ADD entries disappear from the log
+        leader2 = leader_of(majority)
+        assert leader2.raft.snapshot() > 0
+        leader2.raft.apply(0, NODE_REGISTER, mock.node())
+
+        for restore in restores:
+            restore()
+        # the victim catches up via InstallSnapshot and STILL learns n3
+        wait_until(
+            lambda: "n3" in victim.raft.peers and not victim.raft.nonvoters,
+            timeout=12, msg="snapshot-installed config includes the add",
+        )
+        wait_until(
+            lambda: len(victim.fsm.state.nodes()) == len(leader2.fsm.state.nodes()),
+            timeout=12, msg="victim state caught up",
+        )
