@@ -51,6 +51,13 @@ class AgentConfig:
     acl_replication_interval: float = 30.0
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
+    # telemetry push sinks (reference command/agent/command.go:976-1018:
+    # statsite/statsd/DataDog fan-out next to the inmem sink).
+    # "host:port" UDP addresses; statsite speaks the statsd line protocol
+    telemetry_statsd_address: str = ""
+    telemetry_datadog_address: str = ""
+    telemetry_datadog_tags: Dict[str, str] = field(default_factory=dict)
+    telemetry_prefix: str = ""
     # multi-process consensus: real raft over the RPC transport instead of
     # the in-proc shared log. Requires gossip; with bootstrap_expect > 1
     # the raft holds elections only once that many servers are known
@@ -355,6 +362,7 @@ class Agent:
         with self._lock:
             if self._started:
                 return self
+            self._setup_telemetry_sinks()
             if self.rpc is not None:
                 self.rpc.start()
             if self.server is not None:
@@ -433,10 +441,38 @@ class Agent:
         t = threading.Thread(target=loop, name="retry-join", daemon=True)
         t.start()
 
+    def _setup_telemetry_sinks(self) -> None:
+        """Fan metrics out to the configured push sinks (the reference's
+        setupTelemetry, command.go:976-1018)."""
+        from ..utils import metrics as _metrics
+
+        # construct everything FIRST: a bad address raises before any
+        # sink registers, so a failed start leaks nothing process-global
+        sinks = []
+        if self.config.telemetry_statsd_address:
+            sinks.append(_metrics.StatsdSink(
+                self.config.telemetry_statsd_address,
+                prefix=self.config.telemetry_prefix,
+            ))
+        if self.config.telemetry_datadog_address:
+            sinks.append(_metrics.StatsdSink(
+                self.config.telemetry_datadog_address,
+                prefix=self.config.telemetry_prefix,
+                datadog=True, tags=self.config.telemetry_datadog_tags,
+            ))
+        self._telemetry_sinks = sinks
+        for sink in sinks:
+            _metrics.register_sink(sink)
+
     def shutdown(self) -> None:
         with self._lock:
             if not self._started:
                 return
+            from ..utils import metrics as _metrics
+
+            for sink in getattr(self, "_telemetry_sinks", []):
+                _metrics.deregister_sink(sink)
+            self._telemetry_sinks = []
             self.http.stop()
             if self.client is not None:
                 self.client.shutdown()

@@ -78,8 +78,9 @@ border:1px solid var(--line);border-radius:4px;background:#101418;color:#d6dde6}
 <script>
 "use strict";
 const $ = s => document.querySelector(s);
-const NAV = [["jobs","Jobs"],["nodes","Nodes"],["allocs","Allocations"],
-             ["evals","Evaluations"],["deploys","Deployments"],["servers","Servers"]];
+const NAV = [["jobs","Jobs"],["run","Run Job"],["nodes","Nodes"],
+             ["allocs","Allocations"],["evals","Evaluations"],
+             ["deploys","Deployments"],["servers","Servers"]];
 const tokenBox = $("#token");
 tokenBox.value = localStorage.getItem("nomad_token") || "";
 tokenBox.onchange = () => { localStorage.setItem("nomad_token", tokenBox.value); render(); };
@@ -119,9 +120,58 @@ document.addEventListener("click", e => {
 });
 
 const pages = {
+  // job submit/edit: HCL in, parse -> plan preview -> register
+  // (the Ember app's job-run flow; /v1/jobs/parse + /v1/job/<id>/plan)
+  async run(id) {
+    let seed = "";
+    if (id) {
+      try {
+        const j = await api("/v1/job/" + encodeURIComponent(id));
+        seed = JSON.stringify(j, null, 2);
+      } catch (e) { seed = ""; }
+    }
+    const html = `<h2>${id ? "Edit Job" : "Run Job"}</h2>
+      <p class="mut">Paste an HCL jobspec (or JSON when editing); Plan
+      previews the scheduler diff without committing, Run registers.</p>
+      <textarea id="jobspec" class="termin" style="height:260px"
+        placeholder='job "example" { ... }'>${esc(seed)}</textarea>
+      <p style="margin-top:8px">
+        <button id="plan-btn">Plan</button>
+        <button id="run-btn">Run</button></p>
+      <div id="run-out"></div>`;
+    return {html, after: () => {
+      const out = $("#run-out");
+      async function parsed() {
+        const src = $("#jobspec").value;
+        const trimmed = src.trim();
+        if (trimmed.startsWith("{")) return JSON.parse(trimmed).Job || JSON.parse(trimmed);
+        return api("/v1/jobs/parse", {method: "POST",
+          headers: {"Content-Type": "application/json"},
+          body: JSON.stringify({JobHCL: src})});
+      }
+      $("#plan-btn").addEventListener("click", async () => {
+        try {
+          const job = await parsed();
+          const plan = await api("/v1/job/" + encodeURIComponent(job.ID) + "/plan",
+            {method: "PUT", headers: {"Content-Type": "application/json"},
+             body: JSON.stringify({Job: job, Diff: true})});
+          out.innerHTML = `<h3>Plan</h3><pre>${esc(JSON.stringify(plan, null, 2))}</pre>`;
+        } catch (e) { out.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+      });
+      $("#run-btn").addEventListener("click", async () => {
+        try {
+          const job = await parsed();
+          const r = await api("/v1/jobs", {method: "POST",
+            headers: {"Content-Type": "application/json"},
+            body: JSON.stringify({Job: job})});
+          location.hash = "#/jobs/" + encodeURIComponent(job.ID);
+        } catch (e) { out.innerHTML = `<div class="err">${esc(e.message)}</div>`; }
+      });
+    }};
+  },
   async jobs() {
     const jobs = await api("/v1/jobs");
-    return `<h2>Jobs</h2>` + table(
+    return `<h2>Jobs <a href="#/run" style="float:right;font-size:14px">+ Run Job</a></h2>` + table(
       ["ID","Type","Priority","Status","Groups"],
       jobs.map(j => ({__id: j.ID, cells: [
         esc(j.ID), esc(j.Type), j.Priority, tag(j.Status),
@@ -134,7 +184,8 @@ const pages = {
     const evals = await api(`/v1/job/${encodeURIComponent(id)}/evaluations`);
     return `<div class="crumb"><a href="#/jobs">jobs</a> / ${esc(id)}</div>
       <h2>${esc(j.Name || id)} ${tag(j.Status)}</h2>
-      <p><button class="risk" data-stop-job="${esc(id)}">Stop job</button></p>
+      <p><button class="risk" data-stop-job="${esc(id)}">Stop job</button>
+         <a href="#/run/${encodeURIComponent(id)}"><button>Edit job</button></a></p>
       <div class="kv"><div>Type</div><div>${esc(j.Type)}</div>
         <div>Priority</div><div>${j.Priority}</div>
         <div>Datacenters</div><div>${esc((j.Datacenters||[]).join(", "))}</div>
@@ -422,7 +473,9 @@ async function render() {
   $("#main").innerHTML = html;
   if (typeof result === "object" && result.after) result.after();
   clearTimeout(timer);
-  if (!id) timer = setTimeout(render, 4000);  // auto-refresh list pages
+  // auto-refresh list pages — never the Run Job editor, which would
+  // wipe the jobspec being typed
+  if (!id && page !== "run") timer = setTimeout(render, 4000);
 }
 window.addEventListener("hashchange", render);
 render();

@@ -42,21 +42,19 @@ class BasePlugin:
 
 
 def validate_config(schema: Dict[str, Any], config: Dict[str, Any]) -> list:
-    """Schema-check a plugin config stanza; returns error strings."""
-    errors = []
-    types = {"string": str, "int": int, "bool": bool, "float": (int, float),
-             "list": list, "map": dict}
-    for key, spec in schema.items():
-        if spec.get("required") and key not in config:
-            errors.append(f"missing required plugin config {key!r}")
-        if key in config and "type" in spec:
-            want = types.get(spec["type"])
-            if want is not None and not isinstance(config[key], want):
-                errors.append(
-                    f"plugin config {key!r} must be {spec['type']}, "
-                    f"got {type(config[key]).__name__}"
-                )
-    for key in config:
-        if key not in schema:
-            errors.append(f"unknown plugin config {key!r}")
+    """Schema-check a plugin config stanza; returns error strings.
+
+    Schemas are hclspec-style schema-as-data trees (plugins/hclspec.py —
+    the reference's plugins/shared/hclspec protocol); the legacy flat
+    ``{key: {"type", "required"}}`` form upgrades transparently."""
+    from .hclspec import decode
+
+    _, errors = decode(schema, config or {})
     return errors
+
+
+def decode_config(schema: Dict[str, Any], config: Dict[str, Any]):
+    """Validate AND default-apply: (decoded_config, errors)."""
+    from .hclspec import decode
+
+    return decode(schema, config or {})

@@ -399,17 +399,24 @@ class WireRaft:
             "nonvoters": sorted(self.nonvoters),
         }
 
-    def _apply_snapshot_config_locked(self, config) -> None:
-        """Adopt the membership carried by an installed snapshot."""
+    def _apply_snapshot_config_locked(self, config, voter_overlay: bool = True) -> None:
+        """Adopt the membership carried by an installed snapshot.
+
+        ``voter_overlay=False`` adopts only the PEER SET (addresses):
+        used on local restart, where the persisted meta's voter/nonvoter
+        overlay is at least as new as the snapshot's (it is rewritten on
+        every membership change) and must not be reverted to the
+        snapshot-time view."""
         if not config:
             return
         for pid, addr in (config.get("peers") or {}).items():
             if pid != self.node_id:
                 self.add_peer(pid, tuple(addr))
-        nv = set(config.get("nonvoters") or [])
-        self._self_nonvoter = self.node_id in nv
-        self.nonvoters = {p for p in nv if p != self.node_id}
-        self._persist_meta_locked()
+        if voter_overlay:
+            nv = set(config.get("nonvoters") or [])
+            self._self_nonvoter = self.node_id in nv
+            self.nonvoters = {p for p in nv if p != self.node_id}
+            self._persist_meta_locked()
 
     # -- persistence -----------------------------------------------------
 
@@ -446,7 +453,10 @@ class WireRaft:
             self._snapshot_state = state_blob
             self._snapshot_config = snap_config
             if snap_config:
-                self._apply_snapshot_config_locked(snap_config)
+                # peers only: the meta overlay loaded above is newer than
+                # the snapshot-time voter/nonvoter view
+                self._apply_snapshot_config_locked(snap_config,
+                                                   voter_overlay=False)
         if self.store is not None:
             first, last = self.store.first_index, self.store.last_index
             for index in range(max(first, self._snapshot_index + 1), last + 1):

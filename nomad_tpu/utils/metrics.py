@@ -149,8 +149,83 @@ class InmemSink:
             self._intervals = [_Interval(time.time())]
 
 
+class StatsdSink:
+    """Push sink speaking the statsd line protocol over UDP — covers the
+    reference's statsd AND statsite sinks (statsite is line-compatible),
+    and with ``datadog=True`` emits DogStatsD tag suffixes (the DataDog
+    sink slot, command/agent/command.go:976-1018). Fire-and-forget UDP:
+    a down collector never blocks or fails the server."""
+
+    def __init__(self, address: str, prefix: str = "",
+                 datadog: bool = False, tags: Optional[Dict[str, str]] = None) -> None:
+        import socket
+
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.prefix = prefix
+        self.datadog = datadog
+        self._tag_suffix = ""
+        if datadog and tags:
+            pairs = ",".join(f"{k}:{v}" for k, v in sorted(tags.items()))
+            self._tag_suffix = f"|#{pairs}"
+
+    def _emit(self, name: str, value: float, kind: str) -> None:
+        if self.prefix:
+            name = f"{self.prefix}.{name}"
+        line = f"{name}:{value:g}|{kind}{self._tag_suffix}"
+        try:
+            self._sock.sendto(line.encode(), self._addr)
+        except OSError:
+            pass  # telemetry is never load-bearing
+
+    def incr_counter(self, name: str, value: float = 1.0) -> None:
+        self._emit(name, value, "c")
+
+    def add_sample(self, name: str, value: float) -> None:
+        self._emit(name, value, "ms")
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self._emit(name, value, "g")
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 #: process-global sink, like go-metrics' global Default registry
 _global = InmemSink()
+
+#: external push sinks fanned out alongside the inmem sink (go-metrics
+#: FanoutSink: inmem + statsd/statsite/datadog per telemetry config)
+_sinks: List[object] = []
+_sinks_lock = threading.Lock()
+
+
+def register_sink(sink) -> None:
+    with _sinks_lock:
+        _sinks.append(sink)
+
+
+def deregister_sink(sink) -> None:
+    with _sinks_lock:
+        if sink in _sinks:
+            _sinks.remove(sink)
+    close = getattr(sink, "close", None)
+    if close is not None:
+        close()
+
+
+def _fanout(method: str, name: str, value: float) -> None:
+    with _sinks_lock:
+        sinks = list(_sinks)
+    for sink in sinks:
+        try:
+            getattr(sink, method)(name, value)
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            pass
 
 
 def global_sink() -> InmemSink:
@@ -159,18 +234,27 @@ def global_sink() -> InmemSink:
 
 def incr_counter(name: str, value: float = 1.0) -> None:
     _global.incr_counter(name, value)
+    if _sinks:
+        _fanout("incr_counter", name, value)
 
 
 def add_sample(name: str, value: float) -> None:
     _global.add_sample(name, value)
+    if _sinks:
+        _fanout("add_sample", name, value)
 
 
 def set_gauge(name: str, value: float) -> None:
     _global.set_gauge(name, value)
+    if _sinks:
+        _fanout("set_gauge", name, value)
 
 
 def measure_since(name: str, start: float) -> None:
-    _global.measure_since(name, start)
+    elapsed_ms = (time.monotonic() - start) * 1000.0
+    _global.add_sample(name, elapsed_ms)
+    if _sinks:
+        _fanout("add_sample", name, elapsed_ms)
 
 
 def now() -> float:

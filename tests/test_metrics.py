@@ -110,3 +110,97 @@ def test_server_emits_reference_metric_names(dev_agent_factory=None):
         assert "nomad_worker_dequeue_eval" in text
     finally:
         a.shutdown()
+
+
+class TestPushSinks:
+    """statsd/statsite/DataDog push sinks (command/agent/command.go:976-
+    1018 setupTelemetry fan-out)."""
+
+    def _listener(self):
+        import socket
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        sock.settimeout(5.0)
+        return sock
+
+    def _recv_lines(self, sock, n):
+        out = []
+        for _ in range(n):
+            data, _addr = sock.recvfrom(65535)
+            out.append(data.decode())
+        return out
+
+    def test_statsd_line_protocol(self):
+        from nomad_tpu.utils.metrics import StatsdSink
+
+        sock = self._listener()
+        try:
+            sink = StatsdSink("127.0.0.1:%d" % sock.getsockname()[1],
+                              prefix="nomad")
+            sink.incr_counter("worker.dequeue", 2)
+            sink.set_gauge("broker.depth", 7)
+            sink.add_sample("plan.apply", 12.5)
+            lines = sorted(self._recv_lines(sock, 3))
+            assert "nomad.broker.depth:7|g" in lines
+            assert "nomad.plan.apply:12.5|ms" in lines
+            assert "nomad.worker.dequeue:2|c" in lines
+            sink.close()
+        finally:
+            sock.close()
+
+    def test_datadog_tags_suffix(self):
+        from nomad_tpu.utils.metrics import StatsdSink
+
+        sock = self._listener()
+        try:
+            sink = StatsdSink("127.0.0.1:%d" % sock.getsockname()[1],
+                              datadog=True, tags={"role": "server", "dc": "dc1"})
+            sink.incr_counter("evals", 1)
+            (line,) = self._recv_lines(sock, 1)
+            assert line == "evals:1|c|#dc:dc1,role:server"
+            sink.close()
+        finally:
+            sock.close()
+
+    def test_global_fanout_and_deregister(self):
+        from nomad_tpu.utils import metrics
+
+        sock = self._listener()
+        sink = metrics.StatsdSink("127.0.0.1:%d" % sock.getsockname()[1])
+        metrics.register_sink(sink)
+        try:
+            metrics.incr_counter("fanout.test", 3)
+            (line,) = self._recv_lines(sock, 1)
+            assert line == "fanout.test:3|c"
+            # inmem sink still aggregates alongside
+            summary = metrics.global_sink().summary()
+            assert any(c["Name"] == "fanout.test"
+                       for c in summary["Counters"])
+        finally:
+            metrics.deregister_sink(sink)
+            sock.close()
+        # after deregistration, emissions don't reach the socket (closed)
+        metrics.incr_counter("fanout.test", 1)
+
+    def test_agent_wires_sinks_from_config(self):
+        import socket
+
+        from nomad_tpu.agent.agent import Agent, AgentConfig
+        from nomad_tpu.utils import metrics
+
+        sock = self._listener()
+        agent = Agent(AgentConfig(
+            name="telemetry-1", gossip_enabled=False, num_schedulers=0,
+            telemetry_statsd_address="127.0.0.1:%d" % sock.getsockname()[1],
+            telemetry_prefix="nomad",
+        ))
+        try:
+            agent.start()
+            metrics.incr_counter("agent.test.metric", 1)
+            data, _ = sock.recvfrom(65535)
+            assert data.decode().startswith("nomad.agent.test.metric:1|c")
+        finally:
+            agent.shutdown()
+            sock.close()
+        assert not metrics._sinks  # sink deregistered at shutdown

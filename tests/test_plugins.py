@@ -163,10 +163,81 @@ class TestConfigSchema:
                   "gc": {"type": "bool"}}
         assert validate_config(schema, {"endpoint": "unix:///x"}) == []
         errs = validate_config(schema, {"gc": "yes"})
-        assert any("missing required" in e for e in errs)
-        assert any("must be bool" in e for e in errs)
-        assert any("unknown plugin config" in e
+        assert any("required" in e for e in errs)
+        assert any("expected bool" in e for e in errs)
+        assert any("unknown field" in e
                    for e in validate_config(schema, {"endpoint": "x", "zz": 1}))
+
+
+class TestHclSpec:
+    """Schema-as-data decoding (plugins/hclspec.py — the reference's
+    plugins/shared/hclspec protocol slot)."""
+
+    def test_attrs_defaults_and_nested_blocks(self):
+        from nomad_tpu.plugins.hclspec import decode
+
+        spec = {"block": {"spec": {
+            "image": {"attr": {"type": "string", "required": True}},
+            "gc": {"default": {
+                "primary": {"block": {"spec": {
+                    "enabled": {"attr": {"type": "bool"}},
+                    "interval": {"default": {
+                        "primary": {"attr": {"type": "number"}},
+                        "default": 60,
+                    }},
+                }}},
+                "default": {"enabled": True, "interval": 60},
+            }},
+            "mounts": {"block_list": {"spec": {
+                "source": {"attr": {"type": "string", "required": True}},
+                "readonly": {"attr": {"type": "bool"}},
+            }}},
+            "labels": {"attr": {"type": "map(string)"}},
+            "args": {"attr": {"type": "list(string)"}},
+        }}}
+        decoded, errors = decode(spec, {
+            "image": "redis:7",
+            "gc": {"enabled": False},
+            "mounts": [{"source": "/data", "readonly": True}],
+            "labels": {"team": "core"},
+            "args": ["-v"],
+        })
+        assert errors == []
+        assert decoded["gc"]["interval"] == 60  # default applied
+        assert decoded["gc"]["enabled"] is False
+        assert decoded["mounts"][0]["source"] == "/data"
+
+    def test_type_errors_and_unknown_fields(self):
+        from nomad_tpu.plugins.hclspec import decode
+
+        spec = {"block": {"spec": {
+            "count": {"attr": {"type": "number"}},
+            "names": {"attr": {"type": "list(string)"}},
+        }}}
+        _, errors = decode(spec, {"count": "three", "names": [1], "bogus": 1})
+        assert any("expected number" in e for e in errors)
+        assert any("expected string" in e for e in errors)
+        assert any("unknown field" in e for e in errors)
+
+    def test_block_list_and_literal(self):
+        from nomad_tpu.plugins.hclspec import decode
+
+        spec = {"block": {"spec": {
+            "version": {"literal": {"value": 2}},
+            "ports": {"block_list": {"spec": {
+                "label": {"attr": {"type": "string", "required": True}},
+            }}},
+        }}}
+        decoded, errors = decode(spec, {"ports": [{"label": "http"}, {}]})
+        assert decoded["version"] == 2
+        assert any("required" in e for e in errors)  # second port missing label
+
+    def test_bool_not_admitted_as_number(self):
+        from nomad_tpu.plugins.hclspec import decode
+
+        spec = {"block": {"spec": {"n": {"attr": {"type": "number"}}}}}
+        _, errors = decode(spec, {"n": True})
+        assert errors
 
 
 class TestClientWithExternalDriver:
