@@ -390,6 +390,13 @@ class Agent:
                     addr = f"https://{addr}"
                 self.client.node.http_addr = addr
                 self.client.start()
+            # register telemetry sinks LAST: a failure anywhere above
+            # leaves nothing process-global behind (shutdown only runs
+            # once _started is set)
+            from ..utils import metrics as _metrics
+
+            for sink in getattr(self, "_telemetry_sinks", []):
+                _metrics.register_sink(sink)
             self._started = True
         return self
 
@@ -461,8 +468,6 @@ class Agent:
                 datadog=True, tags=self.config.telemetry_datadog_tags,
             ))
         self._telemetry_sinks = sinks
-        for sink in sinks:
-            _metrics.register_sink(sink)
 
     def shutdown(self) -> None:
         with self._lock:

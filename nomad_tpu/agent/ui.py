@@ -89,7 +89,11 @@ async function api(path, opts) {
   const headers = {};
   const tok = localStorage.getItem("nomad_token");
   if (tok) headers["X-Nomad-Token"] = tok;
-  const r = await fetch(path, Object.assign({headers}, opts || {}));
+  // merge caller headers INTO the token headers — Object.assign at the
+  // top level would replace the headers object and drop the token
+  opts = opts || {};
+  const merged = Object.assign({}, headers, opts.headers || {});
+  const r = await fetch(path, Object.assign({}, opts, {headers: merged}));
   if (!r.ok) throw new Error(r.status + " " + await r.text());
   const ct = r.headers.get("Content-Type") || "";
   return ct.includes("json") ? r.json() : r.text();
@@ -144,7 +148,10 @@ const pages = {
       async function parsed() {
         const src = $("#jobspec").value;
         const trimmed = src.trim();
-        if (trimmed.startsWith("{")) return JSON.parse(trimmed).Job || JSON.parse(trimmed);
+        if (trimmed.startsWith("{")) {
+          const j = JSON.parse(trimmed);
+          return j.Job || j;
+        }
         return api("/v1/jobs/parse", {method: "POST",
           headers: {"Content-Type": "application/json"},
           body: JSON.stringify({JobHCL: src})});
@@ -161,7 +168,7 @@ const pages = {
       $("#run-btn").addEventListener("click", async () => {
         try {
           const job = await parsed();
-          const r = await api("/v1/jobs", {method: "POST",
+          await api("/v1/jobs", {method: "POST",
             headers: {"Content-Type": "application/json"},
             body: JSON.stringify({Job: job})});
           location.hash = "#/jobs/" + encodeURIComponent(job.ID);

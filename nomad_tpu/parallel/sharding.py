@@ -95,7 +95,7 @@ def batched_scan_shardings(mesh):
         ns(e, None),                 # sum_sw_p [B, P]
         ns(e, None, None),           # ev_factor [B, P, 2]
         ns(e, None, None),           # rev_factor [B, P, 2]
-        ns(e, None),                 # forced_node [B, P]
+        ns(e, None, None),           # forced_node [B, P, W]
     )
     return static, carry, xs
 

@@ -45,7 +45,8 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
                 evd_pad: Optional[int] = None,
                 fac_pad: Optional[int] = None,
                 dpd_pad: Optional[int] = None,
-                dpv_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
+                dpv_pad: Optional[int] = None,
+                fnd_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
     """Pad one eval's arrays to the batch's shared bucketed dims.
 
     Padding is semantically inert by construction:
@@ -83,6 +84,8 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         dpd_pad = dp_vids.shape[0]
     if dpv_pad is None:
         dpv_pad = dp_counts0.shape[1]
+    if fnd_pad is None:
+        fnd_pad = forced_node.shape[1]
     dn, dg, ds, dv, dp = (n_pad - n0, g_pad - g0, s_pad - s0,
                           v_pad - v0, p_pad - p0)
     dd = d_pad - d0
@@ -104,7 +107,9 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
 
     static = (
         pad(f(totals), ((0, dn), (0, dd))),
-        pad(f(reserved), ((0, dn), (0, dd))),
+        # int-mode evals fold reserved into totals and pass it ZERO-height
+        # (rows only — the D axis must still pad so the batch stacks)
+        pad(f(reserved), ((0, dn if reserved.shape[0] else 0), (0, dd))),
         pad(f(asks), ((0, dg), (0, dd))),
         pad(feas, ((0, dg), (0, dn)), False),
         # aff arrays may have a ZERO G axis (shape-specialized absent
@@ -170,7 +175,7 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         pad(f(sum_sw_p), ((0, dp),), 1.0),
         pad(ev_factor, ((0, dp), (0, fac_pad - ev_factor.shape[1])), _E27_NEUTRAL),
         pad(rev_factor, ((0, dp), (0, fac_pad - rev_factor.shape[1])), _E27_NEUTRAL),
-        pad(forced_node, ((0, dp),), -1),
+        pad(forced_node, ((0, dp), (0, fnd_pad - forced_node.shape[1])), -1),
     )
     return static, carry, xs
 
@@ -344,11 +349,13 @@ class DeviceBatcher:
         fac_pad = max(e.xs[7].shape[1] for e in encs)
         dpd_pad = max(e.static[18].shape[0] for e in encs)
         dpv_pad = max(e.carry[8].shape[1] for e in encs)
+        fnd_pad = max(e.xs[9].shape[1] for e in encs)
         dtype = encs[0].dtype  # dispatch loop groups by dtype
 
         padded = [
             pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad,
-                        k_pad, aff_pad, evd_pad, fac_pad, dpd_pad, dpv_pad)
+                        k_pad, aff_pad, evd_pad, fac_pad, dpd_pad, dpv_pad,
+                        fnd_pad)
             for e in encs
         ]
 
@@ -368,7 +375,7 @@ class DeviceBatcher:
                 padded = [
                     pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype,
                                 d_pad, k_pad, aff_pad, evd_pad, fac_pad,
-                                dpd_pad, dpv_pad)
+                                dpd_pad, dpv_pad, fnd_pad)
                     for e in encs
                 ]
                 n_pad = n_pad2

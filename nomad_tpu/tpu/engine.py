@@ -262,7 +262,14 @@ def _make_step():
             aff_p = pick_g(aff_present, False)
 
         # -- feasibility ---------------------------------------------------
-        util = used + reserved + ask[None, :]  # [N, D]
+        # int mode folds reserved into totals at encode (the scoring
+        # exponentials are precomputed factors, so nothing else needs the
+        # split) and passes a ZERO-height reserved — one [N, D] add less
+        # per step
+        if reserved.shape[0]:
+            util = used + reserved + ask[None, :]  # [N, D]
+        else:
+            util = used + ask[None, :]
         fits = jnp.all(util <= totals, axis=-1)  # superset + bandwidth check
 
         # job-level distinct_hosts: any co-located alloc of the job rejects;
@@ -275,9 +282,11 @@ def _make_step():
 
         feasible = feas_g & fits & dh_mask  # [N]
         # system-scheduler mode: the candidate node is FIXED per placement
-        # (one alloc per eligible node, system_sched.go:268-286); -1 means
-        # unrestricted (the generic scheduler's full candidate set)
-        feasible = feasible & ((forced_node < 0) | (iota == forced_node))
+        # (one alloc per eligible node, system_sched.go:268-286); a
+        # zero-width axis (generic evals) compiles the restriction away
+        if forced_node.shape[-1]:
+            fnode = forced_node[0]
+            feasible = feasible & ((fnode < 0) | (iota == fnode))
 
         # distinct_property (feasible.go:353): per-constraint value-count
         # carry, same mechanism as spread counts but FILTERING — a node is
@@ -312,8 +321,6 @@ def _make_step():
         else:
             pmask = jnp.any(iota[:, None] == penalty_idx[None, :], axis=-1)
 
-        node_cpu = totals[:, DIM_CPU] - reserved[:, DIM_CPU]
-        node_mem = totals[:, DIM_MEM] - reserved[:, DIM_MEM]
         anti_present = tg_counts_g > 0
 
         # spread row selects (shared) — value-id lookups as one-hot sums
@@ -439,6 +446,8 @@ def _make_step():
             neg_inf = jnp.iinfo(jnp.int64).min // 4
             score_zero = i64(0)
         else:
+            node_cpu = totals[:, DIM_CPU] - reserved[:, DIM_CPU]
+            node_mem = totals[:, DIM_MEM] - reserved[:, DIM_MEM]
             free_cpu = 1.0 - util[:, DIM_CPU] / jnp.maximum(node_cpu, 1e-9)
             free_mem = 1.0 - util[:, DIM_MEM] / jnp.maximum(node_mem, 1e-9)
             fitness = 20.0 - (jnp.power(10.0, free_cpu) + jnp.power(10.0, free_mem))
@@ -509,36 +518,46 @@ def _make_step():
         # cumsum, T = total, o = offset, the ring-order cumsum is
         # S(i) - S(o-1) for i >= o and S(i) + (T - S(o-1)) for i < o —
         # elementwise, so the LimitIterator emulation needs no gathers.
+        #
+        # TWO int32 ring cumsums (low, feas) carry everything: the skip
+        # prefix is min(low_cum, MAX_SKIP) (skipped = the first MAX_SKIP
+        # low entries in ring order) and the source prefix is
+        # feas_cum - skip_cum — one cumsum fewer than the direct form.
+        # (int64 field-packing would make it ONE, but int64 prefix sums
+        # are pathologically slow on this backend.)
         valid = iota < n_real
         nr = jnp.maximum(n_real, 1)
-
-        def ring_cumsum(a_int):
-            s_nat = jnp.cumsum(a_int)
-            total = s_nat[-1]
-            before_off = jnp.sum(jnp.where(iota < offset, a_int, 0))
-            return jnp.where(
-                iota >= offset, s_nat - before_off, s_nat + (total - before_off)
-            )
 
         feas_v = feasible & valid
         # threshold 0 is exact in both modes (int: score60 <= 0 iff the
         # rational score <= 0; float: the host's 0.0 skip threshold)
         low = feas_v & (final <= 0)
-        low_i = low.astype(jnp.int32)
-        low_cum = ring_cumsum(low_i)
+
+        def ring_cumsum(a_int):
+            s_nat = jnp.cumsum(a_int)
+            total = s_nat[-1]
+            before = jnp.sum(jnp.where(iota < offset, a_int, 0))
+            ring = jnp.where(
+                iota >= offset, s_nat - before, s_nat + (total - before)
+            )
+            return ring, total
+
+        low_cum, low_total = ring_cumsum(low.astype(jnp.int32))
+        feas_cum, feas_total = ring_cumsum(feas_v.astype(jnp.int32))
+
         skipped = low & (low_cum <= MAX_SKIP)
+        skip_cum = jnp.minimum(low_cum, MAX_SKIP)
         ret = feas_v & ~skipped
         ret_i = ret.astype(jnp.int32)
-        ret_cum = ring_cumsum(ret_i)
+        ret_cum = feas_cum - skip_cum
         ret_excl = ret_cum - ret_i
 
         limit = limit_p
         pulled = valid & (ret_excl < limit)
         src_cand = ret & pulled
-        ret_total = jnp.sum(ret_i)
+        ret_total = feas_total - jnp.minimum(low_total, MAX_SKIP)
         backlog_n = jnp.maximum(limit - ret_total, 0)
         skip_i = skipped.astype(jnp.int32)
-        skip_cum = ring_cumsum(skip_i)
         skip_excl = skip_cum - skip_i
         backlog_cand = skipped & (skip_excl < backlog_n)
         cand = src_cand | backlog_cand
@@ -615,7 +634,8 @@ def _make_step():
                 ).astype(jnp.int32)
         # forced-node (system) placements are independent per-node
         # decisions: a failure must NOT poison the TG for later nodes
-        failed = failed | (sel_g & ((~success) & (~skip_step) & (forced_node < 0)))
+        unforced = (forced_node[0] < 0) if forced_node.shape[-1] else True
+        failed = failed | (sel_g & ((~success) & (~skip_step) & unforced))
 
         new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry,
                      offset, failed, e_base, dp_counts)
@@ -1082,6 +1102,12 @@ class TpuPlacementEngine:
             evict_res = evict_res[:, :0]
             ev_factor = ev_factor[:, :0]
             rev_factor = rev_factor[:, :0]
+        if int_mode:
+            # fold reserved into totals: the E factors above were computed
+            # from the split, and the fits check is identical on the netted
+            # capacities — the step saves one [N, D] add per placement
+            totals = totals - reserved
+            reserved = np.zeros((0, num_dims), fdtype)
 
         # distinct_property encoding (zero-D when absent). Pad the node
         # axis: padded nodes keep the MISSING bucket (v-1) and are
@@ -1118,7 +1144,9 @@ class TpuPlacementEngine:
         xs = (
             tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
             limit_p, sum_sw_p, ev_factor, rev_factor,
-            np.full(p, -1, np.int32),  # forced_node: generic = unrestricted
+            # forced_node rides a WIDTH axis so unrestricted (generic)
+            # evals compile the restriction away entirely
+            np.zeros((p, 0), np.int32),
         )
 
         return EncodedEval(
@@ -1286,6 +1314,12 @@ class TpuPlacementEngine:
         dp_applies = np.zeros((g_count, 0), bool)
         dp_counts0 = np.zeros((0, 1), np.int32)
 
+        if int_mode:
+            # fold reserved into totals (see encode_eval): e factors were
+            # computed from the split above
+            totals = totals - reserved
+            reserved = np.zeros((0, num_dims), fdtype)
+
         static = (
             totals, reserved, asks, feas, aff_score, aff_present,
             desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
@@ -1307,7 +1341,7 @@ class TpuPlacementEngine:
             np.zeros(p, fdtype),
             np.zeros((p, 0), np.int32),
             np.zeros((p, 0), np.int32),
-            forced,
+            forced.reshape(p, 1),
         )
         enc = EncodedEval(
             n_real=n_real, n_pad=n_pad, g=g_count, s=1, v=2, p=p,
@@ -1637,6 +1671,9 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
                     xq_np(np.full(n_pad, -int(asks[gi, d]), np.int64),
                           node_c2[:, d])
                 ).astype(np.int32)
+        # reserved folds into totals (see encode_eval)
+        totals = totals - reserved
+        reserved = np.zeros((0, num_dims), dtype)
     else:
         e_base0 = np.zeros((0, 2), np.int32)
         e_ask = np.zeros((0, 0, 2), np.int32)
@@ -1662,7 +1699,7 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
           np.full(n_placements, 50 * max(n_spreads, 1), dtype),
           np.zeros((n_placements, 0), np.int32),
           np.zeros((n_placements, 0), np.int32),
-          np.full(n_placements, -1, np.int32))
+          np.zeros((n_placements, 0), np.int32))  # forced_node: unrestricted
     return n_pad, static, init_carry, xs
 
 
