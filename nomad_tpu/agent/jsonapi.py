@@ -39,6 +39,9 @@ _FIELD_OVERRIDES = {
     "eval_ids": "EvalIDs",
     "alloc_ids": "AllocIDs",
     "node_ids": "NodeIDs",
+    # Go API name differs from the struct field (api/jobs.go
+    # ParameterizedJob *ParameterizedJobConfig)
+    "parameterized": "ParameterizedJob",
 }
 
 
