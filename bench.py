@@ -439,13 +439,21 @@ def system_benches():
 
     def _sys_done(server):
         # done when the high-priority GPU job covers every GPU node (its
-        # allocs preempted the low-priority ones there)
-        allocs = server.fsm.state.allocs_by_job("default", "sys-high", True)
-        return sum(1 for a in allocs if a.desired_status == "run") >= gpu_nodes
+        # allocs preempted the low-priority ones there) AND the low-
+        # priority job holds the rest of the fleet
+        high = server.fsm.state.allocs_by_job("default", "sys-high", True)
+        low = server.fsm.state.allocs_by_job("default", "sys-low", True)
+        return (
+            sum(1 for a in high if a.desired_status == "run") >= gpu_nodes
+            and sum(1 for a in low if a.desired_status == "run")
+            >= sys_nodes_n - gpu_nodes
+        )
 
+    # steady state: every node holds exactly one alloc (high on the GPU
+    # nodes after preempting low, low on the rest)
     r = _diagnostic(bench_system, "system-preempt-1K", sys_nodes_n, jobs,
                     timeout=300.0, node_factory=_sys_nodes,
-                    expected=sys_nodes_n + gpu_nodes, done=_sys_done)
+                    expected=sys_nodes_n, done=_sys_done)
     if r:
         results.append(r)
 
