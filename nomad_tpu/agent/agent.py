@@ -51,6 +51,9 @@ class AgentConfig:
     acl_replication_interval: float = 30.0
     node_class: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
+    # client host_volume stanzas: name -> host path (reference client
+    # config host_volume blocks)
+    host_volumes: Dict[str, str] = field(default_factory=dict)
     # telemetry push sinks (reference command/agent/command.go:976-1018:
     # statsite/statsd/DataDog fan-out next to the inmem sink).
     # "host:port" UDP addresses; statsite speaks the statsd line protocol
@@ -262,6 +265,7 @@ class Agent:
                 datacenter=self.config.datacenter,
                 node_class=self.config.node_class,
                 meta=dict(self.config.meta),
+                host_volumes=dict(self.config.host_volumes),
                 tls=self.tls,
             )
             if self.config.data_dir:

@@ -31,6 +31,9 @@ _ACRONYMS = {
     "dns": "DNS",
     "ns": "Ns",
     "hcl": "HCL",
+    # Go API: FailedTGAllocs, DesiredTGUpdates (api/evaluations.go,
+    # api/jobs.go plan annotations)
+    "tg": "TG",
 }
 
 # Whole-field overrides where fragment-by-fragment casing is not enough.

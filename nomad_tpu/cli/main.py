@@ -65,6 +65,15 @@ def _split_flags(args: List[str]) -> Tuple[Dict[str, str], List[str]]:
     """Nomad-style single-dash flags: -flag, -flag=value, -flag value."""
     flags: Dict[str, str] = {}
     rest: List[str] = []
+
+    def put(name: str, val: str) -> None:
+        # repeatable flags accumulate comma-separated instead of the
+        # last occurrence silently clobbering earlier ones
+        if name in _REPEATABLE_FLAGS and name in flags:
+            flags[name] = flags[name] + "," + val
+        else:
+            flags[name] = val
+
     i = 0
     while i < len(args):
         a = args[i]
@@ -72,9 +81,9 @@ def _split_flags(args: List[str]) -> Tuple[Dict[str, str], List[str]]:
             name = a.lstrip("-")
             if "=" in name:
                 name, _, val = name.partition("=")
-                flags[name] = val
+                put(name, val)
             elif i + 1 < len(args) and not args[i + 1].startswith("-") and _wants_value(name):
-                flags[name] = args[i + 1]
+                put(name, args[i + 1])
                 i += 1
             else:
                 flags[name] = "true"
@@ -82,6 +91,9 @@ def _split_flags(args: List[str]) -> Tuple[Dict[str, str], List[str]]:
             rest.append(a)
         i += 1
     return flags, rest
+
+
+_REPEATABLE_FLAGS = {"host-volume", "meta", "retry-join", "servers"}
 
 
 _VALUE_FLAGS = {
@@ -92,6 +104,7 @@ _VALUE_FLAGS = {
     "ca-file", "cert-file", "key-file", "n",
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
     "servers", "encrypt", "authoritative-region", "replication-token",
+    "host-volume",
 }
 
 
@@ -119,6 +132,21 @@ def _truthy(flags: Dict[str, str], name: str) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _parse_host_volumes(spec: str) -> Dict[str, str]:
+    """-host-volume name=path[,name=path...]; malformed pairs are errors,
+    not silent drops (a vanished volume fails placements obscurely)."""
+    out: Dict[str, str] = {}
+    for pair in spec.split(","):
+        if not pair:
+            continue
+        if "=" not in pair:
+            raise SystemExit(
+                f"-host-volume expects name=path, got {pair!r}")
+        name, _, path = pair.partition("=")
+        out[name] = path
+    return out
+
+
 def cmd_agent(ctx: Ctx, args: List[str]) -> int:
     flags, _ = _split_flags(args)
     from ..agent import Agent, AgentConfig
@@ -141,6 +169,7 @@ def cmd_agent(ctx: Ctx, args: List[str]) -> int:
         wire_raft=_truthy(flags, "wire-raft"),
         data_dir=flags.get("data-dir", ""),
         node_class=flags.get("node-class", ""),
+        host_volumes=_parse_host_volumes(flags.get("host-volume", "")),
         servers=[a for a in flags.get("servers", "").split(",") if a],
         acl_enabled=_truthy(flags, "acl-enabled"),
         enable_debug=_truthy(flags, "enable-debug"),

@@ -46,6 +46,9 @@ class ClientConfig:
     # Vault address for the template hook's {{ secret }} reads (the token
     # is the TASK's derived token, never the server's)
     vault_addr: str = ""
+    # host_volume stanzas (reference client config): name -> host path,
+    # advertised on the node for the HostVolumeChecker
+    host_volumes: Dict[str, str] = field(default_factory=dict)
     # external plugins (reference client config plugin_dir + plugin stanzas):
     # plugin_dir is scanned for nomad-driver-*/nomad-device-* executables;
     # external_drivers forces built-in drivers out-of-process (the
@@ -164,6 +167,18 @@ class Client:
         self.node.datacenter = self.config.datacenter
         self.node.node_class = self.config.node_class
         self.node.meta.update(self.config.meta)
+        if self.config.host_volumes:
+            from ..structs.structs import HostVolume
+
+            for vname, vpath in self.config.host_volumes.items():
+                if not os.path.isdir(vpath):
+                    # the reference client refuses to start on a missing
+                    # host_volume path — fail loud, not at task runtime
+                    raise ValueError(
+                        f"host_volume {vname!r}: path {vpath!r} is not a "
+                        "directory")
+                self.node.host_volumes[vname] = HostVolume(
+                    name=vname, path=vpath)
         fingerprint_node(self.node)
         if self.device_manager is not None:
             self.device_manager.fingerprint_node(self.node)

@@ -227,6 +227,55 @@ class TaskRunner:
             self._template_hook.stop()
         self.done.set()
 
+    def _mount_volumes(self) -> None:
+        """Host-volume mounts (volume_hook.go): volume_mount.volume names
+        a group ``volume`` request whose source must exist in the node's
+        host_volumes. Destination resolves inside the task dir (leading
+        "/" mapped to the task root, like the container-absolute paths
+        the reference mounts)."""
+        tg = (self.alloc.job.lookup_task_group(self.alloc.task_group)
+              if self.alloc.job else None)
+        vol_requests = tg.volumes if tg is not None else {}
+        host_vols = self.node.host_volumes if self.node is not None else {}
+        root = os.path.realpath(self.task_dir.dir)
+        for vm in self.task.volume_mounts:
+            name = vm.volume
+            req = vol_requests.get(name)
+            if req is None:
+                raise ValueError(
+                    f"volume_mount references undeclared volume {name!r}")
+            hv = host_vols.get(req.source)
+            if hv is None:
+                raise ValueError(
+                    f"host volume {req.source!r} not present on this node")
+            dest_rel = str(vm.destination or name).lstrip("/")
+            dest = os.path.join(root, dest_rel)
+            # escape check resolves the PARENT only: the final component
+            # may legitimately be the (re-used, e.g. after a client
+            # restart) symlink pointing at the host path
+            parent = os.path.realpath(os.path.dirname(dest))
+            norm = os.path.normpath(dest)
+            if (parent != root and not parent.startswith(root + os.sep))                     or not norm.startswith(root):
+                raise ValueError(
+                    f"volume destination escapes task dir: {dest_rel}")
+            os.makedirs(parent, exist_ok=True)
+            if os.path.islink(dest):
+                if os.readlink(dest) == hv.path:
+                    continue  # already mounted (prestart re-run)
+                os.unlink(dest)
+            elif os.path.exists(dest):
+                raise ValueError(
+                    f"volume destination already exists: {dest_rel}")
+            os.symlink(hv.path, dest)
+            if vm.read_only or req.read_only:
+                # symlink realization cannot enforce read-only without
+                # bind mounts (the reference's raw_exec doesn't support
+                # volume mounts at all); advisory here
+                self.logger.warning(
+                    "volume %s mounted read_only=true: advisory only "
+                    "under the symlink realization", name,
+                )
+
     def _write_envoy_bootstrap(self, service_name: str) -> None:
         """Generate the sidecar's Envoy bootstrap into
         secrets/envoy_bootstrap.json (the reference shells out to
@@ -318,6 +367,12 @@ class TaskRunner:
             os.makedirs(os.path.dirname(dest), exist_ok=True)
             with open(dest, "wb") as f:
                 f.write(payload)
+        # volume hook (task_runner_hooks.go volumes hook): resolve the
+        # task's volume_mount stanzas through the group's volume requests
+        # to the node's host volumes; realized as symlinks inside the
+        # task dir (this runtime's raw_exec-compatible bind)
+        if getattr(self.task, "volume_mounts", None):
+            self._mount_volumes()
         # artifacts hook (artifact_hook.go + go-getter core): http(s) and
         # file sources, checksum verification, archive unpacking
         if self.task.artifacts:

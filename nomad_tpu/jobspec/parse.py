@@ -31,6 +31,7 @@ from ..structs.structs import (
     Task,
     TaskGroup,
     UpdateStrategy,
+    VolumeMount,
     VolumeRequest,
 )
 from .hcl import HCLError, HCLObject, parse as parse_hcl
@@ -416,6 +417,13 @@ def _parse_task(name: str, o: HCLObject) -> Task:
     t.affinities = _parse_affinities(o)
     for body in o.get_all("service"):
         t.services.append(_parse_service(body, name))
+    for body in o.get_all("volume_mount"):
+        vm = _plain(body)
+        t.volume_mounts.append(VolumeMount(
+            volume=vm.get("volume", ""),
+            destination=vm.get("destination", ""),
+            read_only=bool(vm.get("read_only", False)),
+        ))
     for body in o.get_all("artifact"):
         t.artifacts.append(_plain(body))
     for body in o.get_all("template"):

@@ -568,6 +568,15 @@ class VolumeRequest:
     read_only: bool = False
 
 
+@dataclass
+class VolumeMount:
+    """A task's mount of a group volume (reference structs.go VolumeMount)."""
+
+    volume: str = ""
+    destination: str = ""
+    read_only: bool = False
+
+
 VOLUME_TYPE_HOST = "host"
 
 
@@ -626,6 +635,8 @@ class Task:
     kill_signal: str = "SIGTERM"
     restart_policy: Optional[RestartPolicy] = None
     dispatch_payload_file: str = ""
+    # volume_mount stanzas (reference structs.go VolumeMount)
+    volume_mounts: List["VolumeMount"] = field(default_factory=list)
     log_config: LogConfig = field(default_factory=LogConfig)
 
 

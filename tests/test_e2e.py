@@ -291,3 +291,62 @@ class TestParameterizedDispatch:
         alloc = running_allocs(api, child_id)[0]
         wait_until(lambda: api.alloc_fs.cat(alloc["ID"], "t/local/out").strip()
                    == b"dispatched-data", msg="payload delivered")
+
+
+class TestHostVolumes:
+    """reference e2e/hostvolumes: a client-declared host volume is
+    scheduled against (HostVolumeChecker) and mounted into the task."""
+
+    def test_volume_scheduling_and_mount(self, tmp_path_factory):
+        host_dir = tmp_path_factory.mktemp("hostvol")
+        (host_dir / "seed.txt").write_text("from-the-host")
+        agent = AgentProc("-dev", "-no-gossip",
+                          "-host-volume", f"shared={host_dir}",
+                          name="hv-agent")
+        try:
+            api = agent.api
+            # the node advertises the volume
+            nodes, _ = api.nodes.list()
+            info, _ = api.nodes.info(nodes[0]["ID"])
+            assert "shared" in (info.get("HostVolumes") or {})
+
+            job = service_job(
+                "e2e-hv", count=1,
+                command="cat data/seed.txt > $NOMAD_TASK_DIR/copied; "
+                        "echo task-was-here > data/written.txt; sleep 300",
+            )
+            job["TaskGroups"][0]["Volumes"] = {
+                "data": {"Name": "data", "Type": "host", "Source": "shared"},
+            }
+            job["TaskGroups"][0]["Tasks"][0]["VolumeMounts"] = [
+                {"Volume": "data", "Destination": "data"},
+            ]
+            api.jobs.register(job)
+            wait_until(lambda: running_allocs(api, "e2e-hv"), timeout=60,
+                       msg="alloc running")
+            alloc = running_allocs(api, "e2e-hv")[0]
+            # the task read host data through the mount...
+            wait_until(lambda: api.alloc_fs.cat(
+                alloc["ID"], "t/local/copied").strip() == b"from-the-host",
+                msg="host file visible through mount")
+            # ...and wrote back to the HOST through it
+            wait_until(lambda: (host_dir / "written.txt").exists(),
+                       msg="task write landed on the host volume")
+            assert (host_dir / "written.txt").read_text().strip() == "task-was-here"
+
+            # a job demanding a MISSING volume doesn't place
+            bad = service_job("e2e-hv-missing", count=1, command="sleep 30")
+            bad["TaskGroups"][0]["Volumes"] = {
+                "data": {"Name": "data", "Type": "host", "Source": "no-such"},
+            }
+            api.jobs.register(bad)
+            evals_seen = []
+            def blocked():
+                evs, _ = api.jobs.evaluations("e2e-hv-missing")
+                evals_seen[:] = evs or []
+                return any(e.get("Status") == "complete"
+                           and e.get("FailedTGAllocs") for e in evals_seen)
+            wait_until(blocked, timeout=60, msg="missing volume fails placement")
+            assert not running_allocs(api, "e2e-hv-missing")
+        finally:
+            agent.stop()
