@@ -322,7 +322,9 @@ class TestHostVolumes:
                 {"Volume": "data", "Destination": "data"},
             ]
             api.jobs.register(job)
-            wait_until(lambda: running_allocs(api, "e2e-hv"), timeout=60,
+            # generous: suite-context CPU contention (jax compiles on all
+            # cores) can starve the agent for a while
+            wait_until(lambda: running_allocs(api, "e2e-hv"), timeout=180,
                        msg="alloc running")
             alloc = running_allocs(api, "e2e-hv")[0]
             # the task read host data through the mount...
@@ -346,7 +348,7 @@ class TestHostVolumes:
                 evals_seen[:] = evs or []
                 return any(e.get("Status") == "complete"
                            and e.get("FailedTGAllocs") for e in evals_seen)
-            wait_until(blocked, timeout=60, msg="missing volume fails placement")
+            wait_until(blocked, timeout=120, msg="missing volume fails placement")
             assert not running_allocs(api, "e2e-hv-missing")
         finally:
             agent.stop()
