@@ -207,7 +207,8 @@ def bench_parity_scan_single(n_nodes=5000, n_placements=10_000):
 
 def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                  timeout=180.0, node_seed=0, warmup=None,
-                 node_factory=None, expected=None, done=None):
+                 node_factory=None, expected=None, done=None,
+                 deterministic=False, window_ms=25.0):
     """Run ``jobs`` through a real in-proc server; returns metrics dict.
 
     ``workers`` is 2x the device batch so the next wave encodes while the
@@ -225,7 +226,7 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
     rng = np.random.default_rng(node_seed)
     server = Server(ServerConfig(
         num_schedulers=0, device_batch=device_batch,
-        device_batch_window_ms=25.0,
+        device_batch_window_ms=window_ms, deterministic=deterministic,
         heartbeat_min_ttl=3600, heartbeat_max_ttl=7200,
     ))
     server.start()
@@ -273,6 +274,9 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             for w in server.workers:
                 w.stats["evals_processed"] = 0
             if server.device_batcher is not None:
+                # background bucket compiles must not steal device time
+                # from the measured window
+                server.device_batcher.wait_warm(timeout=120)
                 for k in server.device_batcher.stats:
                     server.device_batcher.stats[k] = 0
 

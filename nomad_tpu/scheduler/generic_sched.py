@@ -234,7 +234,11 @@ class GenericScheduler:
             tainted,
             self.eval.id,
         )
+        from ..utils import metrics as _metrics
+
+        _t0 = _metrics.now()
         results = reconciler.compute()
+        _metrics.measure_since("nomad.sched.reconcile", _t0)
 
         if self.eval.annotate_plan:
             from ..structs.structs import PlanAnnotations

@@ -35,9 +35,14 @@ class Harness:
     # -- Planner -----------------------------------------------------------
 
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[StateStore]]:
-        self.plans.append(plan)
         if self.planner is not None:
+            self.plans.append(plan)
             return self.planner.submit_plan(plan)
+
+        # The harness applies plans as classic per-alloc objects so tests
+        # (the host-vs-TPU parity oracle above all) diff one shape.
+        plan.inflate_dense()
+        self.plans.append(plan)
 
         index = self.next_index()
 

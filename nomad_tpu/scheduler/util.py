@@ -148,7 +148,22 @@ def diff_system_allocs(
     return result
 
 
+# (store_id, node_epoch, dcs) -> (nodes, dc_map). Node objects are
+# immutable-once-stored and shared across snapshots, so reusing the
+# filtered list across the many evals between node-table writes is safe;
+# callers get copies because the stack may shuffle in place.
+_READY_NODES_CACHE: Dict[tuple, Tuple[List[Node], Dict[str, int]]] = {}
+_READY_NODES_CACHE_MAX = 16
+
+
 def ready_nodes_in_dcs(state, dcs: List[str]) -> Tuple[List[Node], Dict[str, int]]:
+    key = None
+    store_id = getattr(state, "store_id", None)
+    if store_id is not None:
+        key = (store_id, state.node_epoch, tuple(dcs))
+        hit = _READY_NODES_CACHE.get(key)
+        if hit is not None:
+            return list(hit[0]), dict(hit[1])
     dc_map = {dc: 0 for dc in dcs}
     out = []
     for node in state.nodes():
@@ -162,6 +177,11 @@ def ready_nodes_in_dcs(state, dcs: List[str]) -> Tuple[List[Node], Dict[str, int
             continue
         out.append(node)
         dc_map[node.datacenter] += 1
+    if key is not None:
+        if len(_READY_NODES_CACHE) >= _READY_NODES_CACHE_MAX:
+            _READY_NODES_CACHE.clear()
+        _READY_NODES_CACHE[key] = (out, dc_map)
+        return list(out), dict(dc_map)
     return out, dc_map
 
 
@@ -183,6 +203,7 @@ def progress_made(result: Optional[PlanResult]) -> bool:
     return result is not None and (
         bool(result.node_update)
         or bool(result.node_allocation)
+        or bool(result.dense_placements)
         or result.deployment is not None
         or bool(result.deployment_updates)
     )
@@ -332,6 +353,10 @@ def adjust_queued_allocations(logger, result: Optional[PlanResult], queued_alloc
                 continue
             if allocation.task_group in queued_allocs:
                 queued_allocs[allocation.task_group] -= 1
+    for block in result.dense_placements:
+        # dense blocks are fresh placements by construction (create==modify)
+        if block.task_group in queued_allocs:
+            queued_allocs[block.task_group] -= len(block.ids)
 
 
 def update_non_terminal_allocs_to_lost(

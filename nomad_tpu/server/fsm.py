@@ -162,6 +162,7 @@ class NomadFSM:
             alloc_updates=payload["alloc_updates"],
             allocs_stopped=payload["allocs_stopped"],
             allocs_preempted=payload.get("allocs_preempted", []),
+            dense_placements=payload.get("dense_placements", []),
             deployment=payload.get("deployment"),
             deployment_updates=payload.get("deployment_updates"),
             eval_id=payload.get("eval_id", ""),

@@ -266,9 +266,15 @@ class Planner:
                 self.logger.debug("plan for node %s rejected", node_id)
                 partial = True
 
+        if plan.dense_placements:
+            dense_out, dense_partial = self._evaluate_dense(snapshot, plan, result)
+            result.dense_placements = dense_out
+            if dense_partial:
+                partial = True
+
         if partial:
             # Invalid placements: cancel deployment bits if everything failed
-            if not result.node_allocation:
+            if not result.node_allocation and not result.dense_placements:
                 result.deployment = None
                 result.deployment_updates = []
             # COMMITTED state only: an optimistic (uncommitted) index here
@@ -277,6 +283,104 @@ class Planner:
             # plans the apply waiter raises this to the real alloc_index.
             result.refresh_index = self.fsm.state.latest_index
         return result
+
+    def _evaluate_dense(self, snapshot, plan: Plan, result: PlanResult):
+        """Re-check dense placement blocks against current state without
+        materializing a single Allocation: per touched node, committed
+        usage comes from the state store's incremental mirror, this
+        plan's stops/preemptions subtract, and each block's placements
+        add count x ask_vec. Per-node all-or-nothing, like the object
+        path's evaluateNodePlan (reference plan_apply.go:628).
+
+        Returns (committed_blocks, partial)."""
+        from ..structs.funcs import alloc_usage_vec
+
+        # capacity this plan's committed stops/preemptions free per node
+        freed: Dict[str, List[float]] = {}
+
+        def _free(alloc) -> None:
+            base = snapshot.alloc_by_id(alloc.id)
+            if base is None or base.terminal_status():
+                return
+            u = alloc_usage_vec(base)
+            row = freed.setdefault(base.node_id, [0.0, 0.0, 0.0, 0.0])
+            for d in range(4):
+                row[d] += u[d]
+
+        for allocs in result.node_update.values():
+            for alloc in allocs:
+                _free(alloc)
+        for allocs in result.node_preemptions.values():
+            for alloc in allocs:
+                _free(alloc)
+
+        mirror = getattr(snapshot, "_node_usage", {})
+        # adds accumulated across blocks (and the object-path placements
+        # committed above, which the mirror does not include yet)
+        pending: Dict[str, List[float]] = {}
+        for allocs in result.node_allocation.values():
+            for alloc in allocs:
+                if alloc.terminal_status():
+                    continue
+                u = alloc_usage_vec(alloc)
+                row = pending.setdefault(alloc.node_id, [0.0, 0.0, 0.0, 0.0])
+                base = snapshot.alloc_by_id(alloc.id)
+                for d in range(4):
+                    row[d] += u[d]
+                if base is not None and not base.terminal_status():
+                    bu = alloc_usage_vec(base)
+                    for d in range(4):
+                        row[d] -= bu[d]
+
+        # Per-node ALL-OR-NOTHING across the WHOLE plan (the object
+        # path's evaluateNodePlan semantics): aggregate every block's
+        # asks per node first, check each node once against the combined
+        # addition, then trim every block by the failing-node set.
+        zero4 = (0.0, 0.0, 0.0, 0.0)
+        plan_add: Dict[str, List[float]] = {}
+        for block in plan.dense_placements:
+            ask = block.ask_vec
+            for node_id, idxs in block.node_index_map().items():
+                cnt = len(idxs)
+                row = plan_add.setdefault(node_id, [0.0, 0.0, 0.0, 0.0])
+                for d in range(4):
+                    row[d] += cnt * ask[d]
+
+        from ..structs.funcs import node_capacity_vecs
+
+        bad: set = set()
+        for node_id, add in plan_add.items():
+            node = snapshot.node_by_id(node_id)
+            if node is None or node.drain or not node.ready():
+                bad.add(node_id)
+                continue
+            totals, res = node_capacity_vecs(node)
+            used = mirror.get(node_id, zero4)
+            fr = freed.get(node_id, zero4)
+            pend = pending.get(node_id, zero4)
+            if not all(
+                used[d] + pend[d] - fr[d] + res[d] + add[d] <= totals[d]
+                for d in range(4)
+            ):
+                bad.add(node_id)
+
+        out = []
+        partial = bool(bad)
+        for block in plan.dense_placements:
+            if not bad:
+                out.append(block)
+                continue
+            nim = block.node_index_map()
+            if not any(nid in bad for nid in nim):
+                out.append(block)
+                continue
+            keep = [
+                i for nid, idxs in nim.items() if nid not in bad for i in idxs
+            ]
+            if keep:
+                keep.sort()
+                out.append(block.select(keep))
+        return out, partial
 
     @staticmethod
     def _batch_capacity_check(nodes, proposed_by_node) -> np.ndarray:
@@ -382,6 +486,9 @@ class Planner:
             "alloc_updates": alloc_updates,
             "allocs_stopped": allocs_stopped,
             "allocs_preempted": allocs_preempted,
+            # dense blocks ride the raft payload as-is (parallel arrays;
+            # the FSM upserts them without materializing allocs)
+            "dense_placements": result.dense_placements,
             "deployment": result.deployment,
             "deployment_updates": result.deployment_updates,
             "eval_id": plan.eval_id,
@@ -419,6 +526,7 @@ class Planner:
                 alloc_updates=payload["alloc_updates"],
                 allocs_stopped=payload["allocs_stopped"],
                 allocs_preempted=payload["allocs_preempted"],
+                dense_placements=payload["dense_placements"],
                 deployment=deployment.copy() if deployment is not None else None,
                 deployment_updates=payload["deployment_updates"],
                 eval_id=payload["eval_id"],

@@ -39,6 +39,14 @@ class StateStore:
         self._cond = threading.Condition(self._lock)
         self.latest_index = 0
 
+        # (store_id, node_epoch) keys the encode layer's static-node-array
+        # caches: the epoch bumps on every node write, so snapshots taken
+        # between node changes share one dense encoding of the fleet.
+        import uuid as _uuid
+
+        self.store_id = _uuid.uuid4().hex
+        self.node_epoch = 0
+
         self.nodes_table: Dict[str, Node] = {}
         self.jobs_table: Dict[Tuple[str, str], Job] = {}
         self.job_versions: Dict[Tuple[str, str], List[Job]] = {}
@@ -75,19 +83,82 @@ class StateStore:
         # (namespace, parent job id) -> child job ids (periodic/dispatch)
         self._jobs_by_parent: Dict[Tuple[str, str], set] = {}
 
-    # pickling (raft snapshot persistence): locks are recreated on load
+        # Dense placement blocks (structs.DenseTGPlacements): allocs
+        # committed by the TPU engine's dense path live here as parallel
+        # arrays; Allocation objects materialize lazily on read. Indexes
+        # are BLOCK-level (one entry per block, not per alloc) except the
+        # id map. An id in ``_dense_superseded`` has been overwritten by a
+        # regular alloc write (client sync, stop, GC) and its table entry
+        # is authoritative; the block slot is dead.
+        self._dense_blocks: List = []
+        self._dense_by_id: Dict[str, tuple] = {}  # id -> (block, i)
+        self._dense_by_job: Dict[Tuple[str, str], list] = {}
+        self._dense_by_node: Dict[str, list] = {}
+        self._dense_by_eval: Dict[str, list] = {}
+        self._dense_superseded: set = set()
+        # block key -> superseded-slot count; a fully-dead block (every
+        # slot rewritten as a table alloc) is compacted away entirely
+        self._dense_dead: Dict[str, int] = {}
+
+    # pickling (raft snapshot persistence): locks are recreated on load.
+    # Dense secondary indexes are DERIVED from _dense_blocks and dropped:
+    # the snapshot codec has no shared-reference dedup, so serializing
+    # _dense_by_id would re-encode every block once per contained alloc.
     def __getstate__(self):
         d = self.__dict__.copy()
         d.pop("_lock", None)
         d.pop("_cond", None)
+        d.pop("_dense_by_id", None)
+        d.pop("_dense_by_job", None)
+        d.pop("_dense_by_node", None)
+        d.pop("_dense_by_eval", None)
         return d
 
     def __setstate__(self, d):
         self.__dict__.update(d)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
+        # fresh identity: a restored store may diverge from its origin, so
+        # it must never share the origin's encode-cache key space
+        import uuid as _uuid
+
+        self.store_id = _uuid.uuid4().hex
+        if "node_epoch" not in self.__dict__:
+            self.node_epoch = 0
         # Pickles from pre-mirror builds lack the usage mirror: rebuild it
         # from the alloc table so writes and snapshots keep working.
+        # pre-dense snapshots lack the dense tables entirely; fresh ones
+        # carry _dense_blocks (+ superseded set) and the derived indexes
+        # rebuild here
+        if "_dense_blocks" not in self.__dict__:
+            self._dense_blocks = []
+            self._dense_superseded = set()
+        self._dense_by_id = {}
+        self._dense_by_job = {}
+        self._dense_by_node = {}
+        self._dense_by_eval = {}
+        self._dense_dead = {}
+        live_blocks = []
+        for block in self._dense_blocks:
+            dead = sum(1 for aid in block.ids if aid in self._dense_superseded)
+            if dead >= len(block.ids):
+                # fully superseded: compact at load
+                for aid in block.ids:
+                    self._dense_superseded.discard(aid)
+                continue
+            live_blocks.append(block)
+            if dead:
+                self._dense_dead[block.key()] = dead
+            for i, aid in enumerate(block.ids):
+                self._dense_by_id[aid] = (block, i)
+            self._dense_by_job.setdefault(
+                (block.namespace, block.job_id), []
+            ).append(block)
+            if block.eval_id:
+                self._dense_by_eval.setdefault(block.eval_id, []).append(block)
+            for node_id in block.node_index_map():
+                self._dense_by_node.setdefault(node_id, []).append(block)
+        self._dense_blocks = live_blocks
         if "_node_usage" not in self.__dict__:
             from ..structs.funcs import alloc_usage_vec
 
@@ -114,6 +185,8 @@ class StateStore:
             snap._lock = threading.RLock()
             snap._cond = threading.Condition(snap._lock)
             snap.latest_index = self.latest_index
+            snap.store_id = self.store_id
+            snap.node_epoch = self.node_epoch
             snap.nodes_table = dict(self.nodes_table)
             snap.jobs_table = dict(self.jobs_table)
             snap.job_versions = {k: list(v) for k, v in self.job_versions.items()}
@@ -131,6 +204,19 @@ class StateStore:
                 k: list(v) for k, v in self.vault_accessors_table.items()
             }
             snap._node_usage = dict(self._node_usage)  # rows are immutable
+            # dense: blocks are immutable-once-committed and shared;
+            # containers are copied so inserts never cross stores.
+            # _dense_by_id is NOT copied (it can reach alloc-count size —
+            # copying it per snapshot would tax every eval): snapshots
+            # carry None and resolve ids by scanning their block list
+            # through the per-block id_index_map caches.
+            snap._dense_blocks = list(self._dense_blocks)
+            snap._dense_by_id = None
+            snap._dense_by_job = {k: list(v) for k, v in self._dense_by_job.items()}
+            snap._dense_by_node = {k: list(v) for k, v in self._dense_by_node.items()}
+            snap._dense_by_eval = {k: list(v) for k, v in self._dense_by_eval.items()}
+            snap._dense_superseded = set(self._dense_superseded)
+            snap._dense_dead = dict(self._dense_dead)
             snap._allocs_by_node = {k: set(v) for k, v in self._allocs_by_node.items()}
             snap._allocs_by_job = {k: set(v) for k, v in self._allocs_by_job.items()}
             snap._allocs_by_eval = {k: set(v) for k, v in self._allocs_by_eval.items()}
@@ -183,11 +269,13 @@ class StateStore:
             if not node.computed_class:
                 node.compute_class()
             self.nodes_table[node.id] = node
+            self.node_epoch += 1
             self._bump(index)
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
             self.nodes_table.pop(node_id, None)
+            self.node_epoch += 1
             self._bump(index)
 
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
@@ -199,6 +287,7 @@ class StateStore:
             node.status = status
             node.modify_index = index
             self.nodes_table[node_id] = node
+            self.node_epoch += 1
             self._bump(index)
 
     def update_node_drain(
@@ -230,6 +319,7 @@ class StateStore:
                 node.scheduling_eligibility = NODE_SCHED_ELIGIBLE
             node.modify_index = index
             self.nodes_table[node_id] = node
+            self.node_epoch += 1
             self._bump(index)
 
     def update_node_eligibility(self, index: int, node_id: str, eligibility: str) -> None:
@@ -241,6 +331,7 @@ class StateStore:
             node.scheduling_eligibility = eligibility
             node.modify_index = index
             self.nodes_table[node_id] = node
+            self.node_epoch += 1
             self._bump(index)
 
     def node_by_id(self, node_id: str) -> Optional[Node]:
@@ -386,11 +477,108 @@ class StateStore:
     def _remove_alloc_index(self, alloc_id: str) -> None:
         alloc = self.allocs_table.get(alloc_id)
         if alloc is None:
+            # a live dense slot is "removed" by superseding it
+            self._supersede_dense(alloc_id)
             return
         self._allocs_by_node.get(alloc.node_id, set()).discard(alloc_id)
         self._allocs_by_job.get((alloc.namespace, alloc.job_id), set()).discard(alloc_id)
         self._allocs_by_eval.get(alloc.eval_id, set()).discard(alloc_id)
         self._usage_delta(alloc, -1.0)
+
+    # -- dense placement blocks -----------------------------------------
+
+    def _dense_lookup(self, alloc_id: str):
+        """(block, i) for a dense id, superseded or not; None if unknown.
+        The live store resolves through the eager id map; snapshots
+        (which carry _dense_by_id=None to keep snapshotting O(blocks))
+        scan their block list via the per-block id caches."""
+        d = self._dense_by_id
+        if d is not None:
+            return d.get(alloc_id)
+        for block in self._dense_blocks:
+            i = block.id_index_map().get(alloc_id)
+            if i is not None:
+                return (block, i)
+        return None
+
+    def _supersede_dense(self, alloc_id: str) -> None:
+        """Mark a dense slot dead (its id is being rewritten as a regular
+        table alloc, or deleted) and return its usage to the mirror.
+        Dense slots are non-terminal (desired=run, client=pending) until
+        superseded, so the subtraction is unconditional."""
+        entry = self._dense_lookup(alloc_id)
+        if entry is None or alloc_id in self._dense_superseded:
+            return
+        block, i = entry
+        self._dense_superseded.add(alloc_id)
+        u = block.ask_vec
+        node_id = block.node_ids[i]
+        row = self._node_usage.get(node_id, (0.0, 0.0, 0.0, 0.0))
+        self._node_usage[node_id] = (
+            row[0] - u[0], row[1] - u[1], row[2] - u[2], row[3] - u[3]
+        )
+        key = block.key()
+        dead = self._dense_dead.get(key, 0) + 1
+        if dead >= len(block.ids):
+            self._compact_dense_block(block)
+        else:
+            self._dense_dead[key] = dead
+
+    def _compact_dense_block(self, block) -> None:
+        """Every slot of the block has been superseded by a table alloc:
+        drop the block from all containers so a long-lived store doesn't
+        accumulate dead history (client syncs rewrite every alloc in
+        steady state)."""
+        self._dense_dead.pop(block.key(), None)
+        for aid in block.ids:
+            if self._dense_by_id is not None:
+                self._dense_by_id.pop(aid, None)
+            self._dense_superseded.discard(aid)
+        self._dense_blocks = [b for b in self._dense_blocks if b is not block]
+        jk = (block.namespace, block.job_id)
+        lst = self._dense_by_job.get(jk)
+        if lst is not None:
+            lst[:] = [b for b in lst if b is not block]
+            if not lst:
+                del self._dense_by_job[jk]
+        if block.eval_id:
+            lst = self._dense_by_eval.get(block.eval_id)
+            if lst is not None:
+                lst[:] = [b for b in lst if b is not block]
+                if not lst:
+                    del self._dense_by_eval[block.eval_id]
+        for node_id in block.node_index_map():
+            lst = self._dense_by_node.get(node_id)
+            if lst is not None:
+                lst[:] = [b for b in lst if b is not block]
+                if not lst:
+                    del self._dense_by_node[node_id]
+
+    def _existing_alloc(self, alloc_id: str) -> Optional[Allocation]:
+        """Current version of an alloc for copy-on-write updates: the
+        table entry, or the materialized live dense slot."""
+        alloc = self.allocs_table.get(alloc_id)
+        if alloc is not None:
+            return alloc
+        entry = self._dense_lookup(alloc_id)
+        if entry is None or alloc_id in self._dense_superseded:
+            return None
+        block, i = entry
+        return block.materialize(i)
+
+    def _dense_materialize_live(self, blocks, predicate=None) -> List[Allocation]:
+        """Materialize the live (non-superseded) slots of the given
+        blocks, optionally filtered by ``predicate(block, i)``."""
+        out: List[Allocation] = []
+        superseded = self._dense_superseded
+        for block in blocks:
+            for i, aid in enumerate(block.ids):
+                if aid in superseded:
+                    continue
+                if predicate is not None and not predicate(block, i):
+                    continue
+                out.append(block.materialize(i))
+        return out
 
     def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
         with self._lock:
@@ -401,13 +589,14 @@ class StateStore:
         for alloc in allocs:
             # Snapshot isolation: copy the alloc, sharing the (immutable) job.
             alloc = alloc.copy_skip_job()
-            existing = self.allocs_table.get(alloc.id)
+            existing = self._existing_alloc(alloc.id)
             if existing is not None:
                 alloc.create_index = existing.create_index
                 alloc.create_time_ns = existing.create_time_ns
                 # Client-owned fields survive server-side updates
                 if alloc.client_status == "" and existing.client_status != "":
                     alloc.client_status = existing.client_status
+                # table removal or dense supersede, as appropriate
                 self._remove_alloc_index(alloc.id)
             else:
                 alloc.create_index = index
@@ -422,7 +611,7 @@ class StateStore:
         with self._lock:
             flips_by_deployment: Dict[str, List[Tuple[Optional[bool], Allocation]]] = {}
             for client_alloc in allocs:
-                existing = self.allocs_table.get(client_alloc.id)
+                existing = self._existing_alloc(client_alloc.id)
                 if existing is None:
                     continue
                 prev_healthy = (
@@ -472,17 +661,49 @@ class StateStore:
             self._bump(index)
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
-        return self.allocs_table.get(alloc_id)
+        alloc = self.allocs_table.get(alloc_id)
+        if alloc is not None:
+            return alloc
+        if self._dense_blocks:
+            entry = self._dense_lookup(alloc_id)
+            if entry is not None and alloc_id not in self._dense_superseded:
+                return entry[0].materialize(entry[1])
+        return None
 
     def allocs(self) -> List[Allocation]:
-        return list(self.allocs_table.values())
+        out = list(self.allocs_table.values())
+        if self._dense_blocks:
+            out.extend(self._dense_materialize_live(self._dense_blocks))
+        return out
+
+    def count_allocs_desired_run(self) -> int:
+        """O(table + blocks) count of desired_status == run — dense
+        blocks count at block granularity (every live slot is run)."""
+        from ..structs.structs import ALLOC_DESIRED_RUN
+
+        with self._lock:
+            n = sum(
+                1 for a in self.allocs_table.values()
+                if a.desired_status == ALLOC_DESIRED_RUN
+            )
+            n += sum(len(b.ids) for b in self._dense_blocks)
+            n -= len(self._dense_superseded)
+            return n
 
     def allocs_by_node(self, node_id: str) -> List[Allocation]:
-        return [
+        out = [
             self.allocs_table[aid]
             for aid in self._allocs_by_node.get(node_id, set())
             if aid in self.allocs_table
         ]
+        blocks = self._dense_by_node.get(node_id)
+        if blocks:
+            superseded = self._dense_superseded
+            for block in blocks:
+                for i in block.node_index_map().get(node_id, ()):
+                    if block.ids[i] not in superseded:
+                        out.append(block.materialize(i))
+        return out
 
     def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:
         return [a for a in self.allocs_by_node(node_id) if a.terminal_status() == terminal]
@@ -493,6 +714,9 @@ class StateStore:
             for aid in self._allocs_by_job.get((namespace, job_id), set())
             if aid in self.allocs_table
         ]
+        blocks = self._dense_by_job.get((namespace, job_id))
+        if blocks:
+            out.extend(self._dense_materialize_live(blocks))
         if not all_allocs:
             # Exclude allocs from prior job versions that are terminal? The
             # reference's "all" flag includes allocs of all job create indexes;
@@ -510,14 +734,21 @@ class StateStore:
                 out.extend(
                     self.allocs_table[a] for a in ids if a in self.allocs_table
                 )
+        for (_ns, jid), blocks in self._dense_by_job.items():
+            if jid == job_id:
+                out.extend(self._dense_materialize_live(blocks))
         return out
 
     def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
-        return [
+        out = [
             self.allocs_table[aid]
             for aid in self._allocs_by_eval.get(eval_id, set())
             if aid in self.allocs_table
         ]
+        blocks = self._dense_by_eval.get(eval_id)
+        if blocks:
+            out.extend(self._dense_materialize_live(blocks))
+        return out
 
     # ------------------------------------------------------------------
     # deployments
@@ -718,6 +949,7 @@ class StateStore:
         eval_id: str = "",
         preempted_eval_ids: Optional[List[str]] = None,
         timestamp_ns: int = 0,
+        dense_placements: Optional[List] = None,
     ) -> None:
         with self._lock:
             # Which updates are *new to their deployment*? Decided against
@@ -751,12 +983,52 @@ class StateStore:
                     d.modify_index = index
                     self.deployments_table[d.id] = d
             self._upsert_allocs_impl(index, alloc_updates + allocs_stopped + allocs_preempted)
+            for block in dense_placements or []:
+                self._insert_dense_block(index, block, timestamp_ns)
             by_deployment: Dict[str, List[Allocation]] = {}
             for alloc in newly_deployed:
                 by_deployment.setdefault(alloc.deployment_id, []).append(alloc)
             for deployment_id, group in by_deployment.items():
                 self._update_deployment_placements(index, deployment_id, group, timestamp_ns)
             self._bump(index)
+
+    def _insert_dense_block(self, index: int, block, timestamp_ns: int) -> None:
+        """Commit one dense placement block: O(block) id-map inserts and
+        O(touched nodes) mirror/index updates — no per-alloc objects.
+        Fresh ids by construction (the engine mints them), so there is no
+        existing-version handling."""
+        block.stamp(index, timestamp_ns)
+        self._dense_blocks.append(block)
+        if self._dense_by_id is not None:  # snapshots resolve by scan
+            for i, aid in enumerate(block.ids):
+                self._dense_by_id[aid] = (block, i)
+        self._dense_by_job.setdefault(
+            (block.namespace, block.job_id), []
+        ).append(block)
+        if block.eval_id:
+            self._dense_by_eval.setdefault(block.eval_id, []).append(block)
+        ask = block.ask_vec
+        for node_id, idxs in block.node_index_map().items():
+            self._dense_by_node.setdefault(node_id, []).append(block)
+            cnt = len(idxs)
+            row = self._node_usage.get(node_id, (0.0, 0.0, 0.0, 0.0))
+            self._node_usage[node_id] = (
+                row[0] + cnt * ask[0], row[1] + cnt * ask[1],
+                row[2] + cnt * ask[2], row[3] + cnt * ask[3],
+            )
+        if block.deployment_id:
+            d = self.deployments_table.get(block.deployment_id)
+            if d is not None and d.active():
+                d = d.copy()
+                ds = d.task_groups.get(block.task_group)
+                if ds is not None:
+                    ds.placed_allocs += len(block.ids)
+                    if ds.progress_deadline_ns > 0 and ds.require_progress_by_ns == 0:
+                        ds.require_progress_by_ns = (
+                            timestamp_ns + ds.progress_deadline_ns
+                        )
+                    d.modify_index = index
+                    self.deployments_table[d.id] = d
 
     def _update_deployment_placements(
         self, index: int, deployment_id: str, allocs: List[Allocation], timestamp_ns: int

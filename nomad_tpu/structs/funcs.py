@@ -40,6 +40,24 @@ def alloc_usage_vec(alloc: Allocation) -> tuple:
     return u
 
 
+def node_capacity_vecs(node: Node) -> Tuple[tuple, tuple]:
+    """((cpu, mem, disk, mbits) totals, same-shape reserved) for one node
+    — the ONE definition of the 4-dim capacity model shared by the encode
+    layer's fleet arrays and the plan applier's dense re-check, so the
+    two can never silently diverge."""
+    nr = node.node_resources
+    totals = (
+        float(nr.cpu_shares), float(nr.memory_mb), float(nr.disk_mb),
+        float(sum(net.mbits for net in nr.networks)),
+    )
+    rr = node.reserved_resources
+    reserved = (
+        (float(rr.cpu_shares), float(rr.memory_mb), float(rr.disk_mb), 0.0)
+        if rr is not None else (0.0, 0.0, 0.0, 0.0)
+    )
+    return totals, reserved
+
+
 def remove_allocs(allocs: List[Allocation], remove: List[Allocation]) -> List[Allocation]:
     """Remove by alloc ID (order NOT preserved beyond filtering)."""
     remove_set = {a.id for a in remove}

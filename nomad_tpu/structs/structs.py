@@ -107,6 +107,24 @@ def generate_uuid() -> str:
     return str(_uuid.uuid4())
 
 
+def generate_uuids(n: int) -> List[str]:
+    """Batch-mint n v4-format UUID strings from one entropy read — the
+    dense placement path mints one id per placement, and per-call
+    ``uuid.uuid4()`` object construction is measurable at that volume."""
+    import os as _os
+
+    raw = _os.urandom(16 * n).hex()
+    out = []
+    for k in range(n):
+        h = raw[32 * k : 32 * (k + 1)]
+        # stamp version (4) and variant (10xx) nibbles like uuid4
+        out.append(
+            f"{h[0:8]}-{h[8:12]}-4{h[13:16]}-"
+            f"{'89ab'[int(h[16], 16) & 3]}{h[17:20]}-{h[20:32]}"
+        )
+    return out
+
+
 def now_ns() -> int:
     return _time.time_ns()
 
@@ -1347,6 +1365,160 @@ class PlanAnnotations:
 
 
 @dataclass
+class DenseTGPlacements:
+    """A block of fresh placements of ONE task group kept as parallel
+    arrays end to end: device scan -> plan submit -> plan apply -> FSM
+    upsert. The TPU-native answer to the reference's per-alloc object
+    flow (generic_sched.go:497-518 builds one Allocation per placement;
+    plan_apply.go:324-336 already normalizes alloc DIFFS on the wire —
+    this design goes further and defers materializing Allocation objects
+    entirely until something reads them).
+
+    Every placement in a block shares the job, task group, eval,
+    deployment and — because the dense path only engages for task groups
+    with no network or device asks — the exact AllocatedResources shape
+    (``resources_proto``). Per-placement state is just the parallel
+    lists: id, name, node, score, nodes-evaluated. ``materialize(i)``
+    builds (and caches) the classic Allocation object on read; the cache
+    lives outside the dataclass fields so wire/raft codecs never ship it.
+    """
+
+    namespace: str = "default"
+    job_id: str = ""
+    task_group: str = ""
+    eval_id: str = ""
+    deployment_id: str = ""
+    job: Optional[Job] = None
+    resources_proto: Optional[AllocatedResources] = None
+    # capacity ask of ONE placement: (cpu, mem_mb, disk_mb, mbits) — the
+    # plan applier's vectorized re-check and the state store's usage
+    # mirror consume this instead of per-alloc comparable_resources()
+    ask_vec: Tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)
+    ids: List[str] = field(default_factory=list)
+    names: List[str] = field(default_factory=list)
+    node_ids: List[str] = field(default_factory=list)
+    node_names: List[str] = field(default_factory=list)
+    scores: List[float] = field(default_factory=list)
+    nodes_evaluated: List[int] = field(default_factory=list)
+    nodes_available: Dict[str, int] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+    create_time_ns: int = 0
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def __getstate__(self):
+        # lazy caches never ship (pickle path; the wire codec already
+        # serializes declared fields only)
+        d = self.__dict__.copy()
+        d.pop("_mat", None)
+        d.pop("_by_node", None)
+        d.pop("_by_id", None)
+        return d
+
+    def id_index_map(self) -> Dict[str, int]:
+        """alloc id -> slot (cached; blocks are immutable once committed)."""
+        m = self.__dict__.get("_by_id")
+        if m is None:
+            m = {aid: i for i, aid in enumerate(self.ids)}
+            self.__dict__["_by_id"] = m
+        return m
+
+    def key(self) -> str:
+        """Store-level block key (ids are unique, blocks are non-empty)."""
+        return self.ids[0] if self.ids else ""
+
+    def stamp(self, index: int, timestamp_ns: int) -> None:
+        """Index-stamp at FSM apply; invalidates any materialization made
+        against a provisional (optimistic-snapshot) stamp."""
+        self.create_index = index
+        self.modify_index = index
+        if timestamp_ns:
+            self.create_time_ns = timestamp_ns
+        self.__dict__.pop("_mat", None)
+
+    def node_index_map(self) -> Dict[str, List[int]]:
+        """node_id -> placement indices (cached; blocks are immutable
+        once committed)."""
+        m = self.__dict__.get("_by_node")
+        if m is None:
+            m = {}
+            for i, nid in enumerate(self.node_ids):
+                m.setdefault(nid, []).append(i)
+            self.__dict__["_by_node"] = m
+        return m
+
+    def materialize(self, i: int) -> Allocation:
+        cache = self.__dict__.get("_mat")
+        if cache is None:
+            cache = self.__dict__["_mat"] = [None] * len(self.ids)
+        a = cache[i]
+        if a is None:
+            score = self.scores[i] if i < len(self.scores) else 0.0
+            metrics = AllocMetric(
+                nodes_evaluated=(
+                    self.nodes_evaluated[i] if i < len(self.nodes_evaluated) else 0
+                ),
+                nodes_available=self.nodes_available,
+                score_meta=[
+                    NodeScoreMeta(
+                        node_id=self.node_ids[i],
+                        scores={"binpack": score, "normalized-score": score},
+                        norm_score=score,
+                    )
+                ],
+            )
+            a = Allocation(
+                id=self.ids[i],
+                namespace=self.namespace,
+                eval_id=self.eval_id,
+                name=self.names[i],
+                node_id=self.node_ids[i],
+                node_name=self.node_names[i],
+                job_id=self.job_id,
+                job=self.job,
+                task_group=self.task_group,
+                allocated_resources=self.resources_proto,
+                desired_status=ALLOC_DESIRED_RUN,
+                client_status=ALLOC_CLIENT_PENDING,
+                deployment_id=self.deployment_id,
+                metrics=metrics,
+                create_index=self.create_index,
+                modify_index=self.modify_index,
+                create_time_ns=self.create_time_ns,
+                modify_time_ns=self.create_time_ns,
+            )
+            # every placement in the block shares ask_vec by construction
+            a.__dict__["_usage_vec"] = self.ask_vec
+            cache[i] = a
+        return a
+
+    def select(self, keep: List[int]) -> "DenseTGPlacements":
+        """Sub-block of the given placement indices (plan applier partial
+        commit)."""
+        return DenseTGPlacements(
+            namespace=self.namespace,
+            job_id=self.job_id,
+            task_group=self.task_group,
+            eval_id=self.eval_id,
+            deployment_id=self.deployment_id,
+            job=self.job,
+            resources_proto=self.resources_proto,
+            ask_vec=self.ask_vec,
+            ids=[self.ids[i] for i in keep],
+            names=[self.names[i] for i in keep],
+            node_ids=[self.node_ids[i] for i in keep],
+            node_names=[self.node_names[i] for i in keep],
+            scores=[self.scores[i] for i in keep] if self.scores else [],
+            nodes_evaluated=(
+                [self.nodes_evaluated[i] for i in keep] if self.nodes_evaluated else []
+            ),
+            nodes_available=self.nodes_available,
+        )
+
+
+@dataclass
 class Plan:
     """A proposed set of mutations, submitted to the leader (reference structs.go:8645)."""
 
@@ -1361,7 +1533,13 @@ class Plan:
     deployment: Optional[Deployment] = None
     deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
     node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # dense placement blocks (DenseTGPlacements): fresh placements that
+    # never materialize per-alloc objects on the commit path
+    dense_placements: List[DenseTGPlacements] = field(default_factory=list)
     snapshot_index: int = 0
+
+    def dense_count(self) -> int:
+        return sum(len(b.ids) for b in self.dense_placements)
 
     def append_stopped_alloc(
         self, alloc: Allocation, desired_desc: str, client_status: str = ""
@@ -1406,9 +1584,20 @@ class Plan:
         return (
             not self.node_update
             and not self.node_allocation
+            and not self.dense_placements
             and self.deployment is None
             and not self.deployment_updates
         )
+
+    def inflate_dense(self) -> None:
+        """Materialize dense blocks into ``node_allocation`` (test
+        harness / compatibility consumers; the production plan applier
+        keeps blocks dense end to end)."""
+        for block in self.dense_placements:
+            for i in range(len(block.ids)):
+                alloc = block.materialize(i)
+                self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+        self.dense_placements = []
 
 
 @dataclass
@@ -1420,6 +1609,7 @@ class PlanResult:
     deployment: Optional[Deployment] = None
     deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
     node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    dense_placements: List[DenseTGPlacements] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
 
@@ -1427,6 +1617,7 @@ class PlanResult:
         return (
             not self.node_update
             and not self.node_allocation
+            and not self.dense_placements
             and not self.deployment_updates
             and self.deployment is None
         )
@@ -1437,6 +1628,8 @@ class PlanResult:
         for node, alloc_list in plan.node_allocation.items():
             expected += len(alloc_list)
             actual += len(self.node_allocation.get(node, []))
+        expected += plan.dense_count()
+        actual += sum(len(b.ids) for b in self.dense_placements)
         return actual == expected, expected, actual
 
 

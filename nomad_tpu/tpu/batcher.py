@@ -21,7 +21,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -205,6 +205,10 @@ class DeviceBatcher:
         self.mesh = mesh
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._scan = None
+        self._scan_lock = threading.Lock()  # prewarm + dispatcher race
+        # padded-shape key -> set of batch buckets already compiled/warming
+        self._warmed: Dict[tuple, set] = {}
+        self._warm_threads: List[threading.Thread] = []
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -319,17 +323,102 @@ class DeviceBatcher:
 
     def _scan_fn(self):
         """The ONE batched-scan builder (engine._build_batched_scan),
-        sharded over the configured mesh when present."""
-        if self._scan is None:
-            shardings = None
-            if self.mesh is not None:
-                from ..parallel.sharding import batched_scan_shardings
+        sharded over the configured mesh when present. Double-checked
+        lock: the prewarm thread and the dispatcher both initialize
+        lazily, and losing a duplicate build would orphan the loser's
+        jit compile cache."""
+        scan = self._scan
+        if scan is None:
+            with self._scan_lock:
+                if self._scan is None:
+                    shardings = None
+                    if self.mesh is not None:
+                        from ..parallel.sharding import batched_scan_shardings
 
-                shardings = batched_scan_shardings(self.mesh)
-            self._scan = _build_batched_scan(in_shardings=shardings)
-        return self._scan
+                        shardings = batched_scan_shardings(self.mesh)
+                    self._scan = _build_batched_scan(in_shardings=shardings)
+                scan = self._scan
+        return scan
+
+    def _buckets(self) -> List[int]:
+        mid = max(1, self.max_batch // 4)
+        out = [1]
+        if mid not in out:
+            out.append(mid)
+        if self.max_batch not in out:
+            out.append(self.max_batch)
+        if self.mesh is not None:
+            ep = self.mesh.shape.get("evals", 1)
+            out = sorted({((b + ep - 1) // ep) * ep for b in out})
+        return out
+
+    def _prewarm_siblings(self, one_padded, current_b_pad: int) -> None:
+        """First sight of a padded shape: compile its OTHER batch buckets
+        on a background thread by calling the scan with stacked inert
+        copies. The persistent XLA cache makes repeats across restarts
+        cheap, but even a cache HIT load is seconds — hide it off the
+        dispatch path. Device time for the warming calls interleaves with
+        real dispatches at the runtime's discretion; correctness is
+        unaffected (results discarded)."""
+        shape_key = tuple(
+            (a.shape, str(a.dtype)) for part in one_padded for a in part
+        )
+        with self._lock:
+            warmed = self._warmed.setdefault(shape_key, set())
+            todo = [
+                b for b in self._buckets()
+                if b != current_b_pad and b not in warmed
+            ]
+            warmed.add(current_b_pad)
+            if not todo:
+                return
+            warmed.update(todo)
+
+        def warm() -> None:
+            for b in todo:
+                try:
+                    stacked = tuple(
+                        tuple(
+                            np.stack([part[i]] * b)
+                            for i in range(len(part))
+                        )
+                        for part in one_padded
+                    )
+                    scan = self._scan_fn()
+                    np.asarray(scan(*stacked)[1][0])
+                except BaseException:  # noqa: BLE001 — warming is best-effort
+                    logger.debug("bucket prewarm failed", exc_info=True)
+
+        t = threading.Thread(target=warm, name="batcher-prewarm", daemon=True)
+        with self._lock:
+            self._warm_threads.append(t)
+        t.start()
+
+    def wait_warm(self, timeout: Optional[float] = None) -> None:
+        """Block until outstanding bucket-warming finishes (benches /
+        boot sequences that want compiles out of their timed window).
+        Tracking mutations stay under the lock so a warm thread spawned
+        concurrently is never dropped unjoined."""
+        while True:
+            with self._lock:
+                pending = [t for t in self._warm_threads if t.is_alive()]
+                self._warm_threads = pending
+            if not pending:
+                return
+            for t in pending:
+                t.join(timeout=timeout)
+            if timeout is not None:
+                # one bounded pass only
+                with self._lock:
+                    self._warm_threads = [
+                        t for t in self._warm_threads if t.is_alive()
+                    ]
+                return
 
     def _run_batch(self, batch: List[_Request]) -> None:
+        from ..utils import metrics
+
+        t_start = metrics.now()
         encs = [r.enc for r in batch]
         # shared bucketed dims (pow2 to bound recompiles); G always gets a
         # padded slot so padded steps have a pre-failed TG to point at
@@ -379,6 +468,14 @@ class DeviceBatcher:
                     for e in encs
                 ]
                 n_pad = n_pad2
+        # Warm the SIBLING batch buckets of this shape in the background
+        # (VERDICT r3 #3: precompile pinned buckets): the first dispatch
+        # of a new shape pays its own compile/cache-load synchronously,
+        # but the follow-up waves (smaller tails, single-eval retries)
+        # must not stall multi-second on theirs. One zero-input call per
+        # bucket populates the jit executable cache off the hot path.
+        self._prewarm_siblings(padded[0], b_pad)
+
         while len(padded) < b_pad:
             padded.append(padded[0])  # inert copies; results discarded
 
@@ -393,11 +490,14 @@ class DeviceBatcher:
         )
 
         scan = self._scan_fn()
+        t_stack = metrics.now()
+        metrics.measure_since("nomad.device_batcher.pad_stack", t_start)
         _carry, (chosen, scores, pulls, skipped) = scan(static_b, carry_b, xs_b)
         chosen = np.asarray(chosen)
         scores = np.asarray(scores)
         pulls = np.asarray(pulls)
         skipped = np.asarray(skipped)
+        metrics.measure_since("nomad.device_batcher.dispatch", t_stack)
 
         self.stats["dispatches"] += 1
         self.stats["evals"] += b

@@ -242,6 +242,67 @@ def _distinct_property_arrays(ctx, job: Job, nodes: List[Node]):
     return vids, limits, applies, counts0
 
 
+# ---------------------------------------------------------------------------
+# Fleet-static cache: the per-node arrays that depend only on the node
+# table (totals/reserved, index map, computed-class groups) are identical
+# for every eval scheduled between two node writes. Keyed by the store's
+# (store_id, node_epoch); valid only in deterministic mode, where the
+# candidate order is the stable table order (non-deterministic evals
+# shuffle per eval). Node objects are immutable-once-stored, so entries
+# survive snapshots.
+# ---------------------------------------------------------------------------
+
+_FLEET_CACHE: Dict[tuple, dict] = {}
+_FLEET_CACHE_MAX = 16
+
+
+def fleet_static(ctx, job: Job, nodes: List[Node]) -> Optional[dict]:
+    """Cached {totals4, reserved4, node_index, class_groups, nodes} for
+    this fleet, or None when caching can't be validated."""
+    state = ctx.state
+    store_id = getattr(state, "store_id", None)
+    if store_id is None or not getattr(ctx, "deterministic", False):
+        return None
+    n = len(nodes)
+    key = (
+        store_id, getattr(state, "node_epoch", -1),
+        tuple(job.datacenters), n,
+    )
+    ent = _FLEET_CACHE.get(key)
+    if ent is not None:
+        cn = ent["nodes"]
+        # identity spot-checks guard against an aliased key ever handing
+        # back arrays for a different node list
+        if n == 0 or (
+            cn[0] is nodes[0] and cn[-1] is nodes[-1]
+            and cn[n // 2] is nodes[n // 2]
+        ):
+            return ent
+
+    from ..structs.funcs import node_capacity_vecs
+
+    totals4 = np.zeros((n, 4), dtype=np.float64)
+    reserved4 = np.zeros((n, 4), dtype=np.float64)
+    class_members: Dict[str, List[int]] = {}
+    for i, node in enumerate(nodes):
+        totals4[i], reserved4[i] = node_capacity_vecs(node)
+        class_members.setdefault(node.computed_class, []).append(i)
+    ent = {
+        "nodes": list(nodes),
+        "node_index": {node.id: i for i, node in enumerate(nodes)},
+        "totals4": totals4,
+        "reserved4": reserved4,
+        "class_groups": [
+            (idxs[0], np.asarray(idxs, np.int64))
+            for idxs in class_members.values()
+        ],
+    }
+    if len(_FLEET_CACHE) >= _FLEET_CACHE_MAX:
+        _FLEET_CACHE.clear()
+    _FLEET_CACHE[key] = ent
+    return ent
+
+
 from ..structs.funcs import alloc_usage_vec as _alloc_usage_vec
 
 
@@ -266,7 +327,8 @@ def _snapshot_usage(state) -> Dict[str, tuple]:
     return usage
 
 
-def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
+def build_node_table(ctx, job: Job, nodes: List[Node],
+                     fleet: Optional[dict] = None) -> NodeTable:
     """Encode nodes + proposed allocs into dense arrays.
 
     Usage comes from the snapshot-level cache plus per-plan adjustments
@@ -282,28 +344,28 @@ def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
 
     n = len(nodes)
     g = len(job.task_groups)
-    node_index = {node.id: i for i, node in enumerate(nodes)}
     tg_index = {tg.name: gi for gi, tg in enumerate(job.task_groups)}
     device_dims = job_device_dims(job)
     num_dims = job_num_dims(device_dims)
 
-    totals = np.zeros((n, num_dims), dtype=np.float64)
-    reserved = np.zeros((n, num_dims), dtype=np.float64)
     used = np.zeros((n, num_dims), dtype=np.float64)
     job_counts = np.zeros(n, dtype=np.int32)
     tg_counts = np.zeros((g, n), dtype=np.int32)
 
-    for i, node in enumerate(nodes):
-        nr = node.node_resources
-        totals[i, DIM_CPU] = nr.cpu_shares
-        totals[i, DIM_MEM] = nr.memory_mb
-        totals[i, DIM_DISK] = nr.disk_mb
-        totals[i, DIM_MBITS] = sum(net.mbits for net in nr.networks)
-        rr = node.reserved_resources
-        if rr is not None:
-            reserved[i, DIM_CPU] = rr.cpu_shares
-            reserved[i, DIM_MEM] = rr.memory_mb
-            reserved[i, DIM_DISK] = rr.disk_mb
+    if fleet is not None and not device_dims:
+        # static per-node arrays shared across evals (read-only: the
+        # encode layer copies them into padded buffers, never mutates)
+        node_index = fleet["node_index"]
+        totals = fleet["totals4"]
+        reserved = fleet["reserved4"]
+    else:
+        from ..structs.funcs import node_capacity_vecs
+
+        node_index = {node.id: i for i, node in enumerate(nodes)}
+        totals = np.zeros((n, num_dims), dtype=np.float64)
+        reserved = np.zeros((n, num_dims), dtype=np.float64)
+        for i, node in enumerate(nodes):
+            totals[i, :4], reserved[i, :4] = node_capacity_vecs(node)
 
     # -- base usage from the snapshot cache ------------------------------
     base_usage = _snapshot_usage(ctx.state)
@@ -418,9 +480,13 @@ def build_node_table(ctx, job: Job, nodes: List[Node]) -> NodeTable:
     )
 
 
-def _class_feasibility(ctx, job: Job, tg: TaskGroup, nodes: List[Node]) -> np.ndarray:
+def _class_feasibility(ctx, job: Job, tg: TaskGroup, nodes: List[Node],
+                       fleet: Optional[dict] = None) -> np.ndarray:
     """Per-node feasibility mask, memoized per computed class for non-escaped
-    constraints (mirrors FeasibilityWrapper semantics, feasible.go:778)."""
+    constraints (mirrors FeasibilityWrapper semantics, feasible.go:778).
+    With a fleet cache, nodes are pre-grouped by computed class so the
+    per-eval cost is O(classes) checker runs + one vectorized scatter,
+    not an O(nodes) Python loop."""
     from ..scheduler.feasible import ConstraintChecker, DeviceChecker, DriverChecker, HostVolumeChecker
     from ..scheduler.util import task_group_constraints
     from ..structs.node_class import escaped_constraints
@@ -439,20 +505,29 @@ def _class_feasibility(ctx, job: Job, tg: TaskGroup, nodes: List[Node]) -> np.nd
         or escaped_constraints(tg_constr.constraints)
     )
 
-    mask = np.zeros(len(nodes), dtype=bool)
-    class_cache: Dict[str, bool] = {}
-    for i, node in enumerate(nodes):
-        cls = node.computed_class
-        if not escaped and cls in class_cache:
-            mask[i] = class_cache[cls]
-            continue
-        ok = (
+    def check(node) -> bool:
+        return (
             job_checker.feasible(node)
             and drivers.feasible(node)
             and constraints.feasible(node)
             and volumes.feasible(node)
             and devices.feasible(node)
         )
+
+    mask = np.zeros(len(nodes), dtype=bool)
+    if not escaped and fleet is not None:
+        for rep_idx, members in fleet["class_groups"]:
+            if check(nodes[rep_idx]):
+                mask[members] = True
+        return mask
+
+    class_cache: Dict[str, bool] = {}
+    for i, node in enumerate(nodes):
+        cls = node.computed_class
+        if not escaped and cls in class_cache:
+            mask[i] = class_cache[cls]
+            continue
+        ok = check(node)
         mask[i] = ok
         if not escaped:
             class_cache[cls] = ok
@@ -691,7 +766,8 @@ def _port_feasibility(ctx, job: Job, tg: TaskGroup, nodes: List[Node],
 
 
 def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
-                  port_cache: Optional[Dict[str, object]] = None) -> TGSpec:
+                  port_cache: Optional[Dict[str, object]] = None,
+                  fleet: Optional[dict] = None) -> TGSpec:
     import math
 
     check_supported(job, tg)
@@ -710,7 +786,7 @@ def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
     ask[DIM_DISK] = tg.ephemeral_disk.size_mb
     ask[DIM_MBITS], _ = _net_ask(tg)
 
-    constraint_feasible = _class_feasibility(ctx, job, tg, nodes)
+    constraint_feasible = _class_feasibility(ctx, job, tg, nodes, fleet=fleet)
     feasible = constraint_feasible & _port_feasibility(ctx, job, tg, nodes, port_cache)
     affinity_score, affinity_present = _affinity_arrays(
         ctx, job, tg, nodes, int_mode=int_mode

@@ -59,6 +59,14 @@ logger = logging.getLogger("nomad_tpu.tpu.engine")
 
 MAX_SKIP = 3
 
+# GIL convoy guard: encode/apply are pure-Python (serial under the GIL
+# regardless), so letting hundreds of worker threads enter them at once
+# only buys context-switch thrash. A small bound keeps a few threads in
+# flight (numpy sections release the GIL) without the convoy.
+import threading as _threading
+
+_HOST_WORK_SEM = _threading.BoundedSemaphore(4)
+
 
 class EncodedEval:
     """One evaluation's placement problem as dense numpy arrays, plus the
@@ -69,11 +77,12 @@ class EncodedEval:
     __slots__ = (
         "n_real", "n_pad", "g", "s", "v", "p", "dtype",
         "static", "carry", "xs",
-        "missing_list", "nodes", "table", "start_ns",
+        "missing_list", "nodes", "table", "start_ns", "dense_ok",
     )
 
     def __init__(self, *, n_real, n_pad, g, s, v, p, dtype,
-                 static, carry, xs, missing_list, nodes, table, start_ns):
+                 static, carry, xs, missing_list, nodes, table, start_ns,
+                 dense_ok=False):
         self.n_real = n_real
         self.n_pad = n_pad
         self.g = g
@@ -88,6 +97,10 @@ class EncodedEval:
         self.nodes = nodes
         self.table = table
         self.start_ns = start_ns
+        # True when every placement qualifies for the dense plan->FSM
+        # path (fresh, no networks/devices/canaries): results stay as
+        # arrays end to end (structs.DenseTGPlacements)
+        self.dense_ok = dense_ok
 
 
 _cache_enabled = False
@@ -831,20 +844,41 @@ class TpuPlacementEngine:
         there so concurrent evals share ONE eval-batched device dispatch;
         otherwise it runs as a single-eval scan.
         """
-        enc = self.encode_eval(sched, destructive, place)
+        from ..utils import metrics as _metrics
+
+        t0 = _metrics.now()
+        with _HOST_WORK_SEM:
+            t1 = _metrics.now()
+            enc = self.encode_eval(sched, destructive, place)
+            _metrics.measure_since("nomad.tpu_engine.encode_work", t1)
+        _metrics.measure_since("nomad.tpu_engine.encode", t0)
         if enc is NotImplemented:
             return NotImplemented
         if enc is True:
             return True
+        t0 = _metrics.now()
         batcher = getattr(sched.planner, "device_batcher", None)
         if batcher is not None:
             chosen, scores, pulls, skipped_steps = batcher.run(enc)
         else:
             chosen, scores, pulls, skipped_steps = self.run_scan_single(enc)
-        self._apply_results(
-            sched, enc.missing_list, enc.nodes, enc.table, chosen, scores,
-            pulls, skipped_steps, enc.start_ns,
-        )
+        _metrics.measure_since("nomad.tpu_engine.device_wait", t0)
+        t0 = _metrics.now()
+        with _HOST_WORK_SEM:
+            t1 = _metrics.now()
+            chosen = np.asarray(chosen)
+            skipped_steps = np.asarray(skipped_steps)
+            if enc.dense_ok and (chosen >= 0).all() and not skipped_steps.any():
+                # every placement succeeded and qualifies: results stay
+                # dense (no per-alloc objects) all the way to the FSM
+                self._apply_results_dense(sched, enc, chosen, scores, pulls)
+            else:
+                self._apply_results(
+                    sched, enc.missing_list, enc.nodes, enc.table, chosen,
+                    scores, pulls, skipped_steps, enc.start_ns,
+                )
+            _metrics.measure_since("nomad.tpu_engine.apply_work", t1)
+        _metrics.measure_since("nomad.tpu_engine.apply", t0)
         return True
 
     def encode_eval(self, sched, destructive: List, place: List):
@@ -874,10 +908,29 @@ class TpuPlacementEngine:
             return NotImplemented
 
         # Sticky-disk preferred nodes use a different two-phase select; punt.
+        # Simultaneously decide dense-path eligibility: every placement
+        # fresh (no previous alloc), no canaries, and its TG free of
+        # network/device asks — then results stay as arrays through plan
+        # submit -> plan apply -> FSM (structs.DenseTGPlacements).
+        dense_ok = not sched.eval.annotate_plan
+        _dense_tg_cache: Dict[str, bool] = {}
         for missing in missing_list:
             prev = missing.get_previous_allocation()
-            if prev is not None and missing.get_task_group().ephemeral_disk.sticky:
+            tg = missing.get_task_group()
+            if prev is not None and tg.ephemeral_disk.sticky:
                 return fallback("sticky ephemeral disk")
+            if dense_ok:
+                if prev is not None or missing.is_canary():
+                    dense_ok = False
+                    continue
+                tg_ok = _dense_tg_cache.get(tg.name)
+                if tg_ok is None:
+                    tg_ok = not tg.networks and not any(
+                        t.resources.networks or t.resources.devices
+                        for t in tg.tasks
+                    )
+                    _dense_tg_cache[tg.name] = tg_ok
+                dense_ok = tg_ok
 
         # The capacity model tracks one aggregate bandwidth dimension; the
         # host checks per NIC. Gate multi-NIC nodes to keep parity.
@@ -886,7 +939,12 @@ class TpuPlacementEngine:
                 return fallback("multi-NIC node")
 
         # Build TG specs (may refuse). The per-node NetworkIndex cache is
-        # shared across this eval's TGs (port-feasibility masks).
+        # shared across this eval's TGs (port-feasibility masks); the
+        # fleet-static cache (encode.fleet_static) shares totals/index/
+        # class-group arrays across every eval between node writes.
+        from .encode import fleet_static
+
+        fleet = fleet_static(ctx, job, nodes)
         tg_specs: Dict[str, TGSpec] = {}
         port_cache: Dict[str, object] = {}
         try:
@@ -894,9 +952,10 @@ class TpuPlacementEngine:
                 tg = missing.get_task_group()
                 if tg.name not in tg_specs:
                     tg_specs[tg.name] = build_tg_spec(
-                        ctx, job, tg, nodes, sched.batch, port_cache
+                        ctx, job, tg, nodes, sched.batch, port_cache,
+                        fleet=fleet,
                     )
-            table = build_node_table(ctx, job, nodes)
+            table = build_node_table(ctx, job, nodes, fleet=fleet)
         except UnsupportedByEngine as e:
             return fallback(str(e))
         device_dims = job_device_dims(job)  # validated above; never raises here
@@ -1153,7 +1212,7 @@ class TpuPlacementEngine:
             n_real=n_real, n_pad=n_pad, g=g_count, s=sv, v=vv, p=p,
             dtype=fdtype, static=static, carry=init_carry, xs=xs,
             missing_list=missing_list, nodes=nodes, table=table,
-            start_ns=start,
+            start_ns=start, dense_ok=dense_ok,
         )
 
     def run_scan_single(self, enc: "EncodedEval"):
@@ -1480,6 +1539,72 @@ class TpuPlacementEngine:
         ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
 
     # ------------------------------------------------------------------
+
+    def _apply_results_dense(self, sched, enc, chosen, scores, pulls) -> None:
+        """Record scan results as DenseTGPlacements blocks — one per task
+        group, parallel arrays only. The per-placement work here is a few
+        list appends; AllocMetric/Allocation objects materialize lazily
+        on read (structs.DenseTGPlacements.materialize). Preconditions
+        (checked by the caller): enc.dense_ok, every placement chosen."""
+        from ..structs.structs import DenseTGPlacements, generate_uuids
+
+        job = sched.job
+        ctx = sched.ctx
+        deployment_id = ""
+        if sched.deployment is not None and sched.deployment.active():
+            deployment_id = sched.deployment.id
+
+        if scores.dtype.kind == "i":
+            from .intscore import TERM_ONE
+
+            scores_f = np.asarray(scores, np.float64) / (60.0 * TERM_ONE)
+        else:
+            scores_f = np.asarray(scores, np.float64)
+        pulls = np.asarray(pulls)
+        tg_idx = enc.xs[0]  # [p] task-group index per placement
+        nodes = enc.nodes
+        missing_list = enc.missing_list
+        nodes_available = getattr(sched, "_nodes_by_dc", {})
+
+        for gi in np.unique(tg_idx):
+            sel = np.nonzero(tg_idx == gi)[0]
+            tg = job.task_groups[int(gi)]
+            proto = AllocatedResources(
+                tasks={
+                    t.name: AllocatedTaskResources(
+                        cpu_shares=t.resources.cpu,
+                        memory_mb=t.resources.memory_mb,
+                    )
+                    for t in tg.tasks
+                },
+                shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
+            )
+            ask_vec = (
+                float(sum(t.resources.cpu for t in tg.tasks)),
+                float(sum(t.resources.memory_mb for t in tg.tasks)),
+                float(tg.ephemeral_disk.size_mb),
+                0.0,  # dense gate: no network asks
+            )
+            block = DenseTGPlacements(
+                namespace=job.namespace,
+                job_id=job.id,
+                task_group=tg.name,
+                eval_id=sched.eval.id,
+                deployment_id=deployment_id,
+                job=job,
+                resources_proto=proto,
+                ask_vec=ask_vec,
+                ids=generate_uuids(len(sel)),
+                names=[missing_list[k].get_name() for k in sel],
+                node_ids=[nodes[j].id for j in chosen[sel]],
+                node_names=[nodes[j].name for j in chosen[sel]],
+                scores=scores_f[sel].tolist(),
+                nodes_evaluated=pulls[sel].tolist(),
+                nodes_available=nodes_available,
+            )
+            sched.plan.dense_placements.append(block)
+
+        ctx.metrics.allocation_time_ns = _time.monotonic_ns() - enc.start_ns
 
     def _apply_results(self, sched, missing_list, nodes, table, chosen, scores,
                        pulls, skipped_steps, start_ns) -> None:
