@@ -581,6 +581,18 @@ class Agent:
             return [s.name for s in self.membership.servers_in_region()]
         return [f"{self.config.name}"]
 
+    def remove_raft_peer(self, peer_id: str) -> None:
+        """Replicated removal of a consensus peer (reference
+        operator_endpoint.go RaftRemovePeerByID). Wire-raft only; the
+        in-proc dev raft has no membership to mutate."""
+        if self.wire_raft is None:
+            raise ValueError("raft peer removal requires wire raft (-raft)")
+        if peer_id == self.wire_raft.node_id:
+            raise ValueError("refusing to remove self; run on another server")
+        if peer_id not in self.wire_raft.peers:
+            raise ValueError(f"unknown raft peer {peer_id!r}")
+        self.wire_raft.remove_peer_replicated(peer_id)
+
     def raft_servers(self) -> List[Tuple[str, str, bool]]:
         if self.server is None:
             return []

@@ -102,6 +102,7 @@ class Routes:
         r("/v1/status/peers", self.status_peers)
         r("/v1/operator/scheduler/configuration", self.operator_scheduler_config)
         r("/v1/operator/raft/configuration", self.operator_raft_config)
+        r("/v1/operator/raft/peer", self.operator_raft_peer)
         r("/v1/operator/autopilot/configuration", self.operator_autopilot_config)
         r("/v1/operator/autopilot/health", self.operator_autopilot_health)
         r("/v1/agent/monitor", self.agent_monitor)
@@ -577,6 +578,19 @@ class Routes:
             ],
             "Index": self.state.latest_index,
         }
+
+    def operator_raft_peer(self, req: Request):
+        """DELETE /v1/operator/raft/peer?id=<peer-id> — replicated removal
+        of a raft peer (reference operator_endpoint.go RaftRemovePeerByID,
+        command/agent/operator_endpoint.go:37)."""
+        if req.method != "DELETE":
+            raise HTTPError(405, "method not allowed")
+        self._authorize(req, "operator:write")
+        peer_id = req.param("id")
+        if not peer_id:
+            raise HTTPError(400, "missing ?id=<peer-id>")
+        self.agent.remove_raft_peer(peer_id)
+        return {"Removed": peer_id, "Index": self.state.latest_index}
 
     def operator_autopilot_config(self, req: Request):
         from ..server.autopilot import AutopilotConfig

@@ -626,6 +626,14 @@ class Operator(_Sub):
     def raft_get_configuration(self, q: Optional[QueryOptions] = None):
         return self.client.get("/v1/operator/raft/configuration", q)
 
+    def raft_remove_peer(self, peer_id: str, q: Optional[QueryOptions] = None):
+        """Reference api/operator.go RaftRemovePeerByID."""
+        from urllib.parse import quote
+
+        return self.client.delete(
+            f"/v1/operator/raft/peer?id={quote(peer_id, safe='')}", q
+        )
+
 
 class AgentAPI(_Sub):
     def self(self):

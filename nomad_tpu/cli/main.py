@@ -104,7 +104,7 @@ _VALUE_FLAGS = {
     "ca-file", "cert-file", "key-file", "n",
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
     "servers", "encrypt", "authoritative-region", "replication-token",
-    "host-volume",
+    "host-volume", "peer-id", "group",
 }
 
 
@@ -400,6 +400,114 @@ def cmd_job_validate(ctx: Ctx, args: List[str]) -> int:
     return 0
 
 
+_EXAMPLE_JOBSPEC = '''\
+# Minimal example job (reference command/job_init.go example.nomad).
+# Run it with: nomad job run example.nomad
+job "example" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  group "cache" {
+    count = 1
+
+    restart {
+      attempts = 2
+      interval = "30m"
+      delay    = "15s"
+      mode     = "fail"
+    }
+
+    ephemeral_disk {
+      size = 300
+    }
+
+    task "redis" {
+      driver = "raw_exec"
+
+      config {
+        command = "/bin/sh"
+        args    = ["-c", "sleep 600"]
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+    }
+  }
+}
+'''
+
+
+def cmd_job_init(ctx: Ctx, args: List[str]) -> int:
+    """Reference command/job_init.go: write an example jobspec."""
+    flags, rest = _split_flags(args)
+    filename = rest[0] if rest else "example.nomad"
+    if os.path.exists(filename):
+        ctx.out(f"Job file '{filename}' already exists")
+        return 1
+    with open(filename, "w") as f:
+        f.write(_EXAMPLE_JOBSPEC)
+    ctx.out(f"Example job file written to {filename}")
+    return 0
+
+
+def cmd_job_eval(ctx: Ctx, args: List[str]) -> int:
+    """Reference command/job_eval.go: force a new evaluation."""
+    flags, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job eval [-detach] <job>")
+    out, _ = ctx.client.jobs.evaluate(rest[0])
+    eval_id = out.get("EvalID", "")
+    if _truthy(flags, "detach") or not eval_id:
+        ctx.out(f"Evaluation ID: {eval_id}")
+        return 0
+    return monitor_eval(ctx.client, eval_id, ctx.out)
+
+
+def cmd_job_deployments(ctx: Ctx, args: List[str]) -> int:
+    """Reference command/job_deployments.go."""
+    _, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job deployments <job>")
+    deps, _ = ctx.client.jobs.deployments(rest[0])
+    if not deps:
+        ctx.out("No deployments found")
+        return 0
+    rows = [["ID", "Job Version", "Status", "Description"]]
+    for d in deps:
+        rows.append([
+            short_id(d["ID"]), d.get("JobVersion", 0), d.get("Status", ""),
+            d.get("StatusDescription", ""),
+        ])
+    ctx.out(columns(rows))
+    return 0
+
+
+def cmd_job_promote(ctx: Ctx, args: List[str]) -> int:
+    """Reference command/job_promote.go: promote the job's latest
+    deployment's canaries."""
+    flags, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad job promote [-group g] <job>")
+    deps, _ = ctx.client.jobs.deployments(rest[0])
+    active = [
+        d for d in deps or []
+        if d.get("Status") in ("running", "pending", "paused")
+    ]
+    if not active:
+        ctx.out(f"No active deployment for job {rest[0]!r}")
+        return 1
+    latest = max(active, key=lambda d: d.get("CreateIndex", 0))
+    groups = flags["group"].split(",") if "group" in flags else None
+    out, _ = ctx.client.deployments.promote(latest["ID"], groups=groups)
+    eval_id = out.get("EvalID", "")
+    if eval_id and not _truthy(flags, "detach"):
+        return monitor_eval(ctx.client, eval_id, ctx.out)
+    ctx.out(f"Deployment {short_id(latest['ID'])} promoted")
+    return 0
+
+
 def cmd_job_periodic_force(ctx: Ctx, args: List[str]) -> int:
     _, rest = _split_flags(args)
     if not rest:
@@ -420,6 +528,10 @@ def cmd_job(ctx: Ctx, args: List[str]) -> int:
         "dispatch": cmd_job_dispatch,
         "inspect": cmd_job_inspect,
         "validate": cmd_job_validate,
+        "init": cmd_job_init,
+        "eval": cmd_job_eval,
+        "deployments": cmd_job_deployments,
+        "promote": cmd_job_promote,
         "periodic": lambda c, a: cmd_job_periodic_force(c, a[1:]) if a and a[0] == "force" else _usage(c, "job periodic force <job>"),
     }
     return _dispatch(ctx, args, subs, "job")
@@ -719,6 +831,20 @@ def cmd_alloc_exec(ctx: Ctx, args: List[str]) -> int:
     return int(out.get("ExitCode", 0))
 
 
+def cmd_alloc_stop(ctx: Ctx, args: List[str]) -> int:
+    """Reference command/alloc_stop.go: stop + reschedule one alloc."""
+    flags, rest = _split_flags(args)
+    if not rest:
+        raise CLIError("usage: nomad alloc stop [-detach] <alloc-id>")
+    alloc = _find_alloc(ctx, rest[0])
+    out, _ = ctx.client.allocations.stop(alloc["ID"])
+    eval_id = out.get("EvalID", "")
+    if _truthy(flags, "detach") or not eval_id:
+        ctx.out(f"Evaluation ID: {eval_id}")
+        return 0
+    return monitor_eval(ctx.client, eval_id, ctx.out)
+
+
 def cmd_alloc_status(ctx: Ctx, args: List[str]) -> int:
     _, rest = _split_flags(args)
     if not rest:
@@ -792,15 +918,18 @@ def cmd_deployment(ctx: Ctx, args: List[str]) -> int:
         ctx.out(columns(rows))
         return 0
 
+    def _resolve(ctx, prefix: str) -> str:
+        deps, _ = ctx.client.deployments.list()
+        matches = [d for d in deps or [] if d["ID"].startswith(prefix)]
+        if len(matches) != 1:
+            raise CLIError(f"prefix matched {len(matches)} deployments")
+        return matches[0]["ID"]
+
     def dstatus(ctx, a):
         _, rest = _split_flags(a)
         if not rest:
             raise CLIError("usage: nomad deployment status <id>")
-        deps, _ = ctx.client.deployments.list()
-        matches = [d for d in deps or [] if d["ID"].startswith(rest[0])]
-        if len(matches) != 1:
-            raise CLIError(f"prefix matched {len(matches)} deployments")
-        d, _ = ctx.client.deployments.info(matches[0]["ID"])
+        d, _ = ctx.client.deployments.info(_resolve(ctx, rest[0]))
         ctx.out(kv([
             ("ID", d["ID"]),
             ("Job ID", d.get("JobID", "")),
@@ -823,19 +952,31 @@ def cmd_deployment(ctx: Ctx, args: List[str]) -> int:
         _, rest = _split_flags(a)
         if not rest:
             raise CLIError("usage: nomad deployment promote <id>")
-        out, _ = ctx.client.deployments.promote(rest[0])
+        out, _ = ctx.client.deployments.promote(_resolve(ctx, rest[0]))
         return monitor_eval(ctx.client, out.get("EvalID", ""), ctx.out) if out.get("EvalID") else 0
 
     def dfail(ctx, a):
         _, rest = _split_flags(a)
         if not rest:
             raise CLIError("usage: nomad deployment fail <id>")
-        ctx.client.deployments.fail(rest[0])
+        ctx.client.deployments.fail(_resolve(ctx, rest[0]))
         ctx.out("Deployment marked as failed")
+        return 0
+
+    def _dpause(ctx, a, pause: bool):
+        _, rest = _split_flags(a)
+        if not rest:
+            verb = "pause" if pause else "resume"
+            raise CLIError(f"usage: nomad deployment {verb} <id>")
+        ctx.client.deployments.pause(_resolve(ctx, rest[0]), pause)
+        ctx.out("Deployment paused" if pause else "Deployment resumed")
         return 0
 
     return _dispatch(ctx, args, {
         "list": dlist, "status": dstatus, "promote": dpromote, "fail": dfail,
+        # reference command/deployment_pause.go / deployment_resume.go
+        "pause": lambda c, a: _dpause(c, a, True),
+        "resume": lambda c, a: _dpause(c, a, False),
     }, "deployment")
 
 
@@ -969,7 +1110,21 @@ def cmd_operator(ctx: Ctx, args: List[str]) -> int:
         return 0
 
     def raft(ctx, a):
-        _, rest = _split_flags(a)
+        flags, rest = _split_flags(a)
+        if rest and rest[0] == "remove-peer":
+            # reference command/operator_raft_remove.go
+            peer = flags.get("peer-id", "") or (rest[1] if len(rest) > 1 else "")
+            if not peer:
+                raise CLIError(
+                    "usage: nomad operator raft remove-peer -peer-id=<id>"
+                )
+            ctx.client.operator.raft_remove_peer(peer)
+            ctx.out(f"Removed peer {peer}")
+            return 0
+        if rest and rest[0] not in ("list-peers",):
+            raise CLIError(
+                "usage: nomad operator raft [list-peers | remove-peer -peer-id=<id>]"
+            )
         raftcfg, _ = ctx.client.operator.raft_get_configuration()
         rows = [["Node", "ID", "Address", "State", "Voter"]]
         for s in raftcfg.get("Servers") or []:
@@ -1054,7 +1209,7 @@ COMMANDS: Dict[str, Callable[[Ctx, List[str]], int]] = {
         c, a,
         {"status": cmd_alloc_status, "logs": cmd_alloc_logs, "fs": cmd_alloc_fs,
          "restart": cmd_alloc_restart, "signal": cmd_alloc_signal,
-         "exec": cmd_alloc_exec},
+         "exec": cmd_alloc_exec, "stop": cmd_alloc_stop},
         "alloc",
     ),
     "eval": lambda c, a: _dispatch(c, a, {"status": cmd_eval_status}, "eval"),

@@ -93,7 +93,7 @@ class EvalContext:
     """Contextual state for one evaluation (state snapshot, plan, metrics)."""
 
     def __init__(self, state, plan: Plan, logger: Optional[logging.Logger] = None,
-                 deterministic: bool = False) -> None:
+                 deterministic: bool = False, ring_seed: int = 0) -> None:
         self.state = state
         self.plan = plan
         self.logger = logger or logging.getLogger("nomad_tpu.scheduler")
@@ -106,6 +106,14 @@ class EvalContext:
         # deterministic scheduling (no shuffle, lowest-index dynamic ports);
         # used by the host/TPU parity harness
         self.deterministic = deterministic
+        # Deterministic-mode analog of the reference's per-eval node
+        # shuffle (stack.go:67 SetNodes -> util.go:329 shuffleNodes):
+        # a per-eval starting offset for the candidate ring. Without it,
+        # optimistically-concurrent evals sharing one snapshot walk
+        # identical rings and collide at plan apply. 0 = insertion order
+        # (the parity harness's fixed frame); same seed on the host stack
+        # and the TPU scan keeps them plan-identical per eval.
+        self.ring_seed = ring_seed
 
     def reset(self) -> None:
         self.metrics = AllocMetric()

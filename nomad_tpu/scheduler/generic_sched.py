@@ -90,6 +90,15 @@ class GenericScheduler:
         self.planner = planner
         self.batch = batch
         self.deterministic = deterministic
+        # per-eval candidate-ring seeding in deterministic mode (the
+        # reference's shuffle analog; EvalContext.ring_seed). Off by
+        # default so the parity harness keeps its fixed insertion-order
+        # frame; the production server turns it on.
+        self.ring_decorrelate = False
+        # evals below this placement count skip the device and run the
+        # host iterator stack (engine.compute_placements); 0 = always
+        # device. Set by the production server.
+        self.device_min_placements = 0
 
         self.eval: Optional[Evaluation] = None
         self.job = None
@@ -174,8 +183,14 @@ class GenericScheduler:
             )
 
         self.failed_tg_allocs = None
+        ring_seed = 0
+        if self.deterministic and self.ring_decorrelate:
+            import zlib
+
+            ring_seed = zlib.crc32(self.eval.id.encode()) & 0x7FFFFFFF
         self.ctx = EvalContext(self.state, self.plan, self.logger,
-                               deterministic=self.deterministic)
+                               deterministic=self.deterministic,
+                               ring_seed=ring_seed)
         self.stack = GenericStack(self.batch, self.ctx)
         if self.job is not None and not self.job.stopped():
             self.stack.set_job(self.job)

@@ -93,6 +93,10 @@ class GenericStack:
 
             shuffle_nodes(base_nodes)
         self.source.set_nodes(base_nodes)
+        if self.ctx.deterministic and self.ctx.ring_seed and base_nodes:
+            # per-eval ring start (the deterministic shuffle analog;
+            # see EvalContext.ring_seed)
+            self.source.offset = self.ctx.ring_seed % len(base_nodes)
 
         # Candidate sampling bound: batch = power-of-two-choices, service =
         # ceil(log2 N) with a floor of 2 (reference stack.go:74-86).

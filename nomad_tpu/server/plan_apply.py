@@ -120,9 +120,28 @@ class Planner:
         # Pipelined applier (plan_apply.go:45–70): track one outstanding
         # raft apply (apply_future resolves to its committed index, 0 on
         # failure) and an optimistic snapshot that already includes it.
+        #
+        # Snapshot retention: taking a fresh snapshot per plan is O(store)
+        # and was the drain bottleneck at C1M rates. The store's
+        # capacity_epoch counts every capacity-relevant write (nodes,
+        # allocs, dense blocks, jobs); as long as the live epoch equals
+        # our prediction (snapshot epoch + our own dispatched applies),
+        # the only writes that landed since are eval-status noise and the
+        # retained optimistic snapshot is capacity-identical to committed
+        # state — index staleness checks may be bypassed safely.
         apply_future: Optional[Future] = None
         snap = None
         prev_plan_result_index = 0
+        expected_epoch: Optional[int] = None
+
+        def epoch_current() -> bool:
+            live = self.fsm.state
+            return (
+                snap is not None
+                and expected_epoch is not None
+                and getattr(snap, "store_id", None) == live.store_id
+                and live.capacity_epoch == expected_epoch
+            )
 
         while not self._stop.is_set():
             pending = self.plan_queue.dequeue(timeout=0.2)
@@ -130,16 +149,22 @@ class Planner:
                 continue
             metrics.set_gauge("nomad.plan.queue_depth", self.plan_queue.stats().get("depth", 0))
             try:
-                # Previous plan committed during dequeue? Discard the
-                # optimistic view; future snapshots must include it.
+                # Previous plan committed during dequeue? Keep the
+                # optimistic view only if the commit was exactly what we
+                # predicted (no interleaved capacity writes).
                 if apply_future is not None and apply_future.done():
                     idx = self._future_index(apply_future)
                     prev_plan_result_index = max(prev_plan_result_index, idx)
                     apply_future = None
-                    snap = None
+                    if idx == 0 or not epoch_current():
+                        snap = None
+                        expected_epoch = None
 
                 min_index = max(prev_plan_result_index, pending.plan.snapshot_index)
-                if snap is not None and snap.latest_index < min_index:
+                if (
+                    snap is not None and snap.latest_index < min_index
+                    and apply_future is None and not epoch_current()
+                ):
                     snap = None
                 # Does the evaluation snapshot include the in-flight plan's
                 # results? Only the retained optimistic snapshot does; a
@@ -147,8 +172,9 @@ class Planner:
                 # may lack them, and an evaluation against it cannot be
                 # trusted not to double-commit the same capacity.
                 saw_inflight = True
-                if apply_future is None or snap is None:
+                if snap is None:
                     snap = self._snapshot_min_index(min_index)
+                    expected_epoch = snap.capacity_epoch
                     saw_inflight = apply_future is None
 
                 start = metrics.now()
@@ -165,26 +191,35 @@ class Planner:
                     idx = self._future_index(apply_future, wait=True)
                     prev_plan_result_index = max(prev_plan_result_index, idx)
                     apply_future = None
-                    snap = self._snapshot_min_index(
-                        max(prev_plan_result_index, pending.plan.snapshot_index)
-                    )
-                    # Re-validate against committed state when the
-                    # evaluation could not be trusted: it either ran blind
-                    # to the in-flight plan, or ran on optimism the failed
-                    # apply (idx == 0) never delivered — dispatching
-                    # unchecked in the latter case would commit placements
-                    # into capacity whose stops never landed.
-                    if not saw_inflight or idx == 0:
+                    if idx == 0 or not saw_inflight or not epoch_current():
+                        snap = self._snapshot_min_index(
+                            max(prev_plan_result_index, pending.plan.snapshot_index)
+                        )
+                        expected_epoch = snap.capacity_epoch
+                        # Re-validate against committed state whenever the
+                        # evaluation could not be trusted: it ran blind to
+                        # the in-flight plan, or on optimism a failed
+                        # apply (idx == 0) never delivered, or a foreign
+                        # capacity write (node drain, client sync)
+                        # interleaved with the retained snapshot —
+                        # dispatching unchecked in any of these would
+                        # commit placements against capacity state that
+                        # never existed.
                         result = self.evaluate_plan(snap, pending.plan)
                         if result.is_noop():
                             pending.future.set_result(result)
                             continue
 
-                apply_future, snap_ok = self._dispatch_apply(pending, result, snap)
+                apply_future, snap_ok, delta = self._dispatch_apply(
+                    pending, result, snap
+                )
+                if expected_epoch is not None:
+                    expected_epoch += delta
                 if not snap_ok:
                     # the optimistic fold-in failed partway: the snapshot
                     # is inconsistent — never evaluate against it again
                     snap = None
+                    expected_epoch = None
             except Exception as e:  # noqa: BLE001 — worker gets the error
                 self.logger.exception("plan apply failed")
                 if not pending.future.done():
@@ -362,10 +397,17 @@ class Planner:
                 used[d] + pend[d] - fr[d] + res[d] + add[d] <= totals[d]
                 for d in range(4)
             ):
+                self.logger.debug(
+                    "dense re-check rejected node %s: used=%s pend=%s "
+                    "freed=%s reserved=%s add=%s totals=%s",
+                    node_id[:8], used, pend, fr, res, add, totals,
+                )
                 bad.add(node_id)
 
         out = []
         partial = bool(bad)
+        if bad:
+            metrics.incr_counter("nomad.plan.dense_nodes_rejected", len(bad))
         for block in plan.dense_placements:
             if not bad:
                 out.append(block)
@@ -499,16 +541,27 @@ class Planner:
         }
 
     def _dispatch_apply(self, pending: PendingPlan, result: PlanResult,
-                        snap) -> Tuple[Future, bool]:
+                        snap) -> Tuple[Future, bool, int]:
         """Fire the raft apply asynchronously (plan_apply.go applyPlan +
         asyncPlanWait): optimistically fold the results into ``snap`` so
         the NEXT plan evaluates as if this one succeeded, respond to the
         waiting worker from the apply waiter, and return (index_future,
-        snap_ok) — the future resolves to the committed index (0 on
-        failure); snap_ok is False when the optimistic fold-in failed and
-        the snapshot must be discarded."""
+        snap_ok, capacity_delta) — the future resolves to the committed
+        index (0 on failure); snap_ok is False when the optimistic
+        fold-in failed and the snapshot must be discarded; capacity_delta
+        is the number of capacity_epoch bumps the FSM apply of this
+        payload will perform (the applier's snapshot-retention
+        prediction)."""
         plan = pending.plan
         payload = self._build_payload(snap, plan, result)
+        # one bump for the combined object-alloc upsert (when non-empty)
+        # plus one per dense block (state_store.upsert_plan_results)
+        capacity_delta = len(payload["dense_placements"])
+        if (
+            payload["alloc_updates"] or payload["allocs_stopped"]
+            or payload["allocs_preempted"]
+        ):
+            capacity_delta += 1
         snap_ok = True
 
         # Optimistic application to our private snapshot view: the raft
@@ -563,7 +616,7 @@ class Planner:
                 index_future.set_result(0)
 
         threading.Thread(target=waiter, name="plan-apply-wait", daemon=True).start()
-        return index_future, snap_ok
+        return index_future, snap_ok, capacity_delta
 
     def apply_plan(self, plan: Plan) -> PlanResult:
         """Synchronous evaluate+apply (tests / direct callers); the

@@ -57,6 +57,15 @@ from .worker import Worker
 class ServerConfig:
     num_schedulers: int = 2
     deterministic: bool = False
+    # deterministic-mode per-eval candidate-ring seeding (the reference's
+    # per-eval shuffle analog, util.go:329): decorrelates concurrent
+    # evals so optimistic concurrency doesn't funnel every eval onto one
+    # ring prefix. Harness/parity contexts leave it off.
+    ring_decorrelate: bool = True
+    # evals smaller than this skip the device dispatch and place on the
+    # host iterator stack (reference-latency path for small jobs and
+    # partial-commit retries); the device engine amortizes above it
+    device_min_placements: int = 24
     heartbeat_min_ttl: float = 10.0
     heartbeat_max_ttl: float = 30.0
     eval_gc_interval: float = 300.0

@@ -172,6 +172,14 @@ class Worker:
         sched = new_scheduler(evaluation.type, self.logger, snapshot, self)
         if hasattr(sched, "deterministic"):
             sched.deterministic = self.server.config.deterministic
+        if hasattr(sched, "ring_decorrelate"):
+            sched.ring_decorrelate = getattr(
+                self.server.config, "ring_decorrelate", True
+            )
+        if hasattr(sched, "device_min_placements"):
+            sched.device_min_placements = getattr(
+                self.server.config, "device_min_placements", 0
+            )
         start = metrics.now()
         sched.process(evaluation)
         metrics.measure_since(

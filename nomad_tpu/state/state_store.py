@@ -46,6 +46,11 @@ class StateStore:
 
         self.store_id = _uuid.uuid4().hex
         self.node_epoch = 0
+        # bumps on every capacity-relevant write (node tables, alloc
+        # upserts, dense block inserts, client syncs): the plan applier
+        # keeps its optimistic snapshot alive across plans while this
+        # matches its prediction, instead of re-snapshotting per plan
+        self.capacity_epoch = 0
 
         self.nodes_table: Dict[str, Node] = {}
         self.jobs_table: Dict[Tuple[str, str], Job] = {}
@@ -125,6 +130,8 @@ class StateStore:
         self.store_id = _uuid.uuid4().hex
         if "node_epoch" not in self.__dict__:
             self.node_epoch = 0
+        if "capacity_epoch" not in self.__dict__:
+            self.capacity_epoch = 0
         # Pickles from pre-mirror builds lack the usage mirror: rebuild it
         # from the alloc table so writes and snapshots keep working.
         # pre-dense snapshots lack the dense tables entirely; fresh ones
@@ -187,6 +194,7 @@ class StateStore:
             snap.latest_index = self.latest_index
             snap.store_id = self.store_id
             snap.node_epoch = self.node_epoch
+            snap.capacity_epoch = self.capacity_epoch
             snap.nodes_table = dict(self.nodes_table)
             snap.jobs_table = dict(self.jobs_table)
             snap.job_versions = {k: list(v) for k, v in self.job_versions.items()}
@@ -270,12 +278,14 @@ class StateStore:
                 node.compute_class()
             self.nodes_table[node.id] = node
             self.node_epoch += 1
+            self.capacity_epoch += 1
             self._bump(index)
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
             self.nodes_table.pop(node_id, None)
             self.node_epoch += 1
+            self.capacity_epoch += 1
             self._bump(index)
 
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
@@ -288,6 +298,7 @@ class StateStore:
             node.modify_index = index
             self.nodes_table[node_id] = node
             self.node_epoch += 1
+            self.capacity_epoch += 1
             self._bump(index)
 
     def update_node_drain(
@@ -320,6 +331,7 @@ class StateStore:
             node.modify_index = index
             self.nodes_table[node_id] = node
             self.node_epoch += 1
+            self.capacity_epoch += 1
             self._bump(index)
 
     def update_node_eligibility(self, index: int, node_id: str, eligibility: str) -> None:
@@ -332,6 +344,7 @@ class StateStore:
             node.modify_index = index
             self.nodes_table[node_id] = node
             self.node_epoch += 1
+            self.capacity_epoch += 1
             self._bump(index)
 
     def node_by_id(self, node_id: str) -> Optional[Node]:
@@ -361,6 +374,7 @@ class StateStore:
                 job.version = 0
             if job.status not in (JOB_STATUS_PENDING, JOB_STATUS_RUNNING, JOB_STATUS_DEAD):
                 job.status = JOB_STATUS_PENDING
+            self.capacity_epoch += 1  # planner payloads read job state
             self.jobs_table[key] = job
             self.job_versions.setdefault(key, []).append(job)
             # keep a bounded version history (reference keeps 6)
@@ -374,6 +388,7 @@ class StateStore:
 
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
+            self.capacity_epoch += 1
             job = self.jobs_table.pop((namespace, job_id), None)
             self.job_versions.pop((namespace, job_id), None)
             self.periodic_launch_table.pop((namespace, job_id), None)
@@ -432,6 +447,8 @@ class StateStore:
                     s = self._evals_by_job.get((e.namespace, e.job_id))
                     if s is not None:
                         s.discard(eid)
+            if alloc_ids:
+                self.capacity_epoch += 1
             for aid in alloc_ids:
                 self._remove_alloc_index(aid)
                 self.allocs_table.pop(aid, None)
@@ -586,6 +603,8 @@ class StateStore:
             self._bump(index)
 
     def _upsert_allocs_impl(self, index: int, allocs: List[Allocation]) -> None:
+        if allocs:
+            self.capacity_epoch += 1
         for alloc in allocs:
             # Snapshot isolation: copy the alloc, sharing the (immutable) job.
             alloc = alloc.copy_skip_job()
@@ -609,6 +628,8 @@ class StateStore:
     def update_allocs_from_client(self, index: int, allocs: List[Allocation]) -> None:
         """Client status sync (reference state_store.go:1933)."""
         with self._lock:
+            if allocs:
+                self.capacity_epoch += 1
             flips_by_deployment: Dict[str, List[Tuple[Optional[bool], Allocation]]] = {}
             for client_alloc in allocs:
                 existing = self._existing_alloc(client_alloc.id)
@@ -998,6 +1019,7 @@ class StateStore:
         Fresh ids by construction (the engine mints them), so there is no
         existing-version handling."""
         block.stamp(index, timestamp_ns)
+        self.capacity_epoch += 1
         self._dense_blocks.append(block)
         if self._dense_by_id is not None:  # snapshots resolve by scan
             for i, aid in enumerate(block.ids):

@@ -426,7 +426,15 @@ class DeviceBatcher:
         g_pad = _pow2ceil(max(e.g for e in encs) + 1)
         s_pad = _pow2ceil(max(max(e.s for e in encs), 1))
         v_pad = _pow2ceil(max(max(e.v for e in encs), 2))
-        p_pad = _pow2ceil(max(e.p for e in encs))
+        # COARSE placement-count buckets (16/64/256, pow2 beyond): retried
+        # partial evals arrive at arbitrary small p, and a fresh compile
+        # (even a persistent-cache load) per pow2 bucket costs seconds —
+        # far more than the padded steps, which skip cheaply
+        p_raw = max(e.p for e in encs)
+        p_pad = (
+            16 if p_raw <= 16 else 64 if p_raw <= 64
+            else 256 if p_raw <= 256 else _pow2ceil(p_raw)
+        )
         d_pad = max(e.static[0].shape[1] for e in encs)
         # absent-feature axes stay ZERO when the whole batch lacks them
         # (the compiled step skips those ops); mixed batches widen

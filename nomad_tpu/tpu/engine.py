@@ -846,6 +846,16 @@ class TpuPlacementEngine:
         """
         from ..utils import metrics as _metrics
 
+        # Small evals don't amortize a device dispatch (~100ms+ on a
+        # tunneled chip): the host stack places them in low milliseconds,
+        # exactly like the reference's per-placement iterators
+        # (generic_sched.go:426). Threshold 0 = always use the device
+        # (the parity harness's frame); the production server sets it.
+        n_min = getattr(sched, "device_min_placements", 0)
+        if n_min and len(destructive) + len(place) < n_min:
+            _metrics.incr_counter("nomad.tpu_engine.small_eval_host")
+            return NotImplemented
+
         t0 = _metrics.now()
         with _HOST_WORK_SEM:
             t1 = _metrics.now()
@@ -1196,9 +1206,15 @@ class TpuPlacementEngine:
             sum_spread_weights, np.int32(n_real), e_ask,
             dp_vids, dp_limit, dp_applies,
         )
+        # Ring start mirrors the host source iterator's offset as
+        # set_nodes left it — 0 in the classic deterministic frame, the
+        # per-eval seed when ring decorrelation is on
+        # (EvalContext.ring_seed) — so host fallback and device scan walk
+        # the same ring.
+        offset0 = int(getattr(sched.stack.source, "offset", 0)) % max(n_real, 1)
         init_carry = (
             used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
-            np.int32(0), np.zeros(g_count, bool), e_base0, dp_counts0,
+            np.int32(offset0), np.zeros(g_count, bool), e_base0, dp_counts0,
         )
         xs = (
             tg_idx, penalty_idx, evict_node, evict_res, evict_tg,

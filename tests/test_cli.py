@@ -143,3 +143,93 @@ def test_system_and_operator_and_server(agent):
 def test_agent_info(agent):
     code, out = run_cli(agent, "agent-info")
     assert code == 0 and "Server" in out
+
+
+def test_job_init(agent, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    code, out = run_cli(agent, "job", "init")
+    assert code == 0 and "example.nomad" in out
+    # the generated example must parse through our own HCL front end
+    code, out = run_cli(agent, "job", "validate", "example.nomad")
+    assert code == 0, out
+    # refuses to clobber
+    code, out = run_cli(agent, "job", "init")
+    assert code == 1 and "already exists" in out
+
+
+def test_job_eval_and_deployments(agent, tmp_path):
+    jf = tmp_path / "evaljob.hcl"
+    jf.write_text(JOBFILE.replace("cli-job", "cli-eval"))
+    code, out = run_cli(agent, "job", "run", str(jf))
+    assert code == 0, out
+
+    code, out = run_cli(agent, "job", "eval", "cli-eval")
+    assert code == 0, out
+    assert 'finished with status "complete"' in out
+
+    # no update stanza -> no deployments, but the command itself works
+    code, out = run_cli(agent, "job", "deployments", "cli-eval")
+    assert code == 0 and "No deployments" in out
+
+    run_cli(agent, "job", "stop", "-purge", "-detach", "cli-eval")
+
+
+def test_alloc_stop_reschedules(agent, tmp_path):
+    jf = tmp_path / "stopjob.hcl"
+    jf.write_text(JOBFILE.replace("cli-job", "cli-astop").replace("count = 2", "count = 1"))
+    code, out = run_cli(agent, "job", "run", str(jf))
+    assert code == 0, out
+
+    code, out = run_cli(agent, "job", "status", "cli-astop")
+    lines = out.split("Allocations")[-1].splitlines()
+    alloc_id = next(p[0] for p in (l.split() for l in lines[2:]) if p)
+
+    code, out = run_cli(agent, "alloc", "stop", alloc_id)
+    assert code == 0, out
+    assert 'finished with status "complete"' in out
+
+    # the eval replaces the stopped alloc with a fresh one
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        code, out = run_cli(agent, "job", "status", "cli-astop")
+        lines = out.split("Allocations")[-1].splitlines()
+        ids = [p[0] for p in (l.split() for l in lines[2:]) if p]
+        if any(i != alloc_id for i in ids):
+            break
+        time.sleep(0.2)
+    assert any(i != alloc_id for i in ids), out
+    run_cli(agent, "job", "stop", "-purge", "-detach", "cli-astop")
+
+
+def test_deployment_pause_resume_cli(agent, tmp_path):
+    jf = tmp_path / "depjob.hcl"
+    jf.write_text(JOBFILE.replace("cli-job", "cli-dep").replace(
+        'count = 2', 'count = 1\n    update { max_parallel = 1 }'))
+    code, out = run_cli(agent, "job", "run", "-detach", str(jf))
+    assert code == 0, out
+    deadline = time.time() + 10
+    dep_id = None
+    while time.time() < deadline and not dep_id:
+        code, out = run_cli(agent, "job", "deployments", "cli-dep")
+        lines = [l for l in out.splitlines()[1:] if l.strip()]
+        if code == 0 and lines and "No deployments" not in out:
+            dep_id = lines[0].split()[0]
+            break
+        time.sleep(0.2)
+    assert dep_id, out
+    code, out = run_cli(agent, "deployment", "pause", dep_id)
+    assert code == 0 and "paused" in out
+    code, out = run_cli(agent, "deployment", "status", dep_id)
+    assert code == 0 and "paused" in out
+    code, out = run_cli(agent, "deployment", "resume", dep_id)
+    assert code == 0 and "resumed" in out
+    run_cli(agent, "job", "stop", "-purge", "-detach", "cli-dep")
+
+
+def test_operator_raft_remove_peer_cli(agent):
+    # dev agent runs the in-proc raft: removal must refuse cleanly
+    code, out = run_cli(agent, "operator", "raft", "remove-peer",
+                        "-peer-id", "nonexistent")
+    assert code == 1
+    code, out = run_cli(agent, "operator", "raft", "list-peers")
+    assert code == 0 and "leader" in out

@@ -44,7 +44,8 @@ def wait_for(cond, timeout=30.0, msg="condition"):
 @pytest.fixture
 def server():
     s = Server(ServerConfig(num_schedulers=2, deterministic=True,
-                            device_batch=4, device_batch_window_ms=5.0))
+                            device_batch=4, device_batch_window_ms=5.0,
+                            device_min_placements=0))  # always device/dense
     s.start()
     yield s
     s.stop()

@@ -320,6 +320,7 @@ class TestServerBatchedScheduling:
 
         server = Server(ServerConfig(
             num_schedulers=0, device_batch=8, device_batch_window_ms=25.0,
+            device_min_placements=0,  # this test asserts device dispatch
         ))
         try:
             server.start()

@@ -257,10 +257,18 @@ class TestMetricsE2E:
             names |= {s["Name"] for s in m.get("Samples", [])}
             return names
 
-        wait_until(lambda: any("invoke_scheduler" in n
-                               for n in counter_names()),
-                   timeout=30, msg="scheduler counters visible")
-        assert any("plan" in n for n in counter_names())
+        # BOTH names inside ONE polled predicate: asserting "plan" on a
+        # separate fresh fetch can land in a new 10s inmem aggregation
+        # interval that hasn't seen a plan sample yet (r3 suite-load race)
+        def scheduler_and_plan_counters():
+            names = counter_names()
+            return (
+                any("invoke_scheduler" in n for n in names)
+                and any("plan" in n for n in names)
+            )
+
+        wait_until(scheduler_and_plan_counters, timeout=30,
+                   msg="scheduler+plan counters visible in one interval")
         # prometheus format serves too
         import urllib.request
 
