@@ -619,6 +619,36 @@ class Agent:
         )
         return [(self.config.name, addr, self.server.is_leader)]
 
+    def _memberlist(self):
+        if self.membership is None:
+            raise ValueError("gossip is not enabled on this agent")
+        return self.membership.memberlist
+
+    def join(self, addrs: List[str]) -> int:
+        """Runtime gossip join (reference agent Join): 'host:port' list,
+        returns how many seeds responded."""
+        seeds = []
+        for a in addrs:
+            host, _, port = a.rpartition(":")
+            if not host or not port.isdigit():
+                raise ValueError(f"join address {a!r} must be host:port")
+            seeds.append((host, int(port)))
+        return self._memberlist().join(seeds)
+
+    def force_leave(self, name: str) -> bool:
+        """Evict a (failed) gossip member (serf RemoveFailedNode)."""
+        return self._memberlist().force_leave(name)
+
+    def keyring(self, op: str, key: str):
+        """Gossip keyring ops: list/install/use/remove. Mutations
+        propagate cluster-wide over sealed gossip (serf's keyring ops
+        are cluster queries)."""
+        ml = self._memberlist()
+        if op == "list":
+            return ml.keyring_list()
+        ml.keyring_broadcast(op, key)
+        return None
+
     def known_servers(self) -> List[str]:
         if self.membership is not None:
             return [

@@ -110,6 +110,10 @@ class Routes:
         r("/v1/system/gc", self.system_gc)
         r("/v1/system/reconcile/summaries", self.system_reconcile)
         r("/v1/agent/self", self.agent_self)
+        r("/v1/agent/join", self.agent_join)
+        r("/v1/agent/force-leave", self.agent_force_leave)
+        r("/v1/agent/keyring/", self.agent_keyring)
+        r("/v1/client/gc", self.client_gc)
         r("/v1/agent/health", self.agent_health)
         r("/v1/agent/servers", self.agent_servers)
         r("/v1/agent/members", self.agent_members)
@@ -710,6 +714,78 @@ class Routes:
                 "ServerRegion": self.agent.config.region,
                 "ServerDC": self.agent.config.datacenter,
                 "Members": self.agent.members()}
+
+    def agent_join(self, req: Request):
+        """PUT /v1/agent/join?address=host:port[&address=...] — runtime
+        gossip join (reference command/agent/http.go:181 + agent
+        endpoint Join)."""
+        if req.method not in ("PUT", "POST"):
+            raise HTTPError(405, "method not allowed")
+        self._authorize(req, "agent:write")
+        addrs = req.query.get("address") or []
+        if not addrs:
+            raise HTTPError(400, "missing ?address=host:port")
+        try:
+            n = self.agent.join(addrs)
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        return {"num_joined": n, "error": "" if n else "no peers responded"}
+
+    def agent_force_leave(self, req: Request):
+        """PUT /v1/agent/force-leave?node=<name> — evict a (failed)
+        member from gossip (reference http.go:183, serf RemoveFailedNode)."""
+        if req.method not in ("PUT", "POST"):
+            raise HTTPError(405, "method not allowed")
+        self._authorize(req, "agent:write")
+        node = req.param("node")
+        if not node:
+            raise HTTPError(400, "missing ?node=<name>")
+        try:
+            ok = self.agent.force_leave(node)
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        if not ok:
+            raise HTTPError(404, f"unknown member {node!r}")
+        return {}
+
+    def agent_keyring(self, req: Request):
+        """/v1/agent/keyring/<list|install|use|remove> — gossip keyring
+        rotation (reference http.go:185 + serf keyring protocol)."""
+        op = req.path[len("/v1/agent/keyring/"):].strip("/")
+        if op == "list":
+            self._authorize(req, "agent:write")
+            try:
+                keys = self.agent.keyring("list", "")
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+            num_nodes = len(self.agent.members()) or 1
+            return {"Keys": {k: num_nodes for k in keys}, "NumNodes": num_nodes}
+        if op not in ("install", "use", "remove"):
+            raise HTTPError(404, f"unknown keyring op {op!r}")
+        if req.method not in ("PUT", "POST"):
+            raise HTTPError(405, "method not allowed")
+        self._authorize(req, "agent:write")
+        body = req.json() or {}
+        key = body.get("Key", "")
+        if not key:
+            raise HTTPError(400, "missing Key")
+        try:
+            self.agent.keyring(op, key)
+        except ValueError as e:
+            raise HTTPError(400, str(e))
+        return {}
+
+    def client_gc(self, req: Request):
+        """PUT /v1/client/gc — force terminal-alloc GC on this node
+        (reference http.go:176 -> client/gc.go CollectAll). Destructive:
+        GET is rejected like the sibling cluster-ops endpoints."""
+        if req.method not in ("PUT", "POST"):
+            raise HTTPError(405, "method not allowed")
+        self._authorize(req, "node:write")
+        if self.agent.client is None:
+            raise HTTPError(400, "agent is not running a client")
+        collected = self.agent.client.garbage_collect(force=True)
+        return {"Collected": collected}
 
     def regions(self, req: Request):
         return self.agent.regions()

@@ -656,6 +656,35 @@ class AgentAPI(_Sub):
         out, _ = self.client.get("/v1/metrics")
         return out
 
+    def join(self, addresses):
+        """api/agent.go Join: runtime gossip join."""
+        from urllib.parse import quote
+
+        qs = "&".join(f"address={quote(a, safe='')}" for a in addresses)
+        out, _ = self.client.put(f"/v1/agent/join?{qs}", {})
+        return out
+
+    def force_leave(self, node: str):
+        from urllib.parse import quote
+
+        out, _ = self.client.put(
+            f"/v1/agent/force-leave?node={quote(node, safe='')}", {}
+        )
+        return out
+
+    def keyring_list(self):
+        out, _ = self.client.get("/v1/agent/keyring/list")
+        return out
+
+    def keyring_op(self, op: str, key: str):
+        """op: install | use | remove."""
+        out, _ = self.client.put(f"/v1/agent/keyring/{op}", {"Key": key})
+        return out
+
+    def client_gc(self):
+        out, _ = self.client.put("/v1/client/gc", {})
+        return out
+
 
 class System(_Sub):
     def garbage_collect(self):

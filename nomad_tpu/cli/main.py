@@ -93,7 +93,7 @@ def _split_flags(args: List[str]) -> Tuple[Dict[str, str], List[str]]:
     return flags, rest
 
 
-_REPEATABLE_FLAGS = {"host-volume", "meta", "retry-join", "servers"}
+_REPEATABLE_FLAGS = {"host-volume", "meta", "retry-join", "servers", "config"}
 
 
 _VALUE_FLAGS = {
@@ -151,39 +151,95 @@ def cmd_agent(ctx: Ctx, args: List[str]) -> int:
     flags, _ = _split_flags(args)
     from ..agent import Agent, AgentConfig
 
+    # precedence (reference command/agent/command.go readConfig):
+    # built-in defaults < -config files/dirs (in order) < CLI flags
+    cfg = AgentConfig()
+    config_sources = [p for p in flags.get("config", "").split(",") if p]
+    file_data = {}
+    if config_sources:
+        from ..agent.config_file import (
+            ConfigError,
+            apply_file_config,
+            load_config_sources,
+        )
+
+        try:
+            file_data = load_config_sources(config_sources)
+            cfg = apply_file_config(cfg, file_data)
+        except ConfigError as e:
+            raise CLIError(str(e))
+
     dev = _truthy(flags, "dev")
-    server_enabled = _truthy(flags, "server") or dev or not _truthy(flags, "client")
-    cfg = AgentConfig(
-        dev_mode=dev,
-        name=flags.get("name", "agent-1"),
-        region=flags.get("region", "global"),
-        datacenter=flags.get("dc", "dc1"),
-        server_enabled=server_enabled,
-        client_enabled=_truthy(flags, "client") or dev,
-        http_bind=flags.get("bind", "127.0.0.1"),
-        http_port=int(flags.get("http-port", "4646")),
-        rpc_port=int(flags.get("rpc-port", "0")),
-        serf_port=int(flags.get("serf-port", "0")),
-        retry_join=[a for a in flags.get("retry-join", "").split(",") if a],
-        bootstrap_expect=int(flags.get("bootstrap-expect", "1")),
-        wire_raft=_truthy(flags, "wire-raft"),
-        data_dir=flags.get("data-dir", ""),
-        node_class=flags.get("node-class", ""),
-        host_volumes=_parse_host_volumes(flags.get("host-volume", "")),
-        servers=[a for a in flags.get("servers", "").split(",") if a],
-        acl_enabled=_truthy(flags, "acl-enabled"),
-        enable_debug=_truthy(flags, "enable-debug"),
-        gossip_enabled=not _truthy(flags, "no-gossip"),
-        tls_ca_file=flags.get("ca-file", ""),
-        tls_cert_file=flags.get("cert-file", ""),
-        tls_key_file=flags.get("key-file", ""),
-        tls_http=_truthy(flags, "tls-http"),
-        encrypt=flags.get("encrypt", ""),
-        authoritative_region=flags.get("authoritative-region", ""),
-        replication_token=flags.get("replication-token", ""),
-    )
+    if dev:
+        cfg.dev_mode = True
+        cfg.server_enabled = True
+        cfg.client_enabled = True
+    if not config_sources:
+        # legacy flags-only semantics: -client alone = client-only agent
+        cfg.server_enabled = _truthy(flags, "server") or dev or not _truthy(flags, "client")
+        cfg.client_enabled = _truthy(flags, "client") or dev
+    else:
+        if _truthy(flags, "server"):
+            cfg.server_enabled = True
+        if _truthy(flags, "client"):
+            cfg.client_enabled = True
+    if "name" in flags:
+        cfg.name = flags["name"]
+    if "region" in flags:
+        cfg.region = flags["region"]
+    if "dc" in flags:
+        cfg.datacenter = flags["dc"]
+    if "bind" in flags:
+        cfg.http_bind = cfg.rpc_bind = cfg.serf_bind = flags["bind"]
+    if "http-port" in flags:
+        cfg.http_port = int(flags["http-port"])
+    elif "http" not in (file_data.get("ports") or {}):
+        # neither flag nor file chose a port: the reference default.
+        # An explicit ports { http = 0 } means ephemeral and is honored.
+        cfg.http_port = 4646
+    if "rpc-port" in flags:
+        cfg.rpc_port = int(flags["rpc-port"])
+    if "serf-port" in flags:
+        cfg.serf_port = int(flags["serf-port"])
+    if "retry-join" in flags:
+        cfg.retry_join = [a for a in flags["retry-join"].split(",") if a]
+    if "bootstrap-expect" in flags:
+        cfg.bootstrap_expect = int(flags["bootstrap-expect"])
+    if _truthy(flags, "wire-raft"):
+        cfg.wire_raft = True
+    if "data-dir" in flags:
+        cfg.data_dir = flags["data-dir"]
+    if "node-class" in flags:
+        cfg.node_class = flags["node-class"]
+    if "host-volume" in flags:
+        cfg.host_volumes = _parse_host_volumes(flags["host-volume"])
+    if "servers" in flags:
+        cfg.servers = [a for a in flags["servers"].split(",") if a]
+    if _truthy(flags, "acl-enabled"):
+        cfg.acl_enabled = True
+    if _truthy(flags, "enable-debug"):
+        cfg.enable_debug = True
+    if _truthy(flags, "no-gossip"):
+        cfg.gossip_enabled = False
+    if "ca-file" in flags:
+        cfg.tls_ca_file = flags["ca-file"]
+    if "cert-file" in flags:
+        cfg.tls_cert_file = flags["cert-file"]
+    if "key-file" in flags:
+        cfg.tls_key_file = flags["key-file"]
+    if _truthy(flags, "tls-http"):
+        cfg.tls_http = True
+    if "encrypt" in flags:
+        cfg.encrypt = flags["encrypt"]
+    if "authoritative-region" in flags:
+        cfg.authoritative_region = flags["authoritative-region"]
+    if "replication-token" in flags:
+        cfg.replication_token = flags["replication-token"]
+
     agent = Agent(cfg)
     agent.start()
+    for src in config_sources:
+        ctx.out(f"==> Loaded configuration from {src}")
     ctx.out(f"==> Nomad agent started! HTTP at {agent.http_addr}")
     ctx.out("==> Nomad agent configuration:")
     ctx.out(kv([
@@ -1168,7 +1224,28 @@ def cmd_server(ctx: Ctx, args: List[str]) -> int:
         ctx.out(columns(rows))
         return 0
 
-    return _dispatch(ctx, args, {"members": members}, "server")
+    def join(ctx, a):
+        # reference command/server_join.go
+        _, rest = _split_flags(a)
+        if not rest:
+            raise CLIError("usage: nomad server join <addr:port> [...]")
+        out = ctx.client.agent.join(rest)
+        n = out.get("num_joined", 0)
+        ctx.out(f"Joined {n} servers successfully")
+        return 0 if n else 1
+
+    def force_leave(ctx, a):
+        # reference command/server_force_leave.go
+        _, rest = _split_flags(a)
+        if not rest:
+            raise CLIError("usage: nomad server force-leave <node>")
+        ctx.client.agent.force_leave(rest[0])
+        ctx.out(f"Force-leave issued for {rest[0]}")
+        return 0
+
+    return _dispatch(ctx, args, {
+        "members": members, "join": join, "force-leave": force_leave,
+    }, "server")
 
 
 def cmd_ui(ctx: Ctx, args: List[str]) -> int:

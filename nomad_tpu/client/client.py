@@ -59,6 +59,14 @@ class ClientConfig:
     # {"tpu": {}} or {"mock-device": {"count": 4}}); external device
     # plugins arrive via plugin_dir discovery
     device_plugins: Dict[str, dict] = field(default_factory=dict)
+    # Terminal-alloc-dir GC (reference client/gc.go AllocGarbageCollector
+    # + config.go GCInterval/GCDiskUsageThreshold/GCMaxAllocs): a
+    # background sweep destroys the OLDEST terminal alloc runners (and
+    # their dirs) when the alloc-dir filesystem passes the usage
+    # threshold or the retained-alloc count passes gc_max_allocs.
+    gc_interval: float = 60.0
+    gc_disk_usage_threshold: float = 80.0  # percent of the alloc-dir fs
+    gc_max_allocs: int = 50
 
 
 class ServerProxy:
@@ -190,6 +198,9 @@ class Client:
         )
         self.allocrunners: Dict[str, AllocRunner] = {}
         self._dirty: Dict[str, Allocation] = {}  # pending status syncs
+        # locally GC'd alloc id -> modify_index at collection: guards
+        # _run_allocs against re-adding from a stale in-flight pull
+        self._gced: Dict[str, int] = {}
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -226,6 +237,7 @@ class Client:
             (self._heartbeat_loop, "heartbeat"),
             (self._watch_allocations, "watchallocs"),
             (self._alloc_sync_loop, "allocsync"),
+            (self._gc_loop, "gc"),
         ):
             t = threading.Thread(target=target, name=f"client-{name}", daemon=True)
             t.start()
@@ -359,6 +371,9 @@ class Client:
             if ar is None:
                 if alloc.desired_status != ALLOC_DESIRED_RUN or alloc.terminal_status():
                     continue
+                gc_index = self._gced.get(alloc.id)
+                if gc_index is not None and alloc.modify_index <= gc_index:
+                    continue  # stale pull of a locally GC'd alloc
                 self._add_alloc(alloc)
             elif alloc.modify_index > ar.alloc.modify_index:
                 ar.update(alloc)
@@ -454,6 +469,78 @@ class Client:
                 with self._lock:  # retry next tick
                     for a in batch:
                         self._dirty.setdefault(a.id, a)
+
+    # -- terminal-alloc GC (reference client/gc.go) ----------------------
+
+    def _gc_loop(self) -> None:
+        """Periodic sweep (gc.go run): destroy the oldest terminal alloc
+        runners when the alloc-dir filesystem passes the usage threshold
+        or the retained count passes gc_max_allocs. A long-lived node
+        must not keep dead alloc dirs forever."""
+        while not self._shutdown.wait(timeout=self.config.gc_interval):
+            try:
+                self.garbage_collect(force=False)
+            except Exception:  # noqa: BLE001 — GC must never kill the loop
+                self.logger.exception("alloc GC sweep failed")
+
+    def _disk_usage_pct(self) -> float:
+        import shutil as _shutil
+
+        try:
+            du = _shutil.disk_usage(self.alloc_dir_base)
+            return 100.0 * du.used / max(du.total, 1)
+        except OSError:
+            return 0.0
+
+    def garbage_collect(self, force: bool = True) -> int:
+        """GC terminal alloc runners; ``force`` (the /v1/client/gc shape,
+        gc.go CollectAll) destroys every terminal runner, otherwise only
+        down to the configured thresholds, oldest-completion first.
+        Returns the number of allocs collected.
+
+        The terminal client status is pushed to the server FIRST: a
+        runner destroyed while its completion still sits in the dirty
+        batch would be re-added by the next pull (the server still shows
+        it running) and the task would execute twice. Sync failure skips
+        collection this round."""
+        with self._lock:
+            terminal = [
+                ar for ar in self.allocrunners.values()
+                if ar.client_alloc().client_terminal_status()
+            ]
+        if not terminal:
+            return 0
+        try:
+            self.proxy.update_allocs([ar.client_alloc() for ar in terminal])
+            with self._lock:
+                for ar in terminal:
+                    self._dirty.pop(ar.alloc.id, None)
+        except Exception:  # noqa: BLE001 — no server: do not destroy state
+            self.logger.warning("alloc GC skipped: terminal status sync failed")
+            return 0
+        # oldest completion first (gc.go's indexed PQ ordering)
+        terminal.sort(key=lambda ar: ar.alloc.modify_time_ns or ar.alloc.create_time_ns)
+        collected = 0
+        for ar in terminal:
+            if not force:
+                over_disk = (
+                    self._disk_usage_pct() >= self.config.gc_disk_usage_threshold
+                )
+                over_count = self.num_allocs() > self.config.gc_max_allocs
+                if not over_disk and not over_count:
+                    break
+            self.logger.info("garbage collecting alloc %s", ar.alloc.id[:8])
+            try:
+                ar.destroy()
+            except Exception:  # noqa: BLE001
+                self.logger.exception("alloc %s destroy failed", ar.alloc.id[:8])
+            self.state_db.delete_allocation(ar.alloc.id)
+            with self._lock:
+                self.allocrunners.pop(ar.alloc.id, None)
+                # an in-flight stale pull must not resurrect it
+                self._gced[ar.alloc.id] = ar.alloc.modify_index
+            collected += 1
+        return collected
 
     # -- introspection ---------------------------------------------------
 

@@ -96,6 +96,38 @@ class MemberlistConfig:
     encrypt_key: bytes = b""
 
 
+def _normalize_gossip_key(key, logger) -> bytes:
+    """16/24/32 raw bytes, or their base64 (serf keygen's textual form).
+    Base64 takes PRECEDENCE: base64 of a 16-byte key is exactly 24 chars,
+    so "len in (16,24,32) -> raw" would silently use the ASCII text as
+    the key and split the cluster against nodes configured with the
+    decoded bytes."""
+    import base64 as b64_mod
+
+    if isinstance(key, str):
+        key = key.encode()
+    decoded = None
+    try:
+        decoded = b64_mod.b64decode(key, validate=True)
+    except Exception:  # noqa: BLE001 — not base64: try raw
+        decoded = None
+    if decoded is not None and len(decoded) in (16, 24, 32):
+        if len(key) in (16, 24, 32):
+            # ambiguous: a 32-char ASCII string is both a valid raw key
+            # and valid base64 of 24 bytes — be loud about which reading
+            # wins so mixed fleets can't silently partition
+            logger.warning(
+                "encrypt key is both raw-sized and base64-decodable; "
+                "using the BASE64 interpretation (%d bytes)", len(decoded),
+            )
+        return bytes(decoded)
+    if len(key) not in (16, 24, 32):
+        raise ValueError(
+            "encrypt key must be 16/24/32 bytes raw, or their base64"
+        )
+    return bytes(key)
+
+
 class Memberlist:
     """One gossip participant. Thread-safe; all callbacks fire off the
     listener/probe threads — keep them fast and non-blocking."""
@@ -109,40 +141,16 @@ class Memberlist:
         advertise_host = resolve_advertise_host(config.advertise_host or bound[0])
         self.addr: Tuple[str, int] = (advertise_host, bound[1])
 
-        self._aead = None
+        # Keyring (serf keyring semantics): index 0 is the PRIMARY key
+        # (seals outgoing datagrams); every installed key is tried for
+        # unsealing, so a rolling `install -> use -> remove` rotation
+        # never partitions the cluster. Empty = plaintext gossip.
+        self._keys: List[bytes] = []
+        self._aeads: List = []
+        self._keyring_seen: set = set()  # broadcast op ids (dedupe)
         if config.encrypt_key:
-            # Base64 is the canonical textual form (serf keygen output) and
-            # takes PRECEDENCE: base64 of a 16-byte key is exactly 24
-            # chars, so "len in (16,24,32) -> raw" would silently use the
-            # ASCII text as the key and split the cluster against nodes
-            # configured with the decoded bytes.
-            key = config.encrypt_key
-            decoded = None
-            try:
-                import base64 as b64_mod
-
-                decoded = b64_mod.b64decode(key, validate=True)
-            except Exception:  # noqa: BLE001 — not base64: try raw
-                decoded = None
-            if decoded is not None and len(decoded) in (16, 24, 32):
-                if len(key) in (16, 24, 32):
-                    # ambiguous: a 32-char ASCII string is both a valid raw
-                    # key and valid base64 of 24 bytes — be loud about
-                    # which reading wins so mixed fleets can't silently
-                    # partition on interpretation
-                    self.logger.warning(
-                        "encrypt_key is both raw-sized and base64-decodable; "
-                        "using the BASE64 interpretation (%d bytes)",
-                        len(decoded),
-                    )
-                key = decoded
-            elif len(key) not in (16, 24, 32):
-                raise ValueError(
-                    "encrypt_key must be 16/24/32 bytes raw, or their base64"
-                )
-            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
-            self._aead = AESGCM(key)
+            key = _normalize_gossip_key(config.encrypt_key, self.logger)
+            self._install_key_locked(key)
 
         self._lock = threading.RLock()
         self.incarnation = 1
@@ -214,6 +222,20 @@ class Memberlist:
                 ok += 1
         return ok
 
+    def force_leave(self, name: str) -> bool:
+        """Operator eviction of a (typically failed) member: inject a
+        leave rumor at its current incarnation and gossip it (serf
+        RemoveFailedNode). A LIVE target will refute with a higher
+        incarnation — exactly serf's semantics. Returns False for an
+        unknown member."""
+        with self._lock:
+            cur = self.members.get(name)
+            if cur is None or name == self.config.name:
+                return False
+            inc = cur.incarnation
+        self._on_dead_msg(name, inc, STATUS_LEFT)
+        return True
+
     def set_tags(self, tags: Dict[str, str]) -> None:
         """Re-tag and re-broadcast ourselves (serf SetTags)."""
         with self._lock:
@@ -237,27 +259,127 @@ class Memberlist:
     def num_alive(self) -> int:
         return len(self.alive_members())
 
+    # -- keyring (serf agent keyring: install / use / remove / list) -----
+
+    def _install_key_locked(self, key: bytes) -> None:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        if key not in self._keys:
+            self._keys.append(key)
+            self._aeads.append(AESGCM(key))
+
+    def _require_encryption(self) -> None:
+        if not self._keys:
+            raise ValueError("keyring operations require gossip encryption")
+
+    def keyring_list(self) -> List[str]:
+        import base64 as b64_mod
+
+        with self._lock:
+            return [b64_mod.b64encode(k).decode() for k in self._keys]
+
+    def keyring_install(self, key: str) -> None:
+        """Add a key to the ring (starts UNSEALING with it; the primary
+        still seals)."""
+        self._require_encryption()
+        kb = _normalize_gossip_key(key, self.logger)
+        with self._lock:
+            self._install_key_locked(kb)
+
+    def keyring_broadcast(self, op: str, key: str) -> None:
+        """Apply a keyring op locally AND propagate it to the cluster
+        (serf's keyring ops are cluster-wide queries): the op rides a
+        sealed gossip message — only holders of a current ring key can
+        rotate — and is also pushed directly to every alive member for
+        promptness. Apply order matters for `use` (the sender must seal
+        with the NEW key only after peers can unseal it), so operators
+        still follow install-everywhere -> use -> remove-everywhere; this
+        broadcast makes each step one call instead of N."""
+        if op == "list":
+            return
+        import base64 as b64_mod
+        import uuid as uuid_mod
+
+        # seal the op with the CURRENT primary before applying `use`
+        # locally, so peers that still hold only the old key can unseal
+        msg = {
+            "t": "keyring", "op": op,
+            "key": b64_mod.b64encode(
+                _normalize_gossip_key(key, self.logger)
+            ).decode(),
+            "id": uuid_mod.uuid4().hex,
+        }
+        targets = [m for m in self.alive_members() if m.name != self.config.name]
+        for m in targets:
+            self._send(m.addr, msg)
+        self._queue_broadcast(msg)
+        getattr(self, f"keyring_{op}")(key)
+
+    def _on_keyring_msg(self, msg: dict) -> None:
+        mid = msg.get("id", "")
+        with self._lock:
+            if mid in self._keyring_seen:
+                return
+            self._keyring_seen.add(mid)
+            if len(self._keyring_seen) > 256:
+                self._keyring_seen.clear()
+                self._keyring_seen.add(mid)
+        op = msg.get("op", "")
+        if op not in ("install", "use", "remove"):
+            return
+        try:
+            getattr(self, f"keyring_{op}")(msg.get("key", ""))
+            self._queue_broadcast(msg)  # keep the rumor moving
+        except ValueError as e:
+            self.logger.warning("gossiped keyring %s failed: %s", op, e)
+
+    def keyring_use(self, key: str) -> None:
+        """Make an installed key the primary (sealing) key."""
+        self._require_encryption()
+        kb = _normalize_gossip_key(key, self.logger)
+        with self._lock:
+            if kb not in self._keys:
+                raise ValueError("key is not installed in the keyring")
+            i = self._keys.index(kb)
+            self._keys.insert(0, self._keys.pop(i))
+            self._aeads.insert(0, self._aeads.pop(i))
+
+    def keyring_remove(self, key: str) -> None:
+        self._require_encryption()
+        kb = _normalize_gossip_key(key, self.logger)
+        with self._lock:
+            if kb not in self._keys:
+                raise ValueError("key is not installed in the keyring")
+            i = self._keys.index(kb)
+            if i == 0:
+                raise ValueError("cannot remove the primary key; use another first")
+            self._keys.pop(i)
+            self._aeads.pop(i)
+
     # -- wire helpers ----------------------------------------------------
 
     def _seal(self, data: bytes) -> bytes:
         """AES-GCM with a fresh 12-byte nonce per datagram (the serf
-        encrypted-gossip wire: [version byte][nonce][ciphertext+tag])."""
-        if self._aead is None:
+        encrypted-gossip wire: [version byte][nonce][ciphertext+tag]);
+        the PRIMARY keyring key seals."""
+        if not self._aeads:
             return data
         import os as os_mod
 
         nonce = os_mod.urandom(12)
-        return b"\x01" + nonce + self._aead.encrypt(nonce, data, b"")
+        return b"\x01" + nonce + self._aeads[0].encrypt(nonce, data, b"")
 
     def _unseal(self, data: bytes) -> Optional[bytes]:
-        if self._aead is None:
+        if not self._aeads:
             return data
         if len(data) < 13 or data[0:1] != b"\x01":
             return None  # plaintext or foreign traffic: drop
-        try:
-            return self._aead.decrypt(data[1:13], data[13:], b"")
-        except Exception:  # noqa: BLE001 — wrong key / tampered
-            return None
+        for aead in list(self._aeads):
+            try:
+                return aead.decrypt(data[1:13], data[13:], b"")
+            except Exception:  # noqa: BLE001 — try the next ring key
+                continue
+        return None  # no ring key fits / tampered
 
     def _send(self, addr: Tuple[str, int], msg: dict) -> None:
         try:
@@ -345,6 +467,8 @@ class Memberlist:
             self._on_dead_msg(msg["name"], msg["inc"], STATUS_DEAD)
         elif t == "leave":
             self._on_dead_msg(msg["name"], msg["inc"], STATUS_LEFT)
+        elif t == "keyring":
+            self._on_keyring_msg(msg)
         elif t == "push-pull":
             self._merge_remote_state(msg.get("members", []))
             self._send(src, {
@@ -434,7 +558,11 @@ class Memberlist:
             cur = self.members.get(name)
             if cur is None or inc < cur.incarnation:
                 return
-            if cur.status in (STATUS_DEAD, STATUS_LEFT):
+            if cur.status == STATUS_LEFT:
+                return
+            if cur.status == STATUS_DEAD and status != STATUS_LEFT:
+                # dead -> LEFT is allowed: force-leave evicts failed
+                # members (serf RemoveFailedNode); dead -> dead is noise
                 return
             cur.status = status
             cur.incarnation = inc
