@@ -59,6 +59,9 @@ padding:10px 12px}
 .meter .val{font:600 14px/1.2 system-ui;color:var(--ink);margin-bottom:6px}
 .meter .bar{height:6px;background:var(--line);border-radius:3px;overflow:hidden}
 .meter .bar i{display:block;height:100%;background:var(--brand);border-radius:3px}
+.meter .spark{display:block;margin-top:6px;color:var(--brand);width:100%}
+button.act{padding:2px 8px;font-size:12px;margin-right:4px}
+button.act.warn{background:var(--bad)}
 .logbar{display:flex;gap:8px;align-items:center;margin:8px 0}
 .logbar select,.logbar input[type=text]{font:12px var(--mono);padding:4px 6px;
 border:1px solid var(--line);border-radius:4px}
@@ -289,10 +292,27 @@ websocket exec against the task)</div>
   },
   async deploys() {
     const ds = await api("/v1/deployments");
+    // promote/fail actions on ACTIVE deployments (the Ember app's
+    // deployment controls; reference ui/app deployments route). Promote
+    // only renders when a group actually has unpromoted canaries — the
+    // server rejects promoting anything else.
+    const act = d => {
+      if (!["running","paused"].includes(d.Status)) return "";
+      const canPromote = Object.values(d.TaskGroups || {}).some(
+        s => (s.DesiredCanaries || 0) > 0 && !s.Promoted);
+      return (canPromote
+        ? `<button class="act" data-dep-promote="${esc(d.ID)}">promote</button>`
+        : "") +
+        `<button class="act warn" data-dep-fail="${esc(d.ID)}">fail</button>`;
+    };
+    const tgRow = d => Object.entries(d.TaskGroups || {}).map(([g, s]) =>
+      `${esc(g)}: ${s.PlacedAllocs||0}/${s.DesiredTotal||0} placed, ` +
+      `${s.HealthyAllocs||0} healthy` + (s.Promoted ? ", promoted" : "")
+    ).join("<br>");
     return `<h2>Deployments</h2>` + table(
-      ["ID","Job","Status","Description"],
+      ["ID","Job","Status","Groups","Description","Actions"],
       ds.map(d => ({cells: [short(d.ID), esc(d.JobID), tag(d.Status),
-                            esc(d.StatusDescription)]})));
+                            tgRow(d), esc(d.StatusDescription), act(d)]})));
   },
   async servers() {
     const members = await api("/v1/agent/members");
@@ -326,33 +346,75 @@ document.addEventListener("click", e => {
   if (btn) stopJob(btn.dataset.stopJob);
 });
 
+async function deploymentAction(id, action) {
+  if (!confirm(action + " deployment " + id.slice(0, 8) + "?")) return;
+  try {
+    const body = action === "promote" ? {All: true} : {};
+    await api(`/v1/deployment/${action}/${encodeURIComponent(id)}`,
+              {method: "PUT", body: JSON.stringify(body),
+               headers: {"Content-Type": "application/json"}});
+  } catch (e) { alert(e.message); }
+  render();
+}
+document.addEventListener("click", e => {
+  const p = e.target.closest("[data-dep-promote]");
+  if (p) { deploymentAction(p.dataset.depPromote, "promote"); return; }
+  const f = e.target.closest("[data-dep-fail]");
+  if (f) deploymentAction(f.dataset.depFail, "fail");
+});
+
 // -- alloc-page live extras: meters, server-push logs, exec terminal -----
 let pageCleanup = null;     // torn down on navigation (streams, sockets)
 const b64encode = s => btoa(String.fromCharCode(...new TextEncoder().encode(s)));
 const b64decode = b => new TextDecoder().decode(
   Uint8Array.from(atob(b), c => c.charCodeAt(0)));
 
-function meter(label, pct, detail) {
+function meter(label, pct, detail, sparkSvg) {
   const w = Math.max(0, Math.min(100, pct || 0));
   return `<div class="meter"><div class="lbl">${esc(label)}</div>` +
     `<div class="val">${esc(detail)}</div>` +
-    `<div class="bar"><i style="width:${w.toFixed(1)}%"></i></div></div>`;
+    `<div class="bar"><i style="width:${w.toFixed(1)}%"></i></div>` +
+    (sparkSvg || "") + `</div>`;
 }
+
+// Inline SVG sparkline over a rolling sample window (the Ember app's
+// primary-metric charts; reference ui/app stats time-series). Points
+// scale to the window max so spikes stay visible.
+function spark(points) {
+  if (!points || points.length < 2) return "";
+  const W = 220, H = 36, n = points.length;
+  const max = Math.max(...points, 1e-9);
+  const xy = points.map((v, i) => {
+    const x = (i / (n - 1)) * (W - 2) + 1;
+    const y = H - 2 - (Math.max(0, v) / max) * (H - 6);
+    return x.toFixed(1) + "," + y.toFixed(1);
+  });
+  return `<svg class="spark" viewBox="0 0 ${W} ${H}" width="${W}" height="${H}"` +
+    ` preserveAspectRatio="none"><polyline points="${xy.join(" ")}"` +
+    ` fill="none" stroke="currentColor" stroke-width="1.5"/></svg>`;
+}
+
+const SPARK_WINDOW = 40;  // ~2 minutes at the 3s refresh
 
 function wireAllocExtras(id, tasks) {
   const cleanups = [];
   pageCleanup = () => cleanups.forEach(fn => { try { fn(); } catch (e) {} });
 
-  // utilization meters: one hue, values in ink — refreshed while visible
+  // utilization meters + live sparklines: a rolling per-task history of
+  // cpu% and RSS sampled from /v1/client/allocation/<id>/stats
+  const history = {};  // task -> {cpu: [], mem: []}
   async function refreshMeters() {
     try {
       const s = await api(`/v1/client/allocation/${encodeURIComponent(id)}/stats`);
       const parts = [];
       for (const [t, ts] of Object.entries(s.Tasks || {})) {
         const cpu = ts.ResourceUsage?.CpuStats?.Percent || 0;
-        const rss = ts.ResourceUsage?.MemoryStats?.RSS || 0;
-        parts.push(meter(`${t} · CPU`, cpu, cpu.toFixed(1) + " %"));
-        parts.push(meter(`${t} · memory`, 0, (rss/1048576).toFixed(1) + " MiB"));
+        const rssMib = (ts.ResourceUsage?.MemoryStats?.RSS || 0) / 1048576;
+        const h = history[t] = history[t] || {cpu: [], mem: []};
+        h.cpu.push(cpu); h.mem.push(rssMib);
+        if (h.cpu.length > SPARK_WINDOW) { h.cpu.shift(); h.mem.shift(); }
+        parts.push(meter(`${t} · CPU`, cpu, cpu.toFixed(1) + " %", spark(h.cpu)));
+        parts.push(meter(`${t} · memory`, 0, rssMib.toFixed(1) + " MiB", spark(h.mem)));
       }
       if (parts.length) $("#meters").innerHTML = parts.join("");
       else $("#meters").innerHTML = `<div class="meter"><div class="lbl">no running tasks</div></div>`;

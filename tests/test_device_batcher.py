@@ -190,6 +190,45 @@ class TestBatchedScanParity:
             np.testing.assert_array_equal(single[0], batch_r[0])
             np.testing.assert_array_equal(single[1], batch_r[1])
 
+    def test_mesh_sharded_c1m_slice_bit_identical(self):
+        """VERDICT r3 #5b: a C1M-shaped slice — exact INT spec, DISTINCT
+        per-eval inputs, batch sharded over the full ("evals","nodes")
+        mesh — must be bitwise identical to the unsharded single-eval
+        scans on one device. This is the correctness evidence for the
+        production multi-chip dispatch: a shard permutation or wrong-axis
+        bug cannot hide behind identical inputs or float tolerance."""
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        from nomad_tpu.parallel import make_mesh
+
+        engine = TpuPlacementEngine.shared()
+        # C1M shape, scaled: many nodes relative to devices (node axis
+        # shards 512/4 = 128 per device), 2 TGs, spreads active, int32
+        encs = [
+            synthetic_enc(512, 2, 48, n_spreads=1, seed=100 + s,
+                          dtype=np.int32)
+            for s in range(4)
+        ]
+        singles = [engine.run_scan_single(e) for e in encs]
+        mesh = make_mesh(8, eval_parallel=2)  # ("evals": 2, "nodes": 4)
+        batcher = DeviceBatcher(max_batch=4, window_ms=500.0, mesh=mesh)
+        try:
+            batched = run_concurrent(batcher, encs)
+        finally:
+            batcher.stop()
+        assert batcher.stats["dispatches"] == 1
+        for i, (single, batch_r) in enumerate(zip(singles, batched)):
+            for k, name in enumerate(("chosen", "scores", "pulls", "skipped")):
+                np.testing.assert_array_equal(
+                    np.asarray(single[k]), np.asarray(batch_r[k]),
+                    err_msg=(
+                        f"eval {i} {name}: sharded dispatch diverged from "
+                        "the single-device oracle"
+                    ),
+                )
+
     def test_stop_errors_parked_requests(self):
         """stop() must release requests already sitting in the queue (a
         worker parked in run()) with an error, not leave them hanging."""

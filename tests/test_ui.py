@@ -73,7 +73,11 @@ class TestUIBundle:
         # page implementations + core wiring
         for marker in ("async jobs()", "async run(", "async function api(",
                        "data-stop-job", "plan-btn", "run-btn",
-                       "jobspec", "WebSocket", "log-view", "X-Nomad-Token"):
+                       "jobspec", "WebSocket", "log-view", "X-Nomad-Token",
+                       # r4: live cpu/mem sparklines + deployment actions
+                       "function spark(", "SPARK_WINDOW", "polyline",
+                       "data-dep-promote", "data-dep-fail",
+                       "deploymentAction"):
             assert marker in html, f"bundle missing {marker!r}"
 
     def test_ui_route_without_trailing_slash(self, agent):
