@@ -321,11 +321,13 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
 def bench_c1m_system():
     """The HEADLINE: C1M replay through the full system on one chip.
 
-    256 service jobs x 500 identical containers (the C1M challenge
-    scheduled identical simple containers) over 5K heterogeneous nodes;
-    deterministic int-spec scoring with per-eval ring decorrelation; one
-    eval-batched device dispatch carries all 256 evals; placements flow
-    as dense arrays to the FSM."""
+    256 service jobs x 1000 identical containers (the C1M challenge
+    scheduled large batches of identical simple containers) over 5K
+    heterogeneous nodes = 256K placements; deterministic int-spec
+    scoring with per-eval ring decorrelation; ONE eval-batched device
+    dispatch carries all 256 evals (the gather window covers the
+    GIL-serialized encode phase); placements flow as dense arrays
+    through plan apply and the FSM."""
     from nomad_tpu import mock
     from nomad_tpu.structs.structs import Resources
 
@@ -336,12 +338,12 @@ def bench_c1m_system():
         j.task_groups[0].tasks[0].resources = Resources(cpu=15, memory_mb=30)
         return j
 
-    jobs = [dense_job(f"c1m-{i}", 500) for i in range(256)]
+    jobs = [dense_job(f"c1m-{i}", 1000) for i in range(256)]
 
     return bench_system(
         "c1m-system", 5000, jobs, workers=288, device_batch=256,
-        timeout=240.0, deterministic=True, window_ms=4000.0,
-        warmup=lambda: dense_job("warm-c1m", 500),
+        timeout=240.0, deterministic=True, window_ms=5500.0,
+        warmup=lambda: dense_job("warm-c1m", 1000),
     )
 
 
