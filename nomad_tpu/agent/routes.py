@@ -759,7 +759,13 @@ class Routes:
             except ValueError as e:
                 raise HTTPError(400, str(e))
             num_nodes = len(self.agent.members()) or 1
-            return {"Keys": {k: num_nodes for k in keys}, "NumNodes": num_nodes}
+            return {
+                "Keys": {k: num_nodes for k in keys},
+                # serf's keyring -list contract: the sealing key is named
+                # explicitly, not implied by map order
+                "PrimaryKeys": {keys[0]: num_nodes} if keys else {},
+                "NumNodes": num_nodes,
+            }
         if op not in ("install", "use", "remove"):
             raise HTTPError(404, f"unknown keyring op {op!r}")
         if req.method not in ("PUT", "POST"):

@@ -424,7 +424,11 @@ class DeviceBatcher:
         # padded slot so padded steps have a pre-failed TG to point at
         n_pad = max(_round_up(e.n_real) for e in encs)
         g_pad = _pow2ceil(max(e.g for e in encs) + 1)
-        s_pad = _pow2ceil(max(max(e.s for e in encs), 1))
+        # S stays ZERO when no co-batched eval has spreads (the
+        # compiled step skips the whole spread machinery); mixed
+        # batches widen — same pattern as the affinity axis
+        s_raw = max(e.s for e in encs)
+        s_pad = _pow2ceil(s_raw) if s_raw else 0
         v_pad = _pow2ceil(max(max(e.v for e in encs), 2))
         # COARSE placement-count buckets (16/64/256, pow2 beyond): retried
         # partial evals arrive at arbitrary small p, and a fresh compile

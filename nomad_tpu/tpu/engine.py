@@ -1026,7 +1026,8 @@ class TpuPlacementEngine:
         dh_job = np.zeros(g_count, bool)
         dh_tg = np.zeros(g_count, bool)
         limits = np.full(g_count, 2, np.int32)
-        sv = max(s_max, 1)
+        sv = s_max  # 0 when no TG has spreads: the step's [S,V,N]
+        # spread passes become zero-sized and XLA elides them
         vv = max(v_max, 2)
         spread_vids = np.full((g_count, sv, n_pad), vv - 1, np.int32)
         spread_desired = np.full((g_count, sv, vv), -1.0, fdtype)
@@ -1042,14 +1043,25 @@ class TpuPlacementEngine:
         else:
             e_ask = np.zeros((0, 0, 2), np.int32)
 
+        # e_ask rows depend only on (fleet capacities, the TG's cpu/mem
+        # ask): cache them on the fleet entry — recurring TG shapes (the
+        # C1M case: every job identical) skip the two e27 passes per eval
+        e_ask_cache = None if fleet is None else fleet.setdefault("e_ask", {})
         for gi, spec in specs_by_gi.items():
             asks[gi] = spec.ask
             if int_mode:
-                for d in (0, 1):
-                    e_ask[gi, :, d] = e27_np(
-                        xq_np(np.full(n_pad, -int(spec.ask[d]), np.int64),
-                              node_c2[:, d])
-                    ).astype(np.int32)
+                key = (n_pad, int(spec.ask[0]), int(spec.ask[1]))
+                row = None if e_ask_cache is None else e_ask_cache.get(key)
+                if row is None:
+                    row = np.empty((n_pad, 2), np.int32)
+                    for d in (0, 1):
+                        row[:, d] = e27_np(
+                            xq_np(np.full(n_pad, -int(spec.ask[d]), np.int64),
+                                  node_c2[:, d])
+                        ).astype(np.int32)
+                    if e_ask_cache is not None and len(e_ask_cache) < 64:
+                        e_ask_cache[key] = row
+                e_ask[gi] = row
             feas[gi, :n_real] = spec.feasible
             aff_score[gi, :n_real] = spec.affinity_score
             aff_present[gi, :n_real] = spec.affinity_present
@@ -1753,7 +1765,10 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
     int_mode = dtype.kind == "i"
     rng = np.random.default_rng(seed)
     n_pad = _round_up(n_nodes)
-    g, s, v = n_tgs, max(n_spreads, 1), vocab + 1
+    # zero n_spreads = a true ZERO S axis: the spread machinery
+    # (one-hot [S,V,N] lookups, boosts, count carries) compiles away
+    # entirely, matching production encode for spread-free jobs
+    g, s, v = n_tgs, n_spreads, vocab + 1
 
     totals = np.zeros((n_pad, num_dims), dtype)
     totals[:n_nodes, DIM_CPU] = rng.choice([2000, 4000, 8000], n_nodes)

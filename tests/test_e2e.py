@@ -360,3 +360,109 @@ class TestHostVolumes:
             assert not running_allocs(api, "e2e-hv-missing")
         finally:
             agent.stop()
+
+
+class TestClusterOpsE2E:
+    """Config-file boot + runtime join + key rotation + force-leave +
+    client GC, over REAL forked agent processes (VERDICT r3 #4/#6 e2e
+    criteria; reference e2e slots for agent config and cluster ops)."""
+
+    def test_config_boot_join_rotate_forceleave_gc(self, tmp_path):
+        import base64
+        import secrets as _secrets
+        import socket
+
+        def free_port(k):
+            # OUTSIDE the kernel's ephemeral range (and pid-scattered), so
+            # the agents' own ephemeral http/rpc binds can't steal a
+            # reserved port in the boot window (bind TOCTOU)
+            for attempt in range(50):
+                p = 21000 + (os.getpid() * 13 + k * 7919 + attempt) % 9000
+                s = socket.socket()
+                try:
+                    s.bind(("127.0.0.1", p))
+                    return p
+                except OSError:
+                    continue
+                finally:
+                    s.close()
+            raise RuntimeError("no free fixed port found")
+
+        key_a = base64.b64encode(_secrets.token_bytes(32)).decode()
+        key_b = base64.b64encode(_secrets.token_bytes(32)).decode()
+        serf1, serf2 = free_port(1), free_port(2)
+
+        def write_cfg(name, serf_port, client=False):
+            p = tmp_path / f"{name}.hcl"
+            p.write_text(f'''
+name       = "{name}"
+datacenter = "dc1"
+ports {{
+  http = 0
+  serf = {serf_port}
+}}
+server {{
+  enabled          = true
+  bootstrap_expect = 1
+  encrypt          = "{key_a}"
+}}
+client {{
+  enabled = {"true" if client else "false"}
+}}
+''')
+            return str(p)
+
+        # both agents boot from CONFIG FILES; no retry_join — they meet
+        # via the runtime /v1/agent/join endpoint
+        a1 = AgentProc("-config", write_cfg("ops1", serf1, client=True),
+                       "-dev", name="ops1")
+        a2 = AgentProc("-config", write_cfg("ops2", serf2), name="ops2")
+        try:
+            api1, api2 = a1.api, a2.api
+            # config file took effect (name flows into gossip identity)
+            wait_until(lambda: api1.agent.members()["Members"][0]["Name"]
+                       .startswith("ops1"), msg="config-file name visible")
+
+            # runtime join
+            out = api1.agent.join([f"127.0.0.1:{serf2}"])
+            assert out["num_joined"] == 1
+            wait_until(lambda: len(api1.agent.members()["Members"]) == 2,
+                       msg="runtime join converged on 1")
+            wait_until(lambda: len(api2.agent.members()["Members"]) == 2,
+                       msg="runtime join converged on 2")
+
+            # cluster-wide key rotation from ONE node's endpoint
+            api1.agent.keyring_op("install", key_b)
+            wait_until(lambda: key_b in api2.agent.keyring_list()["Keys"],
+                       msg="install propagated to 2")
+            api1.agent.keyring_op("use", key_b)
+            wait_until(lambda: key_b in api2.agent.keyring_list()["PrimaryKeys"],
+                       msg="use propagated to 2")
+            api1.agent.keyring_op("remove", key_a)
+            wait_until(lambda: list(api2.agent.keyring_list()["Keys"])
+                       == [key_b], msg="remove propagated to 2")
+            # gossip still alive post-rotation
+            time.sleep(1.0)
+            assert len(api1.agent.members()["Members"]) == 2
+
+            # run a short batch task on the dev agent's client, then GC it
+            job = service_job("e2e-gc", count=1, command="true")
+            job["Type"] = "batch"
+            api1.jobs.register(job)
+            wait_until(lambda: any(
+                a["ClientStatus"] == "complete"
+                for a in allocs_of(api1, "e2e-gc")), timeout=180,
+                msg="batch task complete")
+            out = api1.agent.client_gc()
+            assert out["Collected"] >= 1
+
+            # kill 2's gossip hard, then evict it from 1's view
+            a2.kill_hard()
+            api1.agent.force_leave("ops2.global")
+            wait_until(lambda: any(
+                m["Name"] == "ops2.global" and m["Status"] in ("left", "failed")
+                for m in api1.agent.members()["Members"]),
+                msg="forced member marked left/failed")
+        finally:
+            a1.stop()
+            a2.stop()
