@@ -1472,11 +1472,34 @@ class TpuPlacementEngine:
         """Materialize system-scan results: allocs for fits, queued-alloc
         bookkeeping for constraint-filtered nodes, failed metrics +
         per-node blocked evals for capacity failures (system_sched.py host
-        path semantics)."""
+        path semantics). The all-clean case (every node placed, fresh,
+        no network/device asks) takes the dense block path — one-per-node
+        system jobs are exactly the shape that benefits."""
         from ..structs.structs import AllocMetric
 
         job = sched.job
         ctx = sched.ctx
+
+        chosen = np.asarray(chosen)
+        if (
+            not getattr(sched.eval, "annotate_plan", False)
+            and len(place)
+            and (chosen[: len(place)] >= 0).all()
+            and all(
+                (tup.alloc is None or not tup.alloc.id)
+                and not tup.task_group.networks
+                and not any(
+                    t.resources.networks or t.resources.devices
+                    for t in tup.task_group.tasks
+                )
+                for tup in place
+            )
+        ):
+            self._apply_system_results_dense(
+                sched, place, nodes, chosen, scores, start_ns
+            )
+            return
+
         assigner = _ResourceAssigner(ctx, nodes)
 
         for pi, tup in enumerate(place):
@@ -1564,6 +1587,63 @@ class TpuPlacementEngine:
                 alloc.previous_allocation = tup.alloc.id
             sched.plan.append_alloc(alloc)
 
+        ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
+
+    def _apply_system_results_dense(self, sched, place, nodes, chosen,
+                                    scores, start_ns) -> None:
+        """System-path dense blocks: same DenseTGPlacements flow as the
+        generic path, grouped by task group. Preconditions checked by the
+        caller: every placement chose its node, all fresh, no
+        network/device asks."""
+        from ..structs.structs import DenseTGPlacements, generate_uuids
+
+        job = sched.job
+        ctx = sched.ctx
+        if scores.dtype.kind == "i":
+            from .intscore import TERM_ONE
+
+            scores_f = np.asarray(scores, np.float64) / (60.0 * TERM_ONE)
+        else:
+            scores_f = np.asarray(scores, np.float64)
+
+        by_tg: Dict[str, List[int]] = {}
+        for pi, tup in enumerate(place):
+            by_tg.setdefault(tup.task_group.name, []).append(pi)
+        tg_by_name = {tg.name: tg for tg in job.task_groups}
+        for tg_name, idxs in by_tg.items():
+            tg = tg_by_name[tg_name]
+            proto = AllocatedResources(
+                tasks={
+                    t.name: AllocatedTaskResources(
+                        cpu_shares=t.resources.cpu,
+                        memory_mb=t.resources.memory_mb,
+                    )
+                    for t in tg.tasks
+                },
+                shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
+            )
+            block = DenseTGPlacements(
+                namespace=job.namespace,
+                job_id=job.id,
+                task_group=tg.name,
+                eval_id=sched.eval.id,
+                job=job,
+                resources_proto=proto,
+                ask_vec=(
+                    float(sum(t.resources.cpu for t in tg.tasks)),
+                    float(sum(t.resources.memory_mb for t in tg.tasks)),
+                    float(tg.ephemeral_disk.size_mb),
+                    0.0,
+                ),
+                ids=generate_uuids(len(idxs)),
+                names=[place[k].name for k in idxs],
+                node_ids=[nodes[int(chosen[k])].id for k in idxs],
+                node_names=[nodes[int(chosen[k])].name for k in idxs],
+                scores=[float(scores_f[k]) for k in idxs],
+                nodes_evaluated=[1] * len(idxs),
+                nodes_available=getattr(sched, "nodes_by_dc", {}),
+            )
+            sched.plan.dense_placements.append(block)
         ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
 
     # ------------------------------------------------------------------
