@@ -60,6 +60,15 @@ padding:10px 12px}
 .meter .bar{height:6px;background:var(--line);border-radius:3px;overflow:hidden}
 .meter .bar i{display:block;height:100%;background:var(--brand);border-radius:3px}
 .meter .spark{display:block;margin-top:6px;color:var(--brand);width:100%}
+.topo{display:grid;grid-template-columns:repeat(auto-fill,minmax(210px,1fr));gap:8px}
+.topo-node{background:var(--panel);border:1px solid var(--line);border-radius:6px;
+  padding:8px;cursor:pointer}
+.topo-node .bar{height:5px;background:var(--line);border-radius:3px;
+  overflow:hidden;margin-top:6px}
+.topo-node .bar i{display:block;height:100%;background:var(--brand)}
+.topo-node .chips{margin-top:6px;line-height:14px}
+.chip{display:inline-block;width:10px;height:10px;border-radius:2px;
+  margin:0 2px 2px 0;cursor:pointer}
 button.act{padding:2px 8px;font-size:12px;margin-right:4px}
 button.act.warn{background:var(--bad)}
 .logbar{display:flex;gap:8px;align-items:center;margin:8px 0}
@@ -82,8 +91,9 @@ border:1px solid var(--line);border-radius:4px;background:#101418;color:#d6dde6}
 "use strict";
 const $ = s => document.querySelector(s);
 const NAV = [["jobs","Jobs"],["run","Run Job"],["nodes","Nodes"],
-             ["allocs","Allocations"],["evals","Evaluations"],
-             ["deploys","Deployments"],["servers","Servers"]];
+             ["topo","Topology"],["allocs","Allocations"],
+             ["evals","Evaluations"],["deploys","Deployments"],
+             ["servers","Servers"]];
 const tokenBox = $("#token");
 tokenBox.value = localStorage.getItem("nomad_token") || "";
 tokenBox.onchange = () => { localStorage.setItem("nomad_token", tokenBox.value); render(); };
@@ -122,8 +132,10 @@ function table(headers, rows, onclickPrefix) {
     + (rows.length ? "" : `<p class="mut">none</p>`);
 }
 document.addEventListener("click", e => {
-  const row = e.target.closest("tr[data-href]");
-  if (row) location.hash = row.dataset.href;
+  // rows, topology cards and alloc chips all navigate the same way;
+  // closest() picks the innermost target (chip inside a node card)
+  const el = e.target.closest("[data-href]");
+  if (el) location.hash = el.dataset.href;
 });
 
 const pages = {
@@ -313,6 +325,67 @@ websocket exec against the task)</div>
       ["ID","Job","Status","Groups","Description","Actions"],
       ds.map(d => ({cells: [short(d.ID), esc(d.JobID), tag(d.Status),
                             tgRow(d), esc(d.StatusDescription), act(d)]})));
+  },
+  async topo() {
+    // Cluster topology (the Ember app's topology viz, ui/app topology
+    // route): one card per node, reserved-capacity fill bars for cpu
+    // and memory from the scheduler's view of non-terminal allocs,
+    // colored chips per alloc linking to the alloc page.
+    const [nodes, stubs] = await Promise.all([
+      api("/v1/nodes"), api("/v1/allocations"),
+    ]);
+    const live = stubs.filter(a => a.DesiredStatus === "run"
+      && !["complete","failed","lost"].includes(a.ClientStatus));
+    // list entries are slim stubs (the reference's AllocListStub):
+    // resources come from the detail endpoint, fetched concurrently
+    // and capped so a C1M-scale cluster doesn't stampede the agent
+    const CAP = 500;
+    const detailed = await Promise.all(live.slice(0, CAP).map(a =>
+      api("/v1/allocation/" + encodeURIComponent(a.ID)).catch(() => a)));
+    const byNode = {};
+    for (const a of detailed) {
+      (byNode[a.NodeID] = byNode[a.NodeID] || []).push(a);
+    }
+    const infos = await Promise.all(nodes.map(n =>
+      api("/v1/node/" + encodeURIComponent(n.ID)).catch(() => null)));
+    const hue = s => { let h = 0;
+      for (const c of String(s)) h = (h * 31 + c.charCodeAt(0)) % 360;
+      return h; };
+    const cards = nodes.map((n, i) => {
+      const info = infos[i] || {};
+      const res = info.NodeResources || {};
+      const cpuCap = res.CPUShares || 0, memCap = res.MemoryMB || 0;
+      const mine = byNode[n.ID] || [];
+      let cpu = 0, mem = 0;
+      for (const a of mine) {
+        const ar = a.AllocatedResources || {};
+        for (const t of Object.values(ar.Tasks || {})) {
+          cpu += t.CPUShares || 0; mem += t.MemoryMB || 0;
+        }
+      }
+      const pct = (v, cap) => cap ? Math.min(100, 100 * v / cap) : 0;
+      const chips = mine.slice(0, 64).map(a =>
+        `<i class="chip" data-href="#/allocs/${encodeURIComponent(a.ID)}"
+            title="${esc(a.JobID)} · ${esc(a.TaskGroup)}"
+            style="background:hsl(${hue(a.JobID)},55%,45%)"></i>`).join("")
+        + (mine.length > 64 ? `<span class="mut">+${mine.length - 64}</span>` : "");
+      return `<div class="topo-node" data-href="#/nodes/${encodeURIComponent(n.ID)}">
+        <div class="lbl">${esc(n.Name)} ${tag(n.Status)}
+          <span class="mut">${mine.length} allocs</span></div>
+        <div class="bar"><i style="width:${pct(cpu, cpuCap).toFixed(1)}%"></i></div>
+        <div class="mut" style="font-size:11px">cpu ${cpu}/${cpuCap} MHz</div>
+        <div class="bar"><i style="width:${pct(mem, memCap).toFixed(1)}%"></i></div>
+        <div class="mut" style="font-size:11px">mem ${mem}/${memCap} MiB</div>
+        <div class="chips">${chips}</div>
+      </div>`;
+    }).join("");
+    const capNote = live.length > CAP
+      ? ` (cards sample the first ${CAP} — counts, bars and chips all
+         reflect the sample, not the full cluster)` : "";
+    return `<h2>Topology</h2>
+      <p class="mut">${nodes.length} nodes · ${live.length} scheduled
+      allocations${capNote} · chip color = job</p>
+      <div class="topo">${cards || '<p class="mut">no nodes</p>'}</div>`;
   },
   async servers() {
     const members = await api("/v1/agent/members");

@@ -67,7 +67,7 @@ class TestUIBundle:
     def test_spa_served_with_all_views(self, agent):
         html = http(agent, "GET", "/ui/", raw=True).decode()
         # nav entries
-        for view in ("jobs", "run", "nodes", "allocs", "evals",
+        for view in ("jobs", "run", "nodes", "topo", "allocs", "evals",
                      "deploys", "servers"):
             assert f'"{view}"' in html, f"view {view} missing from bundle"
         # page implementations + core wiring
@@ -77,7 +77,9 @@ class TestUIBundle:
                        # r4: live cpu/mem sparklines + deployment actions
                        "function spark(", "SPARK_WINDOW", "polyline",
                        "data-dep-promote", "data-dep-fail",
-                       "deploymentAction"):
+                       "deploymentAction",
+                       # r4: cluster topology view
+                       "async topo()", "topo-node", "CPUShares"):
             assert marker in html, f"bundle missing {marker!r}"
 
     def test_ui_route_without_trailing_slash(self, agent):
