@@ -11,10 +11,15 @@ import sys
 # hardware). Since the engine's deterministic mode moved to the exact
 # INTEGER spec (tpu/intscore.py), its selections are bit-identical on every
 # backend — so the parity suite may also run on real hardware:
-#   NOMAD_TPU_TEST_PLATFORM=tpu python -m pytest tests/test_tpu_parity.py
+#   NOMAD_TPU_TEST_PLATFORM=axon python -m pytest tests/test_tpu_parity.py
 # runs the device side on the chip while the host pipeline stays pure
 # Python float64, asserting plan parity ON the TPU.
 _platform = os.environ.get("NOMAD_TPU_TEST_PLATFORM", "cpu")
+if _platform != "cpu":
+    # keep the CPU backend registered alongside the chip: the
+    # cross-backend bit-equality test runs both in ONE process (the
+    # tunneled chip registers as "axon"; use NOMAD_TPU_TEST_PLATFORM=axon)
+    _platform = f"{_platform},cpu"
 os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if _platform == "cpu" and "xla_force_host_platform_device_count" not in _flags:
