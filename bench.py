@@ -495,11 +495,14 @@ def system_benches():
     if r:
         results.append(r)
 
-    # config 3: service + spread stanzas at 5K nodes
-    jobs = []
-    for i in range(10):
+    # config 3: service + affinity/anti-affinity + spread stanzas at 5K
+    # nodes (BASELINE.md names all three; job anti-affinity is intrinsic
+    # to every multi-count service job via JobAntiAffinityIterator)
+    from nomad_tpu.structs import Affinity
+
+    def _spread_job(job_id):
         j = mock.job()
-        j.id = f"spread-{i}"
+        j.id = job_id
         j.task_groups[0].count = 50
         j.task_groups[0].tasks[0].resources.cpu = 50
         j.task_groups[0].tasks[0].resources.memory_mb = 64
@@ -507,18 +510,16 @@ def system_benches():
             attribute="${node.datacenter}", weight=50,
             spread_target=[SpreadTarget(value="dc1", percent=100)],
         )]
-        jobs.append(j)
-    def _spread_warm():
-        j = mock.job()
-        j.id = "warm-spread"
-        j.task_groups[0].count = 50
-        j.task_groups[0].tasks[0].resources.cpu = 50
-        j.task_groups[0].tasks[0].resources.memory_mb = 64
-        j.task_groups[0].spreads = [Spread(
-            attribute="${node.datacenter}", weight=50,
-            spread_target=[SpreadTarget(value="dc1", percent=100)],
+        j.task_groups[0].affinities = [Affinity(
+            ltarget="${attr.kernel.name}", rtarget="linux",
+            operand="=", weight=50,
         )]
         return j
+
+    jobs = [_spread_job(f"spread-{i}") for i in range(10)]
+
+    def _spread_warm():
+        return _spread_job("warm-spread")
 
     r = _diagnostic(bench_system, "service-spread-5K", 5000, jobs, timeout=300.0,
                     warmup=_spread_warm)
