@@ -156,15 +156,7 @@ class StateStore:
             live_blocks.append(block)
             if dead:
                 self._dense_dead[block.key()] = dead
-            for i, aid in enumerate(block.ids):
-                self._dense_by_id[aid] = (block, i)
-            self._dense_by_job.setdefault(
-                (block.namespace, block.job_id), []
-            ).append(block)
-            if block.eval_id:
-                self._dense_by_eval.setdefault(block.eval_id, []).append(block)
-            for node_id in block.node_index_map():
-                self._dense_by_node.setdefault(node_id, []).append(block)
+            self._index_dense_block(block)
         self._dense_blocks = live_blocks
         if "_node_usage" not in self.__dict__:
             from ..structs.funcs import alloc_usage_vec
@@ -503,6 +495,20 @@ class StateStore:
         self._usage_delta(alloc, -1.0)
 
     # -- dense placement blocks -----------------------------------------
+
+    def _index_dense_block(self, block) -> None:
+        """Secondary-index wiring for one block (insert + setstate
+        rebuild share it). The id map is skipped on snapshots (None)."""
+        if self._dense_by_id is not None:
+            for i, aid in enumerate(block.ids):
+                self._dense_by_id[aid] = (block, i)
+        self._dense_by_job.setdefault(
+            (block.namespace, block.job_id), []
+        ).append(block)
+        if block.eval_id:
+            self._dense_by_eval.setdefault(block.eval_id, []).append(block)
+        for node_id in block.node_index_map():
+            self._dense_by_node.setdefault(node_id, []).append(block)
 
     def _dense_lookup(self, alloc_id: str):
         """(block, i) for a dense id, superseded or not; None if unknown.
@@ -1021,17 +1027,9 @@ class StateStore:
         block.stamp(index, timestamp_ns)
         self.capacity_epoch += 1
         self._dense_blocks.append(block)
-        if self._dense_by_id is not None:  # snapshots resolve by scan
-            for i, aid in enumerate(block.ids):
-                self._dense_by_id[aid] = (block, i)
-        self._dense_by_job.setdefault(
-            (block.namespace, block.job_id), []
-        ).append(block)
-        if block.eval_id:
-            self._dense_by_eval.setdefault(block.eval_id, []).append(block)
+        self._index_dense_block(block)
         ask = block.ask_vec
         for node_id, idxs in block.node_index_map().items():
-            self._dense_by_node.setdefault(node_id, []).append(block)
             cnt = len(idxs)
             row = self._node_usage.get(node_id, (0.0, 0.0, 0.0, 0.0))
             self._node_usage[node_id] = (

@@ -1589,62 +1589,79 @@ class TpuPlacementEngine:
 
         ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
 
+    @staticmethod
+    def _scores_to_float(scores) -> np.ndarray:
+        """Display-float conversion (int mode carries score60s)."""
+        if scores.dtype.kind == "i":
+            from .intscore import TERM_ONE
+
+            return np.asarray(scores, np.float64) / (60.0 * TERM_ONE)
+        return np.asarray(scores, np.float64)
+
+    @staticmethod
+    def _dense_block(job, tg, eval_id, node_idxs, nodes, names, scores_f,
+                     nodes_evaluated, nodes_available, deployment_id=""):
+        """One DenseTGPlacements block for a task group's placements —
+        shared by the generic and system dense paths. The dense gate
+        guarantees no network/device asks, so one AllocatedResources
+        prototype covers every slot and ask_vec's mbits is 0."""
+        from ..structs.structs import DenseTGPlacements, generate_uuids
+
+        proto = AllocatedResources(
+            tasks={
+                t.name: AllocatedTaskResources(
+                    cpu_shares=t.resources.cpu,
+                    memory_mb=t.resources.memory_mb,
+                )
+                for t in tg.tasks
+            },
+            shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
+        )
+        return DenseTGPlacements(
+            namespace=job.namespace,
+            job_id=job.id,
+            task_group=tg.name,
+            eval_id=eval_id,
+            deployment_id=deployment_id,
+            job=job,
+            resources_proto=proto,
+            ask_vec=(
+                float(sum(t.resources.cpu for t in tg.tasks)),
+                float(sum(t.resources.memory_mb for t in tg.tasks)),
+                float(tg.ephemeral_disk.size_mb),
+                0.0,
+            ),
+            ids=generate_uuids(len(node_idxs)),
+            names=names,
+            node_ids=[nodes[int(j)].id for j in node_idxs],
+            node_names=[nodes[int(j)].name for j in node_idxs],
+            scores=[float(s) for s in scores_f],
+            nodes_evaluated=list(nodes_evaluated),
+            nodes_available=nodes_available,
+        )
+
     def _apply_system_results_dense(self, sched, place, nodes, chosen,
                                     scores, start_ns) -> None:
         """System-path dense blocks: same DenseTGPlacements flow as the
         generic path, grouped by task group. Preconditions checked by the
         caller: every placement chose its node, all fresh, no
         network/device asks."""
-        from ..structs.structs import DenseTGPlacements, generate_uuids
-
         job = sched.job
-        ctx = sched.ctx
-        if scores.dtype.kind == "i":
-            from .intscore import TERM_ONE
-
-            scores_f = np.asarray(scores, np.float64) / (60.0 * TERM_ONE)
-        else:
-            scores_f = np.asarray(scores, np.float64)
-
+        scores_f = self._scores_to_float(scores)
         by_tg: Dict[str, List[int]] = {}
         for pi, tup in enumerate(place):
             by_tg.setdefault(tup.task_group.name, []).append(pi)
         tg_by_name = {tg.name: tg for tg in job.task_groups}
         for tg_name, idxs in by_tg.items():
-            tg = tg_by_name[tg_name]
-            proto = AllocatedResources(
-                tasks={
-                    t.name: AllocatedTaskResources(
-                        cpu_shares=t.resources.cpu,
-                        memory_mb=t.resources.memory_mb,
-                    )
-                    for t in tg.tasks
-                },
-                shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
-            )
-            block = DenseTGPlacements(
-                namespace=job.namespace,
-                job_id=job.id,
-                task_group=tg.name,
-                eval_id=sched.eval.id,
-                job=job,
-                resources_proto=proto,
-                ask_vec=(
-                    float(sum(t.resources.cpu for t in tg.tasks)),
-                    float(sum(t.resources.memory_mb for t in tg.tasks)),
-                    float(tg.ephemeral_disk.size_mb),
-                    0.0,
-                ),
-                ids=generate_uuids(len(idxs)),
+            sched.plan.dense_placements.append(self._dense_block(
+                job, tg_by_name[tg_name], sched.eval.id,
+                [chosen[k] for k in idxs], nodes,
                 names=[place[k].name for k in idxs],
-                node_ids=[nodes[int(chosen[k])].id for k in idxs],
-                node_names=[nodes[int(chosen[k])].name for k in idxs],
-                scores=[float(scores_f[k]) for k in idxs],
+                scores_f=[scores_f[k] for k in idxs],
                 nodes_evaluated=[1] * len(idxs),
                 nodes_available=getattr(sched, "nodes_by_dc", {}),
-            )
-            sched.plan.dense_placements.append(block)
-        ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
+            ))
+        sched.ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
 
     # ------------------------------------------------------------------
 
@@ -1654,65 +1671,29 @@ class TpuPlacementEngine:
         list appends; AllocMetric/Allocation objects materialize lazily
         on read (structs.DenseTGPlacements.materialize). Preconditions
         (checked by the caller): enc.dense_ok, every placement chosen."""
-        from ..structs.structs import DenseTGPlacements, generate_uuids
-
         job = sched.job
-        ctx = sched.ctx
         deployment_id = ""
         if sched.deployment is not None and sched.deployment.active():
             deployment_id = sched.deployment.id
 
-        if scores.dtype.kind == "i":
-            from .intscore import TERM_ONE
-
-            scores_f = np.asarray(scores, np.float64) / (60.0 * TERM_ONE)
-        else:
-            scores_f = np.asarray(scores, np.float64)
+        scores_f = self._scores_to_float(scores)
         pulls = np.asarray(pulls)
         tg_idx = enc.xs[0]  # [p] task-group index per placement
-        nodes = enc.nodes
         missing_list = enc.missing_list
-        nodes_available = getattr(sched, "_nodes_by_dc", {})
 
         for gi in np.unique(tg_idx):
             sel = np.nonzero(tg_idx == gi)[0]
-            tg = job.task_groups[int(gi)]
-            proto = AllocatedResources(
-                tasks={
-                    t.name: AllocatedTaskResources(
-                        cpu_shares=t.resources.cpu,
-                        memory_mb=t.resources.memory_mb,
-                    )
-                    for t in tg.tasks
-                },
-                shared=AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb),
-            )
-            ask_vec = (
-                float(sum(t.resources.cpu for t in tg.tasks)),
-                float(sum(t.resources.memory_mb for t in tg.tasks)),
-                float(tg.ephemeral_disk.size_mb),
-                0.0,  # dense gate: no network asks
-            )
-            block = DenseTGPlacements(
-                namespace=job.namespace,
-                job_id=job.id,
-                task_group=tg.name,
-                eval_id=sched.eval.id,
-                deployment_id=deployment_id,
-                job=job,
-                resources_proto=proto,
-                ask_vec=ask_vec,
-                ids=generate_uuids(len(sel)),
+            sched.plan.dense_placements.append(self._dense_block(
+                job, job.task_groups[int(gi)], sched.eval.id,
+                chosen[sel], enc.nodes,
                 names=[missing_list[k].get_name() for k in sel],
-                node_ids=[nodes[j].id for j in chosen[sel]],
-                node_names=[nodes[j].name for j in chosen[sel]],
-                scores=scores_f[sel].tolist(),
+                scores_f=scores_f[sel],
                 nodes_evaluated=pulls[sel].tolist(),
-                nodes_available=nodes_available,
-            )
-            sched.plan.dense_placements.append(block)
+                nodes_available=getattr(sched, "_nodes_by_dc", {}),
+                deployment_id=deployment_id,
+            ))
 
-        ctx.metrics.allocation_time_ns = _time.monotonic_ns() - enc.start_ns
+        sched.ctx.metrics.allocation_time_ns = _time.monotonic_ns() - enc.start_ns
 
     def _apply_results(self, sched, missing_list, nodes, table, chosen, scores,
                        pulls, skipped_steps, start_ns) -> None:
