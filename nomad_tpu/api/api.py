@@ -656,6 +656,39 @@ class AgentAPI(_Sub):
         out, _ = self.client.get("/v1/metrics")
         return out
 
+    def monitor(self, log_level: str = "info", seq: int = 0):
+        """One log-tail poll (api/agent.go Monitor's non-follow shape)."""
+        out, _ = self.client.get(
+            f"/v1/agent/monitor?log_level={log_level}&seq={seq}"
+        )
+        return out
+
+    def monitor_follow(self, log_level: str = "info"):
+        """SERVER-PUSH agent log stream (/v1/agent/monitor?follow=true):
+        yields byte chunks until closed (api/agent.go Monitor)."""
+        url = self.client._url(
+            "/v1/agent/monitor",
+            QueryOptions(params={"log_level": log_level, "follow": "true"}),
+        )
+        req = urllib.request.Request(url)
+        if self.client.config.token:
+            req.add_header("X-Nomad-Token", self.client.config.token)
+        resp = urllib.request.urlopen(
+            req, timeout=3600, context=self.client.config.ssl_context()
+        )
+
+        def gen():
+            try:
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        return
+                    yield chunk
+            finally:
+                resp.close()
+
+        return gen()
+
     def join(self, addresses):
         """api/agent.go Join: runtime gossip join."""
         from urllib.parse import quote

@@ -104,7 +104,7 @@ _VALUE_FLAGS = {
     "ca-file", "cert-file", "key-file", "n",
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
     "servers", "encrypt", "authoritative-region", "replication-token",
-    "host-volume", "peer-id", "group",
+    "host-volume", "peer-id", "group", "log-level",
 }
 
 
@@ -267,6 +267,30 @@ def cmd_agent(ctx: Ctx, args: List[str]) -> int:
 def cmd_agent_info(ctx: Ctx, args: List[str]) -> int:
     info = ctx.client.agent.self()
     ctx.out(json.dumps(info, indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_monitor(ctx: Ctx, args: List[str]) -> int:
+    """nomad monitor [-log-level <level>] [-no-follow] — stream the
+    agent's logs (reference command/monitor.go over /v1/agent/monitor)."""
+    flags, _ = _split_flags(args)
+    level = flags.get("log-level", "info")
+    if _truthy(flags, "no-follow"):
+        out = ctx.client.agent.monitor(log_level=level)
+        for line in out.get("Lines") or []:
+            ctx.out(line.rstrip("\n"))
+        return 0
+    pending = b""
+    try:
+        sys.stdout.flush()
+        for chunk in ctx.client.agent.monitor_follow(log_level=level):
+            pending += chunk
+            complete, sep, pending = pending.rpartition(b"\n")
+            if sep:
+                ctx.out(complete.decode(errors="replace"))
+                sys.stdout.flush()
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -1280,6 +1304,7 @@ def _dispatch(ctx: Ctx, args: List[str], subs: Dict[str, Callable], family: str)
 COMMANDS: Dict[str, Callable[[Ctx, List[str]], int]] = {
     "agent": cmd_agent,
     "agent-info": cmd_agent_info,
+    "monitor": cmd_monitor,
     "job": cmd_job,
     "node": cmd_node,
     "alloc": lambda c, a: _dispatch(

@@ -226,6 +226,17 @@ def test_deployment_pause_resume_cli(agent, tmp_path):
     run_cli(agent, "job", "stop", "-purge", "-detach", "cli-dep")
 
 
+def test_monitor_no_follow(agent):
+    # the module-scope agent shares this process: emit a log record the
+    # monitor's ring buffer is guaranteed to capture
+    import logging
+
+    logging.getLogger("nomad_tpu.test").warning("cli-monitor-probe")
+    code, out = run_cli(agent, "monitor", "-no-follow", "-log-level", "warn")
+    assert code == 0
+    assert "cli-monitor-probe" in out
+
+
 def test_operator_raft_remove_peer_cli(agent):
     # dev agent runs the in-proc raft: removal must refuse cleanly
     code, out = run_cli(agent, "operator", "raft", "remove-peer",
