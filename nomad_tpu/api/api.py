@@ -626,6 +626,12 @@ class Operator(_Sub):
     def raft_get_configuration(self, q: Optional[QueryOptions] = None):
         return self.client.get("/v1/operator/raft/configuration", q)
 
+    def autopilot_get_configuration(self, q: Optional[QueryOptions] = None):
+        return self.client.get("/v1/operator/autopilot/configuration", q)
+
+    def autopilot_set_configuration(self, config: Dict[str, Any], q=None):
+        return self.client.put("/v1/operator/autopilot/configuration", config, q)
+
     def raft_remove_peer(self, peer_id: str, q: Optional[QueryOptions] = None):
         """Reference api/operator.go RaftRemovePeerByID."""
         from urllib.parse import quote

@@ -104,7 +104,8 @@ _VALUE_FLAGS = {
     "ca-file", "cert-file", "key-file", "n",
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
     "servers", "encrypt", "authoritative-region", "replication-token",
-    "host-volume", "peer-id", "group", "log-level",
+    "host-volume", "peer-id", "group", "log-level", "install", "use",
+    "remove", "min-quorum",
 }
 
 
@@ -1215,10 +1216,64 @@ def cmd_operator(ctx: Ctx, args: List[str]) -> int:
         ctx.out(columns(rows))
         return 0
 
+    def autopilot(ctx, a):
+        # reference command/operator_autopilot_get.go / _set.go
+        flags, rest = _split_flags(a)
+        if rest and rest[0] == "set-config":
+            # read-modify-write like operator_autopilot_set.go: flags not
+            # passed must keep their current values, not reset to zeros
+            body, _ = ctx.client.operator.autopilot_get_configuration()
+            body = dict(body or {})
+            if "cleanup-dead-servers" in flags:
+                body["CleanupDeadServers"] = _truthy(flags, "cleanup-dead-servers")
+            if "min-quorum" in flags:
+                body["MinQuorum"] = int(flags["min-quorum"])
+            ctx.client.operator.autopilot_set_configuration(body)
+            ctx.out("Configuration updated!")
+            return 0
+        cfg, _ = ctx.client.operator.autopilot_get_configuration()
+        ctx.out(json.dumps(cfg, indent=2, sort_keys=True))
+        return 0
+
+    def keygen(ctx, a):
+        # reference command/operator_keygen.go: 32 bytes of entropy, b64
+        import os as _os
+
+        ctx.out(base64.b64encode(_os.urandom(32)).decode())
+        return 0
+
+    def keyring(ctx, a):
+        # reference command/operator_keyring.go: -list/-install/-use/-remove
+        flags, _ = _split_flags(a)
+        try:
+            if "install" in flags:
+                ctx.client.agent.keyring_op("install", flags["install"])
+                ctx.out("Successfully installed key!")
+            elif "use" in flags:
+                ctx.client.agent.keyring_op("use", flags["use"])
+                ctx.out("Successfully changed primary key!")
+            elif "remove" in flags:
+                ctx.client.agent.keyring_op("remove", flags["remove"])
+                ctx.out("Successfully removed key!")
+            else:
+                out = ctx.client.agent.keyring_list()
+                rows = [["Key", "Primary"]]
+                primaries = out.get("PrimaryKeys") or {}
+                for k in out.get("Keys") or {}:
+                    rows.append([k, "yes" if k in primaries else ""])
+                ctx.out(columns(rows))
+        except APIError as e:
+            ctx.out(f"error: {e}")
+            return 1
+        return 0
+
     return _dispatch(ctx, args, {
         "scheduler": sched,
         "scheduler-config": sched,
         "raft": raft,
+        "autopilot": autopilot,
+        "keygen": keygen,
+        "keyring": keyring,
     }, "operator")
 
 
@@ -1329,6 +1384,10 @@ COMMANDS: Dict[str, Callable[[Ctx, List[str]], int]] = {
     "stop": cmd_job_stop,
     "validate": cmd_job_validate,
     "inspect": cmd_job_inspect,
+    "init": cmd_job_init,
+    "logs": cmd_alloc_logs,
+    "fs": cmd_alloc_fs,
+    "exec": cmd_alloc_exec,
 }
 
 

@@ -244,3 +244,34 @@ def test_operator_raft_remove_peer_cli(agent):
     assert code == 1
     code, out = run_cli(agent, "operator", "raft", "list-peers")
     assert code == 0 and "leader" in out
+
+
+def test_operator_keygen_keyring_autopilot(agent):
+    code, out = run_cli(agent, "operator", "keygen")
+    assert code == 0
+    import base64
+    key = out.strip()
+    assert len(base64.b64decode(key)) == 32
+
+    # dev agent has no gossip encryption: list shows an empty ring and
+    # MUTATIONS refuse cleanly
+    code, out = run_cli(agent, "operator", "keyring")
+    assert code == 0 and "Primary" in out
+    code, out = run_cli(agent, "operator", "keyring", "-install", key)
+    assert code == 1 and "error" in out
+
+    code, out = run_cli(agent, "operator", "autopilot")
+    assert code == 0 and "CleanupDeadServers" in out
+    code, out = run_cli(agent, "operator", "autopilot", "set-config",
+                        "-cleanup-dead-servers=false")
+    assert code == 0 and "updated" in out
+    code, out = run_cli(agent, "operator", "autopilot")
+    assert code == 0 and '"CleanupDeadServers": false' in out
+
+
+def test_top_level_aliases(agent, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    code, out = run_cli(agent, "init")
+    assert code == 0 and "example.nomad" in out
+    code, out = run_cli(agent, "validate", "example.nomad")
+    assert code == 0
