@@ -77,7 +77,12 @@ class ServerConfig:
     # batched placement scan. 0/1 disables batching (per-eval dispatch).
     device_batch: int = 8
     # how long the batcher waits for co-arriving evals before dispatching
+    # (the total CAP when idle-gap gathering is on)
     device_batch_window_ms: float = 1.0
+    # adaptive gather: keep the batch growing while requests keep arriving
+    # within this gap of each other (a burst's encodes trickle in);
+    # 0 disables (fixed window only)
+    device_batch_idle_ms: float = 0.0
     # shard the eval batch over an ("evals", "nodes") jax device mesh when
     # multiple accelerator devices are visible (multi-chip)
     device_mesh: bool = False
@@ -166,6 +171,7 @@ class Server:
             self.device_batcher = DeviceBatcher(
                 max_batch=self.config.device_batch,
                 window_ms=self.config.device_batch_window_ms,
+                idle_ms=getattr(self.config, "device_batch_idle_ms", 0.0),
                 mesh=mesh,
             )
 

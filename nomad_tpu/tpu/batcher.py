@@ -199,9 +199,15 @@ class DeviceBatcher:
     """
 
     def __init__(self, max_batch: int = 8, window_ms: float = 1.0,
-                 mesh=None) -> None:
+                 mesh=None, idle_ms: float = 0.0) -> None:
         self.max_batch = max(1, int(max_batch))
         self.window_s = max(0.0, float(window_ms)) / 1000.0
+        # Adaptive gather: with idle_ms > 0 the batch keeps growing while
+        # requests keep ARRIVING within idle_ms of each other (encode of a
+        # burst trickles evals in), dispatching when the stream pauses;
+        # window_ms then acts as the total cap rather than a workload-
+        # tuned constant. 0 = fixed-window behavior.
+        self.idle_s = max(0.0, float(idle_ms)) / 1000.0
         self.mesh = mesh
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._scan = None
@@ -284,10 +290,12 @@ class DeviceBatcher:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
+                    # adaptive mode waits only as long as the arrival gap
+                    wait = min(remaining, self.idle_s) if self.idle_s else remaining
                     try:
-                        batch.append(self._queue.get(timeout=remaining))
+                        batch.append(self._queue.get(timeout=wait))
                     except queue.Empty:
-                        break
+                        break  # stream paused (or window expired)
             else:
                 while len(batch) < self.max_batch:
                     try:
