@@ -351,13 +351,38 @@ class Client:
 
     def _watch_allocations(self) -> None:
         index = 0
+        rewinds = 0
         while not self._shutdown.is_set():
             try:
-                allocs, index = self.proxy.pull_allocs(self.node.id, index, timeout=1.0)
+                allocs, new_index = self.proxy.pull_allocs(
+                    self.node.id, index, timeout=1.0
+                )
             except Exception:  # noqa: BLE001
                 if self._shutdown.wait(timeout=1.0):
                     return
                 continue
+            # Act only on strictly NEWER views. A blocking-query timeout
+            # returns index == min_index (nothing changed), and after a
+            # server failover a lagging follower can return an OLDER
+            # view than we already processed — acting on a rewound view
+            # could resurrect an alloc this client GC'd (its _gced guard
+            # entry is pruned once a newer view omits the id).
+            if new_index < index:
+                # ...unless the rewind is PERMANENT (servers restored
+                # from an older snapshot / rebuilt cluster): after 3
+                # consecutive rewound replies, adopt the servers' index
+                # as the new truth instead of wedging alloc sync forever.
+                rewinds += 1
+                if rewinds < 3:
+                    continue
+                self.logger.warning(
+                    "server alloc index rewound %d -> %d persistently; "
+                    "adopting server view", index, new_index,
+                )
+            elif new_index == index:
+                continue
+            rewinds = 0
+            index = new_index
             self._run_allocs(allocs)
 
     def _run_allocs(self, server_allocs: List[Allocation]) -> None:
@@ -386,6 +411,14 @@ class Client:
                 self.state_db.delete_allocation(alloc_id)
                 with self._lock:
                     self.allocrunners.pop(alloc_id, None)
+
+        # Prune the GC guard once the server stops reporting an alloc:
+        # pulls arrive from ONE sequential loop, so an id absent from
+        # this (newest) pull can never resurface in a later one — the
+        # guard entry is dead weight on a long-lived node otherwise.
+        with self._lock:
+            for aid in [a for a in self._gced if a not in server_ids]:
+                del self._gced[aid]
 
     def _vault_fn(self):
         fn = getattr(self.proxy, "derive_vault_token", None)

@@ -25,6 +25,7 @@ import random
 import socket
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -147,7 +148,14 @@ class Memberlist:
         # never partitions the cluster. Empty = plaintext gossip.
         self._keys: List[bytes] = []
         self._aeads: List = []
-        self._keyring_seen: set = set()  # broadcast op ids (dedupe)
+        # broadcast op ids (dedupe): bounded FIFO — evicting oldest-first
+        # keeps recently-seen rumors deduped, where a wholesale clear
+        # would let a still-circulating old 'use' op re-apply and flip
+        # the primary sealing key back after a rotation completed
+        self._keyring_seen: "OrderedDict[str, None]" = OrderedDict()
+        # lamport clock over keyring ops: rumors older than the newest
+        # applied op are dropped even after their id ages out of the FIFO
+        self._keyring_clock = 0
         if config.encrypt_key:
             key = _normalize_gossip_key(config.encrypt_key, self.logger)
             self._install_key_locked(key)
@@ -302,12 +310,22 @@ class Memberlist:
 
         # seal the op with the CURRENT primary before applying `use`
         # locally, so peers that still hold only the old key can unseal
+        mid = uuid_mod.uuid4().hex
+        with self._lock:
+            self._keyring_clock += 1
+            clock = self._keyring_clock
+            # our own rumor echoes back via peer rebroadcast: mark it
+            # seen so it is not re-applied against ourselves
+            self._keyring_seen[mid] = None
+            while len(self._keyring_seen) > 256:
+                self._keyring_seen.popitem(last=False)
         msg = {
             "t": "keyring", "op": op,
             "key": b64_mod.b64encode(
                 _normalize_gossip_key(key, self.logger)
             ).decode(),
-            "id": uuid_mod.uuid4().hex,
+            "id": mid,
+            "c": clock,
         }
         targets = [m for m in self.alive_members() if m.name != self.config.name]
         for m in targets:
@@ -317,21 +335,37 @@ class Memberlist:
 
     def _on_keyring_msg(self, msg: dict) -> None:
         mid = msg.get("id", "")
+        clock = msg.get("c")
         with self._lock:
             if mid in self._keyring_seen:
                 return
-            self._keyring_seen.add(mid)
-            if len(self._keyring_seen) > 256:
-                self._keyring_seen.clear()
-                self._keyring_seen.add(mid)
+            # Lamport guard: a still-circulating rumor of an OLDER op
+            # (e.g. the previous 'use' during a rotation) must never
+            # re-apply after newer ops were seen — the bounded id-FIFO
+            # alone forgets ids under rumor pressure. Ties (c == clock)
+            # apply: concurrent ops from distinct origins share a clock
+            # value and each must land at least once.
+            if clock is not None and clock < self._keyring_clock:
+                return
         op = msg.get("op", "")
         if op not in ("install", "use", "remove"):
             return
         try:
             getattr(self, f"keyring_{op}")(msg.get("key", ""))
-            self._queue_broadcast(msg)  # keep the rumor moving
         except ValueError as e:
+            # Apply failed (e.g. 'use' raced ahead of its 'install' in
+            # rumor order): do NOT advance the clock or mark the id
+            # seen — the prerequisite rumor must still apply when it
+            # arrives, and a retransmit of THIS rumor must retry.
             self.logger.warning("gossiped keyring %s failed: %s", op, e)
+            return
+        with self._lock:
+            if clock is not None:
+                self._keyring_clock = max(self._keyring_clock, clock)
+            self._keyring_seen[mid] = None
+            while len(self._keyring_seen) > 256:
+                self._keyring_seen.popitem(last=False)
+        self._queue_broadcast(msg)  # keep the rumor moving
 
     def keyring_use(self, key: str) -> None:
         """Make an installed key the primary (sealing) key."""
@@ -471,13 +505,18 @@ class Memberlist:
             self._on_keyring_msg(msg)
         elif t == "push-pull":
             self._merge_remote_state(msg.get("members", []))
+            self._merge_keyring_clock(msg.get("kc"))
+            with self._lock:
+                kc = self._keyring_clock
             self._send(src, {
                 "t": "push-pull-ack",
                 "seq": msg.get("seq"),
                 "members": [m.to_wire() for m in self.all_members()],
+                "kc": kc,
             })
         elif t == "push-pull-ack":
             self._merge_remote_state(msg.get("members", []))
+            self._merge_keyring_clock(msg.get("kc"))
             ev = self._acks.get(msg.get("seq"))
             if ev is not None:
                 ev.set()
@@ -614,15 +653,27 @@ class Memberlist:
         finally:
             self._acks.pop(seq, None)
 
+    def _merge_keyring_clock(self, kc) -> None:
+        """Adopt the larger keyring lamport clock from push-pull state:
+        a restarted node (clock reset to 0) would otherwise broadcast
+        keyring ops with a clock every converged peer silently drops."""
+        if not isinstance(kc, int):
+            return
+        with self._lock:
+            self._keyring_clock = max(self._keyring_clock, kc)
+
     def _push_pull(self, addr: Tuple[str, int]) -> bool:
         seq = self._next_seq()
         ev = threading.Event()
         self._acks[seq] = ev
         try:
+            with self._lock:
+                kc = self._keyring_clock
             self._send(addr, {
                 "t": "push-pull",
                 "seq": seq,
                 "members": [m.to_wire() for m in self.all_members()],
+                "kc": kc,
             })
             return ev.wait(self.config.probe_timeout * 4)
         finally:

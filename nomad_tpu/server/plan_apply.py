@@ -161,11 +161,20 @@ class Planner:
                         expected_epoch = None
 
                 min_index = max(prev_plan_result_index, pending.plan.snapshot_index)
-                if (
-                    snap is not None and snap.latest_index < min_index
-                    and apply_future is None and not epoch_current()
-                ):
-                    snap = None
+                # Retention invariant: a retained snapshot is capacity-
+                # identical to committed state iff epoch_current(). With
+                # no apply in flight there is no post-wait re-evaluation
+                # to correct a bad evaluation, so ANY epoch mismatch must
+                # discard the snapshot outright — independent of index
+                # staleness (the mismatch means a foreign capacity write
+                # landed: node drain/down, client sync, eval-GC delete).
+                # With an apply in flight the mismatch may just be our own
+                # uncommitted delta; keep the optimistic view unless it is
+                # also index-stale, and rely on the post-wait re-check.
+                if snap is not None and not epoch_current():
+                    if apply_future is None or snap.latest_index < min_index:
+                        snap = None
+                        expected_epoch = None
                 # Does the evaluation snapshot include the in-flight plan's
                 # results? Only the retained optimistic snapshot does; a
                 # fresh snapshot taken while an apply is still in flight
@@ -579,7 +588,15 @@ class Planner:
                 alloc_updates=payload["alloc_updates"],
                 allocs_stopped=payload["allocs_stopped"],
                 allocs_preempted=payload["allocs_preempted"],
-                dense_placements=payload["dense_placements"],
+                # dense blocks CLONED for the same aliasing reason: the
+                # in-proc raft hands the payload's block objects straight
+                # to the FSM store, whose commit stamp must not race with
+                # snapshot readers materializing against our provisional
+                # guess-index stamp
+                dense_placements=[
+                    b.clone_for_snapshot()
+                    for b in payload["dense_placements"]
+                ],
                 deployment=deployment.copy() if deployment is not None else None,
                 deployment_updates=payload["deployment_updates"],
                 eval_id=payload["eval_id"],

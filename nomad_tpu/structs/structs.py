@@ -1438,6 +1438,19 @@ class DenseTGPlacements:
             self.create_time_ns = timestamp_ns
         self.__dict__.pop("_mat", None)
 
+    def clone_for_snapshot(self) -> "DenseTGPlacements":
+        """Shallow copy sharing the (immutable-once-built) parallel
+        arrays but NOT the lazy ``_mat`` cache. The optimistic plan
+        applier folds the COPY into its snapshot while the original
+        rides the raft payload into the live FSM store: the FSM's
+        commit stamp would otherwise mutate index fields and pop the
+        cache on an object that concurrent snapshot readers are
+        materializing against."""
+        c = object.__new__(DenseTGPlacements)
+        c.__dict__.update(self.__dict__)
+        c.__dict__.pop("_mat", None)
+        return c
+
     def node_index_map(self) -> Dict[str, List[int]]:
         """node_id -> placement indices (cached; blocks are immutable
         once committed)."""
