@@ -279,9 +279,14 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                 for k in server.device_batcher.stats:
                     server.device_batcher.stats[k] = 0
 
+        from nomad_tpu.utils import phases
+
+        phases.enable()
+        p_t0 = phases.now()
         t0 = time.perf_counter()
-        for job in jobs:
-            server.register_job(job)
+        with phases.track("register"):
+            for job in jobs:
+                server.register_job(job)
 
         def placed():
             # O(table + blocks): never materializes dense allocs — a
@@ -298,6 +303,8 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                 break
             time.sleep(0.05)
         elapsed = time.perf_counter() - t0
+        phase_shares = phases.wall_shares(p_t0, phases.now())
+        phases.disable()
         got = placed()
         evals = sum(w.stats["evals_processed"] for w in server.workers)
         db = server.device_batcher.stats if server.device_batcher else {}
@@ -312,6 +319,9 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             "device_dispatches": db.get("dispatches", 0),
             "device_evals": db.get("evals", 0),
             "max_eval_batch": db.get("max_batch_seen", 0),
+            # wall-clock share (interval UNION across threads, not a
+            # thread-sum) each pipeline phase held during the window
+            "phases": phase_shares,
         }
         log(f"system[{name}]: {json.dumps(out)}")
         return out

@@ -349,9 +349,19 @@ class Client:
 
     # -- alloc watching (client.go:1873) ---------------------------------
 
+    # rewound pulls must persist this long before the client adopts the
+    # servers' older view as the new truth (DR restore / rebuilt
+    # cluster). Transient replication lag after a failover clears in
+    # seconds; adopting too eagerly could resurrect an alloc this
+    # client GC'd (a lagging follower still lists it desired-run and
+    # the _gced guard was pruned when a newer view omitted it).
+    REWIND_ADOPT_AFTER_S = 30.0
+
     def _watch_allocations(self) -> None:
+        import time as _time
+
         index = 0
-        rewinds = 0
+        rewind_t0: Optional[float] = None
         while not self._shutdown.is_set():
             try:
                 allocs, new_index = self.proxy.pull_allocs(
@@ -369,19 +379,25 @@ class Client:
             # entry is pruned once a newer view omits the id).
             if new_index < index:
                 # ...unless the rewind is PERMANENT (servers restored
-                # from an older snapshot / rebuilt cluster): after 3
-                # consecutive rewound replies, adopt the servers' index
-                # as the new truth instead of wedging alloc sync forever.
-                rewinds += 1
-                if rewinds < 3:
+                # from an older snapshot / rebuilt cluster): only after
+                # rewound replies persist for REWIND_ADOPT_AFTER_S do we
+                # adopt the servers' view instead of wedging alloc sync
+                # forever. A follower merely catching up converges and
+                # returns a newer index well before the deadline, which
+                # resets the streak below.
+                now = _time.monotonic()
+                if rewind_t0 is None:
+                    rewind_t0 = now
+                if now - rewind_t0 < self.REWIND_ADOPT_AFTER_S:
                     continue
                 self.logger.warning(
-                    "server alloc index rewound %d -> %d persistently; "
+                    "server alloc index rewound %d -> %d for over %.0fs; "
                     "adopting server view", index, new_index,
+                    self.REWIND_ADOPT_AFTER_S,
                 )
             elif new_index == index:
                 continue
-            rewinds = 0
+            rewind_t0 = None
             index = new_index
             self._run_allocs(allocs)
 

@@ -153,9 +153,16 @@ class Memberlist:
         # would let a still-circulating old 'use' op re-apply and flip
         # the primary sealing key back after a rotation completed
         self._keyring_seen: "OrderedDict[str, None]" = OrderedDict()
-        # lamport clock over keyring ops: rumors older than the newest
-        # applied op are dropped even after their id ages out of the FIFO
+        # lamport clock over keyring ops: ORDER-SENSITIVE rumors ('use',
+        # 'remove') older than the newest applied op are dropped even
+        # after their id ages out of the FIFO. 'install' is exempt from
+        # the global clock (it is idempotent and commutative, and a
+        # delayed install rumor must still apply after unrelated newer
+        # ops — dropping it would silently partition the node once the
+        # old key is removed); installs are guarded per KEY instead, so
+        # an install can never resurrect a key a newer remove deleted.
         self._keyring_clock = 0
+        self._key_clocks: Dict[bytes, int] = {}
         if config.encrypt_key:
             key = _normalize_gossip_key(config.encrypt_key, self.logger)
             self._install_key_locked(key)
@@ -311,9 +318,11 @@ class Memberlist:
         # seal the op with the CURRENT primary before applying `use`
         # locally, so peers that still hold only the old key can unseal
         mid = uuid_mod.uuid4().hex
+        kb = _normalize_gossip_key(key, self.logger)
         with self._lock:
             self._keyring_clock += 1
             clock = self._keyring_clock
+            self._key_clocks[kb] = max(self._key_clocks.get(kb, 0), clock)
             # our own rumor echoes back via peer rebroadcast: mark it
             # seen so it is not re-applied against ourselves
             self._keyring_seen[mid] = None
@@ -321,9 +330,7 @@ class Memberlist:
                 self._keyring_seen.popitem(last=False)
         msg = {
             "t": "keyring", "op": op,
-            "key": b64_mod.b64encode(
-                _normalize_gossip_key(key, self.logger)
-            ).decode(),
+            "key": b64_mod.b64encode(kb).decode(),
             "id": mid,
             "c": clock,
         }
@@ -336,25 +343,35 @@ class Memberlist:
     def _on_keyring_msg(self, msg: dict) -> None:
         mid = msg.get("id", "")
         clock = msg.get("c")
-        with self._lock:
-            if mid in self._keyring_seen:
-                return
-            # Lamport guard: a still-circulating rumor of an OLDER op
-            # (e.g. the previous 'use' during a rotation) must never
-            # re-apply after newer ops were seen — the bounded id-FIFO
-            # alone forgets ids under rumor pressure. Ties (c == clock)
-            # apply: concurrent ops from distinct origins share a clock
-            # value and each must land at least once.
-            if clock is not None and clock < self._keyring_clock:
-                return
         op = msg.get("op", "")
         if op not in ("install", "use", "remove"):
             return
         try:
+            kb = _normalize_gossip_key(msg.get("key", ""), self.logger)
+        except ValueError:
+            return
+        with self._lock:
+            if mid in self._keyring_seen:
+                return
+            if clock is not None:
+                # Lamport guards: a still-circulating rumor of an OLDER
+                # ORDER-SENSITIVE op ('use'/'remove' — e.g. the previous
+                # 'use' during a rotation) must never re-apply after
+                # newer ops were seen; the bounded id-FIFO alone forgets
+                # ids under rumor pressure. 'install' is order-free and
+                # only guarded against resurrecting a key that a newer
+                # remove deleted (per-key clock). Ties apply: concurrent
+                # ops from distinct origins share a clock value and each
+                # must land at least once.
+                if op in ("use", "remove") and clock < self._keyring_clock:
+                    return
+                if op == "install" and clock < self._key_clocks.get(kb, 0):
+                    return
+        try:
             getattr(self, f"keyring_{op}")(msg.get("key", ""))
         except ValueError as e:
             # Apply failed (e.g. 'use' raced ahead of its 'install' in
-            # rumor order): do NOT advance the clock or mark the id
+            # rumor order): do NOT advance the clocks or mark the id
             # seen — the prerequisite rumor must still apply when it
             # arrives, and a retransmit of THIS rumor must retry.
             self.logger.warning("gossiped keyring %s failed: %s", op, e)
@@ -362,6 +379,7 @@ class Memberlist:
         with self._lock:
             if clock is not None:
                 self._keyring_clock = max(self._keyring_clock, clock)
+                self._key_clocks[kb] = max(self._key_clocks.get(kb, 0), clock)
             self._keyring_seen[mid] = None
             while len(self._keyring_seen) > 256:
                 self._keyring_seen.popitem(last=False)

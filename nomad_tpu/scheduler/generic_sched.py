@@ -234,6 +234,21 @@ class GenericScheduler:
     # ------------------------------------------------------------------
 
     def _compute_job_allocs(self) -> None:
+        # reconcile tracked separately from placement: placement blocks
+        # on the device dispatch and must not pollute host-phase shares.
+        # The host-work semaphore parks excess worker threads (GIL
+        # convoy guard — utils/hostwork.py); it is released before
+        # placement, which may block on the batched device dispatch.
+        from ..utils import phases as _phases
+        from ..utils.hostwork import HOST_WORK_SEM
+
+        with HOST_WORK_SEM:
+            with _phases.track("reconcile"):
+                results = self._reconcile_job_allocs()
+        if results is not None:
+            self._compute_placements(results.destructive_update, results.place)
+
+    def _reconcile_job_allocs(self):
         allocs = self.state.allocs_by_job(self.eval.namespace, self.eval.job_id, True)
         tainted = tainted_nodes(self.state, allocs)
         update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
@@ -289,7 +304,7 @@ class GenericScheduler:
             if self.job is not None:
                 for tg in self.job.task_groups:
                     self.queued_allocs[tg.name] = 0
-            return
+            return None
 
         for place in results.place:
             self.queued_allocs[place.task_group.name] = (
@@ -300,7 +315,7 @@ class GenericScheduler:
                 self.queued_allocs.get(destructive.place_task_group.name, 0) + 1
             )
 
-        self._compute_placements(results.destructive_update, results.place)
+        return results
 
     # ------------------------------------------------------------------
 

@@ -36,6 +36,7 @@ ALLOC_UPDATE = "alloc-update"
 ALLOC_CLIENT_UPDATE = "alloc-client-update"
 ALLOC_UPDATE_DESIRED_TRANSITION = "alloc-update-desired-transition"
 APPLY_PLAN_RESULTS = "apply-plan-results"
+APPLY_PLAN_RESULTS_BATCH = "apply-plan-results-batch"
 DEPLOYMENT_STATUS_UPDATE = "deployment-status-update"
 DEPLOYMENT_PROMOTE = "deployment-promote"
 DEPLOYMENT_ALLOC_HEALTH = "deployment-alloc-health"
@@ -179,6 +180,35 @@ class NomadFSM:
                     seen.add(node.computed_class)
                     self.on_capacity_change(node.computed_class, index)
 
+    def _apply_plan_results_batch(self, index: int, payloads):
+        """One raft entry carrying SEVERAL plans' results — the leader's
+        applier groups queued plans so the commit path pays raft/FSM
+        dispatch once per batch instead of once per plan (the reference
+        serializes per plan at plan_apply.go:45–70; batching is the
+        TPU-era answer to C1M commit rates, where per-plan round trips
+        dominate). Sequential application preserves per-plan semantics:
+        the applier evaluated plan k+1 against a snapshot that already
+        contained plan k's results.
+
+        Payloads are independent plans, so failures are isolated per
+        payload: the rest of the batch still applies (a shared failure
+        would tell workers whose placements DID commit that they
+        failed), and the per-payload error list returns to the leader's
+        apply waiter so it can respond to each plan accurately. The
+        errors are data-deterministic, so every replica partitions the
+        batch identically."""
+        errors = []
+        for payload in payloads:
+            try:
+                self._apply_plan_results(index, payload)
+                errors.append(None)
+            except Exception as e:  # noqa: BLE001 — isolate to this plan
+                logging.getLogger("nomad_tpu.fsm").exception(
+                    "plan payload in batch failed to apply"
+                )
+                errors.append(str(e) or e.__class__.__name__)
+        return errors
+
     def _apply_deployment_status_update(self, index: int, payload):
         update, job, evaluation = payload
         d = self.state.deployment_by_id(update.deployment_id)
@@ -292,6 +322,7 @@ _DISPATCH: Dict[str, Callable] = {
     ALLOC_CLIENT_UPDATE: NomadFSM._apply_alloc_client_update,
     ALLOC_UPDATE_DESIRED_TRANSITION: NomadFSM._apply_alloc_update_desired_transition,
     APPLY_PLAN_RESULTS: NomadFSM._apply_plan_results,
+    APPLY_PLAN_RESULTS_BATCH: NomadFSM._apply_plan_results_batch,
     DEPLOYMENT_STATUS_UPDATE: NomadFSM._apply_deployment_status_update,
     DEPLOYMENT_PROMOTE: NomadFSM._apply_deployment_promote,
     DEPLOYMENT_ALLOC_HEALTH: NomadFSM._apply_deployment_alloc_health,

@@ -34,7 +34,7 @@ import numpy as np
 
 from ..structs.funcs import remove_allocs
 from ..structs.network import NetworkIndex
-from ..utils import metrics
+from ..utils import metrics, phases
 from ..structs.structs import (
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_PREEMPTION,
@@ -43,7 +43,7 @@ from ..structs.structs import (
     Plan,
     PlanResult,
 )
-from .fsm import APPLY_PLAN_RESULTS
+from .fsm import APPLY_PLAN_RESULTS, APPLY_PLAN_RESULTS_BATCH  # noqa: F401 — single-plan op kept for wire compat
 
 
 class PendingPlan:
@@ -97,12 +97,15 @@ class PlanQueue:
 class Planner:
     """The leader's plan applier loop (reference planner.planApply)."""
 
-    def __init__(self, raft, peer: int, fsm, plan_queue: PlanQueue, logger=None) -> None:
+    def __init__(self, raft, peer: int, fsm, plan_queue: PlanQueue, logger=None,
+                 batch_max: int = 32) -> None:
         self.raft = raft
         self.peer = peer
         self.fsm = fsm
         self.plan_queue = plan_queue
         self.logger = logger or logging.getLogger("nomad_tpu.planner")
+        # max queued plans grouped into one raft entry (see _run)
+        self.batch_max = max(1, int(batch_max))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -143,13 +146,31 @@ class Planner:
                 and live.capacity_epoch == expected_epoch
             )
 
+        carry: List[PendingPlan] = []
         while not self._stop.is_set():
-            pending = self.plan_queue.dequeue(timeout=0.2)
-            if pending is None:
-                continue
+            if carry:
+                batch = carry
+                carry = []
+            else:
+                first = self.plan_queue.dequeue(timeout=0.2)
+                if first is None:
+                    continue
+                batch = [first]
+            # Greedy batch gather: at C1M commit rates the per-plan
+            # round trip (waiter thread, raft dispatch, FSM lock) is the
+            # drain bottleneck, so queued plans are grouped into ONE
+            # raft entry (APPLY_PLAN_RESULTS_BATCH). Each plan is still
+            # evaluated sequentially against a snapshot containing its
+            # predecessors' folds, so per-plan semantics are unchanged
+            # (reference serialization point: plan_apply.go:45–70).
+            while len(batch) < self.batch_max:
+                nxt = self.plan_queue.dequeue(timeout=0)
+                if nxt is None:
+                    break
+                batch.append(nxt)
             metrics.set_gauge("nomad.plan.queue_depth", self.plan_queue.stats().get("depth", 0))
             try:
-                # Previous plan committed during dequeue? Keep the
+                # Previous batch committed during dequeue? Keep the
                 # optimistic view only if the commit was exactly what we
                 # predicted (no interleaved capacity writes).
                 if apply_future is not None and apply_future.done():
@@ -160,7 +181,10 @@ class Planner:
                         snap = None
                         expected_epoch = None
 
-                min_index = max(prev_plan_result_index, pending.plan.snapshot_index)
+                min_index = max(
+                    [prev_plan_result_index]
+                    + [p.plan.snapshot_index for p in batch]
+                )
                 # Retention invariant: a retained snapshot is capacity-
                 # identical to committed state iff epoch_current(). With
                 # no apply in flight there is no post-wait re-evaluation
@@ -175,7 +199,7 @@ class Planner:
                     if apply_future is None or snap.latest_index < min_index:
                         snap = None
                         expected_epoch = None
-                # Does the evaluation snapshot include the in-flight plan's
+                # Does the evaluation snapshot include the in-flight batch's
                 # results? Only the retained optimistic snapshot does; a
                 # fresh snapshot taken while an apply is still in flight
                 # may lack them, and an evaluation against it cannot be
@@ -186,13 +210,10 @@ class Planner:
                     expected_epoch = snap.capacity_epoch
                     saw_inflight = apply_future is None
 
-                start = metrics.now()
-                result = self.evaluate_plan(snap, pending.plan)
-                metrics.measure_since("nomad.plan.evaluate", start)
-
-                if result.is_noop():
-                    pending.future.set_result(result)
-                    continue
+                items, batch_delta, snap_ok, leftovers = (
+                    self._evaluate_and_fold(batch, snap)
+                )
+                carry = leftovers
 
                 # Ensure any parallel apply completed before dispatching
                 # the next one (bounds how stale the optimism can get).
@@ -201,38 +222,44 @@ class Planner:
                     prev_plan_result_index = max(prev_plan_result_index, idx)
                     apply_future = None
                     if idx == 0 or not saw_inflight or not epoch_current():
-                        snap = self._snapshot_min_index(
-                            max(prev_plan_result_index, pending.plan.snapshot_index)
-                        )
-                        expected_epoch = snap.capacity_epoch
                         # Re-validate against committed state whenever the
-                        # evaluation could not be trusted: it ran blind to
-                        # the in-flight plan, or on optimism a failed
-                        # apply (idx == 0) never delivered, or a foreign
-                        # capacity write (node drain, client sync)
+                        # evaluations could not be trusted: they ran blind
+                        # to the in-flight batch, or a failed apply
+                        # (idx == 0) never delivered its optimism, or a
+                        # foreign capacity write (node drain, client sync)
                         # interleaved with the retained snapshot —
                         # dispatching unchecked in any of these would
                         # commit placements against capacity state that
                         # never existed.
-                        result = self.evaluate_plan(snap, pending.plan)
-                        if result.is_noop():
-                            pending.future.set_result(result)
-                            continue
+                        snap = self._snapshot_min_index(
+                            max(prev_plan_result_index, min_index)
+                        )
+                        expected_epoch = snap.capacity_epoch
+                        redo = [it[0] for it in items]
+                        items, batch_delta, snap_ok, leftovers = (
+                            self._evaluate_and_fold(redo, snap)
+                        )
+                        carry = leftovers + carry
 
-                apply_future, snap_ok, delta = self._dispatch_apply(
-                    pending, result, snap
-                )
+                if not items:
+                    if not snap_ok:
+                        snap = None
+                        expected_epoch = None
+                    continue
+                apply_future = self._dispatch_batch(items)
                 if expected_epoch is not None:
-                    expected_epoch += delta
+                    expected_epoch += batch_delta
                 if not snap_ok:
-                    # the optimistic fold-in failed partway: the snapshot
+                    # an optimistic fold-in failed partway: the snapshot
                     # is inconsistent — never evaluate against it again
                     snap = None
                     expected_epoch = None
-            except Exception as e:  # noqa: BLE001 — worker gets the error
+            except Exception as e:  # noqa: BLE001 — workers get the error
                 self.logger.exception("plan apply failed")
-                if not pending.future.done():
-                    pending.future.set_exception(e)
+                for pending in batch:
+                    if not pending.future.done():
+                        pending.future.set_exception(e)
+                carry = []
 
         if apply_future is not None:
             apply_future.result()
@@ -393,23 +420,39 @@ class Planner:
         from ..structs.funcs import node_capacity_vecs
 
         bad: set = set()
+        # freed/pending are empty for pure dense plans (the C1M commit
+        # shape): skip their lookups entirely on that path, and keep the
+        # comparison unrolled — a genexpr per node costs more than the
+        # arithmetic at C1M commit rates (~1K touched nodes per plan)
+        has_adj = bool(freed) or bool(pending)
+        nodes_tbl = snapshot.nodes_table
         for node_id, add in plan_add.items():
-            node = snapshot.node_by_id(node_id)
+            node = nodes_tbl.get(node_id)
             if node is None or node.drain or not node.ready():
                 bad.add(node_id)
                 continue
             totals, res = node_capacity_vecs(node)
             used = mirror.get(node_id, zero4)
-            fr = freed.get(node_id, zero4)
-            pend = pending.get(node_id, zero4)
-            if not all(
-                used[d] + pend[d] - fr[d] + res[d] + add[d] <= totals[d]
-                for d in range(4)
-            ):
+            if has_adj:
+                fr = freed.get(node_id, zero4)
+                pend = pending.get(node_id, zero4)
+                ok = (
+                    used[0] + pend[0] - fr[0] + res[0] + add[0] <= totals[0]
+                    and used[1] + pend[1] - fr[1] + res[1] + add[1] <= totals[1]
+                    and used[2] + pend[2] - fr[2] + res[2] + add[2] <= totals[2]
+                    and used[3] + pend[3] - fr[3] + res[3] + add[3] <= totals[3]
+                )
+            else:
+                ok = (
+                    used[0] + res[0] + add[0] <= totals[0]
+                    and used[1] + res[1] + add[1] <= totals[1]
+                    and used[2] + res[2] + add[2] <= totals[2]
+                    and used[3] + res[3] + add[3] <= totals[3]
+                )
+            if not ok:
                 self.logger.debug(
-                    "dense re-check rejected node %s: used=%s pend=%s "
-                    "freed=%s reserved=%s add=%s totals=%s",
-                    node_id[:8], used, pend, fr, res, add, totals,
+                    "dense re-check rejected node %s: used=%s add=%s totals=%s",
+                    node_id[:8], used, add, totals,
                 )
                 bad.add(node_id)
 
@@ -549,33 +592,62 @@ class Planner:
             "timestamp_ns": time.time_ns(),
         }
 
-    def _dispatch_apply(self, pending: PendingPlan, result: PlanResult,
-                        snap) -> Tuple[Future, bool, int]:
-        """Fire the raft apply asynchronously (plan_apply.go applyPlan +
-        asyncPlanWait): optimistically fold the results into ``snap`` so
-        the NEXT plan evaluates as if this one succeeded, respond to the
-        waiting worker from the apply waiter, and return (index_future,
-        snap_ok, capacity_delta) — the future resolves to the committed
-        index (0 on failure); snap_ok is False when the optimistic
-        fold-in failed and the snapshot must be discarded; capacity_delta
-        is the number of capacity_epoch bumps the FSM apply of this
-        payload will perform (the applier's snapshot-retention
-        prediction)."""
-        plan = pending.plan
-        payload = self._build_payload(snap, plan, result)
-        # one bump for the combined object-alloc upsert (when non-empty)
-        # plus one per dense block (state_store.upsert_plan_results)
-        capacity_delta = len(payload["dense_placements"])
-        if (
-            payload["alloc_updates"] or payload["allocs_stopped"]
-            or payload["allocs_preempted"]
-        ):
-            capacity_delta += 1
+    def _evaluate_and_fold(self, batch: List[PendingPlan], snap):
+        """Evaluate each queued plan against ``snap``, folding every
+        non-noop result in so plan k+1 sees plan k's expected outcome
+        (the pipelined optimism of plan_apply.go:45–70, applied within a
+        batch). Noop results are responded immediately. Returns
+        (items, capacity_delta, snap_ok, leftovers): ``items`` is the
+        list of (pending, result, payload) to commit as one raft entry;
+        ``capacity_delta`` predicts the epoch bumps their FSM apply will
+        perform; ``snap_ok`` False means a fold failed and the snapshot
+        must be discarded after dispatch — the un-evaluated remainder of
+        the batch is handed back as ``leftovers``."""
+        items: List[Tuple[PendingPlan, PlanResult, dict]] = []
+        delta_total = 0
         snap_ok = True
+        leftovers: List[PendingPlan] = []
+        for bi, pending in enumerate(batch):
+            try:
+                start = metrics.now()
+                with phases.track("plan_evaluate"):
+                    result = self.evaluate_plan(snap, pending.plan)
+                metrics.measure_since("nomad.plan.evaluate", start)
+                if result.is_noop():
+                    pending.future.set_result(result)
+                    continue
+                payload = self._build_payload(snap, pending.plan, result)
+                # one bump for the combined object-alloc upsert (when
+                # non-empty) plus one per dense block
+                # (state_store.upsert_plan_results)
+                delta = len(payload["dense_placements"])
+                if (
+                    payload["alloc_updates"] or payload["allocs_stopped"]
+                    or payload["allocs_preempted"]
+                ):
+                    delta += 1
+                if not self._fold_optimistic(snap, payload):
+                    # a half-mutated snapshot cannot host further
+                    # evaluations: commit what we have, re-run the rest
+                    # of the batch on a fresh snapshot next iteration
+                    snap_ok = False
+                    delta_total += delta
+                    items.append((pending, result, payload))
+                    leftovers = list(batch[bi + 1:])
+                    break
+                delta_total += delta
+                items.append((pending, result, payload))
+            except Exception as e:  # noqa: BLE001 — isolate to this plan
+                self.logger.exception("plan evaluation failed")
+                if not pending.future.done():
+                    pending.future.set_exception(e)
+        return items, delta_total, snap_ok, leftovers
 
-        # Optimistic application to our private snapshot view: the raft
-        # log is the pessimistic truth; this view lets plan N+1 verify
-        # against plan N's expected outcome during N's apply latency.
+    def _fold_optimistic(self, snap, payload: dict) -> bool:
+        """Optimistic application to the applier's private snapshot: the
+        raft log is the pessimistic truth; this view lets the next plan
+        verify against this one's expected outcome during apply latency.
+        Returns False when the fold failed (snapshot must be discarded)."""
         guess_index = self.fsm.state.latest_index + 1
         try:
             # deployment COPIED: the store keeps (and index-stamps) the
@@ -602,38 +674,59 @@ class Planner:
                 eval_id=payload["eval_id"],
                 timestamp_ns=payload["timestamp_ns"],
             )
+            return True
         except Exception:  # noqa: BLE001 — optimism only; raft is truth,
             # but a half-mutated snapshot must not be reused
             self.logger.exception("optimistic snapshot apply failed")
-            snap_ok = False
+            return False
 
+    def _dispatch_batch(self, items: List[Tuple[PendingPlan, PlanResult, dict]]) -> Future:
+        """Fire ONE raft apply for the whole batch (plan_apply.go
+        applyPlan + asyncPlanWait, batched): respond to every waiting
+        worker from the apply waiter; the returned future resolves to
+        the committed index (0 on failure)."""
+        payloads = [payload for _, _, payload in items]
         index_future: Future = Future()
 
         def waiter() -> None:
             try:
                 start = metrics.now()
-                index, _ = self.raft.apply(self.peer, APPLY_PLAN_RESULTS, payload)
+                with phases.track("raft_fsm"):
+                    index, errors = self.raft.apply(
+                        self.peer, APPLY_PLAN_RESULTS_BATCH, payloads
+                    )
                 metrics.measure_since("nomad.plan.apply", start)
-                result.alloc_index = index
-                if result.refresh_index:
-                    result.refresh_index = max(result.refresh_index, index)
-                # Stamp result allocs (the scheduler checks
-                # create==modify for "new")
-                for alloc in payload["alloc_updates"]:
-                    stored = self.fsm.state.alloc_by_id(alloc.id)
-                    if stored is not None:
-                        alloc.create_index = stored.create_index
-                        alloc.modify_index = stored.modify_index
-                pending.future.set_result(result)
+                for i, (pending, result, payload) in enumerate(items):
+                    # per-payload isolation (fsm._apply_plan_results_batch):
+                    # a failed payload must not be reported as committed,
+                    # and committed ones must not be reported as failed
+                    err = errors[i] if isinstance(errors, list) else None
+                    if err is not None:
+                        pending.future.set_exception(
+                            RuntimeError(f"plan apply failed in FSM: {err}")
+                        )
+                        continue
+                    result.alloc_index = index
+                    if result.refresh_index:
+                        result.refresh_index = max(result.refresh_index, index)
+                    # Stamp result allocs (the scheduler checks
+                    # create==modify for "new")
+                    for alloc in payload["alloc_updates"]:
+                        stored = self.fsm.state.alloc_by_id(alloc.id)
+                        if stored is not None:
+                            alloc.create_index = stored.create_index
+                            alloc.modify_index = stored.modify_index
+                    pending.future.set_result(result)
                 index_future.set_result(index)
             except Exception as e:  # noqa: BLE001
-                self.logger.exception("raft apply of plan failed")
-                if not pending.future.done():
-                    pending.future.set_exception(e)
+                self.logger.exception("raft apply of plan batch failed")
+                for pending, _, _ in items:
+                    if not pending.future.done():
+                        pending.future.set_exception(e)
                 index_future.set_result(0)
 
         threading.Thread(target=waiter, name="plan-apply-wait", daemon=True).start()
-        return index_future, snap_ok, capacity_delta
+        return index_future
 
     def apply_plan(self, plan: Plan) -> PlanResult:
         """Synchronous evaluate+apply (tests / direct callers); the
@@ -645,5 +738,7 @@ class Planner:
         if result.is_noop():
             return result
         pending = PendingPlan(plan)
-        self._dispatch_apply(pending, result, snapshot)
+        payload = self._build_payload(snapshot, plan, result)
+        self._fold_optimistic(snapshot, payload)
+        self._dispatch_batch([(pending, result, payload)])
         return pending.future.result(timeout=60)

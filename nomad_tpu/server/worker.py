@@ -164,9 +164,24 @@ class Worker:
             CoreScheduler(self.server, snapshot).process(evaluation)
             return
 
+        from ..utils import phases
+        from ..utils.hostwork import HOST_WORK_SEM
+
         wait_index = max(evaluation.modify_index, evaluation.snapshot_index)
         start = metrics.now()
-        snapshot = self.server.fsm.state.snapshot_min_index(wait_index)
+        # wait for the raft index WITHOUT the host-work permit (it can
+        # block seconds); the snapshot COPY is a pure-GIL table clone —
+        # park excess threads for that part only
+        self.server.fsm.state.wait_min_index(wait_index)
+        with HOST_WORK_SEM:
+            with phases.track("snapshot"):
+                # read-only shared view: a burst of evals at one state
+                # version shares one table clone (schedulers never
+                # mutate their snapshot; the plan applier, which does,
+                # takes private ones)
+                snapshot = self.server.fsm.state.shared_snapshot_min_index(
+                    wait_index
+                )
         metrics.measure_since("nomad.worker.wait_for_index", start)
         self._snapshot_index = snapshot.latest_index
         sched = new_scheduler(evaluation.type, self.logger, snapshot, self)
@@ -242,7 +257,10 @@ class Worker:
         if result.refresh_index:
             # the follower's replicated state catches up to the leader's
             # commit; schedulers always refresh from LOCAL state
-            new_state = self.server.fsm.state.snapshot_min_index(result.refresh_index)
+            # (read-only shared view — see _process)
+            new_state = self.server.fsm.state.shared_snapshot_min_index(
+                result.refresh_index
+            )
             self._snapshot_index = new_state.latest_index
             return result, new_state
         return result, None

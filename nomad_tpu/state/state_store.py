@@ -51,6 +51,15 @@ class StateStore:
         # keeps its optimistic snapshot alive across plans while this
         # matches its prediction, instead of re-snapshotting per plan
         self.capacity_epoch = 0
+        # bumps on ALLOC-derived writes only (alloc upserts, client
+        # syncs, dense blocks, eval-GC alloc deletes) — NOT on job or
+        # node writes. (store_id, node_epoch, usage_epoch) keys the
+        # encode layer's whole-eval cache: a burst of job registrations
+        # must not invalidate encodings whose usage inputs are unchanged
+        self.usage_epoch = 0
+        # last snapshot served by shared_snapshot_min_index (read-only
+        # consumers; replaced whenever the live version moves past it)
+        self._shared_snap: Optional["StateStore"] = None
 
         self.nodes_table: Dict[str, Node] = {}
         self.jobs_table: Dict[Tuple[str, str], Job] = {}
@@ -113,6 +122,7 @@ class StateStore:
         d = self.__dict__.copy()
         d.pop("_lock", None)
         d.pop("_cond", None)
+        d.pop("_shared_snap", None)
         d.pop("_dense_by_id", None)
         d.pop("_dense_by_job", None)
         d.pop("_dense_by_node", None)
@@ -132,6 +142,9 @@ class StateStore:
             self.node_epoch = 0
         if "capacity_epoch" not in self.__dict__:
             self.capacity_epoch = 0
+        if "usage_epoch" not in self.__dict__:
+            self.usage_epoch = 0
+        self._shared_snap = None
         # Pickles from pre-mirror builds lack the usage mirror: rebuild it
         # from the alloc table so writes and snapshots keep working.
         # pre-dense snapshots lack the dense tables entirely; fresh ones
@@ -187,6 +200,8 @@ class StateStore:
             snap.store_id = self.store_id
             snap.node_epoch = self.node_epoch
             snap.capacity_epoch = self.capacity_epoch
+            snap.usage_epoch = self.usage_epoch
+            snap._shared_snap = None
             snap.nodes_table = dict(self.nodes_table)
             snap.jobs_table = dict(self.jobs_table)
             snap.job_versions = {k: list(v) for k, v in self.job_versions.items()}
@@ -225,6 +240,14 @@ class StateStore:
             snap._jobs_by_parent = {k: set(v) for k, v in self._jobs_by_parent.items()}
             return snap
 
+    def wait_min_index(self, index: int, timeout: float = 5.0) -> None:
+        """Block until the store has applied ``index`` (no snapshot)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self.latest_index >= index, timeout=timeout):
+                raise TimeoutError(
+                    f"timed out waiting for index {index} (at {self.latest_index})"
+                )
+
     def snapshot_min_index(self, index: int, timeout: float = 5.0) -> "StateStore":
         """Wait until the store has applied ``index`` then snapshot
         (reference state_store.go:114)."""
@@ -234,6 +257,37 @@ class StateStore:
                     f"timed out waiting for index {index} (at {self.latest_index})"
                 )
             return self.snapshot()
+
+    def shared_snapshot_min_index(
+        self, index: int, timeout: float = 5.0
+    ) -> "StateStore":
+        """Read-only variant of ``snapshot_min_index`` that SHARES one
+        snapshot object across callers at the same state version.
+
+        SnapshotMinIndex semantics only require a point-in-time view at
+        or after ``index``; any cached snapshot whose latest_index
+        satisfies that is a valid answer, so a burst of evals at one
+        state version shares ONE table clone instead of cloning per
+        eval (the clone is a pure-GIL cost at C1M eval rates).
+
+        Callers MUST treat the result as read-only — the plan applier,
+        which folds optimistic results into its snapshot, must keep
+        using ``snapshot_min_index``."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self.latest_index >= index, timeout=timeout):
+                raise TimeoutError(
+                    f"timed out waiting for index {index} (at {self.latest_index})"
+                )
+            cached = self._shared_snap
+            # serve the cached view only while it matches the LIVE
+            # version: a fresher-than-requested-but-stale-vs-live view
+            # would be legal, but serving current state keeps scheduling
+            # quality identical to the uncached behavior
+            if cached is not None and cached.latest_index == self.latest_index:
+                return cached
+            snap = self.snapshot()
+            self._shared_snap = snap
+            return snap
 
     def blocking_query(
         self, run: Callable[["StateStore"], object], min_index: int, timeout: float = 60.0
@@ -441,6 +495,7 @@ class StateStore:
                         s.discard(eid)
             if alloc_ids:
                 self.capacity_epoch += 1
+                self.usage_epoch += 1
             for aid in alloc_ids:
                 self._remove_alloc_index(aid)
                 self.allocs_table.pop(aid, None)
@@ -611,6 +666,7 @@ class StateStore:
     def _upsert_allocs_impl(self, index: int, allocs: List[Allocation]) -> None:
         if allocs:
             self.capacity_epoch += 1
+            self.usage_epoch += 1
         for alloc in allocs:
             # Snapshot isolation: copy the alloc, sharing the (immutable) job.
             alloc = alloc.copy_skip_job()
@@ -636,6 +692,7 @@ class StateStore:
         with self._lock:
             if allocs:
                 self.capacity_epoch += 1
+                self.usage_epoch += 1
             flips_by_deployment: Dict[str, List[Tuple[Optional[bool], Allocation]]] = {}
             for client_alloc in allocs:
                 existing = self._existing_alloc(client_alloc.id)
@@ -765,6 +822,28 @@ class StateStore:
             if jid == job_id:
                 out.extend(self._dense_materialize_live(blocks))
         return out
+
+    def job_has_live_allocs(self, job_id: str) -> bool:
+        """Any NON-TERMINAL alloc with this job id in ANY namespace,
+        without materializing dense allocs (the encode-cache freshness
+        guard; job anti-affinity matches job_id alone — rank.go:509).
+        Cost: a key scan over jobs-with-allocs plus O(this job's
+        allocs) — never the O(allocs) object materialization that
+        ``allocs_by_job_id`` performs."""
+        for (_ns, jid), ids in self._allocs_by_job.items():
+            if jid == job_id:
+                for a in ids:
+                    alloc = self.allocs_table.get(a)
+                    if alloc is not None and not alloc.terminal_status():
+                        return True
+        for (_ns, jid), blocks in self._dense_by_job.items():
+            if jid == job_id:
+                for b in blocks:
+                    # a dense slot is non-terminal by construction until
+                    # a table alloc supersedes it
+                    if len(b.ids) > self._dense_dead.get(b.key(), 0):
+                        return True
+        return False
 
     def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
         out = [
@@ -1026,6 +1105,7 @@ class StateStore:
         existing-version handling."""
         block.stamp(index, timestamp_ns)
         self.capacity_epoch += 1
+        self.usage_epoch += 1
         self._dense_blocks.append(block)
         self._index_dense_block(block)
         ask = block.ask_vec

@@ -44,7 +44,15 @@ def node_capacity_vecs(node: Node) -> Tuple[tuple, tuple]:
     """((cpu, mem, disk, mbits) totals, same-shape reserved) for one node
     — the ONE definition of the 4-dim capacity model shared by the encode
     layer's fleet arrays and the plan applier's dense re-check, so the
-    two can never silently diverge."""
+    two can never silently diverge.
+
+    Memoized on the node object: stored nodes are immutable (every write
+    inserts a copy), and the plan applier's dense re-check calls this per
+    touched node per plan — C1M commit rates make the rebuild the
+    dominant applier cost otherwise."""
+    cached = node.__dict__.get("_cap_vecs")
+    if cached is not None:
+        return cached
     nr = node.node_resources
     totals = (
         float(nr.cpu_shares), float(nr.memory_mb), float(nr.disk_mb),
@@ -55,6 +63,7 @@ def node_capacity_vecs(node: Node) -> Tuple[tuple, tuple]:
         (float(rr.cpu_shares), float(rr.memory_mb), float(rr.disk_mb), 0.0)
         if rr is not None else (0.0, 0.0, 0.0, 0.0)
     )
+    node.__dict__["_cap_vecs"] = (totals, reserved)
     return totals, reserved
 
 

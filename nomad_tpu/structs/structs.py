@@ -478,7 +478,11 @@ class Node:
     def copy(self) -> "Node":
         import copy as _copy
 
-        return _copy.deepcopy(self)
+        c = _copy.deepcopy(self)
+        # derived caches (funcs.node_capacity_vecs) must not survive into
+        # a copy whose resources the caller may go on to mutate
+        c.__dict__.pop("_cap_vecs", None)
+        return c
 
     def without_secret(self) -> "Node":
         """Shallow copy with secret_id cleared — what read endpoints

@@ -425,6 +425,7 @@ class DeviceBatcher:
 
     def _run_batch(self, batch: List[_Request]) -> None:
         from ..utils import metrics
+        from ..utils import phases as _phases
 
         t_start = metrics.now()
         encs = [r.enc for r in batch]
@@ -461,6 +462,38 @@ class DeviceBatcher:
         fnd_pad = max(e.xs[9].shape[1] for e in encs)
         dtype = encs[0].dtype  # dispatch loop groups by dtype
 
+        with _phases.track("pad_stack"):
+            static_b, carry_b, xs_b, b, b_pad = self._pad_and_stack(
+                encs, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad,
+                k_pad, aff_pad, evd_pad, fac_pad, dpd_pad, dpv_pad, fnd_pad,
+            )
+
+        scan = self._scan_fn()
+        t_stack = metrics.now()
+        metrics.measure_since("nomad.device_batcher.pad_stack", t_start)
+        with _phases.track("device"):
+            _carry, (chosen, scores, pulls, skipped) = scan(static_b, carry_b, xs_b)
+            chosen = np.asarray(chosen)
+            scores = np.asarray(scores)
+            pulls = np.asarray(pulls)
+            skipped = np.asarray(skipped)
+        metrics.measure_since("nomad.device_batcher.dispatch", t_stack)
+
+        self.stats["dispatches"] += 1
+        self.stats["evals"] += b
+        self.stats["padded_evals"] += b_pad - b
+        self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], b)
+
+        for bi, req in enumerate(batch):
+            p = req.enc.p
+            req.result = (
+                chosen[bi, :p], scores[bi, :p], pulls[bi, :p], skipped[bi, :p]
+            )
+            req.event.set()
+
+    def _pad_and_stack(self, encs, n_pad, g_pad, s_pad, v_pad, p_pad, dtype,
+                       d_pad, k_pad, aff_pad, evd_pad, fac_pad, dpd_pad,
+                       dpv_pad, fnd_pad):
         padded = [
             pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad,
                         k_pad, aff_pad, evd_pad, fac_pad, dpd_pad, dpv_pad,
@@ -508,25 +541,4 @@ class DeviceBatcher:
         xs_b = tuple(
             np.stack([p[2][i] for p in padded]) for i in range(len(padded[0][2]))
         )
-
-        scan = self._scan_fn()
-        t_stack = metrics.now()
-        metrics.measure_since("nomad.device_batcher.pad_stack", t_start)
-        _carry, (chosen, scores, pulls, skipped) = scan(static_b, carry_b, xs_b)
-        chosen = np.asarray(chosen)
-        scores = np.asarray(scores)
-        pulls = np.asarray(pulls)
-        skipped = np.asarray(skipped)
-        metrics.measure_since("nomad.device_batcher.dispatch", t_stack)
-
-        self.stats["dispatches"] += 1
-        self.stats["evals"] += b
-        self.stats["padded_evals"] += b_pad - b
-        self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], b)
-
-        for bi, req in enumerate(batch):
-            p = req.enc.p
-            req.result = (
-                chosen[bi, :p], scores[bi, :p], pulls[bi, :p], skipped[bi, :p]
-            )
-            req.event.set()
+        return static_b, carry_b, xs_b, b, b_pad

@@ -255,6 +255,41 @@ def _distinct_property_arrays(ctx, job: Job, nodes: List[Node]):
 _FLEET_CACHE: Dict[tuple, dict] = {}
 _FLEET_CACHE_MAX = 16
 
+# Job fields that never influence placement encoding: identity, audit
+# stamps and server-maintained status. EVERYTHING else (type, priority,
+# datacenters, constraints/affinities/spreads, task groups, meta) is
+# hashed — two jobs with equal signatures encode to identical arrays
+# against the same fleet/usage state (reference precedent: the
+# scheduler's per-class eligibility memoization keys on constraint
+# content, context.go:191 / feasible.go:778; this extends the idea to
+# the WHOLE per-eval encoding so a fleet of same-shaped jobs — the C1M
+# workload — encodes once, not once per eval).
+_SIG_EXCLUDE = frozenset((
+    "id", "name", "parent_id", "status", "status_description", "stable",
+    "version", "create_index", "modify_index", "job_modify_index",
+    "submit_time", "payload",
+))
+
+
+def job_sched_signature(job: Job) -> bytes:
+    """Content hash of the job's scheduling-relevant fields, cached on
+    the job object (stored jobs are immutable and shared by snapshots,
+    so the hash is computed once per job version)."""
+    sig = job.__dict__.get("_sched_sig")
+    if sig is None:
+        import dataclasses
+        import hashlib
+        import pickle
+
+        d = dataclasses.asdict(job)
+        for k in _SIG_EXCLUDE:
+            d.pop(k, None)
+        sig = hashlib.blake2b(
+            pickle.dumps(d, protocol=4), digest_size=16
+        ).digest()
+        job.__dict__["_sched_sig"] = sig
+    return sig
+
 
 def fleet_static(ctx, job: Job, nodes: List[Node]) -> Optional[dict]:
     """Cached {totals4, reserved4, node_index, class_groups, nodes} for

@@ -59,13 +59,10 @@ logger = logging.getLogger("nomad_tpu.tpu.engine")
 
 MAX_SKIP = 3
 
-# GIL convoy guard: encode/apply are pure-Python (serial under the GIL
-# regardless), so letting hundreds of worker threads enter them at once
-# only buys context-switch thrash. A small bound keeps a few threads in
-# flight (numpy sections release the GIL) without the convoy.
-import threading as _threading
-
-_HOST_WORK_SEM = _threading.BoundedSemaphore(4)
+# GIL convoy guard shared with the scheduler's other host phases
+# (utils/hostwork.py): encode/apply are pure-Python, so letting hundreds
+# of worker threads enter them at once only buys context-switch thrash.
+from ..utils.hostwork import HOST_WORK_SEM as _HOST_WORK_SEM
 
 
 class EncodedEval:
@@ -856,10 +853,13 @@ class TpuPlacementEngine:
             _metrics.incr_counter("nomad.tpu_engine.small_eval_host")
             return NotImplemented
 
+        from ..utils import phases as _phases
+
         t0 = _metrics.now()
         with _HOST_WORK_SEM:
             t1 = _metrics.now()
-            enc = self.encode_eval(sched, destructive, place)
+            with _phases.track("encode"):
+                enc = self.encode_eval(sched, destructive, place)
             _metrics.measure_since("nomad.tpu_engine.encode_work", t1)
         _metrics.measure_since("nomad.tpu_engine.encode", t0)
         if enc is NotImplemented:
@@ -876,17 +876,18 @@ class TpuPlacementEngine:
         t0 = _metrics.now()
         with _HOST_WORK_SEM:
             t1 = _metrics.now()
-            chosen = np.asarray(chosen)
-            skipped_steps = np.asarray(skipped_steps)
-            if enc.dense_ok and (chosen >= 0).all() and not skipped_steps.any():
-                # every placement succeeded and qualifies: results stay
-                # dense (no per-alloc objects) all the way to the FSM
-                self._apply_results_dense(sched, enc, chosen, scores, pulls)
-            else:
-                self._apply_results(
-                    sched, enc.missing_list, enc.nodes, enc.table, chosen,
-                    scores, pulls, skipped_steps, enc.start_ns,
-                )
+            with _phases.track("apply"):
+                chosen = np.asarray(chosen)
+                skipped_steps = np.asarray(skipped_steps)
+                if enc.dense_ok and (chosen >= 0).all() and not skipped_steps.any():
+                    # every placement succeeded and qualifies: results stay
+                    # dense (no per-alloc objects) all the way to the FSM
+                    self._apply_results_dense(sched, enc, chosen, scores, pulls)
+                else:
+                    self._apply_results(
+                        sched, enc.missing_list, enc.nodes, enc.table, chosen,
+                        scores, pulls, skipped_steps, enc.start_ns,
+                    )
             _metrics.measure_since("nomad.tpu_engine.apply_work", t1)
         _metrics.measure_since("nomad.tpu_engine.apply", t0)
         return True
@@ -942,19 +943,68 @@ class TpuPlacementEngine:
                     _dense_tg_cache[tg.name] = tg_ok
                 dense_ok = tg_ok
 
+        # Build TG specs (may refuse). The per-node NetworkIndex cache is
+        # shared across this eval's TGs (port-feasibility masks); the
+        # fleet-static cache (encode.fleet_static) shares totals/index/
+        # class-group arrays across every eval between node writes.
+        from .encode import fleet_static, job_sched_signature
+
+        fleet = fleet_static(ctx, job, nodes)
+
+        # Whole-eval encode cache (VERDICT r4 #1/#4): a burst of
+        # same-shaped fresh jobs (the C1M workload — hundreds of
+        # identical service jobs) re-derives identical arrays per eval,
+        # and that re-derivation is the dominant GIL-serialized phase.
+        # When every per-eval input is provably default — all placements
+        # fresh (dense_ok), empty plan, clean shared spread/limit state,
+        # no existing allocs of this job — the encoded arrays depend
+        # only on (job content, fleet, usage state); reuse them
+        # wholesale, swapping the per-eval ring offset and host context.
+        # Extends the reference's per-class eligibility memoization
+        # (scheduler/context.go:191) to the whole encoding.
+        enc_cache = None
+        cache_key = None
+        if fleet is not None and dense_ok and not destructive:
+            plan = ctx.plan
+            spread_state = sched.stack.spread
+            if (
+                not plan.node_allocation and not plan.node_update
+                and not plan.node_preemptions
+                and not spread_state.tg_spread_info
+                and float(spread_state.sum_spread_weights) == 0.0
+                and not ctx.state.job_has_live_allocs(job.id)
+            ):
+                enc_cache = fleet.setdefault("enc_cache", {})
+                cache_key = (
+                    job_sched_signature(job),
+                    getattr(ctx.state, "usage_epoch", -1),
+                    len(missing_list),
+                    tuple(m.get_task_group().name for m in missing_list),
+                )
+                hit = enc_cache.get(cache_key)
+                if hit is not None:
+                    _metrics.incr_counter("nomad.tpu_engine.encode_cache_hit")
+                    _metrics.incr_counter("nomad.tpu_engine.handled")
+                    offset0 = (
+                        int(getattr(sched.stack.source, "offset", 0))
+                        % max(n_real, 1)
+                    )
+                    carry = list(hit.carry)
+                    carry[5] = np.int32(offset0)
+                    return EncodedEval(
+                        n_real=hit.n_real, n_pad=hit.n_pad, g=hit.g,
+                        s=hit.s, v=hit.v, p=hit.p, dtype=hit.dtype,
+                        static=hit.static, carry=tuple(carry), xs=hit.xs,
+                        missing_list=missing_list, nodes=nodes,
+                        table=hit.table, start_ns=_time.monotonic_ns(),
+                        dense_ok=True,
+                    )
+
         # The capacity model tracks one aggregate bandwidth dimension; the
         # host checks per NIC. Gate multi-NIC nodes to keep parity.
         for node in nodes:
             if len({net.device for net in node.node_resources.networks if net.device}) > 1:
                 return fallback("multi-NIC node")
-
-        # Build TG specs (may refuse). The per-node NetworkIndex cache is
-        # shared across this eval's TGs (port-feasibility masks); the
-        # fleet-static cache (encode.fleet_static) shares totals/index/
-        # class-group arrays across every eval between node writes.
-        from .encode import fleet_static
-
-        fleet = fleet_static(ctx, job, nodes)
         tg_specs: Dict[str, TGSpec] = {}
         port_cache: Dict[str, object] = {}
         try:
@@ -1236,12 +1286,22 @@ class TpuPlacementEngine:
             np.zeros((p, 0), np.int32),
         )
 
-        return EncodedEval(
+        enc = EncodedEval(
             n_real=n_real, n_pad=n_pad, g=g_count, s=sv, v=vv, p=p,
             dtype=fdtype, static=static, carry=init_carry, xs=xs,
             missing_list=missing_list, nodes=nodes, table=table,
             start_ns=start, dense_ok=dense_ok,
         )
+        if enc_cache is not None and cache_key is not None:
+            # arrays are read-only downstream (the batcher pads into
+            # fresh buffers; apply only reads); a later hit swaps the
+            # ring offset and host context
+            if len(enc_cache) >= 32:
+                # concurrent encoders (HOST_WORK_SEM admits several) may
+                # race to evict the same oldest key — default-pop
+                enc_cache.pop(next(iter(enc_cache)), None)
+            enc_cache[cache_key] = enc
+        return enc
 
     def run_scan_single(self, enc: "EncodedEval"):
         """Run one encoded eval through the single-eval jit'd scan."""

@@ -1,0 +1,20 @@
+"""GIL convoy guard shared by the scheduling pipeline's host phases.
+
+Pure-Python phases (reconcile, encode, result apply, snapshot copies)
+are serial under the GIL regardless of thread count; letting hundreds of
+worker threads enter them at once only buys context-switch thrash — the
+measured inflation is ~3x at 256+ workers. A small bound keeps a few
+threads in flight (numpy sections release the GIL) while the rest park
+on the semaphore, where they cost nothing.
+
+One SHARED semaphore across phases, not one per phase: the point is to
+cap the number of RUNNABLE threads in the whole process, and a worker
+holds it only for bounded, non-blocking sections (never across a device
+dispatch or a plan-queue wait — that would deadlock the batch gather,
+which needs every co-batched worker to reach the batcher).
+"""
+from __future__ import annotations
+
+import threading
+
+HOST_WORK_SEM = threading.BoundedSemaphore(4)

@@ -29,6 +29,9 @@ from nomad_tpu.structs.structs import (
 )
 
 
+PLAN_APPLY_OPS = ("apply-plan-results", "apply-plan-results-batch")
+
+
 class SlowRaft(InProcRaft):
     """Delays plan applies to widen the apply window; records timings."""
 
@@ -36,11 +39,13 @@ class SlowRaft(InProcRaft):
         super().__init__()
         self.delay = delay
         self.apply_windows = []  # (start, end) per plan apply
+        self.apply_started = threading.Event()
         self._tlock = threading.Lock()
 
     def apply(self, peer, entry_type, payload):
-        if entry_type == "apply-plan-results":
+        if entry_type in PLAN_APPLY_OPS:
             start = time.monotonic()
+            self.apply_started.set()
             time.sleep(self.delay)
             out = super().apply(peer, entry_type, payload)
             with self._tlock:
@@ -100,11 +105,17 @@ class TestPipelinedApply:
         try:
             jobs = [mock.job(), mock.job()]
             pendings = []
+            # stagger arrivals: plan 2 lands while plan 1's apply is in
+            # flight, so it forms a second batch whose evaluation must
+            # overlap that apply (the applier batches same-time arrivals
+            # into one raft entry, which would make "overlap" vacuous)
             for i, job in enumerate(jobs):
                 plan = Plan(eval_id=f"e{i}", priority=50, job=job)
                 alloc = make_alloc(job, node.id, cpu=100, mem=64, name_idx=i)
                 plan.node_allocation = {node.id: [alloc]}
                 pendings.append(queue.enqueue(plan))
+                if i == 0:
+                    assert raft.apply_started.wait(timeout=10)
 
             results = [p.future.result(timeout=10) for p in pendings]
             assert all(r.node_allocation for r in results)
@@ -270,8 +281,9 @@ class TestPipelinedApply:
                 self.failed_once = False
 
             def apply(self, peer, entry_type, payload):
-                if entry_type == "apply-plan-results" and not self.failed_once:
+                if entry_type in PLAN_APPLY_OPS and not self.failed_once:
                     self.failed_once = True
+                    self.apply_started.set()
                     time.sleep(self.delay)
                     raise RuntimeError("injected apply failure")
                 return super().apply(peer, entry_type, payload)
@@ -302,6 +314,9 @@ class TestPipelinedApply:
                 node.id: [make_alloc(job_b, node.id, cpu=700, mem=700)]
             }
             pa = queue.enqueue(plan_a)
+            # B arrives while A's (failing) apply is in flight — a later
+            # batch, so only A is poisoned by the injected failure
+            assert raft.apply_started.wait(timeout=10)
             pb = queue.enqueue(plan_b)
             with pytest.raises(Exception):
                 pa.future.result(timeout=10)
