@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 
 import numpy as np
@@ -206,7 +207,8 @@ def bench_parity_scan_single(n_nodes=5000, n_placements=10_000):
 def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                  timeout=180.0, node_seed=0, warmup=None,
                  node_factory=None, expected=None, done=None,
-                 deterministic=False, window_ms=25.0, idle_ms=0.0):
+                 deterministic=False, window_ms=25.0, idle_ms=0.0,
+                 device_min_placements=24, tranches=0):
     """Run ``jobs`` through a real in-proc server; returns metrics dict.
 
     ``workers`` is 2x the device batch so the next wave encodes while the
@@ -226,6 +228,7 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
         num_schedulers=0, device_batch=device_batch,
         device_batch_window_ms=window_ms, device_batch_idle_ms=idle_ms,
         deterministic=deterministic,
+        device_min_placements=device_min_placements,
         heartbeat_min_ttl=3600, heartbeat_max_ttl=7200,
     ))
     server.start()
@@ -252,22 +255,34 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             w.start()
 
         if warmup is not None:
-            wjob = warmup()
-            server.register_job(wjob)
+            wjobs = warmup()
+            if not isinstance(wjobs, list):
+                wjobs = [wjobs]
+            for wjob in wjobs:
+                server.register_job(wjob)
             deadline = time.perf_counter() + 120
             def warm_done():
-                allocs = server.fsm.state.allocs_by_job("default", wjob.id, True)
-                return sum(1 for a in allocs if a.desired_status == "run") \
-                    >= sum(tg.count for tg in wjob.task_groups)
+                for wjob in wjobs:
+                    allocs = server.fsm.state.allocs_by_job(
+                        "default", wjob.id, True)
+                    if sum(1 for a in allocs if a.desired_status == "run") \
+                            < sum(tg.count for tg in wjob.task_groups):
+                        return False
+                return True
             while time.perf_counter() < deadline and not warm_done():
                 time.sleep(0.05)
-            server.deregister_job("default", wjob.id, purge=False)
-            # wait until the stop eval actually lands: lingering warmup
+            for wjob in wjobs:
+                server.deregister_job("default", wjob.id, purge=False)
+            # wait until the stop evals actually land: lingering warmup
             # allocs would both hold capacity and pollute placed()
             deadline = time.perf_counter() + 60
             def warm_stopped():
-                allocs = server.fsm.state.allocs_by_job("default", wjob.id, True)
-                return all(a.desired_status != "run" for a in allocs)
+                for wjob in wjobs:
+                    allocs = server.fsm.state.allocs_by_job(
+                        "default", wjob.id, True)
+                    if any(a.desired_status == "run" for a in allocs):
+                        return False
+                return True
             while time.perf_counter() < deadline and not warm_stopped():
                 time.sleep(0.05)
             for w in server.workers:
@@ -284,15 +299,49 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
         phases.enable()
         p_t0 = phases.now()
         t0 = time.perf_counter()
-        with phases.track("register"):
-            for job in jobs:
-                server.register_job(job)
 
         def placed():
             # O(table + blocks): never materializes dense allocs — a
             # 50ms poll over state.allocs() would fight the workers for
             # the GIL and depress the number being measured
             return server.fsm.state.count_allocs_desired_run()
+
+        if tranches and tranches > 1:
+            # SUSTAINED ingest (the C1M challenge scheduled its million
+            # containers as a continuous stream, not one atomic burst):
+            # submit the job list in ``tranches`` groups, releasing the
+            # next once the previous is ~placed. Keeps optimistic-
+            # concurrency collision cohorts at tranche size — a big-bang
+            # submission of ~1K evals makes every same-epoch eval replay
+            # a near-identical greedy trajectory once score ties thin
+            # out, and the rejected fraction cascades into retry storms
+            # (measured: >50% of placements at 1M). The registration
+            # thread streams during the timed window; the wall clock
+            # covers full convergence of every tranche.
+            per = (len(jobs) + tranches - 1) // tranches
+            groups = [jobs[i:i + per] for i in range(0, len(jobs), per)]
+
+            def feeder():
+                cum = 0
+                for gi, group in enumerate(groups):
+                    with phases.track("register"):
+                        for job in group:
+                            server.register_job(job)
+                    cum += sum(
+                        tg.count for job in group for tg in job.task_groups
+                    )
+                    gate = cum - max(50, cum // 100)  # ~99% settle gate
+                    g_deadline = time.perf_counter() + timeout
+                    while (placed() < gate
+                           and time.perf_counter() < g_deadline):
+                        time.sleep(0.02)
+
+            feeder_t = threading.Thread(target=feeder, daemon=True)
+            feeder_t.start()
+        else:
+            with phases.track("register"):
+                for job in jobs:
+                    server.register_job(job)
 
         deadline = time.perf_counter() + timeout
         finished = done if done is not None else (
@@ -301,7 +350,9 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
         while time.perf_counter() < deadline:
             if finished(server) and server.plan_queue.stats()["depth"] == 0:
                 break
-            time.sleep(0.05)
+            # 5ms poll: the completion check is O(table); at 50ms the poll
+            # granularity itself dominates sub-second configs
+            time.sleep(0.005)
         elapsed = time.perf_counter() - t0
         phase_shares = phases.wall_shares(p_t0, phases.now())
         phases.disable()
@@ -329,35 +380,101 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
         server.stop()
 
 
-def bench_c1m_system():
-    """The HEADLINE: C1M replay through the full system on one chip.
-
-    256 service jobs x 1000 identical containers (the C1M challenge
-    scheduled large batches of identical simple containers) over 5K
-    heterogeneous nodes = 256K placements; deterministic int-spec
-    scoring with per-eval ring decorrelation; ONE eval-batched device
-    dispatch carries all 256 evals (the gather window covers the
-    GIL-serialized encode phase); placements flow as dense arrays
-    through plan apply and the FSM."""
+def c1m_mixed_jobs(total=1_000_000):
+    """BASELINE config 5 AS WRITTEN (BASELINE.md line 30): mixed
+    service+batch, heterogeneous asks and counts, affinity+spread
+    stanzas on a meaningful fraction, 1M ACTUAL placements over 5K
+    nodes, the full rank stack (the stack the reference always runs,
+    scheduler/stack_oss.go:6-81: job anti-affinity, spread, affinity,
+    binpack, limit). 40 job templates — 28 service (10 with
+    spread+affinity stanzas, ~25%% of jobs) + 12 batch — instantiated
+    round-robin until the placement count is exactly ``total``.
+    Capacity is fleet-scale (~30%% util at 1M), matching the C1M
+    challenge's 1M-containers-on-5K-hosts shape."""
     from nomad_tpu import mock
+    from nomad_tpu.structs import Affinity, Spread, SpreadTarget
     from nomad_tpu.structs.structs import Resources
 
-    def dense_job(job_id, count):
-        j = mock.job()
+    cpus = [8, 12, 16, 20]
+    mems = [16, 24, 32, 48]
+    counts_svc = [900, 950, 1000]   # all pad into the p=1024 scan bucket
+    counts_batch = [950, 1000]
+    templates = []
+    for t in range(28):
+        templates.append(dict(
+            kind="service", cpu=cpus[t % 4], mem=mems[(t // 4) % 4],
+            count=counts_svc[t % 3], stanzas=t < 10,
+        ))
+    for t in range(12):
+        templates.append(dict(
+            kind="batch", cpu=cpus[t % 4], mem=mems[t % 4],
+            count=counts_batch[t % 2], stanzas=False,
+        ))
+
+    def mk_job(tpl, job_id, count):
+        j = mock.job() if tpl["kind"] == "service" else mock.batch_job()
         j.id = job_id
-        j.task_groups[0].count = count
-        j.task_groups[0].tasks[0].resources = Resources(cpu=15, memory_mb=30)
+        tg = j.task_groups[0]
+        tg.count = count
+        tg.ephemeral_disk.size_mb = 50
+        tg.tasks[0].resources = Resources(cpu=tpl["cpu"], memory_mb=tpl["mem"])
+        if tpl["stanzas"]:
+            tg.spreads = [Spread(
+                attribute="${node.datacenter}", weight=50,
+                spread_target=[SpreadTarget(value="dc1", percent=100)],
+            )]
+            tg.affinities = [Affinity(
+                ltarget="${attr.kernel.name}", rtarget="linux",
+                operand="=", weight=50,
+            )]
         return j
 
-    jobs = [dense_job(f"c1m-{i}", 1000) for i in range(256)]
+    jobs = []
+    placed = 0
+    i = 0
+    while placed < total:
+        tpl = templates[i % len(templates)]
+        count = min(tpl["count"], total - placed)
+        jobs.append(mk_job(tpl, f"c1m-{i}", count))
+        placed += count
+        i += 1
+    return jobs, templates, mk_job
 
-    # adaptive gather: the batch keeps growing while the GIL-serialized
-    # encode phase trickles submissions in (inter-arrival well under the
-    # idle gap); window_ms is only the safety cap, not a tuned constant
+
+def bench_c1m_system():
+    """The HEADLINE: BASELINE config 5 replayed IN FULL through the real
+    system on one chip — 1M actual placements (no extrapolating from a
+    smaller run), mixed service+batch with heterogeneous asks/counts and
+    spread+affinity stanzas on ~25%% of jobs, over 5K heterogeneous
+    nodes; deterministic int-spec scoring with per-eval ring
+    decorrelation; ~1K evals ride eval-batched device dispatches (the
+    adaptive gather covers the single-flight encode phase); placements
+    flow as dense arrays through plan apply and the FSM. The JSON's
+    ``phases`` record the measured wall share of every pipeline phase —
+    the v5e-8 extrapolation in main() is computed from THOSE, not from
+    an assumed per-chip proration."""
+    jobs, templates, mk_job = c1m_mixed_jobs()
+
+    def _warm():
+        # one warm job per compiled SHAPE the measured run produces:
+        # plain evals and spread+affinity evals (whose union shape also
+        # covers mixed co-batched dispatches); prewarm compiles their
+        # batch-bucket siblings before the timed window
+        plain = mk_job(templates[12], "warm-plain", templates[12]["count"])
+        stanza = mk_job(templates[0], "warm-stanza", templates[0]["count"])
+        return [plain, stanza]
+
+    # Sustained 16-tranche ingest (see bench_system): tranche-sized
+    # collision cohorts keep the optimistic-concurrency rejection rate
+    # near zero, every dispatch rides the warm (b=64, p=1024) compile
+    # bucket, and the wall covers full convergence of all 1M
+    # placements. Rare partial retries under 600 placements take the
+    # host iterator stack rather than minting fresh compile buckets
+    # mid-run.
     return bench_system(
-        "c1m-system", 5000, jobs, workers=288, device_batch=256,
-        timeout=240.0, deterministic=True, window_ms=15000.0, idle_ms=600.0,
-        warmup=lambda: dense_job("warm-c1m", 1000),
+        "c1m-mixed-1M", 5000, jobs, workers=64, device_batch=64,
+        timeout=600.0, deterministic=True, window_ms=15000.0, idle_ms=600.0,
+        warmup=_warm, device_min_placements=600, tranches=16,
     )
 
 
@@ -535,8 +652,12 @@ def system_benches():
     def _spread_warm():
         return _spread_job("warm-spread")
 
+    # adaptive idle-gap gather: the 10-eval burst rides 1-2 dispatches;
+    # the wall here is dominated by per-dispatch device RTT on the
+    # tunneled chip (see phases in the JSON), not host work — the
+    # single-flight encode cache collapses the per-eval encode
     r = _diagnostic(bench_system, "service-spread-5K", 5000, jobs, timeout=300.0,
-                    warmup=_spread_warm)
+                    idle_ms=100.0, window_ms=2000.0, warmup=_spread_warm)
     if r:
         results.append(r)
 
@@ -595,15 +716,25 @@ def system_benches():
         )
 
     def _sys_warm():
-        # same TG/placement shape as sys-low so the forced-node scan's
-        # compile buckets load outside the timed window (per-process
-        # first-use of a cached executable still costs seconds)
-        j = mock.system_job()
-        j.id = "warm-sys"
-        j.priority = 10
-        j.task_groups[0].tasks[0].resources.cpu = 100
-        j.task_groups[0].tasks[0].resources.memory_mb = 64
-        return j
+        # one warm job per MEASURED EVAL SHAPE: sys-low encodes without
+        # device dims, sys-high with the gpu dims — each is its own
+        # forced-kernel compile bucket, and both must load outside the
+        # timed window (per-process first-use of a cached executable
+        # still costs seconds)
+        plain = mock.system_job()
+        plain.id = "warm-sys"
+        plain.priority = 10
+        plain.task_groups[0].tasks[0].resources.cpu = 100
+        plain.task_groups[0].tasks[0].resources.memory_mb = 64
+        dev = mock.system_job()
+        dev.id = "warm-sys-dev"
+        dev.priority = 10
+        dev.task_groups[0].tasks[0].resources.cpu = 100
+        dev.task_groups[0].tasks[0].resources.memory_mb = 64
+        dev.task_groups[0].tasks[0].resources.devices = [
+            RequestedDevice(name="gpu", count=1)
+        ]
+        return [plain, dev]
 
     # steady state: every node holds exactly one alloc (high on the GPU
     # nodes after preempting low, low on the rest)
@@ -649,24 +780,55 @@ def main():
     if kernel_rate:
         log(f"kernel-rate / system-rate gap: {kernel_rate / rate:,.1f}x")
 
-    # The BASELINE bar (1M in <10s = 100K placements/s) is stated for TPU
-    # v5e-8; this bench runs on ONE chip, so compare against the per-chip
-    # share of the bar. The eval axis is embarrassingly parallel across
-    # chips (dryrun_multichip executes the sharded dispatch).
-    baseline_per_chip = 100_000.0 / 8.0
+    # The BASELINE bar is 1M placements in <10s on TPU v5e-8. The
+    # headline above ran the FULL 1M on ONE chip; extrapolate to 8 chips
+    # from the MEASURED phase wall-shares (VERDICT r4 ask #1), not an
+    # assumed per-chip proration: the device phase (eval-batched scan —
+    # the eval axis shards across chips with zero cross-chip traffic;
+    # dryrun_multichip executes that sharding) divides by 8, every
+    # host-side second (GIL-serialized encode/plan/FSM plus untracked
+    # wall) is conservatively kept AS IS. vs_baseline = 10s / t_v5e8.
+    phases = headline.get("phases", {})
+    wall = headline.get("wall_s", 0.0) or 0.0
+    placements = headline.get("placements", 0)
+    dev_share = min(phases.get("device", 0.0), wall)
+    if wall > 0 and placements > 0:
+        t1m_single = wall * (1_000_000 / placements)
+        dev_1m = dev_share * (1_000_000 / placements)
+        t_v5e8 = (t1m_single - dev_1m) + dev_1m / 8.0
+        vs_baseline = 10.0 / t_v5e8
+    else:
+        t_v5e8 = None
+        vs_baseline = 0.0
+    if t_v5e8 is not None:
+        log(
+            f"v5e-8 extrapolation from measured phases: 1M in {t_v5e8:.2f}s "
+            f"(host {t1m_single - dev_1m:.2f}s held serial + device "
+            f"{dev_1m:.2f}s / 8) -> vs_baseline {vs_baseline:.3f} against "
+            "the <10s bar"
+        )
     print(
         json.dumps(
             {
                 "metric": (
-                    "C1M replay END-TO-END: identical containers through "
-                    "broker/workers/engine/plan-queue/FSM, 5K nodes, exact "
-                    "int-spec scoring, single chip (bar prorated from v5e-8)"
+                    "BASELINE config 5 AS WRITTEN, end-to-end: 1M actual "
+                    "placements, mixed service+batch, heterogeneous asks/"
+                    "counts, spread+affinity stanzas on ~25% of jobs, full "
+                    "rank stack, 5K nodes, exact int-spec scoring, single "
+                    "chip; vs_baseline = 10s bar / v5e-8 time extrapolated "
+                    "from MEASURED phases (device/8, host kept serial)"
                 ),
                 "value": round(rate, 1),
                 "unit": "placements/s",
-                "vs_baseline": round(rate / baseline_per_chip, 4),
+                "vs_baseline": round(vs_baseline, 4),
                 "extra": {
                     "headline_config": headline,
+                    "v5e8_extrapolation_s": (
+                        round(t_v5e8, 2) if t_v5e8 is not None else None
+                    ),
+                    "extrapolation_model": (
+                        "t = host_wall(serial, measured) + device_wall/8"
+                    ),
                     "kernel_placements_per_s": round(kernel_rate or 0.0, 1),
                     "plan_queue_drain_10k_nodes": drain,
                     "system_configs": sys_results,

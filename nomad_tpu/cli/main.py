@@ -105,7 +105,7 @@ _VALUE_FLAGS = {
     "rpc-port", "serf-port", "retry-join", "bootstrap-expect", "data-dir",
     "servers", "encrypt", "authoritative-region", "replication-token",
     "host-volume", "peer-id", "group", "log-level", "install", "use",
-    "remove", "min-quorum",
+    "remove", "min-quorum", "t",
 }
 
 
@@ -126,6 +126,24 @@ def _apply_global_flags(ctx: Ctx, flags: Dict[str, str]) -> None:
 
 def _truthy(flags: Dict[str, str], name: str) -> bool:
     return flags.get(name, "").lower() in ("true", "1", "yes")
+
+
+def _formatted(ctx: Ctx, flags: Dict[str, str], data) -> bool:
+    """Shared -json / -t short-circuit for status commands (reference
+    command/data_format.go:76 Format, used by ~all status commands):
+    True when machine-readable output was emitted and the command should
+    skip its human rendering."""
+    use_json = _truthy(flags, "json")
+    tmpl = flags.get("t", "")
+    if not use_json and not tmpl:
+        return False
+    from .data_format import FormatError, format_data
+
+    try:
+        ctx.out(format_data(use_json, tmpl, data))
+    except FormatError as e:
+        raise CLIError(str(e))
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -355,10 +373,12 @@ def cmd_job_plan(ctx: Ctx, args: List[str]) -> int:
 
 
 def cmd_job_status(ctx: Ctx, args: List[str]) -> int:
-    _, rest = _split_flags(args)
+    flags, rest = _split_flags(args)
     c = ctx.client
     if not rest:
         jobs, _ = c.jobs.list()
+        if _formatted(ctx, flags, jobs or []):
+            return 0
         if not jobs:
             ctx.out("No running jobs")
             return 0
@@ -369,6 +389,8 @@ def cmd_job_status(ctx: Ctx, args: List[str]) -> int:
         return 0
     job_id = rest[0]
     job, _ = c.jobs.info(job_id)
+    if _formatted(ctx, flags, job):
+        return 0
     summary, _ = c.jobs.summary(job_id)
     ctx.out(kv([
         ("ID", job["ID"]),
@@ -417,10 +439,12 @@ def cmd_job_stop(ctx: Ctx, args: List[str]) -> int:
 
 
 def cmd_job_history(ctx: Ctx, args: List[str]) -> int:
-    _, rest = _split_flags(args)
+    flags, rest = _split_flags(args)
     if not rest:
         raise CLIError("usage: nomad job history <job>")
     versions, _ = ctx.client.jobs.versions(rest[0])
+    if _formatted(ctx, flags, versions or []):
+        return 0
     for v in versions or []:
         ctx.out(kv([
             ("Version", v.get("Version", 0)),
@@ -548,10 +572,12 @@ def cmd_job_eval(ctx: Ctx, args: List[str]) -> int:
 
 def cmd_job_deployments(ctx: Ctx, args: List[str]) -> int:
     """Reference command/job_deployments.go."""
-    _, rest = _split_flags(args)
+    flags, rest = _split_flags(args)
     if not rest:
         raise CLIError("usage: nomad job deployments <job>")
     deps, _ = ctx.client.jobs.deployments(rest[0])
+    if _formatted(ctx, flags, deps or []):
+        return 0
     if not deps:
         ctx.out("No deployments found")
         return 0
@@ -628,6 +654,8 @@ def cmd_node_status(ctx: Ctx, args: List[str]) -> int:
     c = ctx.client
     if not rest:
         nodes, _ = c.nodes.list()
+        if _formatted(ctx, flags, nodes or []):
+            return 0
         rows = [["ID", "DC", "Name", "Class", "Drain", "Eligibility", "Status"]]
         for n in nodes or []:
             rows.append([
@@ -638,6 +666,8 @@ def cmd_node_status(ctx: Ctx, args: List[str]) -> int:
         ctx.out(columns(rows))
         return 0
     node, _ = c.nodes.info(_resolve_node(ctx, rest[0]))
+    if _formatted(ctx, flags, node):
+        return 0
     ctx.out(kv([
         ("ID", node["ID"]),
         ("Name", node.get("Name", "")),
@@ -927,14 +957,16 @@ def cmd_alloc_stop(ctx: Ctx, args: List[str]) -> int:
 
 
 def cmd_alloc_status(ctx: Ctx, args: List[str]) -> int:
-    _, rest = _split_flags(args)
+    flags, rest = _split_flags(args)
     if not rest:
-        raise CLIError("usage: nomad alloc status <alloc-id>")
+        raise CLIError("usage: nomad alloc status [-json] [-t <tmpl>] <alloc-id>")
     allocs, _ = ctx.client.allocations.list(QueryOptions(prefix=rest[0]))
     matches = [a for a in allocs or [] if a["ID"].startswith(rest[0])]
     if len(matches) != 1:
         raise CLIError(f"prefix {rest[0]!r} matched {len(matches)} allocations")
     alloc, _ = ctx.client.allocations.info(matches[0]["ID"])
+    if _formatted(ctx, flags, alloc):
+        return 0
     ctx.out(kv([
         ("ID", alloc["ID"]),
         ("Eval ID", short_id(alloc.get("EvalID", ""))),
@@ -967,14 +999,16 @@ def cmd_alloc_status(ctx: Ctx, args: List[str]) -> int:
 
 
 def cmd_eval_status(ctx: Ctx, args: List[str]) -> int:
-    _, rest = _split_flags(args)
+    flags, rest = _split_flags(args)
     if not rest:
-        raise CLIError("usage: nomad eval status <eval-id>")
+        raise CLIError("usage: nomad eval status [-json] [-t <tmpl>] <eval-id>")
     evals, _ = ctx.client.evaluations.list(QueryOptions(prefix=rest[0]))
     matches = [e for e in evals or [] if e["ID"].startswith(rest[0])]
     if len(matches) != 1:
         raise CLIError(f"prefix {rest[0]!r} matched {len(matches)} evaluations")
     ev, _ = ctx.client.evaluations.info(matches[0]["ID"])
+    if _formatted(ctx, flags, ev):
+        return 0
     ctx.out(kv([
         ("ID", ev["ID"]),
         ("Status", ev.get("Status", "")),
@@ -989,7 +1023,10 @@ def cmd_eval_status(ctx: Ctx, args: List[str]) -> int:
 
 def cmd_deployment(ctx: Ctx, args: List[str]) -> int:
     def dlist(ctx, a):
+        flags, _rest = _split_flags(a)
         deps, _ = ctx.client.deployments.list()
+        if _formatted(ctx, flags, deps or []):
+            return 0
         rows = [["ID", "Job ID", "Job Version", "Status", "Description"]]
         for d in deps or []:
             rows.append([
@@ -1007,10 +1044,12 @@ def cmd_deployment(ctx: Ctx, args: List[str]) -> int:
         return matches[0]["ID"]
 
     def dstatus(ctx, a):
-        _, rest = _split_flags(a)
+        flags, rest = _split_flags(a)
         if not rest:
-            raise CLIError("usage: nomad deployment status <id>")
+            raise CLIError("usage: nomad deployment status [-json] [-t <tmpl>] <id>")
         d, _ = ctx.client.deployments.info(_resolve(ctx, rest[0]))
+        if _formatted(ctx, flags, d):
+            return 0
         ctx.out(kv([
             ("ID", d["ID"]),
             ("Job ID", d.get("JobID", "")),
@@ -1293,7 +1332,10 @@ def cmd_system(ctx: Ctx, args: List[str]) -> int:
 
 def cmd_server(ctx: Ctx, args: List[str]) -> int:
     def members(ctx, a):
+        flags, _rest = _split_flags(a)
         out = ctx.client.agent.members()
+        if _formatted(ctx, flags, out.get("Members") or []):
+            return 0
         rows = [["Name", "Address", "Port", "Status", "Leader", "Region"]]
         for m in out.get("Members") or []:
             rows.append([

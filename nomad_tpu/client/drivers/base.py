@@ -101,7 +101,7 @@ class DriverError(Exception):
     pass
 
 
-def open_task_output(path: str, timeout: float = 10.0):
+def open_task_output(path: str, timeout: float = 30.0):
     """Open a task output path for append. Logmon paths are FIFOs: wait
     for the reader with a deadline instead of blocking forever (a dead
     logmon must fail the start, not hang the task runner), then clear

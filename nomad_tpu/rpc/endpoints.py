@@ -68,6 +68,17 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Job.GetJobVersions",
                  lambda ns, job_id: state.job_versions.get((ns, job_id), []))
     rpc.register("Job.Summary", state.job_summary)
+    # write endpoints the HTTP agent reaches through leader_forward when
+    # serving on a follower (reference job_endpoint.go Evaluate/Dispatch/
+    # Revert/Stable, alloc_endpoint.go Stop, node_endpoint.go Evaluate,
+    # core GC trigger)
+    rpc.register("Job.Evaluate", server.evaluate_job)
+    rpc.register("Job.Dispatch", server.dispatch_job)
+    rpc.register("Job.Revert", server.revert_job)
+    rpc.register("Job.Stability", server.set_job_stability)
+    rpc.register("Alloc.Stop", server.stop_alloc)
+    rpc.register("Node.Evaluate", server.create_node_evals)
+    rpc.register("System.GC", server.force_gc)
 
     # -- Eval ----------------------------------------------------------
     rpc.register("Eval.GetEval", state.eval_by_id)

@@ -11,6 +11,8 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..structs.funcs import remove_allocs
 from ..structs.network import NetworkIndex
 from ..structs.structs import (
@@ -94,6 +96,20 @@ def filter_and_group_preemptible_allocs(
     return sorted(by_priority.items(), key=lambda kv: kv[0])
 
 
+def _distance_vec(ask: ComparableResources, used: np.ndarray) -> np.ndarray:
+    """``basic_resource_distance`` over the candidate axis: ``used`` is an
+    (n, 3) float64 tensor of [cpu, mem, disk]. Same IEEE-double ops in the
+    same order as the scalar form, so results are bit-identical."""
+    a_cpu = ask.flattened.cpu_shares
+    a_mem = ask.flattened.memory_mb
+    a_disk = ask.shared.disk_mb
+    zero = np.zeros(used.shape[0])
+    mem = (a_mem - used[:, 1]) / float(a_mem) if a_mem > 0 else zero
+    cpu = (a_cpu - used[:, 0]) / float(a_cpu) if a_cpu > 0 else zero
+    disk = (a_disk - used[:, 2]) / float(a_disk) if a_disk > 0 else zero
+    return np.sqrt(mem * mem + cpu * cpu + disk * disk)
+
+
 class _AllocInfo:
     __slots__ = ("max_parallel", "resources")
 
@@ -149,6 +165,27 @@ class Preemptor:
             alloc.task_group, 0
         )
 
+    def _group_score_arrays(self, grp: List[Allocation]):
+        """Static per-candidate score inputs: (n, 3) used-resource tensor
+        + max_parallel penalty vector (both constant across greedy rounds)."""
+        n = len(grp)
+        used = np.empty((n, 3), np.float64)
+        penalty = np.empty(n, np.float64)
+        for i, alloc in enumerate(grp):
+            details = self.alloc_details[alloc.id]
+            r = details.resources
+            used[i, 0] = r.flattened.cpu_shares
+            used[i, 1] = r.flattened.memory_mb
+            used[i, 2] = r.shared.disk_mb
+            num = self._num_preemptions(alloc)
+            mp = details.max_parallel
+            penalty[i] = (
+                float((num + 1) - mp) * MAX_PARALLEL_PENALTY
+                if (mp > 0 and num >= mp)
+                else 0.0
+            )
+        return used, penalty
+
     # -- task group (cpu/mem/disk) ----------------------------------------
 
     def preempt_for_task_group(self, resource_ask: AllocatedResources) -> List[Allocation]:
@@ -168,21 +205,22 @@ class Preemptor:
 
         for _priority, grp_allocs in allocs_by_priority:
             grp = list(grp_allocs)
-            while grp and not all_requirements_met:
-                best_distance = float("inf")
-                closest_index = -1
-                for index, alloc in enumerate(grp):
-                    details = self.alloc_details[alloc.id]
-                    distance = score_for_task_group(
-                        resources_needed,
-                        details.resources,
-                        details.max_parallel,
-                        self._num_preemptions(alloc),
-                    )
-                    if distance < best_distance:
-                        best_distance = distance
-                        closest_index = index
-                closest = grp.pop(closest_index)
+            # Distance scoring is tensor math over the candidate axis:
+            # the used-resource coordinates and the max_parallel penalty
+            # are static across greedy rounds (set_preemptions is not
+            # updated mid-search), so they encode once per group; each
+            # round recomputes the distance vector against the shrinking
+            # ask in one vectorized op. np.argmin's first-occurrence rule
+            # matches the scalar loop's strict < scan, so selections are
+            # bit-identical (same IEEE-double ops either way).
+            used, penalty = self._group_score_arrays(grp)
+            alive = np.ones(len(grp), bool)
+            while alive.any() and not all_requirements_met:
+                dist = _distance_vec(resources_needed, used) + penalty
+                dist = np.where(alive, dist, np.inf)
+                closest_index = int(np.argmin(dist))
+                alive[closest_index] = False
+                closest = grp[closest_index]
                 closest_resources = self.alloc_details[closest.id].resources
                 available.add(closest_resources)
                 all_requirements_met, _ = available.superset(resources_asked)
@@ -206,11 +244,12 @@ class Preemptor:
         node_remaining: ComparableResources,
         ask: ComparableResources,
     ) -> List[Allocation]:
-        best_allocs = sorted(
-            best_allocs,
-            key=lambda a: basic_resource_distance(ask, self.alloc_details[a.id].resources),
-            reverse=True,
-        )
+        used, _ = self._group_score_arrays(best_allocs)
+        dist = _distance_vec(ask, used)
+        best_allocs = [
+            best_allocs[i]
+            for i in sorted(range(len(best_allocs)), key=dist.__getitem__, reverse=True)
+        ]
         available = node_remaining.copy()
         filtered: List[Allocation] = []
         for alloc in best_allocs:

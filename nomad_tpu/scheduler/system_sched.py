@@ -220,8 +220,14 @@ class SystemScheduler:
         ):
             from ..tpu.integration import compute_system_placements_with_engine
 
-            if compute_system_placements_with_engine(self, place, sched_config) is True:
+            res = compute_system_placements_with_engine(self, place, sched_config)
+            if res is True:
                 return
+            if isinstance(res, list):
+                # the device committed every clean placement; only the
+                # preemption-needing nodes fall through to the host
+                # per-node stack below (BinPackIterator evict path)
+                place = res
 
         node_by_id = {node.id: node for node in self.nodes}
 

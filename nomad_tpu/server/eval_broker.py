@@ -171,7 +171,14 @@ class EvalBroker:
                 self.blocked.setdefault(namespaced, _PendingHeap()).push(evaluation)
                 return
         self.ready.setdefault(queue, _PendingHeap()).push(evaluation)
-        self._cond.notify_all()
+        # ONE eval became ready: wake a bounded number of waiters, not
+        # the whole worker pool — notify_all turns a C1M registration
+        # storm into O(workers x evals) spurious wakeups all contending
+        # for the broker lock (and the GIL). Waking 2 covers the case
+        # where the first woken waiter's scheduler filter skips this
+        # queue; any residual miss self-heals within the dequeue loop's
+        # 1s re-scan timeout.
+        self._cond.notify(2)
 
     # ------------------------------------------------------------------
 

@@ -53,6 +53,38 @@ from .raft import InProcRaft
 from .worker import Worker
 
 
+def leader_forward(rpc_method: str):
+    """Follower-side write forwarding (reference nomad/rpc.go forward():
+    every write endpoint relays to the leader before touching raft). A
+    wire-raft FOLLOWER re-issues the call as the equivalent RPC — the
+    transport routes it to the leader — so the method executes ENTIRELY
+    on the leader and its read-after-write never races local replication.
+    In-proc / leader / leaderless states run the local method unchanged
+    (leaderless writes still fail with NotLeaderError, as the reference's
+    forward() fails without a known leader)."""
+    import functools
+    import inspect
+
+    def deco(fn):
+        sig = inspect.signature(fn)
+
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            get_addr = getattr(self, "get_leader_rpc_addr", None)
+            if get_addr is not None and not self.is_leader:
+                addr = get_addr()
+                if addr:
+                    bound = sig.bind(self, *args, **kwargs)
+                    bound.apply_defaults()
+                    pos = list(bound.arguments.values())[1:]
+                    return self.leader_conn.get(addr).call(rpc_method, *pos)
+            return fn(self, *args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
 @dataclass
 class ServerConfig:
     num_schedulers: int = 2
@@ -78,11 +110,15 @@ class ServerConfig:
     device_batch: int = 8
     # how long the batcher waits for co-arriving evals before dispatching
     # (the total CAP when idle-gap gathering is on)
-    device_batch_window_ms: float = 1.0
+    device_batch_window_ms: float = 25.0
     # adaptive gather: keep the batch growing while requests keep arriving
     # within this gap of each other (a burst's encodes trickle in);
-    # 0 disables (fixed window only)
-    device_batch_idle_ms: float = 0.0
+    # 0 disables (fixed window only). ON by default: a lone eval pays at
+    # most the idle gap (~3ms, well under one device dispatch), a burst
+    # gathers into one dispatch, and window_ms caps the worst case —
+    # the trickle-arrival latency bound is asserted by
+    # tests/test_device_batcher.py::test_trickle_arrivals_latency.
+    device_batch_idle_ms: float = 3.0
     # shard the eval batch over an ("evals", "nodes") jax device mesh when
     # multiple accelerator devices are visible (multi-chip)
     device_mesh: bool = False
@@ -121,6 +157,12 @@ class Server:
         self._leader_generation = 0
         self._leader_timers: List[threading.Timer] = []
         self._lock = threading.RLock()
+
+        # follower->leader write forwarding (leader_forward decorator):
+        # one cached RPC client that follows the moving leader address.
+        # Built lazily (property) so it picks up rpc_tls, which the agent
+        # assigns after construction.
+        self._leader_conn = None
 
         from .timetable import TimeTable
 
@@ -194,6 +236,16 @@ class Server:
     @property
     def is_leader(self) -> bool:
         return self.raft.is_leader(self.peer)
+
+    @property
+    def leader_conn(self):
+        if self._leader_conn is None:
+            from ..rpc.transport import LeaderConn
+
+            self._leader_conn = LeaderConn(
+                timeout=30.0, tls=getattr(self, "rpc_tls", None)
+            )
+        return self._leader_conn
 
     def raft_apply(self, entry_type: str, payload) -> Tuple[int, object]:
         return self.raft.apply(self.peer, entry_type, payload)
@@ -416,6 +468,7 @@ class Server:
         self.raft_apply(NODE_REGISTER, node)
         return self.heartbeaters.reset_heartbeat_timer(node.id)
 
+    @leader_forward("Node.Deregister")
     def deregister_node(self, node_id: str) -> None:
         self.heartbeaters.clear_heartbeat_timer(node_id)
         self.raft_apply(NODE_DEREGISTER, node_id)
@@ -435,6 +488,7 @@ class Server:
         self.raft_apply(NODE_STATUS_UPDATE, (node_id, status))
         self.create_node_evals(node_id)
 
+    @leader_forward("Node.UpdateDrain")
     def update_node_drain(self, node_id: str, drain) -> None:
         """Node.UpdateDrain: ``drain`` is a DrainStrategy, True (default
         strategy), or falsy to cancel. The force deadline is stamped here —
@@ -453,9 +507,11 @@ class Server:
         if drain:
             self.create_node_evals(node_id)
 
+    @leader_forward("Node.UpdateEligibility")
     def update_node_eligibility(self, node_id: str, eligibility: str) -> None:
         self.raft_apply(NODE_ELIGIBILITY_UPDATE, (node_id, eligibility))
 
+    @leader_forward("Node.Evaluate")
     def create_node_evals(self, node_id: str) -> List[str]:
         """One eval per job with allocs on the node (node_endpoint.go)."""
         allocs = self.fsm.state.allocs_by_node(node_id)
@@ -482,6 +538,7 @@ class Server:
 
     # -- jobs ------------------------------------------------------------
 
+    @leader_forward("Job.Register")
     def register_job(self, job: Job) -> str:
         """Job.Register: upsert + create an eval (job_endpoint.go:73)."""
         # first-job latency gauge (VERDICT r3 #3): time from the first
@@ -526,6 +583,7 @@ class Server:
         self.raft_apply(EVAL_UPDATE, [ev])
         return ev.id
 
+    @leader_forward("Job.Deregister")
     def deregister_job(self, namespace: str, job_id: str, purge: bool = False) -> str:
         job = self.fsm.state.job_by_id(namespace, job_id)
         self.raft_apply(JOB_DEREGISTER, (namespace, job_id, purge))
@@ -543,6 +601,7 @@ class Server:
         self.raft_apply(EVAL_UPDATE, [ev])
         return ev.id
 
+    @leader_forward("Job.Evaluate")
     def evaluate_job(self, namespace: str, job_id: str) -> str:
         """Job.Evaluate: force a new evaluation (job_endpoint.go Evaluate)."""
         job = self.fsm.state.job_by_id(namespace, job_id)
@@ -565,6 +624,7 @@ class Server:
         self.raft_apply(EVAL_UPDATE, [ev])
         return ev.id
 
+    @leader_forward("Job.Dispatch")
     def dispatch_job(
         self, namespace: str, job_id: str, payload: bytes = b"", meta=None
     ):
@@ -600,6 +660,7 @@ class Server:
         eval_id = self.register_job(child)
         return child.id, eval_id
 
+    @leader_forward("Job.Stability")
     def set_job_stability(
         self, namespace: str, job_id: str, version: int, stable: bool
     ) -> None:
@@ -612,6 +673,7 @@ class Server:
             raise ValueError(f"job {job_id!r} has no version {version}")
         self.raft_apply("job-stability", (namespace, job_id, version, stable))
 
+    @leader_forward("Job.Revert")
     def revert_job(
         self,
         namespace: str,
@@ -674,6 +736,7 @@ class Server:
                 failed.update(e.failed_tg_allocs)
         return annotations, failed or None, index, jdiff
 
+    @leader_forward("System.GC")
     def force_gc(self) -> None:
         """System.GarbageCollect: a forced core GC eval (system_endpoint.go)."""
         from .core_sched import CoreScheduler
@@ -688,6 +751,7 @@ class Server:
         )
         CoreScheduler(self, self.fsm.state.snapshot()).process(ev)
 
+    @leader_forward("Alloc.Stop")
     def stop_alloc(self, alloc_id: str) -> str:
         """Alloc.Stop: mark the alloc for migration and kick an eval
         (alloc_endpoint.go Stop)."""

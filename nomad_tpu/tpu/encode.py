@@ -362,6 +362,59 @@ def _snapshot_usage(state) -> Dict[str, tuple]:
     return usage
 
 
+def epoch_usage_arrays(ctx, fleet: dict, n_pad: int, int_mode: bool, fdtype):
+    """Usage-epoch patch arrays for the whole-eval encode cache
+    (engine.encode_eval): for a clean-plan, no-live-alloc, no-device-dim
+    job, the ONLY encoded arrays that change between usage epochs are
+    the base node usage (scan carry[0]) and its Q27 exponential chain
+    (carry[7]) — and both are JOB-INDEPENDENT. One (used0, e_base0)
+    pair per (fleet, usage-epoch) therefore refreshes EVERY cached
+    eval, turning the epoch-roll re-encode (~30ms x O(nodes) per eval,
+    the r5 1M run's dominant host phase) into an O(nodes) array swap
+    computed once per commit wave. Same arithmetic as the inline
+    encode-path derivation (int32 casts before the int64 free/capacity
+    subtraction), so patched evals stay bit-identical to fresh ones."""
+    import threading
+
+    key = (getattr(ctx.state, "usage_epoch", -1), n_pad, int_mode)
+    cached = fleet.get("epoch_usage")
+    if cached is not None and cached[0] == key:
+        return cached[1], cached[2]
+    lock = fleet.setdefault("epoch_usage_lock", threading.Lock())
+    with lock:
+        cached = fleet.get("epoch_usage")
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
+        node_index = fleet["node_index"]
+        totals4 = fleet["totals4"]
+        reserved4 = fleet["reserved4"]
+        n_real = totals4.shape[0]
+        used = np.zeros((n_pad, 4), np.float64)
+        for node_id, row in _snapshot_usage(ctx.state).items():
+            i = node_index.get(node_id)
+            if i is not None:
+                used[i, DIM_CPU] += row[0]
+                used[i, DIM_MEM] += row[1]
+                used[i, DIM_DISK] += row[2]
+                used[i, DIM_MBITS] += row[3]
+        used0 = used.astype(fdtype)
+        if int_mode:
+            from .intscore import e27_np, xq_np
+
+            node_c2 = np.zeros((n_pad, 2), np.int64)
+            node_c2[:n_real] = (
+                totals4[:, :2] - reserved4[:, :2]
+            ).astype(np.int64)
+            res2 = np.zeros((n_pad, 2), fdtype)
+            res2[:n_real] = reserved4[:, :2]
+            free0 = node_c2 - used0[:, :2] - res2
+            e_base0 = e27_np(xq_np(free0, node_c2)).astype(np.int32)
+        else:
+            e_base0 = np.zeros((0, 2), np.int32)
+        fleet["epoch_usage"] = (key, used0, e_base0)
+        return used0, e_base0
+
+
 def build_node_table(ctx, job: Job, nodes: List[Node],
                      fleet: Optional[dict] = None) -> NodeTable:
     """Encode nodes + proposed allocs into dense arrays.

@@ -674,6 +674,112 @@ def _build_place_scan():
     return place_scan
 
 
+def _build_forced_kernel():
+    """Scan-free system-eval kernel: when every placement names a DISTINCT
+    forced node (single-TG system jobs — one alloc per eligible node,
+    system_sched.go:268-286) and the eval carries no evictions, spreads,
+    affinities, reschedule penalties or distinct_property (the system
+    encoder emits exactly this shape), the scan steps are independent
+    given the initial carry: no step's placement touches another step's
+    node, spread counts are inert, and the ring offset cannot change any
+    output (each step has at most ONE candidate — selected whether it
+    lands in the source or the backlog window). So the whole eval
+    collapses to ONE vectorized pass over the placement axis — identical
+    arithmetic to the scan step restricted to that shape, bit-identical
+    outputs (asserted by tests/test_system_engine.py host-parity and the
+    scan-equivalence fuzz), at O(1) dispatch instead of O(P) sequential
+    steps."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    _enable_persistent_compile_cache()
+    import jax.numpy as jnp
+
+    def forced_eval(static, carry, xs):
+        (totals, reserved, asks, feas, _aff_score, _aff_present,
+         desired_counts, dh_job, dh_tg, _limits, _spread_vids,
+         _spread_desired, _spread_weights, _spread_has_targets,
+         _spread_active, _sum_spread_weights, n_real, e_ask,
+         _dp_vids, _dp_limit, _dp_applies) = static
+        (used0, tg_counts0, job_counts0, _sc0, _se0, _off0, failed0,
+         e_base0, _dpc0) = carry
+        (tg_idx, _penalty_idx, _evict_node, _evict_res, _evict_tg,
+         _limit_p, _sum_sw_p, _ev_factor, _rev_factor, forced_node) = xs
+
+        fdt = totals.dtype
+        int_mode = jnp.issubdtype(fdt, jnp.integer)
+        i64 = jnp.int64
+        j = forced_node[:, 0]                          # [P] node per step
+        g = tg_idx                                     # [P] TG per step
+
+        ask = asks[g]                                  # [P, D]
+        used_j = used0[j]                              # [P, D]
+        totals_j = totals[j]
+        if reserved.shape[0]:
+            util = used_j + reserved[j] + ask
+        else:
+            util = used_j + ask
+        fits = jnp.all(util <= totals_j, axis=-1)
+
+        jc = job_counts0[j]                            # [P]
+        tgc = tg_counts0[g, j]                         # [P]
+        dh_mask = jnp.where(
+            dh_job[g],
+            jc == 0,
+            jnp.where(dh_tg[g], ~((tgc > 0) & (jc > 0)), True),
+        )
+        feasible = (
+            feas[g, j] & fits & dh_mask & (j >= 0) & (j < n_real)
+            & ~failed0[g]
+        )
+
+        anti_present = tgc > 0
+        if int_mode:
+            from .intscore import E27_BITS, E27_ONE, RECIP_BITS, TERM_BITS
+
+            e_sel = (e_base0[j].astype(i64) * e_ask[g, j].astype(i64)) \
+                >> E27_BITS                            # [P, 2]
+            fit = i64(20 * E27_ONE) - e_sel[:, 0] - e_sel[:, 1]
+            fit = jnp.clip(fit, 0, 18 * E27_ONE)
+            binpack = (fit * 4) // 9
+            rsh = RECIP_BITS - TERM_BITS
+            q_d = jnp.floor_divide(
+                i64(1 << RECIP_BITS),
+                jnp.maximum(desired_counts[g].astype(i64), 1),
+            )
+            anti = jnp.where(
+                anti_present, -(((tgc.astype(i64) + 1) * q_d) >> rsh), 0
+            )
+            num_terms = 1 + anti_present.astype(jnp.int32)
+            factor = jnp.floor_divide(60, num_terms).astype(i64)
+            final = (binpack + anti) * factor
+            score_zero = i64(0)
+        else:
+            node_cpu = totals_j[:, DIM_CPU] - reserved[j][:, DIM_CPU]
+            node_mem = totals_j[:, DIM_MEM] - reserved[j][:, DIM_MEM]
+            free_cpu = 1.0 - util[:, DIM_CPU] / jnp.maximum(node_cpu, 1e-9)
+            free_mem = 1.0 - util[:, DIM_MEM] / jnp.maximum(node_mem, 1e-9)
+            fitness = 20.0 - (jnp.power(10.0, free_cpu)
+                              + jnp.power(10.0, free_mem))
+            binpack = jnp.clip(fitness, 0.0, 18.0) / 18.0
+            anti = jnp.where(
+                anti_present,
+                -(tgc.astype(fdt) + 1.0) / desired_counts[g].astype(fdt),
+                0.0,
+            )
+            num_terms = 1.0 + anti_present.astype(fdt)
+            final = (binpack + anti) / num_terms
+            score_zero = jnp.asarray(0.0, fdt)
+
+        chosen = jnp.where(feasible, j, -1).astype(jnp.int32)
+        scores = jnp.where(feasible, final, score_zero)
+        p = tg_idx.shape[0]
+        return (chosen, scores, jnp.zeros(p, jnp.int32),
+                jnp.zeros(p, bool))
+
+    return jax.jit(forced_eval)
+
+
 def _build_batched_scan(in_shardings=None):
     """Eval-batched scan: vmap the per-eval scan over a leading batch axis.
 
@@ -812,6 +918,7 @@ class TpuPlacementEngine:
 
     def __init__(self) -> None:
         self._place_scan = None
+        self._forced_kernel = None
 
     @classmethod
     def shared(cls) -> "TpuPlacementEngine":
@@ -823,6 +930,51 @@ class TpuPlacementEngine:
         if self._place_scan is None:
             self._place_scan = _build_place_scan()
         return self._place_scan
+
+    def _forced_fn(self):
+        if self._forced_kernel is None:
+            self._forced_kernel = _build_forced_kernel()
+        return self._forced_kernel
+
+    def run_forced(self, enc: "EncodedEval"):
+        """Run one all-distinct forced-node eval through the scan-free
+        kernel (see _build_forced_kernel). The placement axis pads to a
+        pow2 bucket so partial retries (plan-rejection re-evals with
+        fewer placements) reuse the compiled executable: padded entries
+        carry forced_node=-1, which the kernel maps to chosen=-1, and
+        callers only read the first ``enc.p`` slots."""
+        kernel = self._forced_fn()
+        import jax.numpy as jnp
+
+        from ..utils import phases as _phases
+
+        p = enc.p
+        p_pad = _round_up(max(p, 1))
+        xs = enc.xs
+        if p_pad != p:
+            def padp(arr, fill):
+                widths = ((0, p_pad - p),) + ((0, 0),) * (arr.ndim - 1)
+                return np.pad(arr, widths, constant_values=fill)
+
+            (tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
+             limit_p, sum_sw_p, ev_factor, rev_factor, forced_node) = xs
+            xs = (
+                padp(tg_idx, 0), padp(penalty_idx, -1),
+                padp(evict_node, -1), padp(evict_res, 0),
+                padp(evict_tg, -1), padp(limit_p, 0), padp(sum_sw_p, 0),
+                padp(ev_factor, 0), padp(rev_factor, 0),
+                padp(forced_node, -1),
+            )
+        static = tuple(jnp.asarray(a) for a in enc.static)
+        init_carry = tuple(jnp.asarray(a) for a in enc.carry)
+        xs = tuple(jnp.asarray(a) for a in xs)
+        with _phases.track("device"):
+            chosen, scores, pulls, skipped = kernel(static, init_carry, xs)
+            chosen = np.asarray(chosen)
+        return (
+            chosen[:p], np.asarray(scores)[:p],
+            np.asarray(pulls)[:p], np.asarray(skipped)[:p],
+        )
 
     # ------------------------------------------------------------------
 
@@ -913,9 +1065,24 @@ class TpuPlacementEngine:
 
         from ..utils import metrics as _metrics
 
+        # single-flight claim state (see the enc_cache block below): any
+        # exit path that abandons an owned claim must release it, or
+        # same-key waiters stall out their grace period
+        claim_cell: Dict[str, object] = {}
+
+        def _release_claim():
+            c = claim_cell.pop("ev", None)
+            if c is not None:
+                cache = claim_cell.pop("cache", None)
+                key = claim_cell.pop("key", None)
+                if cache is not None and cache.get(key) is c:
+                    cache.pop(key, None)
+                c.set()
+
         def fallback(reason: str):
             logger.debug("tpu engine fallback: %s", reason)
             _metrics.incr_counter("nomad.tpu_engine.fallback")
+            _release_claim()
             return NotImplemented
 
         # Sticky-disk preferred nodes use a different two-phase select; punt.
@@ -975,15 +1142,83 @@ class TpuPlacementEngine:
                 and not ctx.state.job_has_live_allocs(job.id)
             ):
                 enc_cache = fleet.setdefault("enc_cache", {})
+                # NOTE: the usage epoch is NOT part of the key — entries
+                # store (epoch, enc), and a stale-epoch hit is PATCHED
+                # in place of a full re-encode: for jobs satisfying the
+                # preconditions above, the only epoch-dependent arrays
+                # are the job-independent used0/e_base0 pair
+                # (encode.epoch_usage_arrays). Without this, every
+                # commit wave of a C1M ingest invalidated the whole
+                # cache and the re-encode storm became the dominant
+                # host phase.
                 cache_key = (
                     job_sched_signature(job),
-                    getattr(ctx.state, "usage_epoch", -1),
                     len(missing_list),
                     tuple(m.get_task_group().name for m in missing_list),
                 )
-                hit = enc_cache.get(cache_key)
-                if hit is not None:
-                    _metrics.incr_counter("nomad.tpu_engine.encode_cache_hit")
+                cur_epoch = getattr(ctx.state, "usage_epoch", -1)
+                # SINGLE-FLIGHT: a same-key burst (the C1M registration
+                # storm — hundreds of evals of identically-shaped jobs
+                # dequeued at one snapshot) must not thundering-herd the
+                # encode. The first encoder claims the key with an Event
+                # and builds; the rest wait for its published arrays
+                # instead of re-deriving them concurrently (which made
+                # the cache 0%-hit exactly when it mattered most).
+                import threading as _threading
+
+                while True:
+                    hit = enc_cache.get(cache_key)
+                    if hit is None:
+                        claim = _threading.Event()
+                        cur = enc_cache.setdefault(cache_key, claim)
+                        if cur is claim:
+                            claim_cell["ev"] = claim
+                            claim_cell["cache"] = enc_cache
+                            claim_cell["key"] = cache_key
+                            break  # we build and publish
+                        hit = cur
+                    if isinstance(hit, _threading.Event):
+                        _metrics.incr_counter(
+                            "nomad.tpu_engine.encode_cache_wait")
+                        if not hit.wait(timeout=10.0):
+                            # owner wedged or died mid-encode: clear the
+                            # stuck claim so the key heals, build our own
+                            if enc_cache.get(cache_key) is hit:
+                                enc_cache.pop(cache_key, None)
+                            break
+                        continue  # re-read the published entry
+                    hit_epoch, hit = hit
+                    num_dims = hit.static[0].shape[1]
+                    if hit_epoch != cur_epoch:
+                        if num_dims != 4:
+                            # device-dim jobs carry usage on job-shaped
+                            # dims; no shared patch — full re-encode
+                            break
+                        from .encode import epoch_usage_arrays
+
+                        used0, e_base0 = epoch_usage_arrays(
+                            ctx, fleet, hit.n_pad,
+                            hit.dtype == np.int32, hit.dtype,
+                        )
+                        carry = list(hit.carry)
+                        carry[0] = used0
+                        carry[7] = e_base0
+                        hit = EncodedEval(
+                            n_real=hit.n_real, n_pad=hit.n_pad, g=hit.g,
+                            s=hit.s, v=hit.v, p=hit.p, dtype=hit.dtype,
+                            static=hit.static, carry=tuple(carry),
+                            xs=hit.xs, missing_list=hit.missing_list,
+                            nodes=hit.nodes, table=hit.table,
+                            start_ns=hit.start_ns, dense_ok=True,
+                        )
+                        # re-publish at the current epoch: the rest of
+                        # this wave's evals hit the pure-clone path
+                        enc_cache[cache_key] = (cur_epoch, hit)
+                        _metrics.incr_counter(
+                            "nomad.tpu_engine.encode_cache_patch")
+                    else:
+                        _metrics.incr_counter(
+                            "nomad.tpu_engine.encode_cache_hit")
                     _metrics.incr_counter("nomad.tpu_engine.handled")
                     offset0 = (
                         int(getattr(sched.stack.source, "offset", 0))
@@ -1295,12 +1530,18 @@ class TpuPlacementEngine:
         if enc_cache is not None and cache_key is not None:
             # arrays are read-only downstream (the batcher pads into
             # fresh buffers; apply only reads); a later hit swaps the
-            # ring offset and host context
+            # ring offset and host context (and usage arrays on an
+            # epoch roll)
             if len(enc_cache) >= 32:
                 # concurrent encoders (HOST_WORK_SEM admits several) may
-                # race to evict the same oldest key — default-pop
+                # race to evict the same oldest key — default-pop (an
+                # evicted in-flight claim is re-published right below or
+                # released by its owner's fallback path)
                 enc_cache.pop(next(iter(enc_cache)), None)
-            enc_cache[cache_key] = enc
+            enc_cache[cache_key] = (cur_epoch, enc)
+        ev = claim_cell.pop("ev", None)
+        if ev is not None:
+            ev.set()
         return enc
 
     def run_scan_single(self, enc: "EncodedEval"):
@@ -1331,10 +1572,13 @@ class TpuPlacementEngine:
 
     def compute_system_placements(self, sched, place: List, sched_config=None):
         """Batch a SystemScheduler eval's placements through one device
-        scan. True when handled; NotImplemented falls back to the host
-        per-node stack (which is semantically complete, incl. preemption).
-        ``sched_config`` is the SchedulerConfiguration the caller already
-        read when choosing this path.
+        scan. Returns True when fully handled, a non-empty list of
+        leftover placement tuples when the device handled everything
+        except nodes that need preemption (the caller runs its host
+        per-node loop over just that subset), or NotImplemented to fall
+        back to the host stack wholesale (which is semantically
+        complete). ``sched_config`` is the SchedulerConfiguration the
+        caller already read when choosing this path.
         """
         try:
             import jax  # noqa: F401
@@ -1359,14 +1603,18 @@ class TpuPlacementEngine:
             if len({net.device for net in node.node_resources.networks if net.device}) > 1:
                 return fallback("multi-NIC node")
 
+        from ..utils import phases as _phases
+
         tg_specs: Dict[str, TGSpec] = {}
         port_cache: Dict[str, object] = {}
         try:
-            for tup in place:
-                tg = tup.task_group
-                if tg.name not in tg_specs:
-                    tg_specs[tg.name] = build_tg_spec(ctx, job, tg, nodes, False, port_cache)
-            table = build_node_table(ctx, job, nodes)
+            with _phases.track("encode"):
+                for tup in place:
+                    tg = tup.task_group
+                    if tg.name not in tg_specs:
+                        tg_specs[tg.name] = build_tg_spec(
+                            ctx, job, tg, nodes, False, port_cache)
+                table = build_node_table(ctx, job, nodes)
         except UnsupportedByEngine as e:
             return fallback(str(e))
         int_mode = bool(ctx.deterministic)
@@ -1497,35 +1745,62 @@ class TpuPlacementEngine:
             start_ns=start,
         )
 
+        # All-distinct forced nodes (single-TG system jobs): the scan-free
+        # vectorized kernel — O(1) dispatch instead of O(P) scan steps.
+        # Duplicated forced nodes (multi-TG system jobs placing several
+        # allocs on one node) interact through used/tg_counts and keep
+        # the sequential scan.
         batcher = getattr(sched.planner, "device_batcher", None)
-        if batcher is not None:
+        if len(set(forced.tolist())) == p:
+            chosen, scores, pulls, skipped = self.run_forced(enc)
+            if batcher is not None:
+                # the forced kernel bypasses the gather queue; count it in
+                # the batcher's stats so dispatch accounting stays whole
+                batcher.stats["dispatches"] = batcher.stats.get("dispatches", 0) + 1
+                batcher.stats["evals"] = batcher.stats.get("evals", 0) + 1
+        elif batcher is not None:
             chosen, scores, pulls, skipped = batcher.run(enc)
         else:
             chosen, scores, pulls, skipped = self.run_scan_single(enc)
 
-        # Preemption is a host-side combinatorial search: when enabled and
-        # any forced node failed on CAPACITY (feasible by constraints but
-        # no fit — port occupancy included: the host preempts port
-        # holders), redo the WHOLE eval on the host stack so the
-        # sequential preemption semantics hold exactly. Constraint-
-        # filtered nodes never preempt, so they don't force the fallback.
+        # Preemption is a host-side greedy search per node. When enabled
+        # and a forced node failed on CAPACITY (feasible by constraints
+        # but no fit — port occupancy included: the host preempts port
+        # holders), the device results are KEPT for every other placement
+        # and only the capacity-failed subset is handed back to the host
+        # per-node stack (rank.py BinPackIterator with evict=True), which
+        # runs the Preemptor with vectorized distance scoring
+        # (scheduler/preemption.py). Constraint-filtered nodes never
+        # preempt, so they stay on the device path. The host processes
+        # the leftover subset in placement order — the same order the
+        # pure-host loop would visit those nodes — so preemption-count
+        # penalties (max_parallel) accumulate identically.
         preemption_on = True
         if sched_config is not None:
             preemption_on = sched_config.preemption_config.system_scheduler_enabled
+        leftover: List = []
         if preemption_on:
+            chosen = np.asarray(chosen)
+            keep: List[int] = []
             for pi, tup in enumerate(place):
-                if int(chosen[pi]) >= 0:
-                    continue
-                spec = tg_specs[tup.task_group.name]
-                idx = int(forced[pi])
-                if idx < n_real and spec.constraint_feasible[idx]:
-                    return fallback("system capacity failure with preemption enabled")
+                if int(chosen[pi]) < 0:
+                    spec = tg_specs[tup.task_group.name]
+                    idx = int(forced[pi])
+                    if idx < n_real and spec.constraint_feasible[idx]:
+                        leftover.append(tup)
+                        continue
+                keep.append(pi)
+            if leftover:
+                place = [place[k] for k in keep]
+                kp = np.asarray(keep, np.int64)
+                chosen = np.asarray(chosen)[kp]
+                scores = np.asarray(scores)[kp]
 
         _metrics.incr_counter("nomad.tpu_engine.handled")
         self._apply_system_results(
             sched, place, nodes, table, tg_specs, chosen, scores, start
         )
-        return True
+        return leftover if leftover else True
 
     def _apply_system_results(self, sched, place, nodes, table, tg_specs,
                               chosen, scores, start_ns) -> None:

@@ -18,7 +18,9 @@ def compute_placements_with_engine(sched, destructive, place):
 
 def compute_system_placements_with_engine(sched, place, sched_config=None):
     """SystemScheduler device path (forced-node dense pass); True when
-    handled, NotImplemented to fall back to the host per-node stack."""
+    handled, a list of leftover placements when only preemption-needing
+    nodes remain for the host loop, NotImplemented to fall back to the
+    host per-node stack wholesale."""
     try:
         from .engine import TpuPlacementEngine
     except ImportError:

@@ -275,3 +275,106 @@ def test_top_level_aliases(agent, tmp_path, monkeypatch):
     assert code == 0 and "example.nomad" in out
     code, out = run_cli(agent, "validate", "example.nomad")
     assert code == 0
+
+
+def test_json_and_template_output(agent):
+    """-json / -t on status commands (reference command/data_format.go,
+    wired into node/job/alloc/eval/deployment status)."""
+    import json as _json
+
+    # node status -json: full API payloads, 4-space indent
+    code, out = run_cli(agent, "node", "status", "-json")
+    assert code == 0
+    nodes = _json.loads(out)
+    assert isinstance(nodes, list) and nodes
+    assert "ID" in nodes[0]
+
+    # node status -t: Go-template subset with range/field access
+    code, out = run_cli(
+        agent, "node", "status", "-t",
+        '{{range .}}{{.Name}}:{{.Status}}{{"\\n"}}{{end}}')
+    assert code == 0
+    assert f"{nodes[0]['Name']}:ready" in out
+
+    # single node via template
+    node_id = nodes[0]["ID"]
+    code, out = run_cli(agent, "node", "status", "-t", "{{.ID}}", node_id)
+    assert code == 0 and out.strip() == node_id
+
+    # job status -json (list + single); cli-job ran earlier in the module
+    code, out = run_cli(agent, "job", "status", "-json")
+    assert code == 0
+    jobs = _json.loads(out)
+    assert isinstance(jobs, list)
+    if jobs:
+        code, out = run_cli(agent, "job", "status", "-json", jobs[0]["ID"])
+        assert code == 0
+        job = _json.loads(out)
+        assert job["ID"] == jobs[0]["ID"]
+
+    # eval status -json + alloc status -t
+    code, out = run_cli(agent, "eval", "status", "-json", "x-no-such")
+    assert code == 1  # no match is still an error, not empty json
+
+    evals = agent.server.fsm.state.evals()
+    if evals:
+        ev = evals[0]
+        code, out = run_cli(agent, "eval", "status", "-json", ev.id)
+        assert code == 0
+        assert _json.loads(out)["ID"] == ev.id
+        code, out = run_cli(agent, "eval", "status", "-t",
+                            "{{.ID}} {{.Status}}", ev.id)
+        assert code == 0 and ev.id in out
+
+    allocs = agent.server.fsm.state.allocs()
+    if allocs:
+        al = allocs[0]
+        code, out = run_cli(agent, "alloc", "status", "-t",
+                            "{{.ID}}|{{.JobID}}", al.id)
+        assert code == 0 and out.strip() == f"{al.id}|{al.job_id}"
+
+    # deployment list -json (empty or not, must be a JSON array)
+    code, out = run_cli(agent, "deployment", "list", "-json")
+    assert code == 0
+    assert isinstance(_json.loads(out), list)
+
+    # server members -t
+    code, out = run_cli(agent, "server", "members", "-t",
+                        '{{range .}}{{.Name}}{{end}}')
+    assert code == 0 and "cli-dev" in out
+
+    # -json and -t together is an error (data_format.go:27)
+    code, out = run_cli(agent, "node", "status", "-json", "-t", "{{.}}")
+    assert code == 1 and "does not support template" in out
+
+    # template errors surface, not swallowed
+    code, out = run_cli(agent, "node", "status", "-t", "{{range .}}no end")
+    assert code == 1 and "unclosed" in out
+
+
+def test_template_subset_semantics():
+    """Unit coverage for the Go-template subset evaluator."""
+    from nomad_tpu.cli.data_format import (
+        FormatError, format_data, render_template,
+    )
+
+    data = {"A": {"B": [1, 2, 3]}, "Ok": True, "Null": None}
+    assert render_template("{{.A.B}}", data) == "[1, 2, 3]"
+    assert render_template("{{len .A.B}}", data) == "3"
+    assert render_template("{{range .A.B}}<{{.}}>{{end}}", data) == "<1><2><3>"
+    assert render_template("{{if .Ok}}y{{else}}n{{end}}", data) == "y"
+    assert render_template("{{if .Null}}y{{else}}n{{end}}", data) == "n"
+    assert render_template('{{.Missing}}', data) == "<no value>"
+    assert render_template('{{"\\t"}}', data) == "\t"
+    # nested range
+    assert render_template(
+        "{{range .}}{{range .}}{{.}}{{end}};{{end}}", [[1, 2], [3]]
+    ) == "12;3;"
+
+    import pytest as _pytest
+    with _pytest.raises(FormatError):
+        format_data(True, "{{.}}", data)
+    with _pytest.raises(FormatError):
+        render_template("{{frobnicate .}}", data)
+    with _pytest.raises(FormatError):
+        render_template("{{end}}", data)

@@ -578,3 +578,118 @@ class TestSystemJobPreemptionE2E:
             assert any(e.triggered_by == EVAL_TRIGGER_PREEMPTION for e in evals)
         finally:
             server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Vectorized distance scoring parity: the tensor path in
+# preemption.py (_distance_vec + the argmin greedy loop) must select
+# the exact sequence the scalar reference loop (preemption.go:608-660)
+# would — same IEEE-double math, same first-min tie-breaking.
+# ---------------------------------------------------------------------------
+
+
+class TestVectorizedScoringParity:
+    def _scalar_greedy(self, preemptor, resource_ask):
+        """Straight transliteration of the reference greedy loop
+        (scalar score_for_task_group per candidate per round)."""
+        from nomad_tpu.scheduler.preemption import (
+            filter_and_group_preemptible_allocs,
+        )
+
+        resources_needed = resource_ask.comparable()
+        remaining = preemptor.node_remaining_resources.copy()
+        for alloc in preemptor.current_allocs:
+            remaining.subtract(preemptor.alloc_details[alloc.id].resources)
+        groups = filter_and_group_preemptible_allocs(
+            preemptor.job_priority, preemptor.current_allocs
+        )
+        best, met = [], False
+        available = remaining.copy()
+        asked = resource_ask.comparable()
+        for _prio, grp_allocs in groups:
+            grp = list(grp_allocs)
+            while grp and not met:
+                best_distance, closest_index = float("inf"), -1
+                for index, alloc in enumerate(grp):
+                    d = preemptor.alloc_details[alloc.id]
+                    dist = score_for_task_group(
+                        resources_needed, d.resources, d.max_parallel,
+                        preemptor._num_preemptions(alloc),
+                    )
+                    if dist < best_distance:
+                        best_distance, closest_index = dist, index
+                closest = grp.pop(closest_index)
+                cr = preemptor.alloc_details[closest.id].resources
+                available.add(cr)
+                met, _ = available.superset(asked)
+                best.append(closest)
+                resources_needed.subtract(cr)
+            if met:
+                break
+        if not met:
+            return []
+        # scalar superset filter (preemption.go second pass)
+        needed = resource_ask.comparable()
+        best = sorted(
+            best,
+            key=lambda a: basic_resource_distance(
+                needed, preemptor.alloc_details[a.id].resources),
+            reverse=True,
+        )
+        avail = remaining.copy()
+        filtered = []
+        for alloc in best:
+            filtered.append(alloc)
+            avail.add(preemptor.alloc_details[alloc.id].resources)
+            ok, _ = avail.superset(needed)
+            if ok:
+                break
+        return [a.id for a in filtered]
+
+    def test_randomized_selection_parity(self):
+        import random
+
+        from nomad_tpu.scheduler.preemption import Preemptor
+
+        rng = random.Random(42)
+        for trial in range(40):
+            node = mock.node()
+            node.node_resources = default_node_resources()
+            node.reserved_resources = RESERVED
+            n = rng.randint(1, 12)
+            allocs = []
+            for i in range(n):
+                job = make_job(rng.choice([10, 20, 30, 40, 50]))
+                a = create_alloc(
+                    generate_uuid(), job,
+                    cpu=rng.randint(50, 1200),
+                    mem=rng.randint(32, 2048),
+                    disk=rng.randint(0, 4096),
+                )
+                allocs.append(a)
+            ask_res = AllocatedResources(
+                tasks={WEB: AllocatedTaskResources(
+                    cpu_shares=rng.randint(200, 3000),
+                    memory_mb=rng.randint(128, 6000),
+                )},
+                shared=AllocatedSharedResources(disk_mb=rng.randint(0, 8192)),
+            )
+
+            def build():
+                p = Preemptor(100, None, None)
+                p.set_node(node)
+                p.set_candidates(list(allocs))
+                p.set_preemptions(allocs[: rng.randint(0, n)])
+                return p
+
+            seed_state = rng.getstate()
+            rng.setstate(seed_state)
+            p_vec = build()
+            rng.setstate(seed_state)
+            p_ref = build()
+            got = [a.id for a in p_vec.preempt_for_task_group(ask_res)]
+            want = self._scalar_greedy(p_ref, ask_res)
+            assert got == want, (
+                f"trial {trial}: vectorized selection diverged from the "
+                f"scalar reference loop"
+            )

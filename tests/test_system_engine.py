@@ -144,10 +144,11 @@ def test_system_engine_capacity_failure_parity_no_preemption():
     assert_parity(plans)
 
 
-def test_system_engine_preemption_falls_back_to_host(monkeypatch):
-    # capacity failure with preemption ENABLED: the engine must hand the
-    # whole eval back to the host stack (which preempts) — plans and
-    # preemption sets must match the host run exactly
+def test_system_engine_preemption_hybrid_parity(monkeypatch):
+    # capacity failure with preemption ENABLED: the device keeps every
+    # clean placement and hands ONLY the preemption-needing nodes back
+    # to the host per-node stack — plans and preemption sets must match
+    # the pure-host run exactly
     spy = _CounterSpy(monkeypatch)
     nodes = make_nodes(4, seed=4, cpus=(1000,))
     for n in nodes:  # all eligible: dc1, linux
@@ -163,7 +164,10 @@ def test_system_engine_preemption_falls_back_to_host(monkeypatch):
     high.priority = 80
     high.task_groups[0].tasks[0].resources.cpu = 700
     plans = run_pair(nodes, [low, high], preemption=True)
-    assert "nomad.tpu_engine.fallback" in spy.calls
+    assert "nomad.tpu_engine.handled" in spy.calls
+    assert "nomad.tpu_engine.fallback" not in spy.calls, (
+        "preemption must no longer abandon the device wholesale"
+    )
     assert_parity(plans)
     # the high-priority job preempted: its plan carries preemptions
     tpu_plans = plans["tpu_binpack"][0]
@@ -172,6 +176,34 @@ def test_system_engine_preemption_falls_back_to_host(monkeypatch):
         for entries in plan.node_preemptions.values() for a in entries
     ]
     assert preempted, "high-priority system job should preempt"
+
+
+def test_system_engine_preemption_partial_hybrid(monkeypatch):
+    """Mixed eval: some nodes fit cleanly (device path), some need
+    preemption (host subset). The hybrid must keep device placements
+    for the clean nodes and still match the pure-host plan."""
+    spy = _CounterSpy(monkeypatch)
+    nodes = make_nodes(8, seed=11, cpus=(2000,))
+    for n in nodes:
+        n.datacenter = "dc1"
+        n.attributes["kernel.name"] = "linux"
+        n.compute_class()
+    low = mock.system_job()
+    low.id = "low-half"
+    low.priority = 20
+    # low fills half the fleet via a rack constraint
+    low.constraints.append(
+        Constraint(ltarget="${attr.rack}", rtarget="r1", operand="=")
+    )
+    low.task_groups[0].tasks[0].resources.cpu = 1500
+    high = mock.system_job()
+    high.id = "high-all"
+    high.priority = 80
+    high.task_groups[0].tasks[0].resources.cpu = 900
+    plans = run_pair(nodes, [low, high], preemption=True)
+    assert "nomad.tpu_engine.handled" in spy.calls
+    assert "nomad.tpu_engine.fallback" not in spy.calls
+    assert_parity(plans)
 
 
 def test_system_engine_destructive_update_parity():
@@ -244,3 +276,37 @@ def test_system_engine_multi_tg_parity():
                 if n.attributes.get("kernel.name") != "windows"
                 and n.datacenter == "dc1"]
     assert len(got) == 2 * len(eligible)
+
+
+def test_forced_kernel_bit_identical_to_scan(monkeypatch):
+    """The scan-free forced-node kernel must return bit-identical
+    (chosen, scores) to the sequential scan on the same encoded eval —
+    asserted in-line on every system eval these scenarios produce."""
+    from nomad_tpu.tpu.engine import TpuPlacementEngine
+
+    orig = TpuPlacementEngine.run_forced
+    checked = []
+
+    def check(self, enc):
+        got = orig(self, enc)
+        ref = self.run_scan_single(enc)
+        assert (got[0] == ref[0]).all(), "chosen diverged from the scan"
+        assert (got[1] == ref[1]).all(), "scores diverged from the scan"
+        checked.append(enc.p)
+        return got
+
+    monkeypatch.setattr(TpuPlacementEngine, "run_forced", check)
+
+    # heterogeneous fleet, some windows/dc2 nodes filtered, capacity
+    # collisions between the two jobs
+    nodes = make_nodes(24, seed=7, cpus=(800, 2000, 4000))
+    a = mock.system_job()
+    a.id = "sys-a"
+    a.task_groups[0].tasks[0].resources.cpu = 600
+    b = mock.system_job()
+    b.id = "sys-b"
+    b.priority = a.priority  # same priority: no preemption, pure capacity
+    b.task_groups[0].tasks[0].resources.cpu = 1500
+    plans = run_pair(nodes, [a, b], preemption=False)
+    assert checked, "forced kernel should have been exercised"
+    assert_parity(plans)

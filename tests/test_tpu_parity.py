@@ -557,3 +557,78 @@ def test_parity_affinity_matching_no_node():
     job.task_groups[0].count = 6
     job.affinities = [Affinity("${attr.rack}", "no-such-rack", "=", 100)]
     assert_parity(run_pair(nodes, [job], lambda j: "service"))
+
+
+def test_parity_epoch_patched_encode_cache(monkeypatch):
+    """The whole-eval encode cache's usage-epoch PATCH (engine.encode_eval
+    + encode.epoch_usage_arrays): identically-shaped jobs scheduled
+    SEQUENTIALLY — each commit rolls the usage epoch, so every eval
+    after the first takes the patched-arrays path — must produce plans
+    bit-identical to the host pipeline, and the patch counter must
+    actually fire (no silent fallback to full re-encode)."""
+    from nomad_tpu.utils import metrics
+
+    calls = []
+    orig = metrics.incr_counter
+
+    def spy(name, value=1.0):
+        calls.append(name)
+        orig(name, value)
+
+    monkeypatch.setattr(metrics, "incr_counter", spy)
+
+    nodes = make_nodes(40, seed=9)
+    jobs = []
+    for i in range(6):
+        j = mock.job()
+        j.id = f"epoch-{i}"
+        j.task_groups[0].count = 30
+        # replace resources wholesale: the default mock task carries a
+        # network ask, which (correctly) disqualifies the dense path
+        from nomad_tpu.structs.structs import Resources
+        j.task_groups[0].tasks[0].resources = Resources(cpu=120, memory_mb=96)
+        jobs.append(j)
+    plans = run_pair(nodes, jobs, lambda j: "service")
+    assert "nomad.tpu_engine.encode_cache_patch" in calls, (
+        "sequential same-shape jobs across commits should hit the "
+        "epoch-patched cache path"
+    )
+    assert_parity(plans)
+
+
+def test_parity_epoch_patched_with_spread_affinity(monkeypatch):
+    """Same, with the full rank stack active (spread + affinity): the
+    patch must leave the job-scoped spread/affinity arrays untouched
+    while swapping only the usage pair."""
+    from nomad_tpu.utils import metrics
+
+    calls = []
+    orig = metrics.incr_counter
+
+    def spy(name, value=1.0):
+        calls.append(name)
+        orig(name, value)
+
+    monkeypatch.setattr(metrics, "incr_counter", spy)
+
+    nodes = make_nodes(40, seed=10)
+    jobs = []
+    for i in range(5):
+        j = mock.job()
+        j.id = f"epoch-sp-{i}"
+        j.task_groups[0].count = 25
+        from nomad_tpu.structs.structs import Resources
+        j.task_groups[0].tasks[0].resources = Resources(cpu=100, memory_mb=64)
+        j.task_groups[0].spreads = [Spread(
+            attribute="${node.datacenter}", weight=50,
+            spread_target=[SpreadTarget(value="dc1", percent=70),
+                           SpreadTarget(value="dc2", percent=30)],
+        )]
+        j.task_groups[0].affinities = [Affinity(
+            ltarget="${attr.kernel.name}", rtarget="linux",
+            operand="=", weight=50,
+        )]
+        jobs.append(j)
+    plans = run_pair(nodes, jobs, lambda j: "service")
+    assert "nomad.tpu_engine.encode_cache_patch" in calls
+    assert_parity(plans)
