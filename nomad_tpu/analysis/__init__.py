@@ -1,0 +1,35 @@
+"""nomad-lint: AST invariant checkers for the repo's load-bearing rules.
+
+Four rules (see ``nomad_tpu/analysis/README.md``):
+
+  - ``jit-purity``       jax.jit-compiled functions (and their transitive
+                         same-module callees) stay host-effect free
+  - ``dtype-discipline`` no float64 creep in the integer parity encode path
+  - ``lock-discipline``  ``# guarded-by: <lock>``-annotated attributes are
+                         only written under that lock
+  - ``fsm-determinism``  FSM apply handlers never read wall clock or RNG
+
+Run: ``python -m nomad_tpu.analysis [paths...]`` — exits non-zero on any
+finding not recorded in ``nomad_tpu/analysis/baseline.json`` and not
+suppressed by an inline ``# nomad-lint: disable=<rule>`` comment.
+The tier-1 suite runs the same pass in ``tests/test_static_analysis.py``.
+"""
+from .core import (  # noqa: F401
+    Finding,
+    apply_baseline,
+    default_checkers,
+    load_baseline,
+    run_paths,
+    run_source,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "apply_baseline",
+    "default_checkers",
+    "load_baseline",
+    "run_paths",
+    "run_source",
+    "write_baseline",
+]

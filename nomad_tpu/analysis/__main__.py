@@ -1,0 +1,81 @@
+"""CLI: ``python -m nomad_tpu.analysis [paths...]``.
+
+Exit 0 when every finding is baselined or suppressed; 1 otherwise; 2 on
+bad usage. ``--write-baseline`` records the current findings as the new
+baseline (the ratchet: fix a finding, re-write, commit the smaller file).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (
+    apply_baseline,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.analysis",
+        description="nomad-lint: AST invariant checks "
+                    "(jit-purity, dtype-discipline, lock-discipline, "
+                    "fsm-determinism)",
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to lint (default: nomad_tpu)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: the shipped "
+                             "nomad_tpu/analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baselined or not")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record current findings as the new baseline")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as JSON")
+    args = parser.parse_args(argv)
+
+    paths = args.paths or ["nomad_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings = run_paths(paths, rel_to=os.getcwd())
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    stale = []
+    if not args.no_baseline and os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+        findings, stale = apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps(
+            [f.__dict__ for f in findings], indent=2, sort_keys=True
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        if stale:
+            print(f"note: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) — "
+                  "re-run with --write-baseline to prune", file=sys.stderr)
+    if findings:
+        print(f"{len(findings)} new finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,178 @@
+"""dtype-discipline: no float64 creep in the integer-parity encode path.
+
+The parity engine's guarantee (PARITY.md) is that patched/cached encodes
+are bit-identical to fresh ones. The inline encode path casts capacities
+to the eval dtype (int32 in parity mode) BEFORE subtracting; a float64
+subtraction cast to int64 afterwards rounds differently on fractional
+capacities — exactly the ``epoch_usage_arrays`` divergence this checker
+exists to catch mechanically.
+
+Scoped to the integer-spec modules (``tpu/encode.py``, ``tpu/intscore.py``
+— the rest of the host codebase legitimately computes in float64). Two
+sub-patterns:
+
+  A. ``(x - y).astype(np.int64)`` where the subtraction operands are not
+     each themselves ``.astype(...)`` casts: the subtraction ran in
+     whatever dtype the operands carried (float64 capacities) instead of
+     the eval dtype.
+  B. binary arithmetic where one operand is provably float64 — a literal
+     ``np.float64(...)`` call or a variable assigned from an allocation
+     with an explicit ``np.float64`` dtype — without an ``.astype`` cast.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .core import Finding, ParsedModule, dotted_name, resolve_call_name
+
+RULE = "dtype-discipline"
+
+TARGET_SUFFIXES = ("tpu/encode.py", "tpu/intscore.py")
+
+_ALLOC_FNS = {
+    "numpy.zeros", "numpy.ones", "numpy.full", "numpy.empty",
+    "numpy.array", "numpy.asarray", "numpy.zeros_like", "numpy.full_like",
+    "np.zeros", "np.ones", "np.full", "np.empty",
+    "np.array", "np.asarray", "np.zeros_like", "np.full_like",
+}
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod)
+
+
+def _is_float64_ref(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    """np.float64 / numpy.float64 / "float64"."""
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    name = dotted_name(node)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    return (aliases.get(head, head) + ("." + rest if rest else "")) in (
+        "numpy.float64", "np.float64",
+    )
+
+
+def _is_int64_ref(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "int64":
+        return True
+    name = dotted_name(node)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    return (aliases.get(head, head) + ("." + rest if rest else "")) in (
+        "numpy.int64", "np.int64",
+    )
+
+
+def _is_astype_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "astype"
+    )
+
+
+def _sub_leaves(node: ast.BinOp) -> List[ast.AST]:
+    """Leaf operands of a +/- chain: ``a - b - c`` -> [a, b, c]."""
+    out: List[ast.AST] = []
+    for side in (node.left, node.right):
+        if isinstance(side, ast.BinOp) and isinstance(side.op, (ast.Add, ast.Sub)):
+            out.extend(_sub_leaves(side))
+        else:
+            out.append(side)
+    return out
+
+
+def _float64_alloc(call: ast.Call, aliases: Dict[str, str]) -> bool:
+    """An array allocation whose explicit dtype is float64 (keyword or
+    positional)."""
+    fn = resolve_call_name(call.func, aliases)
+    if fn is None:
+        return False
+    head = fn.split(".")[0]
+    norm = fn if head == "numpy" else fn.replace(head, "np", 1)
+    if norm not in _ALLOC_FNS and fn not in _ALLOC_FNS:
+        return False
+    for kw in call.keywords:
+        if kw.arg == "dtype" and _is_float64_ref(kw.value, aliases):
+            return True
+    return any(_is_float64_ref(a, aliases) for a in call.args)
+
+
+class DtypeDisciplineChecker:
+    rule = RULE
+
+    def __init__(self, restrict_to=TARGET_SUFFIXES):
+        self.restrict_to = tuple(restrict_to)
+
+    def check(self, module: ParsedModule) -> List[Finding]:
+        if self.restrict_to and not module.rel.endswith(self.restrict_to):
+            return []
+        from .core import body_walk, import_aliases
+
+        aliases = import_aliases(module.tree)
+        findings: List[Finding] = []
+
+        # sub-pattern B, per lexical scope (body_walk skips nested defs, so
+        # every node belongs to exactly one scope): names assigned float64
+        # allocations taint arithmetic they appear in un-cast
+        scopes = [module.tree] + [
+            n for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            tainted: Set[str] = set()
+            for node in body_walk(scope):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                        and _float64_alloc(node.value, aliases):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            tainted.add(tgt.id)
+            if not tainted:
+                continue
+            for node in body_walk(scope):
+                if isinstance(node, ast.BinOp) and isinstance(node.op, _ARITH_OPS):
+                    for operand in (node.left, node.right):
+                        if self._operand_float64(operand, tainted, aliases):
+                            findings.append(Finding(
+                                RULE, module.rel, node.lineno,
+                                "float64 operand "
+                                f"'{ast.unparse(operand)}' in arithmetic "
+                                "without an explicit dtype cast",
+                            ))
+                            break
+
+        # sub-pattern A: (a - b).astype(np.int64) with un-cast operands
+        for node in ast.walk(module.tree):
+            if not _is_astype_call(node):
+                continue
+            if not any(_is_int64_ref(a, aliases) for a in node.args):
+                continue
+            target = node.func.value
+            if not (isinstance(target, ast.BinOp)
+                    and isinstance(target.op, (ast.Add, ast.Sub))):
+                continue
+            uncast = [
+                leaf for leaf in _sub_leaves(target)
+                if not (_is_astype_call(leaf) or isinstance(leaf, ast.Constant))
+            ]
+            if uncast:
+                findings.append(Finding(
+                    RULE, module.rel, target.lineno,
+                    "int64 cast of a subtraction whose operands are not "
+                    "each .astype()-cast first "
+                    f"(un-cast: {', '.join(ast.unparse(u) for u in uncast)})",
+                ))
+        return findings
+
+    def _operand_float64(self, node: ast.AST, tainted: Set[str],
+                         aliases: Dict[str, str]) -> bool:
+        # a tainted name, a subscript/slice of one, or a float64 literal call
+        cur = node
+        while isinstance(cur, ast.Subscript):
+            cur = cur.value
+        if isinstance(cur, ast.Name) and cur.id in tainted:
+            return True
+        if isinstance(node, ast.Call) and _is_float64_ref(node.func, aliases):
+            return True
+        return False

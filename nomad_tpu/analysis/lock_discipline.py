@@ -1,0 +1,127 @@
+"""lock-discipline: annotated shared attributes are only written under
+their lock.
+
+Shared mutable state that scheduler worker threads and the batcher's
+dispatcher thread both touch (the ``DeviceBatcher.stats`` counters) is
+declared at its initializing assignment:
+
+    self.stats = {...}  # guarded-by: _lock
+
+From then on the checker enforces, across the WHOLE analyzed file set
+(the engine's forced-kernel path mutates ``batcher.stats`` from another
+module — exactly the race this rule exists for):
+
+  - writes to ``self.<attr>`` inside the DECLARING class must sit inside
+    a ``with <expr>.<lockname>:`` block (the annotated line itself is
+    the declaration and is exempt);
+  - writes to ``<other>.<attr>`` (non-self base) anywhere must too —
+    attribute names are assumed unique enough among ANNOTATED attributes
+    that a non-self write to one is a write to the guarded object.
+
+"Write" covers plain/augmented assignment to the attribute and to any
+subscript chain rooted at it (``x.stats["k"] += 1``).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, ParsedModule
+
+RULE = "lock-discipline"
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+
+
+def _base_attribute(target: ast.AST) -> Optional[ast.Attribute]:
+    """The Attribute node at the root of a write target: ``x.a`` for
+    ``x.a``, ``x.a[k]`` and ``x.a[k][j]``; None for plain names."""
+    cur = target
+    while isinstance(cur, ast.Subscript):
+        cur = cur.value
+    return cur if isinstance(cur, ast.Attribute) else None
+
+
+class LockDisciplineChecker:
+    rule = RULE
+
+    def __init__(self) -> None:
+        # attr -> lockname, across all collected modules
+        self.guarded: Dict[str, str] = {}
+        # (module rel, class name, attr) declared there; declaration linenos
+        self.declaring: Set[Tuple[str, str, str]] = set()
+        self.decl_lines: Set[Tuple[str, int]] = set()
+
+    # -- pass 1: find `# guarded-by:` annotations ------------------------
+
+    def collect(self, module: ParsedModule) -> None:
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for tgt in targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    line = module.lines[node.lineno - 1] \
+                        if node.lineno <= len(module.lines) else ""
+                    m = _GUARDED_RE.search(line)
+                    if m:
+                        self.guarded[tgt.attr] = m.group(1)
+                        self.declaring.add((module.rel, cls.name, tgt.attr))
+                        self.decl_lines.add((module.rel, node.lineno))
+
+    # -- pass 2: flag unguarded writes -----------------------------------
+
+    def check(self, module: ParsedModule) -> List[Finding]:
+        if not self.guarded:
+            return []
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, stack: List[ast.AST], cls: Optional[str]) -> None:
+            if isinstance(node, ast.ClassDef):
+                cls = node.name
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                attr = _base_attribute(tgt)
+                if attr is None or attr.attr not in self.guarded:
+                    continue
+                is_self = isinstance(attr.value, ast.Name) and attr.value.id == "self"
+                if is_self:
+                    if cls is None or (module.rel, cls, attr.attr) not in self.declaring:
+                        continue  # an unrelated class's same-named attr
+                    if (module.rel, node.lineno) in self.decl_lines:
+                        continue  # the annotated declaration itself
+                lock = self.guarded[attr.attr]
+                held = set()
+                for anc in stack:
+                    if isinstance(anc, (ast.With, ast.AsyncWith)):
+                        for item in anc.items:
+                            expr = item.context_expr
+                            if isinstance(expr, ast.Attribute):
+                                held.add(expr.attr)
+                            elif isinstance(expr, ast.Name):
+                                held.add(expr.id)
+                if lock not in held:
+                    base = ast.unparse(attr.value)
+                    findings.append(Finding(
+                        RULE, module.rel, node.lineno,
+                        f"write to '{base}.{attr.attr}' (guarded-by "
+                        f"{lock}) outside a 'with ....{lock}:' block",
+                    ))
+            stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack, cls)
+            stack.pop()
+
+        visit(module.tree, [], None)
+        return findings

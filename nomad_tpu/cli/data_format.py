@@ -98,15 +98,34 @@ def _parse(tmpl: str) -> List[tuple]:
     return nodes
 
 
+# Backslash escape sequences in template string literals. Only these are
+# rewritten; every other character passes through verbatim — a blanket
+# unicode_escape decode of the whole literal mojibake'd non-ASCII text
+# (each UTF-8 byte of "café" decoded as its own latin-1 codepoint).
+_ESCAPE_SEQ = re.compile(
+    r"\\(?:u[0-9a-fA-F]{4}|U[0-9a-fA-F]{8}|x[0-9a-fA-F]{2}|[0-7]{1,3}|.)",
+    re.DOTALL,
+)
+
+
+def _unescape_literal(raw: str) -> str:
+    def repl(m: "re.Match[str]") -> str:
+        seq = m.group(0)
+        try:
+            # unicode_escape is safe HERE: the match is pure ASCII
+            return seq.encode("ascii").decode("unicode_escape")
+        except UnicodeEncodeError:
+            raise FormatError(f"template: bad escape sequence {seq!r}")
+
+    return _ESCAPE_SEQ.sub(repl, raw)
+
+
 def _resolve(expr: str, scope: Any) -> Any:
     expr = expr.strip()
     if expr == ".":
         return scope
     if len(expr) >= 2 and expr[0] == '"' and expr[-1] == '"':
-        try:
-            return expr[1:-1].encode().decode("unicode_escape")
-        except UnicodeDecodeError as e:
-            raise FormatError(f"template: bad string literal {expr}: {e}")
+        return _unescape_literal(expr[1:-1])
     if expr.startswith("len "):
         v = _resolve(expr[4:], scope)
         try:

@@ -308,6 +308,12 @@ class NomadFSM:
         self.state = snapshot
 
 
+# Every handler reachable from this table replays on every replica from
+# the raft log — it must be a pure function of (state, index, payload).
+# The fsm-determinism lint rule (nomad_tpu/analysis/) enforces that no
+# handler, directly or transitively, reads the wall clock or RNG;
+# timestamps/UUIDs must be stamped by the proposer and carried in the
+# log entry payload.
 _DISPATCH: Dict[str, Callable] = {
     NODE_REGISTER: NomadFSM._apply_node_register,
     NODE_DEREGISTER: NomadFSM._apply_node_deregister,

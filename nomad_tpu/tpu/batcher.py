@@ -222,8 +222,11 @@ class DeviceBatcher:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # observability — the server publishes these as
-        # nomad.device_batcher.* gauges in its stats sweep (/v1/metrics)
-        self.stats = {
+        # nomad.device_batcher.* gauges in its stats sweep (/v1/metrics).
+        # Written by the dispatcher thread AND by scheduler workers on the
+        # forced-kernel path (engine.compute_system_placements), so every
+        # read-modify-write takes _lock (enforced by nomad-lint).
+        self.stats = {  # guarded-by: _lock
             "dispatches": 0,
             "evals": 0,
             "max_batch_seen": 0,
@@ -488,18 +491,19 @@ class DeviceBatcher:
             skipped = np.asarray(skipped)
         metrics.measure_since("nomad.device_batcher.dispatch", t_stack)
 
-        self.stats["dispatches"] += 1
-        self.stats["evals"] += b
-        self.stats["padded_evals"] += b_pad - b
-        self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], b)
-        for req in batch:
-            # t_start and t_enqueue share the monotonic clock
-            wait_ms = (t_start - req.t_enqueue) * 1000.0
-            if wait_ms > 0:
-                self.stats["gather_wait_ms_total"] += wait_ms
-                self.stats["gather_wait_ms_max"] = max(
-                    self.stats["gather_wait_ms_max"], wait_ms
-                )
+        with self._lock:
+            self.stats["dispatches"] += 1
+            self.stats["evals"] += b
+            self.stats["padded_evals"] += b_pad - b
+            self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], b)
+            for req in batch:
+                # t_start and t_enqueue share the monotonic clock
+                wait_ms = (t_start - req.t_enqueue) * 1000.0
+                if wait_ms > 0:
+                    self.stats["gather_wait_ms_total"] += wait_ms
+                    self.stats["gather_wait_ms_max"] = max(
+                        self.stats["gather_wait_ms_max"], wait_ms
+                    )
 
         for bi, req in enumerate(batch):
             p = req.enc.p

@@ -402,8 +402,12 @@ def epoch_usage_arrays(ctx, fleet: dict, n_pad: int, int_mode: bool, fdtype):
             from .intscore import e27_np, xq_np
 
             node_c2 = np.zeros((n_pad, 2), np.int64)
+            # cast each operand to the eval dtype BEFORE subtracting —
+            # matching the inline encode path, which assigns the float64
+            # capacities into fdtype buffers first; subtracting in float64
+            # and truncating after diverges on fractional capacities
             node_c2[:n_real] = (
-                totals4[:, :2] - reserved4[:, :2]
+                totals4[:, :2].astype(fdtype) - reserved4[:, :2].astype(fdtype)
             ).astype(np.int64)
             res2 = np.zeros((n_pad, 2), fdtype)
             res2[:n_real] = reserved4[:, :2]

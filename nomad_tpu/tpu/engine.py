@@ -908,6 +908,21 @@ def _int_spec_gate_reason(table, tg_specs, job):
     return None
 
 
+def _release_enc_claim(claim_cell: Dict[str, object]) -> None:
+    """Release an owned single-flight encode claim: drop the claim Event
+    from the enc_cache (if it is still the parked entry) and wake every
+    waiter so one of them can re-claim. Idempotent — the success path
+    pops "ev" when it publishes, making later calls no-ops."""
+    ev = claim_cell.pop("ev", None)
+    if ev is None:
+        return
+    cache = claim_cell.pop("cache", None)
+    key = claim_cell.pop("key", None)
+    if cache is not None and cache.get(key) is ev:
+        cache.pop(key, None)
+    ev.set()
+
+
 # ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
@@ -1048,7 +1063,23 @@ class TpuPlacementEngine:
         """Encode one eval's placement problem into dense numpy arrays.
 
         Returns an EncodedEval, True (nothing to place) or NotImplemented
-        (unsupported feature — host fallback)."""
+        (unsupported feature — host fallback).
+
+        try/finally wrapper: the impl may claim a single-flight encode
+        slot (an Event parked in the fleet's enc_cache). Success and the
+        UnsupportedByEngine fallbacks release it themselves, but an
+        UNEXPECTED exception must too — an abandoned claim stalls every
+        same-key eval for the full 10s waiter grace period, each holding
+        a HOST_WORK_SEM slot while it waits."""
+        claim_cell: Dict[str, object] = {}
+        try:
+            return self._encode_eval_impl(sched, destructive, place, claim_cell)
+        finally:
+            # no-op when the claim was already published or released
+            _release_enc_claim(claim_cell)
+
+    def _encode_eval_impl(self, sched, destructive: List, place: List,
+                          claim_cell: Dict[str, object]):
         try:
             import jax  # noqa: F401 — device path requires jax
         except ImportError:
@@ -1067,22 +1098,13 @@ class TpuPlacementEngine:
 
         # single-flight claim state (see the enc_cache block below): any
         # exit path that abandons an owned claim must release it, or
-        # same-key waiters stall out their grace period
-        claim_cell: Dict[str, object] = {}
-
-        def _release_claim():
-            c = claim_cell.pop("ev", None)
-            if c is not None:
-                cache = claim_cell.pop("cache", None)
-                key = claim_cell.pop("key", None)
-                if cache is not None and cache.get(key) is c:
-                    cache.pop(key, None)
-                c.set()
+        # same-key waiters stall out their grace period — encode_eval's
+        # finally covers the unexpected-exception paths
 
         def fallback(reason: str):
             logger.debug("tpu engine fallback: %s", reason)
             _metrics.incr_counter("nomad.tpu_engine.fallback")
-            _release_claim()
+            _release_enc_claim(claim_cell)
             return NotImplemented
 
         # Sticky-disk preferred nodes use a different two-phase select; punt.
@@ -1182,9 +1204,14 @@ class TpuPlacementEngine:
                             "nomad.tpu_engine.encode_cache_wait")
                         if not hit.wait(timeout=10.0):
                             # owner wedged or died mid-encode: clear the
-                            # stuck claim so the key heals, build our own
+                            # stuck claim so the key heals, build our own.
+                            # Wake the REST of the waiter cohort too —
+                            # they re-read the cache now (and one
+                            # re-claims) instead of each burning its own
+                            # full grace period on the dead Event.
                             if enc_cache.get(cache_key) is hit:
                                 enc_cache.pop(cache_key, None)
+                            hit.set()
                             break
                         continue  # re-read the published entry
                     hit_epoch, hit = hit
@@ -1755,9 +1782,13 @@ class TpuPlacementEngine:
             chosen, scores, pulls, skipped = self.run_forced(enc)
             if batcher is not None:
                 # the forced kernel bypasses the gather queue; count it in
-                # the batcher's stats so dispatch accounting stays whole
-                batcher.stats["dispatches"] = batcher.stats.get("dispatches", 0) + 1
-                batcher.stats["evals"] = batcher.stats.get("evals", 0) + 1
+                # the batcher's stats so dispatch accounting stays whole.
+                # This read-modify-write runs on scheduler worker threads
+                # concurrently with the dispatcher thread's own updates —
+                # both sides take the batcher's lock (guarded-by _lock).
+                with batcher._lock:
+                    batcher.stats["dispatches"] = batcher.stats.get("dispatches", 0) + 1
+                    batcher.stats["evals"] = batcher.stats.get("evals", 0) + 1
         elif batcher is not None:
             chosen, scores, pulls, skipped = batcher.run(enc)
         else:

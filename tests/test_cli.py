@@ -366,6 +366,13 @@ def test_template_subset_semantics():
     assert render_template("{{if .Null}}y{{else}}n{{end}}", data) == "n"
     assert render_template('{{.Missing}}', data) == "<no value>"
     assert render_template('{{"\\t"}}', data) == "\t"
+    # non-ASCII literals pass through verbatim — the old blanket
+    # unicode_escape decode turned each UTF-8 byte of é into its own
+    # latin-1 codepoint ("cafÃ©" mojibake)
+    assert render_template('{{"café"}}', data) == "café"
+    assert render_template('{{"café\\n"}}', data) == "café\n"
+    assert render_template('{{"\\u00e9"}}', data) == "é"
+    assert render_template('{{"a\\\\b"}}', data) == "a\\b"
     # nested range
     assert render_template(
         "{{range .}}{{range .}}{{.}}{{end}};{{end}}", [[1, 2], [3]]

@@ -1,0 +1,495 @@
+"""nomad-lint (nomad_tpu/analysis): the repo's invariants, enforced in tier-1.
+
+Two layers:
+
+  1. The whole-tree gate: every checker over ``nomad_tpu/`` must report
+     zero findings beyond the shipped baseline — this is the same pass
+     ``python -m nomad_tpu.analysis`` runs, so CI needs no extra plumbing.
+  2. Fixture units per checker: a positive (the exact bug-shaped pattern
+     each satellite fix removed — reverting a fix re-creates it) and a
+     negative (the fixed shape) per rule, plus suppression/baseline
+     mechanics.
+
+Plus behavioral regressions for the two engine fixes a linter can't see
+structurally: the single-flight claim release on unexpected exceptions,
+and the stale-claim waiter-cohort wakeup.
+"""
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+    run_paths,
+    run_source,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "nomad_tpu")
+BASELINE = os.path.join(PKG, "analysis", "baseline.json")
+
+
+def dedent(s: str) -> str:
+    return textwrap.dedent(s).lstrip("\n")
+
+
+# ---------------------------------------------------------------------------
+# 1. the tree gate
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_clean_modulo_baseline():
+    """`python -m nomad_tpu.analysis nomad_tpu/` semantics: zero
+    non-baselined findings across the whole package."""
+    findings = run_paths([PKG], rel_to=REPO_ROOT)
+    baseline = load_baseline(BASELINE) if os.path.exists(BASELINE) else []
+    new, _stale = apply_baseline(findings, baseline)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_cli_module_exits_zero():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "nomad_tpu.analysis", "nomad_tpu"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# 2. fixture units — dtype-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_flags_uncast_int64_subtraction():
+    # the exact epoch_usage_arrays bug shape (reverting the encode.py
+    # satellite fix re-creates this finding)
+    src = dedent("""
+        import numpy as np
+        def epoch_usage_arrays(fleet, n_pad, n_real, fdtype):
+            totals4 = fleet["totals4"]
+            reserved4 = fleet["reserved4"]
+            node_c2 = np.zeros((n_pad, 2), np.int64)
+            node_c2[:n_real] = (totals4[:, :2] - reserved4[:, :2]).astype(np.int64)
+            return node_c2
+    """)
+    fs = run_source(src, "tpu/encode.py")
+    assert [f.rule for f in fs] == ["dtype-discipline"]
+    assert "int64 cast of a subtraction" in fs[0].message
+
+
+def test_dtype_accepts_percast_operands():
+    # the fixed shape: each operand cast to the eval dtype first
+    src = dedent("""
+        import numpy as np
+        def epoch_usage_arrays(fleet, n_pad, n_real, fdtype):
+            totals4 = fleet["totals4"]
+            reserved4 = fleet["reserved4"]
+            node_c2 = np.zeros((n_pad, 2), np.int64)
+            node_c2[:n_real] = (
+                totals4[:, :2].astype(fdtype) - reserved4[:, :2].astype(fdtype)
+            ).astype(np.int64)
+            return node_c2
+    """)
+    assert run_source(src, "tpu/encode.py") == []
+
+
+def test_dtype_flags_float64_allocation_arithmetic():
+    src = dedent("""
+        import numpy as np
+        def f(x):
+            buf = np.zeros((4, 4), dtype=np.float64)
+            return buf - x
+    """)
+    fs = run_source(src, "tpu/intscore.py")
+    assert [f.rule for f in fs] == ["dtype-discipline"]
+    assert "float64 operand" in fs[0].message
+
+
+def test_dtype_scoped_to_parity_modules():
+    # the same pattern outside encode/intscore is host-path float64 by
+    # design and not flagged
+    src = dedent("""
+        import numpy as np
+        def f(a, b):
+            return (a - b).astype(np.int64)
+    """)
+    assert run_source(src, "server/worker.py") == []
+
+
+# ---------------------------------------------------------------------------
+# fixture units — lock-discipline
+# ---------------------------------------------------------------------------
+
+BATCHER_DECL = dedent("""
+    import threading
+    class DeviceBatcher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.stats = {"dispatches": 0}  # guarded-by: _lock
+""")
+
+
+def test_lock_flags_unguarded_cross_module_write():
+    # the exact run_forced bug shape (reverting the engine.py satellite
+    # fix re-creates this finding)
+    src = dedent("""
+        def compute_system_placements(batcher):
+            batcher.stats["dispatches"] = batcher.stats.get("dispatches", 0) + 1
+    """)
+    fs = run_source(src, "tpu/engine.py",
+                    extra_modules=[(BATCHER_DECL, "tpu/batcher.py")])
+    assert [f.rule for f in fs] == ["lock-discipline"]
+    assert "batcher.stats" in fs[0].message
+
+
+def test_lock_accepts_with_lock_write():
+    src = dedent("""
+        def compute_system_placements(batcher):
+            with batcher._lock:
+                batcher.stats["dispatches"] = batcher.stats.get("dispatches", 0) + 1
+    """)
+    assert run_source(src, "tpu/engine.py",
+                      extra_modules=[(BATCHER_DECL, "tpu/batcher.py")]) == []
+
+
+def test_lock_flags_self_write_in_declaring_class():
+    # the annotated declaration itself is exempt
+    assert run_source(BATCHER_DECL, "tpu/batcher.py") == []
+
+    src2 = dedent("""
+        import threading
+        class DeviceBatcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.stats = {"d": 0}  # guarded-by: _lock
+            def _run_batch(self):
+                self.stats["d"] += 1
+            def _run_batch_locked(self):
+                with self._lock:
+                    self.stats["d"] += 1
+    """)
+    fs = run_source(src2, "tpu/batcher.py")
+    assert len(fs) == 1 and fs[0].rule == "lock-discipline"
+    assert fs[0].line == 7
+
+
+def test_lock_ignores_unannotated_same_name_attr():
+    # worker.py has its own self.stats with no annotation: self-writes in
+    # a NON-declaring class are not flagged
+    src = dedent("""
+        class Worker:
+            def __init__(self):
+                self.stats = {"evals_processed": 0}
+            def run(self):
+                self.stats["evals_processed"] += 1
+    """)
+    fs = run_source(src, "server/worker.py",
+                    extra_modules=[(BATCHER_DECL, "tpu/batcher.py")])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# fixture units — jit-purity
+# ---------------------------------------------------------------------------
+
+
+def test_jit_flags_impure_call_in_decorated_fn():
+    src = dedent("""
+        import jax, time
+        @jax.jit
+        def f(x):
+            t = time.time()
+            return x
+    """)
+    fs = run_source(src, "tpu/kernels.py")
+    assert [f.rule for f in fs] == ["jit-purity"]
+    assert "time.time" in fs[0].message
+
+
+def test_jit_flags_transitive_callee_and_jit_call_form():
+    # the engine's builder pattern: jax.jit(fn) on a closure that calls a
+    # same-module helper
+    src = dedent("""
+        import jax
+        import numpy as np
+        def _make_step():
+            def helper(c):
+                print("debug", c)
+                return c
+            def step(c, x):
+                return helper(c), x
+            return step
+        def build():
+            step = _make_step()
+            return jax.jit(step)
+    """)
+    fs = run_source(src, "tpu/kernels.py")
+    assert [f.rule for f in fs] == ["jit-purity"]
+    assert "print" in fs[0].message
+
+
+def test_jit_flags_partial_jit_and_global_mutation():
+    src = dedent("""
+        import jax
+        from functools import partial
+        COUNTER = 0
+        @partial(jax.jit, static_argnames=("n",))
+        def f(n, x):
+            global COUNTER
+            COUNTER += 1
+            return x
+    """)
+    fs = run_source(src, "tpu/kernels.py")
+    assert [f.rule for f in fs] == ["jit-purity"]
+    assert "global" in fs[0].message
+
+
+def test_jit_clean_scan_passes():
+    src = dedent("""
+        import jax
+        @jax.jit
+        def f(x):
+            import jax.numpy as jnp
+            return jnp.where(x > 0, x, -x)
+    """)
+    assert run_source(src, "tpu/kernels.py") == []
+
+
+def test_jit_alias_resolution():
+    src = dedent("""
+        import jax
+        import time as _time
+        def body(c):
+            return c + _time.monotonic_ns()
+        def build():
+            return jax.jit(body)
+    """)
+    fs = run_source(src, "tpu/kernels.py")
+    assert len(fs) == 1 and "time.monotonic_ns" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# fixture units — fsm-determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fsm_flags_wall_clock_in_handler():
+    src = dedent("""
+        import time
+        class NomadFSM:
+            def _apply_eval_update(self, index, payload):
+                stamp = time.time_ns()
+                self.state.upsert(index, payload, stamp)
+        _DISPATCH = {"eval-update": NomadFSM._apply_eval_update}
+    """)
+    fs = run_source(src, "server/fsm.py")
+    assert [f.rule for f in fs] == ["fsm-determinism"]
+    assert "time.time_ns" in fs[0].message
+
+
+def test_fsm_flags_transitive_self_call():
+    src = dedent("""
+        import random
+        class NomadFSM:
+            def _apply_plan(self, index, payload):
+                self._helper(payload)
+            def _helper(self, payload):
+                return random.random()
+        _DISPATCH = {"plan": NomadFSM._apply_plan}
+    """)
+    fs = run_source(src, "server/fsm.py")
+    assert len(fs) == 1 and "random.random" in fs[0].message
+
+
+def test_fsm_clean_handlers_and_unreachable_impurity():
+    # impure code NOT reachable from the dispatch table is out of scope
+    src = dedent("""
+        import time
+        class NomadFSM:
+            def _apply_x(self, index, payload):
+                self.state.upsert(index, payload)
+            def leader_only_tick(self):
+                return time.time()
+        _DISPATCH = {"x": NomadFSM._apply_x}
+    """)
+    assert run_source(src, "server/fsm.py") == []
+
+
+def test_fsm_real_module_is_deterministic():
+    fsm_path = os.path.join(PKG, "server", "fsm.py")
+    from nomad_tpu.analysis.fsm_determinism import FsmDeterminismChecker
+    from nomad_tpu.analysis.core import parse_file
+
+    module, err = parse_file(fsm_path, "nomad_tpu/server/fsm.py")
+    assert err is None
+    # the real dispatch table is found (non-trivially exercised: 30 handlers)
+    checker = FsmDeterminismChecker()
+    assert checker.check(module) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression():
+    src = dedent("""
+        import jax, time
+        @jax.jit
+        def f(x):
+            t = time.time()  # nomad-lint: disable=jit-purity
+            return x
+    """)
+    assert run_source(src, "tpu/kernels.py") == []
+
+
+def test_suppression_is_rule_scoped():
+    src = dedent("""
+        import jax, time
+        @jax.jit
+        def f(x):
+            t = time.time()  # nomad-lint: disable=dtype-discipline
+            return x
+    """)
+    assert len(run_source(src, "tpu/kernels.py")) == 1
+
+
+def test_baseline_subtracts_and_reports_stale():
+    f1 = Finding("jit-purity", "a.py", 3, "impure call 'time.time' in f")
+    f2 = Finding("jit-purity", "a.py", 9, "impure call 'print' in g")
+    base = [
+        {"rule": "jit-purity", "file": "a.py",
+         "message": "impure call 'time.time' in f"},
+        {"rule": "dtype-discipline", "file": "b.py", "message": "gone"},
+    ]
+    new, stale = apply_baseline([f1, f2], base)
+    assert new == [f2]
+    assert stale == [{"rule": "dtype-discipline", "file": "b.py",
+                      "message": "gone"}]
+
+
+def test_shipped_baseline_is_valid_json_list():
+    with open(BASELINE) as fh:
+        data = json.load(fh)
+    assert isinstance(data, list)
+    for ent in data:
+        assert set(ent) == {"rule", "file", "message"}
+
+
+# ---------------------------------------------------------------------------
+# behavioral regressions for the engine single-flight fixes
+# ---------------------------------------------------------------------------
+
+
+def test_release_enc_claim_clears_cache_and_wakes():
+    from nomad_tpu.tpu.engine import _release_enc_claim
+
+    ev = threading.Event()
+    cache = {"key": ev}
+    cell = {"ev": ev, "cache": cache, "key": "key"}
+    _release_enc_claim(cell)
+    assert ev.is_set() and "key" not in cache and cell == {}
+    _release_enc_claim(cell)  # idempotent
+
+    # published-entry case: the cache now holds data, not the claim — the
+    # release must NOT evict it
+    ev2 = threading.Event()
+    cache2 = {"key": (3, "enc")}
+    _release_enc_claim({"ev": ev2, "cache": cache2, "key": "key"})
+    assert ev2.is_set() and cache2 == {"key": (3, "enc")}
+
+
+def test_encode_eval_releases_claim_on_unexpected_exception():
+    """An exception AFTER the single-flight claim must release it (pop the
+    parked Event and set it) so same-key waiters don't burn their 10s
+    grace period. Exercised end-to-end through encode_eval's finally."""
+    from nomad_tpu.tpu.engine import TpuPlacementEngine
+
+    engine = TpuPlacementEngine()
+
+    class _Boom(RuntimeError):
+        pass
+
+    class _Sched:
+        # encode_eval touches sched.job first inside the impl; raising
+        # there models any unexpected host error mid-encode
+        @property
+        def job(self):
+            raise _Boom("unexpected encode failure")
+
+    cell_seen = {}
+    orig = TpuPlacementEngine._encode_eval_impl
+
+    def spy(self, sched, destructive, place, claim_cell):
+        # plant a fake claim exactly as the impl's claim path would
+        ev = threading.Event()
+        cache = {"k": ev}
+        claim_cell["ev"] = ev
+        claim_cell["cache"] = cache
+        claim_cell["key"] = "k"
+        cell_seen["ev"] = ev
+        cell_seen["cache"] = cache
+        return orig(self, sched, destructive, place, claim_cell)
+
+    TpuPlacementEngine._encode_eval_impl = spy
+    try:
+        with pytest.raises(_Boom):
+            engine.encode_eval(_Sched(), [], [object()])
+    finally:
+        TpuPlacementEngine._encode_eval_impl = orig
+
+    assert cell_seen["ev"].is_set(), "claim Event not released"
+    assert cell_seen["cache"] == {}, "stuck claim left parked in enc_cache"
+
+
+def test_stale_claim_timeout_wakes_waiter_cohort():
+    """A timed-out waiter pops the stuck claim AND sets the dead Event so
+    the remaining cohort re-reads the cache immediately instead of each
+    serving its own full grace period. Modeled on the engine's waiter
+    loop with a short timeout."""
+    enc_cache = {}
+    cache_key = "k"
+    stuck = threading.Event()  # the wedged owner's claim, never set by it
+    enc_cache[cache_key] = stuck
+
+    results = []
+
+    def waiter(grace):
+        # the engine's loop shape: wait; on timeout pop + set; on wake
+        # re-read the cache
+        t0 = time.monotonic()
+        while True:
+            hit = enc_cache.get(cache_key)
+            if hit is None or not isinstance(hit, threading.Event):
+                results.append(("healed", time.monotonic() - t0))
+                return
+            if not hit.wait(timeout=grace):
+                if enc_cache.get(cache_key) is hit:
+                    enc_cache.pop(cache_key, None)
+                hit.set()  # wake the cohort (the fix under test)
+                results.append(("timeout", time.monotonic() - t0))
+                return
+            continue
+
+    # one short-fuse waiter and three long-fuse cohort members
+    threads = [threading.Thread(target=waiter, args=(0.2,))]
+    threads += [threading.Thread(target=waiter, args=(30.0,)) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert not any(t.is_alive() for t in threads), \
+        "cohort members still parked on the dead claim"
+    kinds = sorted(k for k, _ in results)
+    assert kinds == ["healed", "healed", "healed", "timeout"]
+    # the cohort healed promptly (well under its own 30s grace)
+    assert all(dt < 2.0 for k, dt in results if k == "healed")
