@@ -18,6 +18,7 @@ chunked throughput mode, and the remaining BASELINE system configs.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
@@ -27,6 +28,33 @@ import numpy as np
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Crash-proof artifacts: every config's JSON lands on disk the moment it
+# finishes, and the long headline window also writes periodic in-flight
+# progress snapshots — so a later SIGSEGV/OOM/timeout in an unrelated
+# diagnostic can never erase results already earned (the "parsed: null"
+# failure mode: one crash at minute 40 used to lose the whole run).
+# ---------------------------------------------------------------------------
+
+_ARTIFACT_DIR = os.environ.get("NOMAD_BENCH_ARTIFACT_DIR", "bench_artifacts")
+
+
+def write_artifact(name, payload):
+    """Atomically persist one JSON artifact under ``_ARTIFACT_DIR``.
+
+    Failures are logged, never raised — persistence must not be able to
+    break the bench it is protecting."""
+    try:
+        os.makedirs(_ARTIFACT_DIR, exist_ok=True)
+        path = os.path.join(_ARTIFACT_DIR, f"{name}.json")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, sort_keys=True, default=str)
+        os.replace(tmp, path)
+    except Exception as e:  # noqa: BLE001
+        log(f"artifact write failed for {name}: {e}")
 
 
 # ---------------------------------------------------------------------------
@@ -347,9 +375,25 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
         finished = done if done is not None else (
             lambda srv: placed() >= expected
         )
+        next_snap = t0 + 5.0
         while time.perf_counter() < deadline:
             if finished(server) and server.plan_queue.stats()["depth"] == 0:
                 break
+            if time.perf_counter() >= next_snap:
+                # in-flight progress snapshot: if the run dies mid-window
+                # (600s headline), the artifact still shows how far it got
+                # and where the wall time was going
+                next_snap = time.perf_counter() + 5.0
+                el = time.perf_counter() - t0
+                got_now = placed()
+                write_artifact(f"{name}.progress", {
+                    "config": name,
+                    "placements": got_now,
+                    "expected": expected,
+                    "elapsed_s": round(el, 2),
+                    "placements_per_s": round(got_now / el, 1) if el else 0.0,
+                    "phases": phases.wall_shares(p_t0, phases.now()),
+                })
             # 5ms poll: the completion check is O(table); at 50ms the poll
             # granularity itself dominates sub-second configs
             time.sleep(0.005)
@@ -374,7 +418,10 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             # thread-sum) each pipeline phase held during the window
             "phases": phase_shares,
         }
+        if server.device_batcher:
+            out["dispatch_profile"] = server.device_batcher.dispatch_profile()
         log(f"system[{name}]: {json.dumps(out)}")
+        write_artifact(name, out)
         return out
     finally:
         server.stop()
@@ -572,6 +619,7 @@ def bench_plan_queue_drain(n_nodes=10_000, n_plans=256, per_plan=100,
             "placements_per_s": round(committed / drain_s, 1),
         }
         log(f"drain[10K nodes]: {json.dumps(out)}")
+        write_artifact("plan-queue-drain", out)
         return out
     finally:
         server.stop()
@@ -749,7 +797,9 @@ def system_benches():
 
 def _diagnostic(fn, *args, **kwargs):
     """Run one diagnostic bench in isolation: a failure is reported but
-    never skips later diagnostics or breaks the headline JSON line."""
+    never skips later diagnostics or breaks the headline JSON line. The
+    failure itself becomes an artifact, so a crashed config is diagnosable
+    from disk even when stderr is lost."""
     try:
         return fn(*args, **kwargs)
     except Exception as e:
@@ -757,6 +807,11 @@ def _diagnostic(fn, *args, **kwargs):
 
         traceback.print_exc(file=sys.stderr)
         log(f"diagnostic bench {fn.__name__} failed: {e}")
+        write_artifact(f"{fn.__name__}.error", {
+            "bench": fn.__name__,
+            "error": repr(e),
+            "traceback": traceback.format_exc(),
+        })
         return None
 
 
@@ -766,6 +821,9 @@ def main():
     headline = _diagnostic(bench_c1m_system)
 
     kernel_rate = _diagnostic(bench_batched_parity_c1m, budget_s=40.0)
+    if kernel_rate:
+        write_artifact("kernel-rate",
+                       {"placements_per_s": round(kernel_rate, 1)})
     drain = _diagnostic(bench_plan_queue_drain)
     _diagnostic(bench_c1m_chunked)
     _diagnostic(bench_parity_scan_single)
@@ -807,35 +865,33 @@ def main():
             f"{dev_1m:.2f}s / 8) -> vs_baseline {vs_baseline:.3f} against "
             "the <10s bar"
         )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "BASELINE config 5 AS WRITTEN, end-to-end: 1M actual "
-                    "placements, mixed service+batch, heterogeneous asks/"
-                    "counts, spread+affinity stanzas on ~25% of jobs, full "
-                    "rank stack, 5K nodes, exact int-spec scoring, single "
-                    "chip; vs_baseline = 10s bar / v5e-8 time extrapolated "
-                    "from MEASURED phases (device/8, host kept serial)"
-                ),
-                "value": round(rate, 1),
-                "unit": "placements/s",
-                "vs_baseline": round(vs_baseline, 4),
-                "extra": {
-                    "headline_config": headline,
-                    "v5e8_extrapolation_s": (
-                        round(t_v5e8, 2) if t_v5e8 is not None else None
-                    ),
-                    "extrapolation_model": (
-                        "t = host_wall(serial, measured) + device_wall/8"
-                    ),
-                    "kernel_placements_per_s": round(kernel_rate or 0.0, 1),
-                    "plan_queue_drain_10k_nodes": drain,
-                    "system_configs": sys_results,
-                },
-            }
-        )
-    )
+    record = {
+        "metric": (
+            "BASELINE config 5 AS WRITTEN, end-to-end: 1M actual "
+            "placements, mixed service+batch, heterogeneous asks/"
+            "counts, spread+affinity stanzas on ~25% of jobs, full "
+            "rank stack, 5K nodes, exact int-spec scoring, single "
+            "chip; vs_baseline = 10s bar / v5e-8 time extrapolated "
+            "from MEASURED phases (device/8, host kept serial)"
+        ),
+        "value": round(rate, 1),
+        "unit": "placements/s",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {
+            "headline_config": headline,
+            "v5e8_extrapolation_s": (
+                round(t_v5e8, 2) if t_v5e8 is not None else None
+            ),
+            "extrapolation_model": (
+                "t = host_wall(serial, measured) + device_wall/8"
+            ),
+            "kernel_placements_per_s": round(kernel_rate or 0.0, 1),
+            "plan_queue_drain_10k_nodes": drain,
+            "system_configs": sys_results,
+        },
+    }
+    write_artifact("headline", record)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -121,6 +121,7 @@ class Routes:
         r("/v1/validate/job", self.validate_job)
         r("/v1/search", self.search)
         r("/v1/metrics", self.metrics)
+        r("/v1/trace", self.trace)
 
     # -- jobs ------------------------------------------------------------
 
@@ -804,6 +805,27 @@ class Routes:
         if req.param("format") == "prometheus":
             return global_sink().prometheus().encode()
         return global_sink().summary()
+
+    def trace(self, req: Request):
+        """Eval-lifecycle trace snapshot (nomad-trace): tail-latency
+        summary, in-flight eval records (enqueue -> dequeue -> invoke ->
+        submit -> apply stamps, host/device path, OCC attempt), recent
+        completions, and — when this agent runs a server — per-worker
+        current spans and the device batcher's dispatch profile.
+        ?recent=N bounds the completed-record tail (default 64)."""
+        from ..trace import lifecycle
+
+        try:
+            recent = int(req.param("recent") or 64)
+        except ValueError:
+            raise HTTPError(400, "recent must be an integer")
+        out = lifecycle.snapshot(recent=max(0, recent))
+        srv = self.agent.server
+        if srv is not None:
+            out["workers"] = srv.watchdog.worker_spans()
+            if srv.device_batcher is not None:
+                out["dispatch_profile"] = srv.device_batcher.dispatch_profile()
+        return out
 
     def search(self, req: Request):
         """Prefix search across objects (reference nomad/search_endpoint.go;

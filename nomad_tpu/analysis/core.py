@@ -145,12 +145,14 @@ def default_checkers() -> list:
     from .fsm_determinism import FsmDeterminismChecker
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
+    from .trace_span_discipline import TraceSpanDisciplineChecker
 
     return [
         JitPurityChecker(),
         DtypeDisciplineChecker(),
         LockDisciplineChecker(),
         FsmDeterminismChecker(),
+        TraceSpanDisciplineChecker(),
     ]
 
 

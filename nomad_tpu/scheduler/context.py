@@ -121,19 +121,23 @@ class EvalContext:
     def proposed_allocs(self, node_id: str) -> List[Allocation]:
         """Existing non-terminal allocs - planned evictions - preemptions
         + planned placements (reference context.go:120)."""
-        existing = self.state.allocs_by_node_terminal(node_id, False)
-        proposed = existing
-        update = self.plan.node_update.get(node_id, [])
-        if update:
-            proposed = remove_allocs(existing, update)
-        preempted = self.plan.node_preemptions.get(node_id, [])
-        if preempted:
-            proposed = remove_allocs(proposed, preempted)
-        # Index by ID so in-place updates override rather than double count.
-        by_id = {a.id: a for a in proposed}
-        for alloc in self.plan.node_allocation.get(node_id, []):
-            by_id[alloc.id] = alloc
-        return list(by_id.values())
+        from ..utils import phases as _phases
+
+        with _phases.track("proposed"):
+            existing = self.state.allocs_by_node_terminal(node_id, False)
+            proposed = existing
+            update = self.plan.node_update.get(node_id, [])
+            if update:
+                proposed = remove_allocs(existing, update)
+            preempted = self.plan.node_preemptions.get(node_id, [])
+            if preempted:
+                proposed = remove_allocs(proposed, preempted)
+            # Index by ID so in-place updates override rather than
+            # double count.
+            by_id = {a.id: a for a in proposed}
+            for alloc in self.plan.node_allocation.get(node_id, []):
+                by_id[alloc.id] = alloc
+            return list(by_id.values())
 
     def get_eligibility(self) -> EvalEligibility:
         if self.eligibility is None:

@@ -43,6 +43,7 @@ from ..structs.structs import (
     RescheduleTracker,
     deployment_get_id,
 )
+from ..trace import lifecycle as _trace_lc
 from .context import EvalContext
 from .reconcile import AllocReconciler
 from .reconcile_util import AllocPlaceResult
@@ -337,8 +338,21 @@ class GenericScheduler:
             from ..tpu.integration import compute_placements_with_engine
 
             if compute_placements_with_engine(self, destructive, place) is True:
+                _trace_lc.set_path(self.eval.id, "device")
                 return
 
+        # falling through = the python iterator stack places this eval
+        # (small-eval gate, unsupported features, or host algorithm)
+        _trace_lc.set_path(self.eval.id, "host")
+
+        from ..utils import phases as _phases
+
+        with _phases.track("place"):
+            self._host_placement_loop(destructive, place, by_dc,
+                                      deployment_id)
+
+    def _host_placement_loop(self, destructive: List, place: List,
+                             by_dc, deployment_id: str) -> None:
         now = _time.time_ns()
 
         # Destructive before place: their resources must be discounted first.

@@ -111,6 +111,16 @@ class BinPackIterator:
         self.task_group = task_group
 
     def next(self) -> Optional[RankedNode]:
+        from ..utils import phases as _phases
+
+        # "rank" attributes the whole host placement pull: the upstream
+        # feasibility iterator chain executes inside self.source.next(),
+        # so one span here covers feasibility + network/device fit +
+        # scoring for this candidate (the region round 5 left untracked)
+        with _phases.track("rank"):
+            return self._next_ranked()
+
+    def _next_ranked(self) -> Optional[RankedNode]:
         from .preemption import Preemptor
 
         while True:

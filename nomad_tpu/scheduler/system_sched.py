@@ -220,8 +220,11 @@ class SystemScheduler:
         ):
             from ..tpu.integration import compute_system_placements_with_engine
 
+            from ..trace import lifecycle as _trace_lc
+
             res = compute_system_placements_with_engine(self, place, sched_config)
             if res is True:
+                _trace_lc.set_path(self.eval.id, "device")
                 return
             if isinstance(res, list):
                 # the device committed every clean placement; only the
@@ -229,6 +232,14 @@ class SystemScheduler:
                 # per-node stack below (BinPackIterator evict path)
                 place = res
 
+        from ..trace import lifecycle as _trace_lc
+        from ..utils import phases as _phases
+
+        _trace_lc.set_path(self.eval.id, "host")
+        with _phases.track("place"):
+            self._host_placement_loop(place)
+
+    def _host_placement_loop(self, place) -> None:
         node_by_id = {node.id: node for node in self.nodes}
 
         for missing in place:

@@ -14,6 +14,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..structs.structs import Evaluation, generate_uuid
+from ..trace import lifecycle as _trace
 
 FAILED_QUEUE = "_failed"
 
@@ -171,6 +172,11 @@ class EvalBroker:
                 self.blocked.setdefault(namespaced, _PendingHeap()).push(evaluation)
                 return
         self.ready.setdefault(queue, _PendingHeap()).push(evaluation)
+        if queue != FAILED_QUEUE:
+            # trace record opens when the eval becomes READY (nack
+            # re-enqueues open a fresh one; the failed queue never
+            # delivers, so it gets none)
+            _trace.on_enqueue(evaluation)
         # ONE eval became ready: wake a bounded number of waiters, not
         # the whole worker pool — notify_all turns a C1M registration
         # storm into O(workers x evals) spurious wakeups all contending
@@ -218,6 +224,8 @@ class EvalBroker:
         evaluation = self.ready[best_queue].pop()
         token = generate_uuid()
         self.evals[evaluation.id] = self.evals.get(evaluation.id, 0) + 1
+        # the delivery counter doubles as the OCC retry count on the trace
+        _trace.on_dequeue(evaluation.id, self.evals[evaluation.id])
         timer = threading.Timer(self.nack_timeout, self._nack_expired, args=(evaluation.id, token))
         timer.daemon = True
         self.unack[evaluation.id] = _Unack(evaluation, token, timer)
@@ -247,6 +255,8 @@ class EvalBroker:
             unack.nack_timer.cancel()
             del self.unack[eval_id]
             del self.evals[eval_id]
+            # close BEFORE the requeue below may reopen the same id
+            _trace.on_ack(eval_id)
 
             namespaced = (unack.eval.namespace, unack.eval.job_id)
             if self.job_evals.get(namespaced) == eval_id:
@@ -276,8 +286,10 @@ class EvalBroker:
 
             prev_dequeues = self.evals.get(eval_id, 0)
             if prev_dequeues >= self.delivery_limit:
+                _trace.on_nack(eval_id, failed=True)
                 self._enqueue_locked(unack.eval, FAILED_QUEUE)
                 return
+            _trace.on_nack(eval_id)
 
             delay = self._nack_reenqueue_delay(prev_dequeues)
             timer = threading.Timer(delay, self._wait_done, args=(unack.eval,))
@@ -332,6 +344,7 @@ class EvalBroker:
             self.time_wait.clear()
             self._delayed.clear()
             self._cond.notify_all()
+        _trace.on_flush()
 
     def stats(self) -> Dict[str, object]:
         with self._lock:

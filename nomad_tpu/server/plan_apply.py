@@ -34,6 +34,7 @@ import numpy as np
 
 from ..structs.funcs import remove_allocs
 from ..structs.network import NetworkIndex
+from ..trace import lifecycle as _lifecycle
 from ..utils import metrics, phases
 from ..structs.structs import (
     EVAL_STATUS_PENDING,
@@ -614,6 +615,7 @@ class Planner:
                     result = self.evaluate_plan(snap, pending.plan)
                 metrics.measure_since("nomad.plan.evaluate", start)
                 if result.is_noop():
+                    _lifecycle.on_apply(pending.plan.eval_id)
                     pending.future.set_result(result)
                     continue
                 payload = self._build_payload(snap, pending.plan, result)
@@ -716,6 +718,7 @@ class Planner:
                         if stored is not None:
                             alloc.create_index = stored.create_index
                             alloc.modify_index = stored.modify_index
+                    _lifecycle.on_apply(payload["eval_id"])
                     pending.future.set_result(result)
                 index_future.set_result(index)
             except Exception as e:  # noqa: BLE001

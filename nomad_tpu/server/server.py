@@ -102,6 +102,12 @@ class ServerConfig:
     heartbeat_max_ttl: float = 30.0
     eval_gc_interval: float = 300.0
     unblock_failed_interval: float = 60.0
+    # liveness watchdog (nomad-trace): when placement throughput is flat
+    # for watchdog_stall_s while evals are in flight, dump broker stats,
+    # per-worker current spans and thread stacks to the monitor stream.
+    # watchdog_interval <= 0 disables the tick entirely.
+    watchdog_interval: float = 10.0
+    watchdog_stall_s: float = 30.0
     scheduler_algorithm: str = "tpu_binpack"
     vault: Optional[object] = None  # integrations.vault.VaultConfig
     # Eval-batched device scheduling (SURVEY §2.6 row 1): up to this many
@@ -225,6 +231,15 @@ class Server:
         self._first_job_t0: Optional[float] = None
         self._first_job_latency_recorded = False
 
+        # liveness watchdog: ticked from the leader timer loop (below);
+        # the instance survives leadership churn, its progress baseline
+        # re-seeds on the first tick of each generation
+        from ..trace import LivenessWatchdog
+
+        self.watchdog = LivenessWatchdog(
+            self, stall_after=self.config.watchdog_stall_s
+        )
+
         # Join before observing: the join-time election fires observers, and
         # start() handles the initial-leadership case explicitly.
         self.peer = self.raft.join(self.fsm)
@@ -248,7 +263,14 @@ class Server:
         return self._leader_conn
 
     def raft_apply(self, entry_type: str, payload) -> Tuple[int, object]:
-        return self.raft.apply(self.peer, entry_type, payload)
+        # every log append funnels through here (plan commits take the
+        # applier's own tracked region too — the phase union dedups): the
+        # worker-thread applies (eval status updates, follow-up evals)
+        # otherwise show up as unexplained worker_busy time
+        from ..utils import phases
+
+        with phases.track("raft_fsm"):
+            return self.raft.apply(self.peer, entry_type, payload)
 
     def start(self) -> None:
         for i in range(self.config.num_schedulers):
@@ -308,6 +330,10 @@ class Server:
                                    self._reap_failed_evals)
         self._schedule_leader_task(gen, self.config.eval_gc_interval, self._create_gc_evals)
         self._schedule_leader_task(gen, 10.0, self._emit_stats)
+        if self.config.watchdog_interval > 0:
+            self._schedule_leader_task(
+                gen, self.config.watchdog_interval, self.watchdog.tick
+            )
         if self.vault is not None:
             self._schedule_leader_task(gen, 60.0, self._sweep_vault_accessors)
         if (self.config.authoritative_region
@@ -343,6 +369,12 @@ class Server:
             "nomad.heartbeat.active", self.heartbeaters.num_active()
         )
         metrics.set_gauge("nomad.state.latest_index", self.fsm.state.latest_index)
+        # eval-lifecycle tail latency (nomad.trace.eval_ms.p50/p95/p99,
+        # slowest_inflight_ms, inflight) — same sweep, so /v1/metrics
+        # carries the trace gauges without a /v1/trace round trip
+        from ..trace import lifecycle as _trace_lc
+
+        _trace_lc.publish_gauges()
 
     def _revoke_leadership(self) -> None:
         with self._lock:

@@ -9,10 +9,13 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 from ..scheduler.scheduler import new_scheduler
-from ..utils import metrics
+from ..trace import lifecycle as _lifecycle
+from ..utils import metrics, phases
 from ..structs.structs import Evaluation, Plan, PlanResult
 from .eval_broker import NotOutstandingError, TokenMismatchError
 from .fsm import EVAL_UPDATE
@@ -39,6 +42,23 @@ class Worker:
         )
         self._active_remote = None
         self.stats = {"evals_processed": 0, "plans_submitted": 0, "nacks": 0}
+        # what this worker is doing RIGHT NOW — {eval_id, phase, since} or
+        # None when idle; single-writer (the worker thread), read racily
+        # by the liveness watchdog's dump
+        self.current: Optional[Dict[str, object]] = None
+
+    @contextmanager
+    def _span(self, phase: str, eval_id: str):
+        """Mark the worker's current span for the watchdog; restores the
+        enclosing span on exit so nesting (submit inside invoke) works."""
+        prev = self.current
+        self.current = {
+            "eval_id": eval_id, "phase": phase, "since": time.monotonic()
+        }
+        try:
+            yield
+        finally:
+            self.current = prev
 
     def start(self) -> None:
         self._stop.clear()
@@ -117,9 +137,14 @@ class Worker:
                     self._stop.wait(0.1)
                 continue
             metrics.incr_counter("nomad.worker.dequeue_eval")
+            _lifecycle.on_worker(evaluation.id, self.id)
             self._eval_token = token
             try:
-                self._process(evaluation, token)
+                # worker_busy is the coverage denominator: everything the
+                # worker does between dequeue and ack should be explained
+                # by some fine phase (phases.coverage)
+                with phases.track("worker_busy"):
+                    self._process(evaluation, token)
                 self._ack(evaluation.id, token)
                 self.stats["evals_processed"] += 1
             except (NotOutstandingError, TokenMismatchError):
@@ -164,24 +189,25 @@ class Worker:
             CoreScheduler(self.server, snapshot).process(evaluation)
             return
 
-        from ..utils import phases
         from ..utils.hostwork import HOST_WORK_SEM
 
         wait_index = max(evaluation.modify_index, evaluation.snapshot_index)
         start = metrics.now()
-        # wait for the raft index WITHOUT the host-work permit (it can
-        # block seconds); the snapshot COPY is a pure-GIL table clone —
-        # park excess threads for that part only
-        self.server.fsm.state.wait_min_index(wait_index)
-        with HOST_WORK_SEM:
-            with phases.track("snapshot"):
-                # read-only shared view: a burst of evals at one state
-                # version shares one table clone (schedulers never
-                # mutate their snapshot; the plan applier, which does,
-                # takes private ones)
-                snapshot = self.server.fsm.state.shared_snapshot_min_index(
-                    wait_index
-                )
+        with self._span("wait_for_index", evaluation.id):
+            # wait for the raft index WITHOUT the host-work permit (it can
+            # block seconds); the snapshot COPY is a pure-GIL table clone —
+            # park excess threads for that part only
+            with phases.track("wait_index"):
+                self.server.fsm.state.wait_min_index(wait_index)
+            with HOST_WORK_SEM:
+                with phases.track("snapshot"):
+                    # read-only shared view: a burst of evals at one state
+                    # version shares one table clone (schedulers never
+                    # mutate their snapshot; the plan applier, which does,
+                    # takes private ones)
+                    snapshot = self.server.fsm.state.shared_snapshot_min_index(
+                        wait_index
+                    )
         metrics.measure_since("nomad.worker.wait_for_index", start)
         self._snapshot_index = snapshot.latest_index
         sched = new_scheduler(evaluation.type, self.logger, snapshot, self)
@@ -196,7 +222,12 @@ class Worker:
                 self.server.config, "device_min_placements", 0
             )
         start = metrics.now()
-        sched.process(evaluation)
+        _lifecycle.on_invoke_start(evaluation.id)
+        try:
+            with self._span("invoke_scheduler", evaluation.id):
+                sched.process(evaluation)
+        finally:
+            _lifecycle.on_invoke_end(evaluation.id)
         metrics.measure_since(
             f"nomad.worker.invoke_scheduler.{evaluation.type}", start
         )
@@ -217,6 +248,7 @@ class Worker:
         # the newest index — the plan applier uses this to decide how much
         # optimistic re-validation the plan needs
         plan.snapshot_index = self._snapshot_index
+        _lifecycle.on_plan_submit(plan.eval_id)
         if self._active_remote is not None:
             # the leader-side handler waits up to 60s on the plan queue;
             # the socket must outlast it, and a resend would enqueue the
@@ -227,8 +259,10 @@ class Worker:
         else:
             self.server.eval_broker.pause_nack_timeout(plan.eval_id, self._eval_token)
             try:
-                pending = self.server.plan_queue.enqueue(plan)
-                result = pending.future.result(timeout=60)
+                with self._span("submit_plan", plan.eval_id):
+                    with phases.track("plan_submit"):
+                        pending = self.server.plan_queue.enqueue(plan)
+                        result = pending.future.result(timeout=60)
             finally:
                 try:
                     self.server.eval_broker.resume_nack_timeout(

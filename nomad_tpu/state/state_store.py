@@ -14,6 +14,7 @@ import copy
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils import phases as _phases
 from ..structs.structs import (
     ALLOC_CLIENT_COMPLETE,
     ALLOC_CLIENT_FAILED,
@@ -647,16 +648,17 @@ class StateStore:
     def _dense_materialize_live(self, blocks, predicate=None) -> List[Allocation]:
         """Materialize the live (non-superseded) slots of the given
         blocks, optionally filtered by ``predicate(block, i)``."""
-        out: List[Allocation] = []
-        superseded = self._dense_superseded
-        for block in blocks:
-            for i, aid in enumerate(block.ids):
-                if aid in superseded:
-                    continue
-                if predicate is not None and not predicate(block, i):
-                    continue
-                out.append(block.materialize(i))
-        return out
+        with _phases.track("dense_mat"):
+            out: List[Allocation] = []
+            superseded = self._dense_superseded
+            for block in blocks:
+                for i, aid in enumerate(block.ids):
+                    if aid in superseded:
+                        continue
+                    if predicate is not None and not predicate(block, i):
+                        continue
+                    out.append(block.materialize(i))
+            return out
 
     def upsert_allocs(self, index: int, allocs: List[Allocation]) -> None:
         with self._lock:
@@ -782,11 +784,15 @@ class StateStore:
         ]
         blocks = self._dense_by_node.get(node_id)
         if blocks:
-            superseded = self._dense_superseded
-            for block in blocks:
-                for i in block.node_index_map().get(node_id, ()):
-                    if block.ids[i] not in superseded:
-                        out.append(block.materialize(i))
+            # the per-node inline variant of _dense_materialize_live —
+            # the C1M host-path hot loop (every proposed_allocs rebuild
+            # lands here), so it carries the same phase attribution
+            with _phases.track("dense_mat"):
+                superseded = self._dense_superseded
+                for block in blocks:
+                    for i in block.node_index_map().get(node_id, ()):
+                        if block.ids[i] not in superseded:
+                            out.append(block.materialize(i))
         return out
 
     def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -29,6 +30,21 @@ from .engine import EncodedEval, _build_batched_scan, _round_up
 from .intscore import E27_ONE as _E27_NEUTRAL
 
 logger = logging.getLogger("nomad_tpu.tpu.batcher")
+
+# every constructed batcher, weakly held, so the engine's atexit
+# shutdown path (TpuPlacementEngine.shutdown) can stop dispatcher and
+# warm-compile threads deterministically instead of letting interpreter
+# teardown race them into the runtime (the multichip dryrun's rc 139)
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def shutdown_all() -> None:
+    """Stop every live batcher and join its warm-compile threads."""
+    for b in list(_LIVE):
+        try:
+            b.stop()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            logger.debug("batcher stop failed at shutdown", exc_info=True)
 
 
 def _pow2ceil(x: int) -> int:
@@ -237,7 +253,16 @@ class DeviceBatcher:
             # scheduling latency (VERDICT r4 weak #6)
             "gather_wait_ms_total": 0.0,
             "gather_wait_ms_max": 0.0,
+            # per-dispatch timing split (ISSUE 4 device profiling hooks):
+            # host pad/stack vs device compute (scan + block_until_ready)
+            # vs D2H transfer (np.asarray), feeding dispatch_profile()'s
+            # roofline note
+            "pad_stack_ms_total": 0.0,
+            "compute_ms_total": 0.0,
+            "transfer_ms_total": 0.0,
+            "d2h_bytes_total": 0,
         }
+        _LIVE.add(self)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -256,6 +281,9 @@ class DeviceBatcher:
         t = self._thread
         if t is not None:
             t.join(timeout=5)
+        # join outstanding warm-compile threads: a prewarm mid-compile at
+        # interpreter teardown segfaults inside the runtime
+        self.wait_warm(timeout=5)
         # release anyone still parked
         while True:
             try:
@@ -435,6 +463,45 @@ class DeviceBatcher:
                     ]
                 return
 
+    def dispatch_profile(self) -> Dict[str, object]:
+        """Per-dispatch timing split + a roofline note for the batched
+        placement scan: where does a dispatch's wall time go (host
+        pad/stack vs device compute vs D2H transfer), and what D2H
+        bandwidth does the transfer leg sustain? The note names the
+        binding resource so four-rounds-flat throughput plateaus read as
+        "compute-bound at X ms/dispatch" instead of a bare number."""
+        with self._lock:
+            s = dict(self.stats)
+        n = s["dispatches"]
+        if n == 0:
+            return {"dispatches": 0, "note": "no dispatches recorded"}
+        pad = s["pad_stack_ms_total"] / n
+        comp = s["compute_ms_total"] / n
+        xfer = s["transfer_ms_total"] / n
+        gbps = 0.0
+        if s["transfer_ms_total"] > 0:
+            gbps = s["d2h_bytes_total"] / (s["transfer_ms_total"] / 1e3) / 1e9
+        legs = {"pad/stack (host)": pad, "compute (device)": comp,
+                "transfer (D2H)": xfer}
+        bound = max(legs, key=legs.get)
+        total = pad + comp + xfer
+        note = (
+            f"{bound}-bound: {legs[bound]:.2f}ms of {total:.2f}ms per "
+            f"dispatch (pad/stack {pad:.2f}ms, compute {comp:.2f}ms, "
+            f"transfer {xfer:.2f}ms at {gbps:.2f} GB/s D2H, "
+            f"{s['evals'] / n:.1f} evals/dispatch)"
+        )
+        return {
+            "dispatches": n,
+            "evals": s["evals"],
+            "pad_stack_ms_avg": round(pad, 3),
+            "compute_ms_avg": round(comp, 3),
+            "transfer_ms_avg": round(xfer, 3),
+            "d2h_bytes_total": s["d2h_bytes_total"],
+            "d2h_gbps": round(gbps, 3),
+            "note": note,
+        }
+
     def _run_batch(self, batch: List[_Request]) -> None:
         from ..utils import metrics
         from ..utils import phases as _phases
@@ -484,18 +551,42 @@ class DeviceBatcher:
         t_stack = metrics.now()
         metrics.measure_since("nomad.device_batcher.pad_stack", t_start)
         with _phases.track("device"):
+            # compute vs transfer split: block_until_ready fences the
+            # device work so np.asarray below times ONLY the D2H copy
             _carry, (chosen, scores, pulls, skipped) = scan(static_b, carry_b, xs_b)
+            try:
+                import jax
+
+                jax.block_until_ready((chosen, scores, pulls, skipped))
+            except Exception:  # noqa: BLE001 — non-jax outputs need no fence
+                pass
+            t_compute = metrics.now()
             chosen = np.asarray(chosen)
             scores = np.asarray(scores)
             pulls = np.asarray(pulls)
             skipped = np.asarray(skipped)
+            t_transfer = metrics.now()
         metrics.measure_since("nomad.device_batcher.dispatch", t_stack)
+        metrics.add_sample(
+            "nomad.device_batcher.compute", (t_compute - t_stack) * 1000.0
+        )
+        metrics.add_sample(
+            "nomad.device_batcher.transfer",
+            (t_transfer - t_compute) * 1000.0,
+        )
+        d2h_bytes = (
+            chosen.nbytes + scores.nbytes + pulls.nbytes + skipped.nbytes
+        )
 
         with self._lock:
             self.stats["dispatches"] += 1
             self.stats["evals"] += b
             self.stats["padded_evals"] += b_pad - b
             self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"], b)
+            self.stats["pad_stack_ms_total"] += (t_stack - t_start) * 1000.0
+            self.stats["compute_ms_total"] += (t_compute - t_stack) * 1000.0
+            self.stats["transfer_ms_total"] += (t_transfer - t_compute) * 1000.0
+            self.stats["d2h_bytes_total"] += d2h_bytes
             for req in batch:
                 # t_start and t_enqueue share the monotonic clock
                 wait_ms = (t_start - req.t_enqueue) * 1000.0

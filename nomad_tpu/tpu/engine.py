@@ -930,6 +930,7 @@ def _release_enc_claim(claim_cell: Dict[str, object]) -> None:
 
 class TpuPlacementEngine:
     _shared: Optional["TpuPlacementEngine"] = None
+    _atexit_registered = False
 
     def __init__(self) -> None:
         self._place_scan = None
@@ -939,7 +940,31 @@ class TpuPlacementEngine:
     def shared(cls) -> "TpuPlacementEngine":
         if cls._shared is None:
             cls._shared = TpuPlacementEngine()
+            if not cls._atexit_registered:
+                # deterministic teardown: interpreter exit with a
+                # dispatcher or warm-compile thread still inside the
+                # runtime segfaults (the multichip dryrun's rc 139);
+                # atexit runs BEFORE daemon threads are killed
+                import atexit
+
+                atexit.register(cls.shutdown)
+                cls._atexit_registered = True
         return cls._shared
+
+    @classmethod
+    def shutdown(cls) -> None:
+        """Stop every live DeviceBatcher (dispatcher thread joined, warm
+        compiles joined, parked workers released) and drop the shared
+        engine's compiled-callable references. Idempotent; registered
+        via atexit by shared() and callable explicitly by benches/tests
+        that want the TPU stack quiesced inside their own lifetime."""
+        from .batcher import shutdown_all
+
+        shutdown_all()
+        eng = cls._shared
+        if eng is not None:
+            eng._place_scan = None
+            eng._forced_kernel = None
 
     def _scan_fn(self):
         if self._place_scan is None:

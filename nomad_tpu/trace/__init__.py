@@ -1,0 +1,22 @@
+"""nomad-trace: always-on, low-overhead eval-lifecycle observability.
+
+Three pieces (ISSUE 4 tentpole):
+
+  lifecycle  per-delivery eval trace records stamped at broker enqueue ->
+             dequeue -> scheduler invoke (host/device path, OCC attempt) ->
+             plan submit -> apply -> ack/nack, with tail-latency gauges
+  watchdog   leader-side liveness monitor: dumps broker stats, per-worker
+             current spans and thread stacks when placement throughput
+             flatlines while evals are in flight
+  (phases)   wall-clock phase attribution lives in utils/phases.py; this
+             package consumes it for the coverage self-check
+
+The reference scatters the same signals across per-call timers
+(nomad/worker.go:245 invoke_scheduler, nomad/plan_apply.go:185/369/400);
+here they are joined per evaluation so a stalled eval is a queryable
+record, not a needle across counters.
+"""
+from . import lifecycle
+from .watchdog import LivenessWatchdog
+
+__all__ = ["lifecycle", "LivenessWatchdog"]

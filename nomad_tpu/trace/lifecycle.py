@@ -1,0 +1,272 @@
+"""Eval lifecycle spans: enqueue -> dequeue -> invoke -> submit -> apply -> ack.
+
+Each DELIVERY ATTEMPT of an evaluation gets one ``EvalTrace`` record,
+stamped in place by the broker, the worker, the scheduler (host/device
+path tag) and the plan applier. Records move from an in-flight table to
+a bounded ring buffer on ack/nack, so memory is O(inflight + ring) no
+matter how long the server runs. A nacked eval's re-enqueue (after the
+broker's compounding delay) opens a FRESH record; the broker's delivery
+counter rides along as ``attempt`` — the OCC retry count.
+
+Everything here is a dict op under one lock: cheap enough to stay on in
+production, which is the point (round 5's 40x collapse was invisible
+because nothing always-on recorded per-eval latency). Exported via the
+``/v1/trace`` agent endpoint and as ``nomad.trace.*`` gauges on
+``/v1/metrics`` (publish_gauges, called from the server's stats sweep).
+
+Reference anchors: nomad/worker.go:245 (invoke_scheduler timing),
+nomad/plan_apply.go:185,369,400 (submit/evaluate/apply timing) — the
+same stages, joined per evaluation instead of aggregated per call.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..utils import metrics
+
+_DONE_CAP = 2048
+
+_clock = time.monotonic
+
+
+class EvalTrace:
+    """One delivery attempt of one evaluation (all times ``time.monotonic``)."""
+
+    __slots__ = (
+        "eval_id", "job_id", "namespace", "type", "triggered_by", "priority",
+        "attempt", "worker_id", "path",
+        "enqueue_t", "dequeue_t", "invoke_start_t", "invoke_end_t",
+        "submit_t", "apply_t", "end_t", "outcome",
+    )
+
+    def __init__(self, eval_id: str, job_id: str, namespace: str,
+                 type_: str, triggered_by: str, priority: int,
+                 enqueue_t: float) -> None:
+        self.eval_id = eval_id
+        self.job_id = job_id
+        self.namespace = namespace
+        self.type = type_
+        self.triggered_by = triggered_by
+        self.priority = priority
+        self.attempt = 0
+        self.worker_id: Optional[int] = None
+        self.path: Optional[str] = None  # "host" | "device"
+        self.enqueue_t = enqueue_t
+        self.dequeue_t: Optional[float] = None
+        self.invoke_start_t: Optional[float] = None
+        self.invoke_end_t: Optional[float] = None
+        self.submit_t: Optional[float] = None
+        self.apply_t: Optional[float] = None
+        self.end_t: Optional[float] = None
+        self.outcome: Optional[str] = None  # "ack" | "nack" | "failed" | "flush"
+
+    def total_ms(self, now: Optional[float] = None) -> float:
+        end = self.end_t if self.end_t is not None else (now or _clock())
+        return (end - self.enqueue_t) * 1000.0
+
+    def to_dict(self, now: Optional[float] = None) -> Dict[str, object]:
+        def ms(a: Optional[float], b: Optional[float]) -> Optional[float]:
+            if a is None or b is None:
+                return None
+            return round((b - a) * 1000.0, 3)
+
+        return {
+            "eval_id": self.eval_id,
+            "job_id": self.job_id,
+            "namespace": self.namespace,
+            "type": self.type,
+            "triggered_by": self.triggered_by,
+            "priority": self.priority,
+            "attempt": self.attempt,
+            "worker_id": self.worker_id,
+            "path": self.path,
+            "outcome": self.outcome,
+            "queue_ms": ms(self.enqueue_t, self.dequeue_t),
+            "invoke_wait_ms": ms(self.dequeue_t, self.invoke_start_t),
+            "invoke_ms": ms(self.invoke_start_t, self.invoke_end_t),
+            "submit_to_apply_ms": ms(self.submit_t, self.apply_t),
+            "apply_to_end_ms": ms(self.apply_t, self.end_t),
+            "total_ms": round(self.total_ms(now), 3),
+        }
+
+
+_lock = threading.Lock()
+_inflight: Dict[str, EvalTrace] = {}
+_done: "deque[EvalTrace]" = deque(maxlen=_DONE_CAP)
+_counts: Dict[str, int] = {"ack": 0, "nack": 0, "failed": 0, "flush": 0}
+
+
+def reset() -> None:
+    """Drop all records (tests / broker re-enable)."""
+    with _lock:
+        _inflight.clear()
+        _done.clear()
+        for k in _counts:
+            _counts[k] = 0
+
+
+# -- stamping API (call sites: broker, worker, scheduler, applier) ---------
+
+
+def on_enqueue(evaluation) -> None:
+    """Eval entered a READY heap: open a record (no-op if one is already
+    in flight for this id — e.g. requeue-after-ack dedup races)."""
+    rec = EvalTrace(
+        evaluation.id, getattr(evaluation, "job_id", ""),
+        getattr(evaluation, "namespace", ""), getattr(evaluation, "type", ""),
+        getattr(evaluation, "triggered_by", ""),
+        getattr(evaluation, "priority", 0), _clock(),
+    )
+    with _lock:
+        _inflight.setdefault(evaluation.id, rec)
+
+
+def on_dequeue(eval_id: str, attempt: int) -> None:
+    with _lock:
+        rec = _inflight.get(eval_id)
+        if rec is not None and rec.dequeue_t is None:
+            rec.dequeue_t = _clock()
+            rec.attempt = attempt
+
+
+def on_worker(eval_id: str, worker_id: int) -> None:
+    with _lock:
+        rec = _inflight.get(eval_id)
+        if rec is not None:
+            rec.worker_id = worker_id
+
+
+def set_path(eval_id: str, path: str) -> None:
+    """Tag which placement path the scheduler took: ``host`` (python
+    iterator stack) or ``device`` (TPU batched scan)."""
+    with _lock:
+        rec = _inflight.get(eval_id)
+        if rec is not None:
+            rec.path = path
+
+
+def on_invoke_start(eval_id: str) -> None:
+    with _lock:
+        rec = _inflight.get(eval_id)
+        if rec is not None:
+            rec.invoke_start_t = _clock()
+
+
+def on_invoke_end(eval_id: str) -> None:
+    with _lock:
+        rec = _inflight.get(eval_id)
+        if rec is not None:
+            rec.invoke_end_t = _clock()
+
+
+def on_plan_submit(eval_id: str) -> None:
+    with _lock:
+        rec = _inflight.get(eval_id)
+        if rec is not None and rec.submit_t is None:
+            rec.submit_t = _clock()
+
+
+def on_apply(eval_id: str) -> None:
+    """Plan applier resolved this eval's plan (committed or rejected)."""
+    with _lock:
+        rec = _inflight.get(eval_id)
+        if rec is not None:
+            rec.apply_t = _clock()
+
+
+def _close(eval_id: str, outcome: str) -> None:
+    with _lock:
+        rec = _inflight.pop(eval_id, None)
+        if rec is None:
+            return
+        rec.end_t = _clock()
+        rec.outcome = outcome
+        _done.append(rec)
+        _counts[outcome] = _counts.get(outcome, 0) + 1
+
+
+def on_ack(eval_id: str) -> None:
+    _close(eval_id, "ack")
+
+
+def on_nack(eval_id: str, failed: bool = False) -> None:
+    """Delivery failed. ``failed=True`` means the delivery limit was hit
+    (eval routed to the failed queue — no fresh record will open)."""
+    _close(eval_id, "failed" if failed else "nack")
+
+
+def on_flush() -> None:
+    """Broker flushed (leadership lost): close every in-flight record."""
+    with _lock:
+        now = _clock()
+        for rec in _inflight.values():
+            rec.end_t = now
+            rec.outcome = "flush"
+            _done.append(rec)
+            _counts["flush"] += 1
+        _inflight.clear()
+
+
+# -- read side -------------------------------------------------------------
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summary() -> Dict[str, object]:
+    now = _clock()
+    with _lock:
+        durations = sorted(r.total_ms() for r in _done)
+        inflight = list(_inflight.values())
+        counts = dict(_counts)
+    slowest = max((r.total_ms(now) for r in inflight), default=0.0)
+    return {
+        "inflight": len(inflight),
+        "completed": len(durations),
+        "outcomes": counts,
+        "eval_ms_p50": round(_percentile(durations, 0.50), 3),
+        "eval_ms_p95": round(_percentile(durations, 0.95), 3),
+        "eval_ms_p99": round(_percentile(durations, 0.99), 3),
+        "slowest_inflight_ms": round(slowest, 3),
+    }
+
+
+def slowest_inflight(n: int = 5) -> List[Dict[str, object]]:
+    """The n oldest in-flight records (watchdog dump material)."""
+    now = _clock()
+    with _lock:
+        recs = sorted(_inflight.values(), key=lambda r: r.enqueue_t)[:n]
+        return [r.to_dict(now) for r in recs]
+
+
+def snapshot(recent: int = 64) -> Dict[str, object]:
+    """The /v1/trace payload: summary + in-flight + recent completions."""
+    now = _clock()
+    with _lock:
+        inflight = [r.to_dict(now) for r in
+                    sorted(_inflight.values(), key=lambda r: r.enqueue_t)]
+        done = [r.to_dict(now) for r in list(_done)[-recent:]]
+    out = summary()
+    out["inflight_evals"] = inflight
+    out["recent"] = done
+    return out
+
+
+def publish_gauges() -> None:
+    """Push trace tail-latency gauges into the metrics sink (the server
+    calls this from its periodic stats sweep, so /v1/metrics carries
+    them without a /v1/trace round trip)."""
+    s = summary()
+    metrics.set_gauge("nomad.trace.eval_ms.p50", s["eval_ms_p50"])
+    metrics.set_gauge("nomad.trace.eval_ms.p95", s["eval_ms_p95"])
+    metrics.set_gauge("nomad.trace.eval_ms.p99", s["eval_ms_p99"])
+    metrics.set_gauge("nomad.trace.slowest_inflight_ms",
+                      s["slowest_inflight_ms"])
+    metrics.set_gauge("nomad.trace.inflight", s["inflight"])

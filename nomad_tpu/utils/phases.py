@@ -20,6 +20,34 @@ window. Phases tracked across the system path:
   apply          decode results -> plan blocks (engine._apply_*, GIL)
   plan_evaluate  applier re-check against snapshot (plan_apply, GIL)
   raft_fsm       raft apply -> FSM -> state store commit (GIL)
+  snapshot       worker's shared state-snapshot clone (worker._process)
+  reconcile      desired-vs-existing alloc diff (generic_sched)
+  rank           host placement iterator stack pull: feasibility +
+                 scoring per candidate (rank.BinPackIterator.next —
+                 covers the whole upstream iterator chain)
+  proposed       per-candidate proposed-alloc rebuild (context.py)
+  dense_mat      dense-block slot materialization (state_store)
+  place          host placement loop: select + alloc construction glue
+                 around the rank pulls (generic/system scheduler)
+  engine_gate    device-path gate checks + encode attempts + fallback
+                 decision (tpu/integration.py; engine phases nest inside)
+  plan_submit    worker parked on the plan queue future (worker)
+  wait_index     worker parked on raft replication before snapshotting
+  raft_fsm       raft log append -> FSM -> state store commit (every
+                 Server.raft_apply, plan commits included)
+
+META-PHASES (excluded from ``any_host``/``busy``, which aggregate only
+fine phases): ``worker_busy`` brackets the whole of a worker's eval
+processing and exists so ``coverage()`` can answer "what fraction of
+measured worker busy time do the fine phases explain" — the ISSUE 4
+self-check against round 5's 17%-busy blindness, where the host
+iterator stack burned wall time no phase accounted for.
+
+Hot-loop spans (rank/proposed/dense_mat run per candidate, thousands of
+times per eval) COALESCE: a span starting within _COALESCE_GAP of the
+previous same-phase span's end merges into it, bounding memory at
+O(distinct bursts) instead of O(calls) with at most _COALESCE_GAP of
+union-length overestimate per merge.
 """
 from __future__ import annotations
 
@@ -31,6 +59,14 @@ from typing import Dict, List, Tuple
 _lock = threading.Lock()
 _intervals: Dict[str, List[Tuple[float, float]]] = {}
 _enabled = False
+
+# phases that measure a measurement (a window, not work); never summed
+# into the busy/any_host aggregates
+_META = frozenset({"worker_busy"})
+
+# merge same-phase spans closer than this (seconds); ~10k coalesced
+# hot-loop calls collapse into a handful of burst intervals
+_COALESCE_GAP = 2e-4
 
 
 def enable() -> None:
@@ -60,7 +96,12 @@ def track(name: str):
         t1 = time.perf_counter()
         with _lock:
             if _enabled:
-                _intervals.setdefault(name, []).append((t0, t1))
+                spans = _intervals.setdefault(name, [])
+                if spans and t0 - spans[-1][1] < _COALESCE_GAP:
+                    last = spans[-1]
+                    spans[-1] = (min(last[0], t0), max(last[1], t1))
+                else:
+                    spans.append((t0, t1))
 
 
 def now() -> float:
@@ -90,16 +131,78 @@ def wall_shares(t0: float, t1: float) -> Dict[str, float]:
     """Seconds of the [t0, t1] window during which >= 1 thread was inside
     each phase (interval union — NOT a thread-sum), plus:
 
-      any_host   union over every host-side phase (all but ``device``)
-      busy       union over every phase
+      any_host   union over every host-side fine phase (all but
+                 ``device`` and meta-phases)
+      busy       union over every fine phase (meta-phases excluded)
       window     t1 - t0
     """
     with _lock:
         snap = {k: list(v) for k, v in _intervals.items()}
     out = {k: round(_union_len(v, t0, t1), 3) for k, v in snap.items()}
-    host = [s for k, v in snap.items() if k != "device" for s in v]
-    every = [s for v in snap.values() for s in v]
+    host = [s for k, v in snap.items()
+            if k != "device" and k not in _META for s in v]
+    every = [s for k, v in snap.items() if k not in _META for s in v]
     out["any_host"] = round(_union_len(host, t0, t1), 3)
     out["busy"] = round(_union_len(every, t0, t1), 3)
     out["window"] = round(t1 - t0, 3)
     return out
+
+
+def _merged(spans: List[Tuple[float, float]], lo: float,
+            hi: float) -> List[Tuple[float, float]]:
+    """Sorted, disjoint, window-clipped intervals."""
+    clipped = sorted(
+        (max(a, lo), min(b, hi)) for a, b in spans if b > lo and a < hi
+    )
+    out: List[Tuple[float, float]] = []
+    for a, b in clipped:
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _intersect_len(xs: List[Tuple[float, float]],
+                   ys: List[Tuple[float, float]]) -> float:
+    """Total overlap length of two disjoint-sorted interval lists."""
+    total = 0.0
+    i = j = 0
+    while i < len(xs) and j < len(ys):
+        a = max(xs[i][0], ys[j][0])
+        b = min(xs[i][1], ys[j][1])
+        if b > a:
+            total += b - a
+        if xs[i][1] < ys[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def coverage(t0: float, t1: float) -> Dict[str, float]:
+    """Phase-attribution coverage self-check (ISSUE 4): what fraction of
+    measured worker busy wall time (the ``worker_busy`` meta-phase
+    union) do the fine phases explain?
+
+      worker_busy   union seconds any worker spent processing an eval
+      tracked_busy  seconds of that during which >= 1 fine phase was
+                    also active (anywhere — the device phase runs on the
+                    dispatcher thread while the worker blocks, and still
+                    explains the worker's wait)
+      coverage      tracked_busy / worker_busy  (1.0 when never busy)
+
+    Round 5's blindness was coverage ~0.17: the host iterator stack
+    burned wall time no phase claimed. The stress suite asserts >= 0.9.
+    """
+    with _lock:
+        snap = {k: list(v) for k, v in _intervals.items()}
+    busy = _merged(snap.get("worker_busy", []), t0, t1)
+    fine = [s for k, v in snap.items() if k not in _META for s in v]
+    tracked = _intersect_len(_merged(fine, t0, t1), busy)
+    busy_len = sum(b - a for a, b in busy)
+    return {
+        "worker_busy": round(busy_len, 3),
+        "tracked_busy": round(tracked, 3),
+        "coverage": round(tracked / busy_len, 4) if busy_len else 1.0,
+    }

@@ -337,6 +337,69 @@ def test_fsm_real_module_is_deterministic():
 
 
 # ---------------------------------------------------------------------------
+# fixture units — trace-span-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_trace_span_flags_bare_track_call():
+    # discarding the context manager: the span never opens (or worse,
+    # opens in __init__-style factories and never closes)
+    src = dedent("""
+        from nomad_tpu.utils import phases
+        def process(ev):
+            phases.track("rank")
+            return rank(ev)
+    """)
+    fs = run_source(src, "server/worker.py")
+    assert [f.rule for f in fs] == ["trace-span-discipline"]
+    assert "phases.track" in fs[0].message
+
+
+def test_trace_span_flags_manual_enter_dance():
+    # storing the manager for a manual __enter__/__exit__ pair: an
+    # exception between the two leaves the span open forever
+    src = dedent("""
+        from ..utils import phases as _phases
+        def process(ev):
+            cm = _phases.track("rank")
+            cm.__enter__()
+            work(ev)
+            cm.__exit__(None, None, None)
+    """)
+    fs = run_source(src, "server/worker.py")
+    assert [f.rule for f in fs] == ["trace-span-discipline"]
+    assert "_phases.track" in fs[0].message
+
+
+def test_trace_span_flags_bare_worker_span():
+    src = dedent("""
+        class Worker:
+            def _process(self, ev):
+                self._span("invoke_scheduler", ev.id)
+                self.sched.process(ev)
+    """)
+    fs = run_source(src, "server/worker.py")
+    assert [f.rule for f in fs] == ["trace-span-discipline"]
+    assert "._span" in fs[0].message
+
+
+def test_trace_span_accepts_with_and_enter_context():
+    src = dedent("""
+        from contextlib import ExitStack
+        from nomad_tpu.utils import phases
+        class Worker:
+            def _process(self, ev):
+                with phases.track("worker_busy"):
+                    with self._span("invoke_scheduler", ev.id):
+                        work(ev)
+                with ExitStack() as st:
+                    st.enter_context(phases.track("rank"))
+                    work(ev)
+    """)
+    assert run_source(src, "server/worker.py") == []
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

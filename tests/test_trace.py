@@ -1,0 +1,216 @@
+"""nomad-trace: eval lifecycle records, the liveness watchdog, and the
+/v1/trace surface.
+
+The lifecycle tests drive a bare EvalBroker (the stamping call sites are
+inside enqueue/dequeue/ack/nack, so no server is needed); the watchdog
+test runs a real in-proc Server whose scheduler is replaced by a stub
+that parks mid-invoke — the synthetic form of round 5's stall, where
+evals sat unacked for minutes with placement flat and nothing alarmed.
+"""
+import logging
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.eval_broker import EvalBroker
+from nomad_tpu.structs.structs import EVAL_STATUS_PENDING, Evaluation
+from nomad_tpu.trace import lifecycle
+from nomad_tpu.utils import metrics
+
+
+def _gauges():
+    return {g["Name"]: g["Value"]
+            for g in metrics.global_sink().summary()["Gauges"]}
+
+
+def spin_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out: {msg}")
+
+
+# ---------------------------------------------------------------------------
+# lifecycle records through the broker
+# ---------------------------------------------------------------------------
+
+
+def test_broker_round_trip_produces_one_acked_record():
+    lifecycle.reset()
+    broker = EvalBroker(nack_timeout=5.0)
+    broker.set_enabled(True)
+    ev = Evaluation(job_id="trace-job", type="service",
+                    status=EVAL_STATUS_PENDING, priority=50)
+    broker.enqueue(ev)
+    assert lifecycle.summary()["inflight"] == 1
+
+    got, token = broker.dequeue(["service"], timeout=2.0)
+    assert got is not None and got.id == ev.id
+    broker.ack(ev.id, token)
+
+    s = lifecycle.summary()
+    assert s["inflight"] == 0
+    assert s["completed"] == 1
+    assert s["outcomes"]["ack"] == 1
+    assert s["eval_ms_p50"] > 0
+
+    rec = lifecycle.snapshot()["recent"][-1]
+    assert rec["eval_id"] == ev.id
+    assert rec["job_id"] == "trace-job"
+    assert rec["outcome"] == "ack"
+    assert rec["attempt"] == 1
+    assert rec["queue_ms"] is not None and rec["queue_ms"] >= 0
+    assert rec["total_ms"] >= rec["queue_ms"]
+
+
+def test_nack_closes_record_and_redelivery_opens_fresh_one():
+    lifecycle.reset()
+    broker = EvalBroker(nack_timeout=5.0, delivery_limit=10,
+                        initial_nack_delay=0.02, subsequent_nack_delay=0.05)
+    broker.set_enabled(True)
+    ev = Evaluation(job_id="trace-nack", type="service",
+                    status=EVAL_STATUS_PENDING, priority=50)
+    broker.enqueue(ev)
+    got, token = broker.dequeue(["service"], timeout=2.0)
+    broker.nack(got.id, token)
+
+    s = lifecycle.summary()
+    assert s["outcomes"]["nack"] == 1
+
+    # after the nack delay the broker re-enqueues: a FRESH record opens
+    # carrying the bumped delivery counter as the OCC attempt number
+    got2, token2 = broker.dequeue(["service"], timeout=5.0)
+    assert got2 is not None and got2.id == ev.id
+    broker.ack(got2.id, token2)
+    recs = lifecycle.snapshot()["recent"]
+    assert [r["outcome"] for r in recs] == ["nack", "ack"]
+    assert recs[-1]["attempt"] == 2
+
+
+def test_publish_gauges_exports_tail_latency():
+    lifecycle.reset()
+    metrics.global_sink().reset()
+    broker = EvalBroker(nack_timeout=5.0)
+    broker.set_enabled(True)
+    ev = Evaluation(job_id="trace-gauge", type="service",
+                    status=EVAL_STATUS_PENDING, priority=50)
+    broker.enqueue(ev)
+    got, token = broker.dequeue(["service"], timeout=2.0)
+    broker.ack(ev.id, token)
+
+    lifecycle.publish_gauges()
+    g = _gauges()
+    assert g["nomad.trace.eval_ms.p50"] > 0
+    assert g["nomad.trace.inflight"] == 0
+    assert "nomad.trace.slowest_inflight_ms" in g
+
+
+# ---------------------------------------------------------------------------
+# liveness watchdog on a synthetic stall
+# ---------------------------------------------------------------------------
+
+
+class _StuckScheduler:
+    """Stands in for every scheduler type: parks mid-invoke until released."""
+
+    started = threading.Event()
+    release = threading.Event()
+
+    def __init__(self, *a, **kw):
+        pass
+
+    def process(self, evaluation):
+        _StuckScheduler.started.set()
+        _StuckScheduler.release.wait(timeout=60)
+
+
+def test_watchdog_dumps_on_stalled_eval(monkeypatch, caplog):
+    from nomad_tpu.server.server import Server, ServerConfig
+
+    lifecycle.reset()
+    _StuckScheduler.started.clear()
+    _StuckScheduler.release.clear()
+    monkeypatch.setattr("nomad_tpu.server.worker.new_scheduler",
+                        lambda *a, **kw: _StuckScheduler())
+
+    server = Server(ServerConfig(
+        num_schedulers=1, device_batch=0,
+        heartbeat_min_ttl=3600, heartbeat_max_ttl=7200,
+        watchdog_interval=0,  # tick manually for determinism
+    ))
+    server.watchdog.stall_after = 0.3
+    server.start()
+    try:
+        server.register_job(mock.job())
+        assert _StuckScheduler.started.wait(timeout=15), \
+            "worker never invoked the stub scheduler"
+
+        # first tick establishes the placed-count baseline
+        assert server.watchdog.tick() is False
+        time.sleep(0.4)
+        with caplog.at_level(logging.WARNING,
+                             logger="nomad_tpu.trace.watchdog"):
+            fired = server.watchdog.tick()
+        assert fired is True
+        assert server.watchdog.fired == 1
+
+        dump = caplog.text
+        assert "liveness watchdog" in dump
+        assert "total_unacked" in dump           # broker stats
+        assert "invoke_scheduler" in dump        # per-worker current span
+        assert "slowest in-flight" in dump
+        assert "thread stacks" in dump
+
+        spans = server.watchdog.worker_spans()
+        assert any(s["span"] is not None
+                   and s["span"]["phase"] == "invoke_scheduler"
+                   for s in spans)
+
+        # the stuck eval shows up as a nonzero slowest-in-flight gauge
+        metrics.global_sink().reset()
+        lifecycle.publish_gauges()
+        g = _gauges()
+        assert g["nomad.trace.slowest_inflight_ms"] > 300
+        assert g["nomad.trace.inflight"] >= 1
+
+        # rate limit: an immediate re-tick inside the window stays quiet
+        assert server.watchdog.tick() is False
+    finally:
+        _StuckScheduler.release.set()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# /v1/trace endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_v1_trace_endpoint_end_to_end():
+    import json
+    import urllib.request
+
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    lifecycle.reset()
+    agent = Agent(AgentConfig(dev_mode=True, num_schedulers=2, name="trace1"))
+    agent.start()
+    try:
+        agent.server.register_job(mock.job())
+        spin_until(lambda: lifecycle.summary()["completed"] >= 1,
+                   msg="an eval completing")
+        with urllib.request.urlopen(
+                agent.http_addr + "/v1/trace?recent=8", timeout=30) as resp:
+            out = json.loads(resp.read().decode())
+        assert out["completed"] >= 1
+        assert "eval_ms_p50" in out and "slowest_inflight_ms" in out
+        assert isinstance(out["inflight_evals"], list)
+        assert isinstance(out["recent"], list) and len(out["recent"]) <= 8
+        assert out["recent"][-1]["outcome"] in ("ack", "nack", "failed")
+        # agent runs a server: worker spans ride along
+        assert "workers" in out
+    finally:
+        agent.shutdown()
