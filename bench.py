@@ -417,6 +417,14 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             # wall-clock share (interval UNION across threads, not a
             # thread-sum) each pipeline phase held during the window
             "phases": phase_shares,
+            # gather/routing knobs this row ran with, so rows measuring
+            # the PRODUCTION ServerConfig defaults are distinguishable
+            # from bench-tuned gather windows
+            "batcher_config": {
+                "device_min_placements": device_min_placements,
+                "window_ms": window_ms,
+                "idle_ms": idle_ms,
+            },
         }
         if server.device_batcher:
             out["dispatch_profile"] = server.device_batcher.dispatch_profile()
@@ -706,6 +714,30 @@ def system_benches():
     # single-flight encode cache collapses the per-eval encode
     r = _diagnostic(bench_system, "service-spread-5K", 5000, jobs, timeout=300.0,
                     idle_ms=100.0, window_ms=2000.0, warmup=_spread_warm)
+    if r:
+        results.append(r)
+
+    # config 3b: the PRODUCTION batcher defaults at the 5K-node shape —
+    # device_min_placements=24, gather window 25ms, idle gap 3ms (the
+    # ServerConfig defaults). Recorded as its own row so regressions in
+    # the defaults an operator actually gets are visible directly,
+    # instead of hiding behind the bench-tuned gather windows above.
+    def _prod_job(job_id):
+        j = mock.job()
+        j.id = job_id
+        j.task_groups[0].count = 100
+        j.task_groups[0].tasks[0].resources.cpu = 50
+        j.task_groups[0].tasks[0].resources.memory_mb = 64
+        return j
+
+    jobs = [_prod_job(f"prod-{i}") for i in range(10)]
+
+    def _prod_warm():
+        return _prod_job("warm-prod")
+
+    r = _diagnostic(bench_system, "service-prod-defaults-5K", 5000, jobs,
+                    timeout=300.0, window_ms=25.0, idle_ms=3.0,
+                    device_min_placements=24, warmup=_prod_warm)
     if r:
         results.append(r)
 

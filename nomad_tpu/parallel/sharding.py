@@ -73,6 +73,12 @@ def batched_scan_shardings(mesh):
         ns(e, None, "nodes"),        # dp_vids [B, D, N]
         ns(e, None),                 # dp_limit [B, D]
         ns(e, None, None),           # dp_applies [B, G, D]
+        ns(e, "nodes", None, None),  # pre_res [B, N, C, 4]
+        ns(e, "nodes", None),        # pre_prio [B, N, C]
+        ns(e, "nodes", None),        # pre_elig [B, N, C]
+        ns(e, "nodes", None),        # pre_mp [B, N, C]
+        ns(e, "nodes", None),        # pre_gid [B, N, C]
+        ns(e, "nodes", None, None),  # pre_evf [B, N, C, 2]
     )
     carry = (
         ns(e, "nodes", None),        # used [B, N, D]
@@ -84,6 +90,9 @@ def batched_scan_shardings(mesh):
         ns(e, None),                 # failed [B, G]
         ns(e, "nodes", None),        # e_base [B, N, 2]
         ns(e, None, None),           # dp_counts [B, D, V]
+        ns(e, "nodes", None),        # pre_alive [B, N, C]
+        ns(e, "nodes", None),        # pre_remaining [B, N, 3]
+        ns(e, None),                 # pre_counts [B, GP]
     )
     xs = (
         ns(e, None),                 # tg_idx [B, P]

@@ -106,6 +106,12 @@ class EvalContext:
         # deterministic scheduling (no shuffle, lowest-index dynamic ports);
         # used by the host/TPU parity harness
         self.deterministic = deterministic
+        # Per-node memoization across one eval's placements. The snapshot
+        # is immutable for the eval's lifetime, so a node's proposed set
+        # (and the NetworkIndex built from it) only changes when THIS
+        # plan touches the node — keyed by the plan-shape token below.
+        self._proposed_cache: Dict[str, tuple] = {}
+        self._netidx_cache: Dict[str, tuple] = {}
         # Deterministic-mode analog of the reference's per-eval node
         # shuffle (stack.go:67 SetNodes -> util.go:329 shuffleNodes):
         # a per-eval starting offset for the candidate ring. Without it,
@@ -118,11 +124,27 @@ class EvalContext:
     def reset(self) -> None:
         self.metrics = AllocMetric()
 
+    def _plan_token(self, node_id: str) -> tuple:
+        """Shape of this plan's mutations for one node; any placement,
+        eviction or preemption appended for the node changes a length
+        and invalidates that node's cached proposed/NetworkIndex state."""
+        return (
+            len(self.plan.node_allocation.get(node_id, ())),
+            len(self.plan.node_update.get(node_id, ())),
+            len(self.plan.node_preemptions.get(node_id, ())),
+        )
+
     def proposed_allocs(self, node_id: str) -> List[Allocation]:
         """Existing non-terminal allocs - planned evictions - preemptions
-        + planned placements (reference context.go:120)."""
+        + planned placements (reference context.go:120), memoized per
+        node for the duration of the eval (invalidated when the plan
+        touches the node)."""
         from ..utils import phases as _phases
 
+        token = self._plan_token(node_id)
+        hit = self._proposed_cache.get(node_id)
+        if hit is not None and hit[0] == token:
+            return list(hit[1])
         with _phases.track("proposed"):
             existing = self.state.allocs_by_node_terminal(node_id, False)
             proposed = existing
@@ -137,7 +159,27 @@ class EvalContext:
             by_id = {a.id: a for a in proposed}
             for alloc in self.plan.node_allocation.get(node_id, []):
                 by_id[alloc.id] = alloc
-            return list(by_id.values())
+            out = list(by_id.values())
+            self._proposed_cache[node_id] = (token, out)
+            return list(out)
+
+    def network_index(self, node, proposed: List[Allocation]):
+        """Base NetworkIndex for ``node`` with ``proposed`` folded in,
+        memoized like proposed_allocs; callers get a fork so their
+        add_reserved calls never mutate the cached base. ``proposed``
+        MUST be the ctx.proposed_allocs set for the node (the cache key
+        assumes it)."""
+        from ..structs.network import NetworkIndex
+
+        token = self._plan_token(node.id)
+        hit = self._netidx_cache.get(node.id)
+        if hit is not None and hit[0] == token:
+            return hit[1].fork()
+        base = NetworkIndex(deterministic=self.deterministic)
+        base.set_node(node)
+        base.add_allocs(proposed)
+        self._netidx_cache[node.id] = (token, base)
+        return base.fork()
 
     def get_eligibility(self) -> EvalEligibility:
         if self.eligibility is None:

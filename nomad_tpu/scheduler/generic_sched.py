@@ -355,6 +355,14 @@ class GenericScheduler:
                              by_dc, deployment_id: str) -> None:
         now = _time.time_ns()
 
+        # Config-gated preemption for generic (service/batch) evals: the
+        # same switch the device encode consults, so host fallback and
+        # device scan agree on whether this eval may evict.
+        from .preemption import preemption_enabled
+
+        _, sched_config = self.state.scheduler_config()
+        preempt = preemption_enabled(sched_config, self.job.type)
+
         # Destructive before place: their resources must be discounted first.
         for results in (destructive, place):
             for missing in results:
@@ -371,7 +379,9 @@ class GenericScheduler:
                 if stop_prev_alloc:
                     self.plan.append_stopped_alloc(prev_allocation, stop_prev_alloc_desc, "")
 
-                select_options = get_select_options(prev_allocation, preferred_node)
+                select_options = get_select_options(
+                    prev_allocation, preferred_node, preempt=preempt
+                )
                 option = self.select_next_option(tg, select_options)
 
                 self.ctx.metrics.nodes_available = by_dc
@@ -496,8 +506,9 @@ class GenericScheduler:
         return update_fn
 
 
-def get_select_options(prev_allocation: Optional[Allocation], preferred_node) -> SelectOptions:
-    options = SelectOptions()
+def get_select_options(prev_allocation: Optional[Allocation], preferred_node,
+                       preempt: bool = False) -> SelectOptions:
+    options = SelectOptions(preempt=preempt)
     if prev_allocation is not None:
         penalty = set()
         if prev_allocation.client_status == ALLOC_CLIENT_FAILED:

@@ -31,6 +31,31 @@ MAX_PARALLEL_PENALTY = 50.0
 PRIORITY_DELTA = 10
 
 
+def preemption_enabled(sched_config, job_type: str) -> bool:
+    """Whether the cluster's SchedulerConfiguration allows preemption for
+    evals of ``job_type`` (reference structs/operator.go PreemptionConfig
+    defaults: system on, service/batch off). The ONE switch both the host
+    stack wiring (generic_sched.get_select_options, stack.SystemStack)
+    and the device encode (tpu/engine) consult, so they can never
+    disagree on whether an eval preempts."""
+    from ..structs.structs import (
+        JOB_TYPE_BATCH,
+        JOB_TYPE_SYSTEM,
+        PreemptionConfig,
+    )
+
+    pc = (
+        sched_config.preemption_config
+        if sched_config is not None
+        else PreemptionConfig()
+    )
+    if job_type == JOB_TYPE_SYSTEM:
+        return pc.system_scheduler_enabled
+    if job_type == JOB_TYPE_BATCH:
+        return pc.batch_scheduler_enabled
+    return pc.service_scheduler_enabled
+
+
 def basic_resource_distance(
     ask: ComparableResources, used: ComparableResources
 ) -> float:
@@ -194,6 +219,14 @@ class Preemptor:
         for alloc in self.current_allocs:
             self.node_remaining_resources.subtract(self.alloc_details[alloc.id].resources)
 
+        # Deterministic (parity) mode: the exact integer spec of
+        # tpu/preempt.py IS the selection algorithm, shared verbatim with
+        # the device kernel so host and device eviction sets are
+        # bit-identical on every backend. Float64 remains the
+        # throughput-mode scorer below.
+        if self.ctx is not None and getattr(self.ctx, "deterministic", False):
+            return self._preempt_for_task_group_int(resource_ask)
+
         allocs_by_priority = filter_and_group_preemptible_allocs(
             self.job_priority, self.current_allocs
         )
@@ -237,6 +270,49 @@ class Preemptor:
         return self._filter_superset_basic(
             best_allocs, self.node_remaining_resources, resources_needed
         )
+
+    def _preempt_for_task_group_int(self, resource_ask: AllocatedResources) -> List[Allocation]:
+        """Integer-spec selection (deterministic mode): flatten the
+        candidate list in insertion order and run the shared greedy +
+        second-pass spec. ``node_remaining_resources`` has already had
+        every candidate subtracted by the caller."""
+        from ..tpu.preempt import penalty_q_py, select_eviction_set_py
+
+        ask_cmp = resource_ask.comparable()
+        ask3 = [
+            int(ask_cmp.flattened.cpu_shares),
+            int(ask_cmp.flattened.memory_mb),
+            int(ask_cmp.shared.disk_mb),
+        ]
+        rem = self.node_remaining_resources
+        remaining3 = [
+            int(rem.flattened.cpu_shares),
+            int(rem.flattened.memory_mb),
+            int(rem.shared.disk_mb),
+        ]
+        res3: List[List[int]] = []
+        prio: List[int] = []
+        pen: List[int] = []
+        elig: List[bool] = []
+        for alloc in self.current_allocs:
+            details = self.alloc_details[alloc.id]
+            r = details.resources
+            res3.append([
+                int(r.flattened.cpu_shares),
+                int(r.flattened.memory_mb),
+                int(r.shared.disk_mb),
+            ])
+            ok = (
+                alloc.job is not None
+                and self.job_priority - alloc.job.priority >= PRIORITY_DELTA
+            )
+            elig.append(ok)
+            prio.append(alloc.job.priority if alloc.job is not None else 0)
+            pen.append(penalty_q_py(details.max_parallel, self._num_preemptions(alloc)))
+        sel = select_eviction_set_py(ask3, remaining3, res3, prio, pen, elig)
+        if sel is None:
+            return []
+        return [self.current_allocs[i] for i in sel]
 
     def _filter_superset_basic(
         self,

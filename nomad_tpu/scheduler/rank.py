@@ -46,6 +46,25 @@ class RankedNode:
         return f"<Node: {self.node.id} Score: {self.final_score:.3f}>"
 
 
+class _FitProbe:
+    """Duck-typed stand-in for the would-be placement in the final fit
+    check: allocs_fit only calls terminal_status() and
+    comparable_resources(), so minting a UUID-bearing Allocation per
+    node visit is pure id-generation overhead at ranking volume."""
+
+    __slots__ = ("_resources",)
+
+    def __init__(self, resources: AllocatedResources) -> None:
+        self._resources = resources
+
+    @staticmethod
+    def terminal_status() -> bool:
+        return False
+
+    def comparable_resources(self):
+        return self._resources.comparable()
+
+
 class FeasibleRankIterator:
     """Upgrades a feasible iterator to a rank iterator."""
 
@@ -130,9 +149,9 @@ class BinPackIterator:
 
             proposed = option.proposed_allocs(self.ctx)
 
-            net_idx = NetworkIndex(deterministic=self.ctx.deterministic)
-            net_idx.set_node(option.node)
-            net_idx.add_allocs(proposed)
+            # forked from the ctx's per-node cached base index; our
+            # add_reserved calls stay private to this candidate visit
+            net_idx = self.ctx.network_index(option.node, proposed)
 
             dev_allocator = DeviceAllocator(self.ctx, option.node)
             dev_allocator.add_allocs(proposed)
@@ -248,7 +267,7 @@ class BinPackIterator:
                 continue
 
             current = proposed
-            proposed = proposed + [Allocation(allocated_resources=total)]
+            proposed = proposed + [_FitProbe(total)]
 
             fit, dim, used = allocs_fit(option.node, proposed, net_idx, check_devices=False)
             if not fit:

@@ -386,6 +386,19 @@ class Planner:
             for alloc in allocs:
                 _free(alloc)
 
+        # Dense-path preemptions: plan.node_preemptions rows for nodes the
+        # object path never touched are credited (and later committed)
+        # here. Object-path nodes were already folded above — accepted ones
+        # are in result.node_preemptions, rejected ones must stay dropped.
+        dense_pre: Dict[str, list] = {
+            nid: allocs
+            for nid, allocs in plan.node_preemptions.items()
+            if allocs and nid not in plan.node_allocation
+        }
+        for allocs in dense_pre.values():
+            for alloc in allocs:
+                _free(alloc)
+
         mirror = getattr(snapshot, "_node_usage", {})
         # adds accumulated across blocks (and the object-path placements
         # committed above, which the mirror does not include yet)
@@ -461,6 +474,12 @@ class Planner:
         partial = bool(bad)
         if bad:
             metrics.incr_counter("nomad.plan.dense_nodes_rejected", len(bad))
+        # Commit dense-node preemptions only when the node's dense
+        # placements survived (per-node all-or-nothing, same as the
+        # object path: a rejected node keeps its victims running).
+        for nid, allocs in dense_pre.items():
+            if nid in plan_add and nid not in bad:
+                result.node_preemptions[nid] = allocs
         for block in plan.dense_placements:
             if not bad:
                 out.append(block)

@@ -56,6 +56,18 @@ class NetworkIndex:
     def release(self) -> None:  # compat no-op; no pooled bitmaps here
         pass
 
+    def fork(self) -> "NetworkIndex":
+        """Cheap copy for speculative mutation: shares the node's
+        avail_networks/avail_bandwidth (only set_node writes those, and
+        forks never call it), copies the used-port sets and bandwidth
+        tallies so add_reserved on the fork never bleeds into the base."""
+        c = NetworkIndex(deterministic=self.deterministic)
+        c.avail_networks = self.avail_networks
+        c.avail_bandwidth = self.avail_bandwidth
+        c.used_ports = {ip: set(ports) for ip, ports in self.used_ports.items()}
+        c.used_bandwidth = dict(self.used_bandwidth)
+        return c
+
     def overcommitted(self) -> bool:
         for device, used in self.used_bandwidth.items():
             if used > self.avail_bandwidth.get(device, 0):

@@ -1405,6 +1405,10 @@ class DenseTGPlacements:
     scores: List[float] = field(default_factory=list)
     nodes_evaluated: List[int] = field(default_factory=list)
     nodes_available: Dict[str, int] = field(default_factory=dict)
+    # per-placement preempted alloc ids (device-side preemption engine,
+    # tpu/preempt.py); empty when the block preempts nothing — the
+    # common case, so the wire cost is one empty list
+    preempted: List[List[str]] = field(default_factory=list)
     create_index: int = 0
     modify_index: int = 0
     create_time_ns: int = 0
@@ -1508,6 +1512,8 @@ class DenseTGPlacements:
             )
             # every placement in the block shares ask_vec by construction
             a.__dict__["_usage_vec"] = self.ask_vec
+            if self.preempted and i < len(self.preempted) and self.preempted[i]:
+                a.preempted_allocations = list(self.preempted[i])
             cache[i] = a
         return a
 
@@ -1532,6 +1538,9 @@ class DenseTGPlacements:
                 [self.nodes_evaluated[i] for i in keep] if self.nodes_evaluated else []
             ),
             nodes_available=self.nodes_available,
+            preempted=(
+                [self.preempted[i] for i in keep] if self.preempted else []
+            ),
         )
 
 

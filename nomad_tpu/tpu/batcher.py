@@ -62,7 +62,9 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
                 fac_pad: Optional[int] = None,
                 dpd_pad: Optional[int] = None,
                 dpv_pad: Optional[int] = None,
-                fnd_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
+                fnd_pad: Optional[int] = None,
+                prec_pad: Optional[int] = None,
+                pregp_pad: Optional[int] = None) -> Tuple[tuple, tuple, tuple]:
     """Pad one eval's arrays to the batch's shared bucketed dims.
 
     Padding is semantically inert by construction:
@@ -78,9 +80,11 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
     (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
      dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
      spread_has_targets, spread_active, sum_spread_weights, n_real,
-     e_ask, dp_vids, dp_limit, dp_applies) = enc.static
+     e_ask, dp_vids, dp_limit, dp_applies,
+     pre_res, pre_prio, pre_elig, pre_mp, pre_gid, pre_evf) = enc.static
     (used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
-     offset0, failed0, e_base0, dp_counts0) = enc.carry
+     offset0, failed0, e_base0, dp_counts0,
+     pre_alive0, pre_remaining0, pre_counts0) = enc.carry
     (tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
      limit_p, sum_sw_p, ev_factor, rev_factor, forced_node) = enc.xs
 
@@ -102,6 +106,10 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         dpv_pad = dp_counts0.shape[1]
     if fnd_pad is None:
         fnd_pad = forced_node.shape[1]
+    if prec_pad is None:
+        prec_pad = pre_res.shape[1]
+    if pregp_pad is None:
+        pregp_pad = pre_counts0.shape[0]
     dn, dg, ds, dv, dp = (n_pad - n0, g_pad - g0, s_pad - s0,
                           v_pad - v0, p_pad - p0)
     dd = d_pad - d0
@@ -161,6 +169,18 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         ),
         pad(dp_limit, ((0, dpd_pad - dp_limit.shape[0]),), 1),
         pad(dp_applies, ((0, dg), (0, dpd_pad - dp_applies.shape[1])), False),
+        # preemption candidate axis (tpu/preempt.py): ZERO-width when no
+        # co-batched eval preempts (the step's eviction block compiles
+        # away); mixed batches widen with inert slots — eligibility stays
+        # False, so the greedy pass never takes them and pre_met stays
+        # False (cap_ok falls back to fits) for widened evals
+        pad(pre_res, ((0, dn), (0, prec_pad - pre_res.shape[1]), (0, 0)), 0),
+        pad(pre_prio, ((0, dn), (0, prec_pad - pre_prio.shape[1])), 0),
+        pad(pre_elig, ((0, dn), (0, prec_pad - pre_elig.shape[1])), False),
+        pad(pre_mp, ((0, dn), (0, prec_pad - pre_mp.shape[1])), 0),
+        pad(pre_gid, ((0, dn), (0, prec_pad - pre_gid.shape[1])), 0),
+        pad(pre_evf, ((0, dn), (0, prec_pad - pre_evf.shape[1]), (0, 0)),
+            _E27_NEUTRAL),
     )
     carry = (
         pad(f(used0), ((0, dn), (0, dd))),
@@ -175,6 +195,14 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
             _E27_NEUTRAL),
         pad(dp_counts0, ((0, dpd_pad - dp_counts0.shape[0]),
                          (0, dpv_pad - dp_counts0.shape[1])), 0),
+        pad(pre_alive0, ((0, dn), (0, prec_pad - pre_alive0.shape[1])), False),
+        # pre_remaining rides a zero-HEIGHT row axis when this eval has no
+        # candidate tables; a preempt batch needs full rows (zeros inert:
+        # widened evals' eligibility is all-False)
+        (pad(pre_remaining0, ((0, dn), (0, 0)), 0)
+         if pre_remaining0.shape[0]
+         else np.zeros((n_pad if prec_pad else 0, 3), np.int64)),
+        pad(pre_counts0, ((0, pregp_pad - pre_counts0.shape[0]),), 0),
     )
     xs = (
         pad(tg_idx, ((0, dp),), g0),  # g0 = first padded (pre-failed) slot
@@ -276,14 +304,19 @@ class DeviceBatcher:
                 )
                 self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, timeout: Optional[float] = 5) -> None:
+        """Stop the dispatcher and join warm-compile threads. The default
+        bounded join keeps production/atexit shutdown from hanging on a
+        wedged compile; pass timeout=None for a DETERMINISTIC full join
+        (the multichip dryrun's clean-exit contract — a prewarm thread
+        still inside the runtime at interpreter teardown segfaults)."""
         self._stop.set()
         t = self._thread
         if t is not None:
-            t.join(timeout=5)
+            t.join(timeout=timeout)
         # join outstanding warm-compile threads: a prewarm mid-compile at
         # interpreter teardown segfaults inside the runtime
-        self.wait_warm(timeout=5)
+        self.wait_warm(timeout=timeout)
         # release anyone still parked
         while True:
             try:
@@ -295,10 +328,18 @@ class DeviceBatcher:
 
     # -- worker-facing ---------------------------------------------------
 
+    def has_warmed(self) -> bool:
+        """True once at least one batch has dispatched — i.e. compile
+        buckets exist and a follow-up eval of a seen shape pays only the
+        padded-step cost. The engine's warm-bucket retry gate
+        (compute_placements) reroutes small OCC retries here."""
+        with self._lock:
+            return self.stats["dispatches"] > 0
+
     def run(self, enc: EncodedEval):
         """Submit one encoded eval; blocks until its results are ready.
-        Returns (chosen, scores, pulls, skipped) numpy arrays of length
-        enc.p (already sliced back from the padded batch).
+        Returns (chosen, scores, pulls, skipped, evict) numpy arrays of
+        length enc.p (already sliced back from the padded batch).
 
         Robust against a concurrent stop(): the wait loop re-ensures the
         dispatcher is alive, so a request that slipped into the queue
@@ -539,12 +580,20 @@ class DeviceBatcher:
         dpd_pad = max(e.static[18].shape[0] for e in encs)
         dpv_pad = max(e.carry[8].shape[1] for e in encs)
         fnd_pad = max(e.xs[9].shape[1] for e in encs)
+        # preemption candidate axis: zero when no co-batched eval preempts
+        prec_raw = max(e.static[21].shape[1] for e in encs)
+        prec_pad = _pow2ceil(prec_raw) if prec_raw else 0
+        pregp_pad = (
+            _pow2ceil(max(max(e.carry[11].shape[0] for e in encs), 1))
+            if prec_pad else 0
+        )
         dtype = encs[0].dtype  # dispatch loop groups by dtype
 
         with _phases.track("pad_stack"):
             static_b, carry_b, xs_b, b, b_pad = self._pad_and_stack(
                 encs, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad,
                 k_pad, aff_pad, evd_pad, fac_pad, dpd_pad, dpv_pad, fnd_pad,
+                prec_pad, pregp_pad,
             )
 
         scan = self._scan_fn()
@@ -553,11 +602,12 @@ class DeviceBatcher:
         with _phases.track("device"):
             # compute vs transfer split: block_until_ready fences the
             # device work so np.asarray below times ONLY the D2H copy
-            _carry, (chosen, scores, pulls, skipped) = scan(static_b, carry_b, xs_b)
+            _carry, (chosen, scores, pulls, skipped, evict) = scan(
+                static_b, carry_b, xs_b)
             try:
                 import jax
 
-                jax.block_until_ready((chosen, scores, pulls, skipped))
+                jax.block_until_ready((chosen, scores, pulls, skipped, evict))
             except Exception:  # noqa: BLE001 — non-jax outputs need no fence
                 pass
             t_compute = metrics.now()
@@ -565,6 +615,7 @@ class DeviceBatcher:
             scores = np.asarray(scores)
             pulls = np.asarray(pulls)
             skipped = np.asarray(skipped)
+            evict = np.asarray(evict)
             t_transfer = metrics.now()
         metrics.measure_since("nomad.device_batcher.dispatch", t_stack)
         metrics.add_sample(
@@ -576,6 +627,7 @@ class DeviceBatcher:
         )
         d2h_bytes = (
             chosen.nbytes + scores.nbytes + pulls.nbytes + skipped.nbytes
+            + evict.nbytes
         )
 
         with self._lock:
@@ -599,17 +651,18 @@ class DeviceBatcher:
         for bi, req in enumerate(batch):
             p = req.enc.p
             req.result = (
-                chosen[bi, :p], scores[bi, :p], pulls[bi, :p], skipped[bi, :p]
+                chosen[bi, :p], scores[bi, :p], pulls[bi, :p], skipped[bi, :p],
+                evict[bi, :p],
             )
             req.event.set()
 
     def _pad_and_stack(self, encs, n_pad, g_pad, s_pad, v_pad, p_pad, dtype,
                        d_pad, k_pad, aff_pad, evd_pad, fac_pad, dpd_pad,
-                       dpv_pad, fnd_pad):
+                       dpv_pad, fnd_pad, prec_pad=0, pregp_pad=0):
         padded = [
             pad_encoded(e, n_pad, g_pad, s_pad, v_pad, p_pad, dtype, d_pad,
                         k_pad, aff_pad, evd_pad, fac_pad, dpd_pad, dpv_pad,
-                        fnd_pad)
+                        fnd_pad, prec_pad, pregp_pad)
             for e in encs
         ]
 
@@ -629,7 +682,7 @@ class DeviceBatcher:
                 padded = [
                     pad_encoded(e, n_pad2, g_pad, s_pad, v_pad, p_pad, dtype,
                                 d_pad, k_pad, aff_pad, evd_pad, fac_pad,
-                                dpd_pad, dpv_pad, fnd_pad)
+                                dpd_pad, dpv_pad, fnd_pad, prec_pad, pregp_pad)
                     for e in encs
                 ]
                 n_pad = n_pad2

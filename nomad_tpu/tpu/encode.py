@@ -931,3 +931,114 @@ def build_tg_spec(ctx, job: Job, tg: TaskGroup, nodes: List[Node], batch: bool,
         spread_has_targets=has_targets,
         sum_spread_weights=sum_weights,
     )
+
+
+# ---------------------------------------------------------------------------
+# Preemption candidate tables (device-side eviction, tpu/preempt.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PreemptTables:
+    """Per-node current-allocation tables for the device preemption
+    kernel: one slot per ELIGIBLE candidate (has a job, priority at least
+    PRIORITY_DELTA below the placing job's, not the placing job's own).
+    Ineligible non-own-job allocs only contribute to ``remaining3`` (the
+    reference subtracts every candidate from node remaining, eligible or
+    not; own-job allocs are invisible to the met-check)."""
+
+    c: int            # candidate slots per node (>= 1)
+    gp: int           # distinct (job_id, ns, task_group) count groups
+    res4: np.ndarray  # [N, C, 4] int32 (cpu, mem, disk, mbits)
+    prio: np.ndarray  # [N, C] int32
+    elig: np.ndarray  # [N, C] bool
+    mp: np.ndarray    # [N, C] int32 max_parallel
+    gid: np.ndarray   # [N, C] int32 count-group id
+    remaining3: np.ndarray  # [N, 3] int64
+    counts0: np.ndarray     # [GP] int32 preemption counts at eval start
+    allocs: List[List[object]]  # [N][<=C] candidate Allocation objects
+
+
+def build_preempt_tables(ctx, job, nodes: List[Node]):
+    """Build PreemptTables for one eval, or (None, reason) when a spec
+    gate fails (the engine must then fall back to the host stack for the
+    WHOLE eval — encoding without preemption would diverge from a
+    preempting host oracle)."""
+    from ..structs.funcs import alloc_usage_vec, node_capacity_vecs
+    from .preempt import C_MAX, GP_MAX, PRIORITY_DELTA, RES_CAP as _RES_CAP
+
+    job_key = (job.namespace, job.id)
+    job_priority = job.priority
+
+    n = len(nodes)
+    per_node: List[List[object]] = [[] for _ in range(n)]
+    remaining3 = np.empty((n, 3), np.int64)
+    gid_map: Dict[Tuple[str, str, str], int] = {}
+    c_max_seen = 0
+
+    for i, node in enumerate(nodes):
+        totals, reserved = node_capacity_vecs(node)
+        rem = [
+            int(totals[0]) - int(reserved[0]),
+            int(totals[1]) - int(reserved[1]),
+            int(totals[2]) - int(reserved[2]),
+        ]
+        cands = per_node[i]
+        for alloc in ctx.proposed_allocs(node.id):
+            if (alloc.namespace, alloc.job_id) == job_key:
+                continue
+            u = alloc_usage_vec(alloc)
+            if max(u[0], u[1], u[2], u[3]) > _RES_CAP:
+                return None, "preempt: candidate resources exceed 2**28"
+            rem[0] -= int(u[0])
+            rem[1] -= int(u[1])
+            rem[2] -= int(u[2])
+            if alloc.job is None or job_priority - alloc.job.priority < PRIORITY_DELTA:
+                continue
+            cands.append(alloc)
+            key = (alloc.job_id, alloc.namespace, alloc.task_group)
+            if key not in gid_map:
+                gid_map[key] = len(gid_map)
+        remaining3[i] = rem
+        if len(cands) > C_MAX:
+            return None, "preempt: too many candidates on one node"
+        if len(cands) > c_max_seen:
+            c_max_seen = len(cands)
+
+    gp = len(gid_map)
+    if gp > GP_MAX:
+        return None, "preempt: too many count groups"
+    c = max(c_max_seen, 1)
+
+    res4 = np.zeros((n, c, 4), np.int32)
+    prio = np.zeros((n, c), np.int32)
+    elig = np.zeros((n, c), bool)
+    mp = np.zeros((n, c), np.int32)
+    gid = np.zeros((n, c), np.int32)
+    for i in range(n):
+        for j, alloc in enumerate(per_node[i]):
+            u = alloc_usage_vec(alloc)
+            res4[i, j] = (int(u[0]), int(u[1]), int(u[2]), int(u[3]))
+            prio[i, j] = alloc.job.priority
+            elig[i, j] = True
+            tg = alloc.job.lookup_task_group(alloc.task_group)
+            if tg is not None and tg.migrate is not None:
+                mp[i, j] = tg.migrate.max_parallel
+            gid[i, j] = gid_map[(alloc.job_id, alloc.namespace, alloc.task_group)]
+
+    # Preemption counts already in the plan (the reference's
+    # set_preemptions at each node visit).
+    counts0 = np.zeros(max(gp, 1), np.int32)
+    for allocs in ctx.plan.node_preemptions.values():
+        for alloc in allocs:
+            g = gid_map.get((alloc.job_id, alloc.namespace, alloc.task_group))
+            if g is not None:
+                counts0[g] += 1
+
+    return (
+        PreemptTables(
+            c=c, gp=max(gp, 1), res4=res4, prio=prio, elig=elig, mp=mp,
+            gid=gid, remaining3=remaining3, counts0=counts0, allocs=per_node,
+        ),
+        None,
+    )

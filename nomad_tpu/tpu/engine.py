@@ -59,6 +59,11 @@ logger = logging.getLogger("nomad_tpu.tpu.engine")
 
 MAX_SKIP = 3
 
+# Partial OCC retries below device_min_placements still ride the device
+# when their compile bucket is already warm (see compute_placements) —
+# but only above this floor; 1-2 placement stragglers stay on the host.
+RETRY_DEVICE_FLOOR = 4
+
 # GIL convoy guard shared with the scheduler's other host phases
 # (utils/hostwork.py): encode/apply are pure-Python, so letting hundreds
 # of worker threads enter them at once only buys context-switch thrash.
@@ -75,11 +80,12 @@ class EncodedEval:
         "n_real", "n_pad", "g", "s", "v", "p", "dtype",
         "static", "carry", "xs",
         "missing_list", "nodes", "table", "start_ns", "dense_ok",
+        "pre_allocs",
     )
 
     def __init__(self, *, n_real, n_pad, g, s, v, p, dtype,
                  static, carry, xs, missing_list, nodes, table, start_ns,
-                 dense_ok=False):
+                 dense_ok=False, pre_allocs=None):
         self.n_real = n_real
         self.n_pad = n_pad
         self.g = g
@@ -98,6 +104,55 @@ class EncodedEval:
         # path (fresh, no networks/devices/canaries): results stay as
         # arrays end to end (structs.DenseTGPlacements)
         self.dense_ok = dense_ok
+        # Device-side preemption (tpu/preempt.py): per-node candidate
+        # Allocation lists parallel to the encoded candidate slots, for
+        # mapping eviction-set output columns back to real allocs. None
+        # when the eval encodes no preemption.
+        self.pre_allocs = pre_allocs
+
+
+def _pad_preempt_arrays(pre_tables, n_pad, n_real, node_c2):
+    """Pad one eval's PreemptTables (encode.build_preempt_tables) to the
+    node grid and derive the Q27 eviction-free factors. ``None`` tables
+    yield width-0 arrays — the step's whole eviction block compiles away
+    (``has_pre`` is a shape test). Returns the 6 static entries followed
+    by the 3 carry seeds."""
+    if pre_tables is None:
+        return (
+            np.zeros((n_pad, 0, 4), np.int32), np.zeros((n_pad, 0), np.int32),
+            np.zeros((n_pad, 0), bool), np.zeros((n_pad, 0), np.int32),
+            np.zeros((n_pad, 0), np.int32), np.zeros((n_pad, 0, 2), np.int32),
+            np.zeros((n_pad, 0), bool), np.zeros((0, 3), np.int64),
+            np.zeros(0, np.int32),
+        )
+    from .intscore import E27_ONE, e27_np, xq_np
+
+    c_w = pre_tables.c
+    pre_res = np.zeros((n_pad, c_w, 4), np.int32)
+    pre_res[:n_real] = pre_tables.res4
+    pre_prio = np.zeros((n_pad, c_w), np.int32)
+    pre_prio[:n_real] = pre_tables.prio
+    pre_elig = np.zeros((n_pad, c_w), bool)
+    pre_elig[:n_real] = pre_tables.elig
+    pre_mp = np.zeros((n_pad, c_w), np.int32)
+    pre_mp[:n_real] = pre_tables.mp
+    pre_gid = np.zeros((n_pad, c_w), np.int32)
+    pre_gid[:n_real] = pre_tables.gid
+    # Eviction FREES capacity: Q27 factor e27(+res/cap) per candidate on
+    # cpu/mem — same convention as the destructive-update ev_factor.
+    # Padded nodes / empty slots hold the neutral factor.
+    pre_evf = np.full((n_pad, c_w, 2), E27_ONE, np.int32)
+    for d in (0, 1):
+        pre_evf[:, :, d] = e27_np(
+            xq_np(pre_res[:, :, d].astype(np.int64),
+                  np.maximum(node_c2[:, d], 1)[:, None])
+        ).astype(np.int32)
+    pre_alive0 = np.ones((n_pad, c_w), bool)
+    pre_remaining0 = np.zeros((n_pad, 3), np.int64)
+    pre_remaining0[:n_real] = pre_tables.remaining3
+    pre_counts0 = pre_tables.counts0.astype(np.int32)
+    return (pre_res, pre_prio, pre_elig, pre_mp, pre_gid, pre_evf,
+            pre_alive0, pre_remaining0, pre_counts0)
 
 
 _cache_enabled = False
@@ -173,9 +228,10 @@ def _make_step():
         (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
          dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
          spread_has_targets, spread_active, sum_spread_weights, n_real,
-         e_ask, dp_vids, dp_limit, dp_applies) = static
+         e_ask, dp_vids, dp_limit, dp_applies,
+         pre_res, pre_prio, pre_elig, pre_mp, pre_gid, pre_evf) = static
         (used, tg_counts, job_counts, spread_counts, spread_entry, offset,
-         failed, e_base, dp_counts) = carry
+         failed, e_base, dp_counts, pre_alive, pre_remaining, pre_counts) = carry
         (tg_idx, penalty_idx, evict_node, evict_res, evict_tg, limit_p,
          sum_sw_p, ev_factor, rev_factor, forced_node) = x
 
@@ -290,7 +346,43 @@ def _make_step():
             jnp.where(dh_tg_g, ~((tg_counts_g > 0) & (job_counts > 0)), True),
         )
 
-        feasible = feas_g & fits & dh_mask  # [N]
+        # -- device-side preemption (tpu/preempt.py) -----------------------
+        # shape specialization: non-preempting evals encode the candidate
+        # axis C as ZERO width and the whole greedy sweep compiles away.
+        # When present, a node whose capacity check fails may be rescued
+        # by an eviction set of lower-priority allocs (the reference's
+        # PreemptForTaskGroup): cap_ok = fits | pre_met. Preemption never
+        # rescues class/constraint/distinct-hosts infeasibility — those
+        # masks still AND in below, matching the host stack ordering.
+        has_pre = pre_res.shape[1] > 0
+        if has_pre:
+            from .preempt import CQ_BITS, PENALTY_UNIT, greedy_select_jnp
+
+            gp_w = pre_counts.shape[0]
+            iota_gp = jnp.arange(gp_w, dtype=jnp.int32)
+            # num preemptions already planned for each candidate's
+            # (job, ns, tg) group — the reference's maxParallel penalty
+            oh_gid = pre_gid[:, :, None] == iota_gp[None, None, :]
+            num_pre = jnp.sum(
+                jnp.where(oh_gid, pre_counts[None, None, :], 0), axis=-1
+            ).astype(jnp.int32)                                    # [N, C]
+            pen = jnp.where(
+                (pre_mp > 0) & (num_pre >= pre_mp),
+                (((num_pre + 1) - pre_mp).astype(i64) * PENALTY_UNIT)
+                << CQ_BITS,
+                i64(0),
+            )
+            ask3 = ask[:3].astype(i64)                             # cpu/mem/disk
+            pre_res3 = pre_res[:, :, :3].astype(i64)
+            sel_ord, pre_met = greedy_select_jnp(
+                ask3, pre_res3, pre_prio, pen,
+                pre_alive & pre_elig, pre_remaining,
+            )
+            cap_ok = fits | pre_met
+        else:
+            cap_ok = fits
+
+        feasible = feas_g & cap_ok & dh_mask  # [N]
         # system-scheduler mode: the candidate node is FIXED per placement
         # (one alloc per eligible node, system_sched.go:268-286); a
         # zero-width axis (generic evals) compiles the restriction away
@@ -622,6 +714,84 @@ def _make_step():
                 (iota_v2[None, :] == ch_vid_dp[:, None]) & inc_dp[:, None]
             ).astype(jnp.int32)
 
+        # -- commit the eviction set on the chosen node --------------------
+        # Host ordering: preemption fires only when the node did NOT fit
+        # outright. The greedy set is filtered by the reference's second
+        # pass (distance vs the FRESH ask, descending) on the chosen
+        # node's extracted [C] row — off the hot [N] axis.
+        if has_pre:
+            c_w = pre_res.shape[1]
+            from .preempt import second_pass_jnp
+
+            fits_ch = jnp.any(oh_ch & fits)
+            use_pre = success & (~fits_ch) & (~skip_step)
+
+            def row_c(arr):
+                # arr[ch] without gather: one-hot sum over N (exactly one
+                # non-zero term, so negative fills survive intact)
+                shape = (n_pad,) + (1,) * (arr.ndim - 1)
+                out = jnp.sum(jnp.where(oh_ch.reshape(shape), arr, 0), axis=0)
+                return out.astype(arr.dtype)
+
+            sel_ord_ch = row_c(sel_ord)                        # [C]
+            res3_ch = row_c(pre_res3)                          # [C, 3] i64
+            rem_ch = row_c(pre_remaining)                      # [3] i64
+            keep, p_rank = second_pass_jnp(ask3, res3_ch, sel_ord_ch, rem_ch)
+            keep = keep & use_pre                              # [C]
+
+            # freed capacity credits `used` (the alloc itself stays
+            # overcommitted for SCORING, matching the host's allocs_fit
+            # used — the credit lands after the score terms above)
+            res4_ch = row_c(pre_res)                           # [C, 4] i32
+            freed4 = jnp.sum(
+                jnp.where(keep[:, None], res4_ch.astype(fdt), 0), axis=0,
+                dtype=fdt,
+            )                                                  # [4]
+            d_dims = totals.shape[1]
+            if d_dims > 4:
+                # batch padding may widen D past the gate's 4 dims; the
+                # extra (device) dims free nothing
+                freed_vec = jnp.concatenate(
+                    [freed4, jnp.zeros(d_dims - 4, freed4.dtype)]
+                )
+            else:
+                freed_vec = freed4[:d_dims]
+            used = used - oh_chf[:, None] * freed_vec[None, :]
+
+            # running Q27 exponential: multiply the just-committed chosen
+            # row by each kept candidate's eviction factor (slot-ascending
+            # product order is fixed, so the result is deterministic)
+            if e_base.shape[0]:
+                from .intscore import E27_BITS as _PB, E27_ONE as _PO
+
+                eb_ch = row_c(e_base).astype(i64)              # [2]
+                evf_ch = row_c(pre_evf)                        # [C, 2] i32
+                for ci in range(c_w):
+                    f = jnp.where(keep[ci], evf_ch[ci].astype(i64), i64(_PO))
+                    eb_ch = (eb_ch * f) >> _PB
+                e_base = jnp.where(
+                    (oh_ch & use_pre)[:, None], eb_ch.astype(jnp.int32), e_base
+                )
+
+            evicted = oh_ch[:, None] & keep[None, :]           # [N, C]
+            pre_alive = pre_alive & ~evicted
+            freed3 = jnp.sum(jnp.where(keep[:, None], res3_ch, 0), axis=0)
+            pre_remaining = pre_remaining + jnp.where(
+                oh_ch[:, None], freed3[None, :], 0
+            )
+            gid_ch = row_c(pre_gid)                            # [C]
+            pre_counts = pre_counts + jnp.sum(
+                ((gid_ch[:, None] == iota_gp[None, :]) & keep[:, None])
+                .astype(jnp.int32),
+                axis=0,
+                dtype=jnp.int32,
+            )
+            # output column: second-pass rank per evicted slot (ascending
+            # rank = final eviction order), -1 for untouched slots
+            evict_out = jnp.where(keep, p_rank, jnp.int32(-1))  # [C]
+        else:
+            evict_out = jnp.zeros((0,), jnp.int32)
+
         # failed placement: revert eviction, mark TG failed
         if has_evict:
             revert = do_evict & (~success)
@@ -648,8 +818,10 @@ def _make_step():
         failed = failed | (sel_g & ((~success) & (~skip_step) & unforced))
 
         new_carry = (used, tg_counts, job_counts, spread_counts, spread_entry,
-                     offset, failed, e_base, dp_counts)
-        out = (chosen, jnp.where(success, best_score, score_zero), pulls, skip_step)
+                     offset, failed, e_base, dp_counts,
+                     pre_alive, pre_remaining, pre_counts)
+        out = (chosen, jnp.where(success, best_score, score_zero), pulls,
+               skip_step, evict_out)
         return new_carry, out
 
     return step
@@ -700,9 +872,11 @@ def _build_forced_kernel():
          desired_counts, dh_job, dh_tg, _limits, _spread_vids,
          _spread_desired, _spread_weights, _spread_has_targets,
          _spread_active, _sum_spread_weights, n_real, e_ask,
-         _dp_vids, _dp_limit, _dp_applies) = static
+         _dp_vids, _dp_limit, _dp_applies,
+         _pre_res, _pre_prio, _pre_elig, _pre_mp, _pre_gid,
+         _pre_evf) = static
         (used0, tg_counts0, job_counts0, _sc0, _se0, _off0, failed0,
-         e_base0, _dpc0) = carry
+         e_base0, _dpc0, _pre_alive0, _pre_rem0, _pre_counts0) = carry
         (tg_idx, _penalty_idx, _evict_node, _evict_res, _evict_tg,
          _limit_p, _sum_sw_p, _ev_factor, _rev_factor, forced_node) = xs
 
@@ -774,8 +948,9 @@ def _build_forced_kernel():
         chosen = jnp.where(feasible, j, -1).astype(jnp.int32)
         scores = jnp.where(feasible, final, score_zero)
         p = tg_idx.shape[0]
+        # the forced fast path never encodes preemption -> empty column
         return (chosen, scores, jnp.zeros(p, jnp.int32),
-                jnp.zeros(p, bool))
+                jnp.zeros(p, bool), jnp.zeros((p, 0), jnp.int32))
 
     return jax.jit(forced_eval)
 
@@ -1009,11 +1184,12 @@ class TpuPlacementEngine:
         init_carry = tuple(jnp.asarray(a) for a in enc.carry)
         xs = tuple(jnp.asarray(a) for a in xs)
         with _phases.track("device"):
-            chosen, scores, pulls, skipped = kernel(static, init_carry, xs)
+            chosen, scores, pulls, skipped, evict = kernel(static, init_carry, xs)
             chosen = np.asarray(chosen)
         return (
             chosen[:p], np.asarray(scores)[:p],
             np.asarray(pulls)[:p], np.asarray(skipped)[:p],
+            np.asarray(evict)[:p],
         )
 
     # ------------------------------------------------------------------
@@ -1042,8 +1218,24 @@ class TpuPlacementEngine:
         # (the parity harness's frame); the production server sets it.
         n_min = getattr(sched, "device_min_placements", 0)
         if n_min and len(destructive) + len(place) < n_min:
-            _metrics.incr_counter("nomad.tpu_engine.small_eval_host")
-            return NotImplemented
+            # Warm-bucket retry ride-along: a partial OCC retry (the tail
+            # of a plan-rejected eval) is usually a few placements of a
+            # job shape whose compile bucket is ALREADY warm from the
+            # first pass — padding it into that bucket costs nothing,
+            # while the host fallback re-walks the ranking iterators per
+            # placement. Only reroute when the batcher has completed at
+            # least one batch (so buckets exist) and the retry isn't
+            # trivially small.
+            batcher = getattr(sched.planner, "device_batcher", None)
+            total = len(destructive) + len(place)
+            if (
+                batcher is None
+                or total < RETRY_DEVICE_FLOOR
+                or not batcher.has_warmed()
+            ):
+                _metrics.incr_counter("nomad.tpu_engine.small_eval_host")
+                return NotImplemented
+            _metrics.incr_counter("nomad.tpu_engine.small_eval_device_retry")
 
         from ..utils import phases as _phases
 
@@ -1061,9 +1253,9 @@ class TpuPlacementEngine:
         t0 = _metrics.now()
         batcher = getattr(sched.planner, "device_batcher", None)
         if batcher is not None:
-            chosen, scores, pulls, skipped_steps = batcher.run(enc)
+            chosen, scores, pulls, skipped_steps, evict = batcher.run(enc)
         else:
-            chosen, scores, pulls, skipped_steps = self.run_scan_single(enc)
+            chosen, scores, pulls, skipped_steps, evict = self.run_scan_single(enc)
         _metrics.measure_since("nomad.tpu_engine.device_wait", t0)
         t0 = _metrics.now()
         with _HOST_WORK_SEM:
@@ -1071,14 +1263,17 @@ class TpuPlacementEngine:
             with _phases.track("apply"):
                 chosen = np.asarray(chosen)
                 skipped_steps = np.asarray(skipped_steps)
+                evict = np.asarray(evict)
                 if enc.dense_ok and (chosen >= 0).all() and not skipped_steps.any():
                     # every placement succeeded and qualifies: results stay
                     # dense (no per-alloc objects) all the way to the FSM
-                    self._apply_results_dense(sched, enc, chosen, scores, pulls)
+                    self._apply_results_dense(sched, enc, chosen, scores, pulls,
+                                              evict)
                 else:
                     self._apply_results(
                         sched, enc.missing_list, enc.nodes, enc.table, chosen,
                         scores, pulls, skipped_steps, enc.start_ns,
+                        enc=enc, evict=evict,
                     )
             _metrics.measure_since("nomad.tpu_engine.apply_work", t1)
         _metrics.measure_since("nomad.tpu_engine.apply", t0)
@@ -1165,6 +1360,15 @@ class TpuPlacementEngine:
 
         fleet = fleet_static(ctx, job, nodes)
 
+        # Device-side preemption (tpu/preempt.py): does this eval's host
+        # oracle preempt? Config-gated per job type — the SAME switch the
+        # host stack consults (generic_sched.get_select_options), so the
+        # two paths can never disagree on whether the eval may evict.
+        from ..scheduler.preemption import preemption_enabled
+
+        _, _sched_cfg = ctx.state.scheduler_config()
+        preempt_on = preemption_enabled(_sched_cfg, job.type)
+
         # Whole-eval encode cache (VERDICT r4 #1/#4): a burst of
         # same-shaped fresh jobs (the C1M workload — hundreds of
         # identical service jobs) re-derives identical arrays per eval,
@@ -1178,7 +1382,7 @@ class TpuPlacementEngine:
         # (scheduler/context.go:191) to the whole encoding.
         enc_cache = None
         cache_key = None
-        if fleet is not None and dense_ok and not destructive:
+        if fleet is not None and dense_ok and not destructive and not preempt_on:
             plan = ctx.plan
             spread_state = sched.stack.spread
             if (
@@ -1317,6 +1521,33 @@ class TpuPlacementEngine:
         fdtype = np.int32 if int_mode else np.float32
         if int_mode:
             reason = _int_spec_gate_reason(table, tg_specs, job)
+            if reason is not None:
+                return fallback(reason)
+
+        pre_tables = None
+        if preempt_on:
+            # PARITY-CRITICAL: a preemption-enabled host oracle may evict
+            # on ANY node, so encoding this eval WITHOUT the candidate
+            # tables would diverge from it — every gate below fails the
+            # WHOLE eval back to the host stack, never a partial encode.
+            if not int_mode:
+                return fallback("preemption requires deterministic int mode")
+            if destructive:
+                return fallback("preemption with destructive updates")
+            if device_dims:
+                # host oracle would run preempt_for_device (float scoring,
+                # instance-level assignment state) — host-only
+                return fallback("preemption with device asks")
+            if any(
+                tg.networks or any(t.resources.networks for t in tg.tasks)
+                for tg in (m.get_task_group() for m in missing_list)
+            ):
+                # host oracle runs preempt_for_network first (reservable
+                # port / MBits walk) — host-only
+                return fallback("preemption with network asks")
+            from .encode import build_preempt_tables
+
+            pre_tables, reason = build_preempt_tables(ctx, job, nodes)
             if reason is not None:
                 return fallback(reason)
         _metrics.incr_counter("nomad.tpu_engine.handled")
@@ -1542,11 +1773,18 @@ class TpuPlacementEngine:
             # one refund per distinct re-used value) — the scan's exact
             # counters would diverge; host fallback keeps plan parity
             return fallback("distinct_property with in-eval evictions")
+        if dp_vids_r.shape[0] and pre_tables is not None:
+            # same PropertySet refund quirk, via preempted allocs
+            return fallback("distinct_property with preemption")
         d_dp = dp_vids_r.shape[0]
         v_dp = dp_counts0.shape[1] if d_dp else 1
         dp_vids = np.full((d_dp, n_pad), v_dp - 1, np.int32)
         if d_dp:
             dp_vids[:, :n_real] = dp_vids_r
+
+        (pre_res, pre_prio, pre_elig, pre_mp, pre_gid, pre_evf,
+         pre_alive0, pre_remaining0, pre_counts0) = _pad_preempt_arrays(
+            pre_tables, n_pad, n_real, node_c2 if int_mode else None)
 
         static = (
             totals, reserved, asks, feas, aff_score, aff_present,
@@ -1554,6 +1792,7 @@ class TpuPlacementEngine:
             spread_weights, spread_has_targets, spread_active,
             sum_spread_weights, np.int32(n_real), e_ask,
             dp_vids, dp_limit, dp_applies,
+            pre_res, pre_prio, pre_elig, pre_mp, pre_gid, pre_evf,
         )
         # Ring start mirrors the host source iterator's offset as
         # set_nodes left it — 0 in the classic deterministic frame, the
@@ -1564,6 +1803,7 @@ class TpuPlacementEngine:
         init_carry = (
             used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
             np.int32(offset0), np.zeros(g_count, bool), e_base0, dp_counts0,
+            pre_alive0, pre_remaining0, pre_counts0,
         )
         xs = (
             tg_idx, penalty_idx, evict_node, evict_res, evict_tg,
@@ -1578,6 +1818,7 @@ class TpuPlacementEngine:
             dtype=fdtype, static=static, carry=init_carry, xs=xs,
             missing_list=missing_list, nodes=nodes, table=table,
             start_ns=start, dense_ok=dense_ok,
+            pre_allocs=(pre_tables.allocs if pre_tables is not None else None),
         )
         if enc_cache is not None and cache_key is not None:
             # arrays are read-only downstream (the batcher pads into
@@ -1607,12 +1848,12 @@ class TpuPlacementEngine:
         init_carry = tuple(jnp.asarray(a) for a in enc.carry)
         xs = tuple(jnp.asarray(a) for a in enc.xs)
 
-        _carry, (chosen, scores, pulls, skipped) = place_scan(
+        _carry, (chosen, scores, pulls, skipped, evict) = place_scan(
             enc.n_pad, static, init_carry, xs
         )
         return (
             np.asarray(chosen), np.asarray(scores),
-            np.asarray(pulls), np.asarray(skipped),
+            np.asarray(pulls), np.asarray(skipped), np.asarray(evict),
         )
 
     # ------------------------------------------------------------------
@@ -1622,7 +1863,8 @@ class TpuPlacementEngine:
     # spread/affinity/limit machinery (SystemStack has none, stack.go:166).
     # ------------------------------------------------------------------
 
-    def compute_system_placements(self, sched, place: List, sched_config=None):
+    def compute_system_placements(self, sched, place: List, sched_config=None,
+                                  _preempt_pass: bool = False):
         """Batch a SystemScheduler eval's placements through one device
         scan. Returns True when fully handled, a non-empty list of
         leftover placement tuples when the device handled everything
@@ -1677,6 +1919,29 @@ class TpuPlacementEngine:
         num_dims = table.totals.shape[1]
         start = _time.monotonic_ns()
         fdtype = np.int32 if int_mode else np.float32
+
+        pre_tables = None
+        if _preempt_pass:
+            # Second device pass over capacity-failed forced nodes: encode
+            # WITH the preemption candidate tables. Any gate failure hands
+            # the SUBSET to the host per-node loop (list return), never
+            # the whole eval — pass-1 results are already applied.
+            if not int_mode:
+                return list(place)
+            if num_dims != 4:
+                return list(place)  # preempt_for_device is host-only
+            if any(
+                tup.task_group.networks
+                or any(t.resources.networks for t in tup.task_group.tasks)
+                for tup in place
+            ):
+                return list(place)  # preempt_for_network is host-only
+            from .encode import build_preempt_tables
+
+            pre_tables, _pre_reason = build_preempt_tables(ctx, job, nodes)
+            if _pre_reason is not None:
+                logger.debug("tpu system preempt pass to host: %s", _pre_reason)
+                return list(place)
 
         n_pad = _round_up(max(n_real, 1))
         g_count = len(job.task_groups)
@@ -1767,16 +2032,22 @@ class TpuPlacementEngine:
             totals = totals - reserved
             reserved = np.zeros((0, num_dims), fdtype)
 
+        (pre_res, pre_prio, pre_elig, pre_mp, pre_gid, pre_evf,
+         pre_alive0, pre_remaining0, pre_counts0) = _pad_preempt_arrays(
+            pre_tables, n_pad, n_real, node_c2 if int_mode else None)
+
         static = (
             totals, reserved, asks, feas, aff_score, aff_present,
             desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
             spread_weights, spread_has_targets, spread_active,
             sum_spread_weights, np.int32(n_real), e_ask,
             dp_vids, dp_limit, dp_applies,
+            pre_res, pre_prio, pre_elig, pre_mp, pre_gid, pre_evf,
         )
         init_carry = (
             used0, tg_counts0, job_counts0, spread_counts0, spread_entry0,
             np.int32(0), np.zeros(g_count, bool), e_base0, dp_counts0,
+            pre_alive0, pre_remaining0, pre_counts0,
         )
         xs = (
             tg_idx,
@@ -1795,6 +2066,7 @@ class TpuPlacementEngine:
             dtype=fdtype, static=static, carry=init_carry, xs=xs,
             missing_list=list(place), nodes=nodes, table=table,
             start_ns=start,
+            pre_allocs=(pre_tables.allocs if pre_tables is not None else None),
         )
 
         # All-distinct forced nodes (single-TG system jobs): the scan-free
@@ -1803,8 +2075,10 @@ class TpuPlacementEngine:
         # allocs on one node) interact through used/tg_counts and keep
         # the sequential scan.
         batcher = getattr(sched.planner, "device_batcher", None)
-        if len(set(forced.tolist())) == p:
-            chosen, scores, pulls, skipped = self.run_forced(enc)
+        if len(set(forced.tolist())) == p and pre_tables is None:
+            # (the forced fast path never encodes preemption — a preempt
+            # pass always takes the sequential scan below)
+            chosen, scores, pulls, skipped, evict = self.run_forced(enc)
             if batcher is not None:
                 # the forced kernel bypasses the gather queue; count it in
                 # the batcher's stats so dispatch accounting stays whole.
@@ -1815,9 +2089,9 @@ class TpuPlacementEngine:
                     batcher.stats["dispatches"] = batcher.stats.get("dispatches", 0) + 1
                     batcher.stats["evals"] = batcher.stats.get("evals", 0) + 1
         elif batcher is not None:
-            chosen, scores, pulls, skipped = batcher.run(enc)
+            chosen, scores, pulls, skipped, evict = batcher.run(enc)
         else:
-            chosen, scores, pulls, skipped = self.run_scan_single(enc)
+            chosen, scores, pulls, skipped, evict = self.run_scan_single(enc)
 
         # Preemption is a host-side greedy search per node. When enabled
         # and a forced node failed on CAPACITY (feasible by constraints
@@ -1835,7 +2109,7 @@ class TpuPlacementEngine:
         if sched_config is not None:
             preemption_on = sched_config.preemption_config.system_scheduler_enabled
         leftover: List = []
-        if preemption_on:
+        if preemption_on and not _preempt_pass:
             chosen = np.asarray(chosen)
             keep: List[int] = []
             for pi, tup in enumerate(place):
@@ -1851,15 +2125,33 @@ class TpuPlacementEngine:
                 kp = np.asarray(keep, np.int64)
                 chosen = np.asarray(chosen)[kp]
                 scores = np.asarray(scores)[kp]
+                evict = np.asarray(evict)[kp]
 
-        _metrics.incr_counter("nomad.tpu_engine.handled")
+        if not _preempt_pass:
+            _metrics.incr_counter("nomad.tpu_engine.handled")
         self._apply_system_results(
-            sched, place, nodes, table, tg_specs, chosen, scores, start
+            sched, place, nodes, table, tg_specs, chosen, scores, start,
+            enc=enc, evict=np.asarray(evict),
         )
-        return leftover if leftover else True
+        if not leftover:
+            return True
+        # Second device pass: re-encode JUST the capacity-failed forced
+        # nodes with the preemption candidate tables (tpu/preempt.py), so
+        # preempting system evals never leave the TPU path. Pass-1
+        # results are already applied above, so the re-encode sees the
+        # same proposed plan state the host per-node loop would. A pass-2
+        # gate failure returns the subset for the host loop instead —
+        # never NotImplemented (pass 1 is committed).
+        _metrics.incr_counter("nomad.tpu_engine.system_preempt_pass")
+        res = self.compute_system_placements(
+            sched, leftover, sched_config, _preempt_pass=True)
+        if res is NotImplemented:
+            return leftover
+        return res
 
     def _apply_system_results(self, sched, place, nodes, table, tg_specs,
-                              chosen, scores, start_ns) -> None:
+                              chosen, scores, start_ns, enc=None,
+                              evict=None) -> None:
         """Materialize system-scan results: allocs for fits, queued-alloc
         bookkeeping for constraint-filtered nodes, failed metrics +
         per-node blocked evals for capacity failures (system_sched.py host
@@ -1887,7 +2179,8 @@ class TpuPlacementEngine:
             )
         ):
             self._apply_system_results_dense(
-                sched, place, nodes, chosen, scores, start_ns
+                sched, place, nodes, chosen, scores, start_ns,
+                enc=enc, evict=evict,
             )
             return
 
@@ -1976,6 +2269,21 @@ class TpuPlacementEngine:
             )
             if tup.alloc is not None and tup.alloc.id:
                 alloc.previous_allocation = tup.alloc.id
+            if (
+                evict is not None and evict.ndim == 2 and evict.shape[1]
+                and enc is not None and enc.pre_allocs is not None
+            ):
+                row = evict[pi]
+                ks = sorted(
+                    (c for c in range(row.shape[0]) if int(row[c]) >= 0),
+                    key=lambda c: int(row[c]),
+                )
+                if ks:
+                    cand = enc.pre_allocs[node_idx]
+                    stops = [cand[c] for c in ks]
+                    for stop in stops:
+                        sched.plan.append_preempted_alloc(stop, alloc.id)
+                    alloc.preempted_allocations = [s.id for s in stops]
             sched.plan.append_alloc(alloc)
 
         ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
@@ -2032,7 +2340,8 @@ class TpuPlacementEngine:
         )
 
     def _apply_system_results_dense(self, sched, place, nodes, chosen,
-                                    scores, start_ns) -> None:
+                                    scores, start_ns, enc=None,
+                                    evict=None) -> None:
         """System-path dense blocks: same DenseTGPlacements flow as the
         generic path, grouped by task group. Preconditions checked by the
         caller: every placement chose its node, all fresh, no
@@ -2043,20 +2352,46 @@ class TpuPlacementEngine:
         for pi, tup in enumerate(place):
             by_tg.setdefault(tup.task_group.name, []).append(pi)
         tg_by_name = {tg.name: tg for tg in job.task_groups}
+        has_pre = (
+            evict is not None and evict.ndim == 2 and evict.shape[1] > 0
+            and enc is not None and enc.pre_allocs is not None
+        )
         for tg_name, idxs in by_tg.items():
-            sched.plan.dense_placements.append(self._dense_block(
+            block = self._dense_block(
                 job, tg_by_name[tg_name], sched.eval.id,
                 [chosen[k] for k in idxs], nodes,
                 names=[place[k].name for k in idxs],
                 scores_f=[scores_f[k] for k in idxs],
                 nodes_evaluated=[1] * len(idxs),
                 nodes_available=getattr(sched, "nodes_by_dc", {}),
-            ))
+            )
+            if has_pre:
+                pre_ids: List[List[str]] = []
+                any_pre = False
+                for bi, k in enumerate(idxs):
+                    row = evict[int(k)]
+                    ks = sorted(
+                        (c for c in range(row.shape[0]) if int(row[c]) >= 0),
+                        key=lambda c: int(row[c]),
+                    )
+                    if not ks:
+                        pre_ids.append([])
+                        continue
+                    cand = enc.pre_allocs[int(chosen[int(k)])]
+                    stops = [cand[c] for c in ks]
+                    for stop in stops:
+                        sched.plan.append_preempted_alloc(stop, block.ids[bi])
+                    pre_ids.append([s.id for s in stops])
+                    any_pre = True
+                if any_pre:
+                    block.preempted = pre_ids
+            sched.plan.dense_placements.append(block)
         sched.ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
 
     # ------------------------------------------------------------------
 
-    def _apply_results_dense(self, sched, enc, chosen, scores, pulls) -> None:
+    def _apply_results_dense(self, sched, enc, chosen, scores, pulls,
+                             evict=None) -> None:
         """Record scan results as DenseTGPlacements blocks — one per task
         group, parallel arrays only. The per-placement work here is a few
         list appends; AllocMetric/Allocation objects materialize lazily
@@ -2071,10 +2406,14 @@ class TpuPlacementEngine:
         pulls = np.asarray(pulls)
         tg_idx = enc.xs[0]  # [p] task-group index per placement
         missing_list = enc.missing_list
+        has_pre = (
+            evict is not None and evict.ndim == 2 and evict.shape[1] > 0
+            and enc.pre_allocs is not None
+        )
 
         for gi in np.unique(tg_idx):
             sel = np.nonzero(tg_idx == gi)[0]
-            sched.plan.dense_placements.append(self._dense_block(
+            block = self._dense_block(
                 job, job.task_groups[int(gi)], sched.eval.id,
                 chosen[sel], enc.nodes,
                 names=[missing_list[k].get_name() for k in sel],
@@ -2082,12 +2421,37 @@ class TpuPlacementEngine:
                 nodes_evaluated=pulls[sel].tolist(),
                 nodes_available=getattr(sched, "_nodes_by_dc", {}),
                 deployment_id=deployment_id,
-            ))
+            )
+            if has_pre:
+                # eviction sets ride the block as parallel id lists AND go
+                # into plan.node_preemptions (plan_apply re-checks them and
+                # the FSM commits the evictions)
+                pre_ids: List[List[str]] = []
+                any_pre = False
+                for bi, k in enumerate(sel):
+                    row = evict[int(k)]
+                    ks = sorted(
+                        (c for c in range(row.shape[0]) if int(row[c]) >= 0),
+                        key=lambda c: int(row[c]),
+                    )
+                    if not ks:
+                        pre_ids.append([])
+                        continue
+                    cand = enc.pre_allocs[int(chosen[int(k)])]
+                    stops = [cand[c] for c in ks]
+                    for stop in stops:
+                        sched.plan.append_preempted_alloc(stop, block.ids[bi])
+                    pre_ids.append([s.id for s in stops])
+                    any_pre = True
+                if any_pre:
+                    block.preempted = pre_ids
+            sched.plan.dense_placements.append(block)
 
         sched.ctx.metrics.allocation_time_ns = _time.monotonic_ns() - enc.start_ns
 
     def _apply_results(self, sched, missing_list, nodes, table, chosen, scores,
-                       pulls, skipped_steps, start_ns) -> None:
+                       pulls, skipped_steps, start_ns, enc=None,
+                       evict=None) -> None:
         """Materialize scan results into the plan (allocs, stops, metrics)."""
         from ..structs.structs import AllocMetric
 
@@ -2192,6 +2556,25 @@ class TpuPlacementEngine:
 
                 alloc.deployment_status = AllocDeploymentStatus(canary=True)
 
+            if (
+                evict is not None and evict.ndim == 2 and evict.shape[1]
+                and enc is not None and enc.pre_allocs is not None
+            ):
+                # device eviction set: column c holds the second-pass rank
+                # (>=0 kept, -1 dropped); materialize in rank order — the
+                # order the host oracle reports preempted allocs in
+                row = evict[pi]
+                ks = sorted(
+                    (c for c in range(row.shape[0]) if int(row[c]) >= 0),
+                    key=lambda c: int(row[c]),
+                )
+                if ks:
+                    cand = enc.pre_allocs[node_idx]
+                    stops = [cand[c] for c in ks]
+                    for stop in stops:
+                        sched.plan.append_preempted_alloc(stop, alloc.id)
+                    alloc.preempted_allocations = [s.id for s in stops]
+
             sched.plan.append_alloc(alloc)
 
         ctx.metrics.allocation_time_ns = _time.monotonic_ns() - start_ns
@@ -2292,10 +2675,17 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
               spread_active, sum_spread_weights, np.int32(n_nodes), e_ask,
               np.zeros((0, n_pad), np.int32),   # dp_vids: no distinct_property
               np.zeros(0, np.int32),
-              np.zeros((g, 0), bool))
+              np.zeros((g, 0), bool),
+              # no preemption: zero-width candidate axis compiles the
+              # eviction path away
+              np.zeros((n_pad, 0, 4), np.int32), np.zeros((n_pad, 0), np.int32),
+              np.zeros((n_pad, 0), bool), np.zeros((n_pad, 0), np.int32),
+              np.zeros((n_pad, 0), np.int32), np.zeros((n_pad, 0, 2), np.int32))
     init_carry = (used0, np.zeros((g, n_pad), np.int32), np.zeros(n_pad, np.int32),
                   spread_counts0, spread_entry0, np.int32(0), np.zeros(g, bool),
-                  e_base0, np.zeros((0, 1), np.int32))
+                  e_base0, np.zeros((0, 1), np.int32),
+                  np.zeros((n_pad, 0), bool), np.zeros((0, 3), np.int64),
+                  np.zeros(0, np.int32))
     limit_val = max(2, int(np.ceil(np.log2(max(n_nodes, 2)))))
     xs = (rng.integers(0, g, n_placements).astype(np.int32),
           np.full((n_placements, 0), -1, np.int32),  # no reschedule history
