@@ -375,13 +375,15 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
         finished = done if done is not None else (
             lambda srv: placed() >= expected
         )
+        completed = False
         next_snap = t0 + 5.0
         while time.perf_counter() < deadline:
             if finished(server) and server.plan_queue.stats()["depth"] == 0:
+                completed = True
                 break
             if time.perf_counter() >= next_snap:
                 # in-flight progress snapshot: if the run dies mid-window
-                # (600s headline), the artifact still shows how far it got
+                # (360s headline), the artifact still shows how far it got
                 # and where the wall time was going
                 next_snap = time.perf_counter() + 5.0
                 el = time.perf_counter() - t0
@@ -408,6 +410,12 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             "nodes": n_nodes,
             "placements": got,
             "expected": expected,
+            # "ok" = completion predicate met inside the budget; "timeout"
+            # = the window expired first (the artifact still carries
+            # whatever was placed). The headline record surfaces this as
+            # headline_status so a budget overrun is machine-readable
+            # instead of inferable from placements < expected.
+            "status": "ok" if completed else "timeout",
             "wall_s": round(elapsed, 2),
             "placements_per_s": round(got / elapsed, 1),
             "evals_per_s": round(evals / elapsed, 1),
@@ -525,10 +533,12 @@ def bench_c1m_system():
     # bucket, and the wall covers full convergence of all 1M
     # placements. Rare partial retries under 600 placements take the
     # host iterator stack rather than minting fresh compile buckets
-    # mid-run.
+    # mid-run. The 360s internal budget is the acceptance bar: overruns
+    # surface as headline_status="timeout" in the artifact rather than
+    # eating the whole bench wall.
     return bench_system(
         "c1m-mixed-1M", 5000, jobs, workers=64, device_batch=64,
-        timeout=600.0, deterministic=True, window_ms=15000.0, idle_ms=600.0,
+        timeout=360.0, deterministic=True, window_ms=15000.0, idle_ms=600.0,
         warmup=_warm, device_min_placements=600, tranches=16,
     )
 
@@ -848,10 +858,12 @@ def _diagnostic(fn, *args, **kwargs):
 
 
 def main():
-    # HEADLINE: end-to-end system C1M replay (jobs -> broker -> workers ->
-    # eval-batched engine -> plan queue -> raft/FSM), one chip.
-    headline = _diagnostic(bench_c1m_system)
-
+    # Cheap, bounded diagnostics run FIRST — kernel microbench, plan-queue
+    # drain, chunked/single-scan modes, the small system configs — so a
+    # crash or overrun inside the expensive headline window can never
+    # erase them (they are already on disk as artifacts by the time the
+    # headline starts). The headline runs LAST with its own 360s internal
+    # budget and reports headline_status instead of hanging the run.
     kernel_rate = _diagnostic(bench_batched_parity_c1m, budget_s=40.0)
     if kernel_rate:
         write_artifact("kernel-rate",
@@ -861,11 +873,15 @@ def main():
     _diagnostic(bench_parity_scan_single)
     sys_results = _diagnostic(system_benches) or []
 
+    # HEADLINE: end-to-end system C1M replay (jobs -> broker -> workers ->
+    # eval-batched engine -> plan queue -> raft/FSM), one chip.
+    headline = _diagnostic(bench_c1m_system)
+
     if headline is None:
         # never lose the bench record: fall back to the kernel rate at
         # the per-chip bar (the r3 headline form)
         headline = {"placements_per_s": kernel_rate or 0.0,
-                    "config": "kernel-fallback"}
+                    "config": "kernel-fallback", "status": "timeout"}
     rate = headline["placements_per_s"] or 1e-9
     if kernel_rate:
         log(f"kernel-rate / system-rate gap: {kernel_rate / rate:,.1f}x")
@@ -909,6 +925,7 @@ def main():
         "value": round(rate, 1),
         "unit": "placements/s",
         "vs_baseline": round(vs_baseline, 4),
+        "headline_status": headline.get("status", "timeout"),
         "extra": {
             "headline_config": headline,
             "v5e8_extrapolation_s": (

@@ -145,6 +145,7 @@ def default_checkers() -> list:
     from .fsm_determinism import FsmDeterminismChecker
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
+    from .pipeline_stage_discipline import PipelineStageDisciplineChecker
     from .trace_span_discipline import TraceSpanDisciplineChecker
 
     return [
@@ -153,6 +154,7 @@ def default_checkers() -> list:
         LockDisciplineChecker(),
         FsmDeterminismChecker(),
         TraceSpanDisciplineChecker(),
+        PipelineStageDisciplineChecker(),
     ]
 
 

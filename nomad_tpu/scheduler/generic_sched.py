@@ -339,6 +339,10 @@ class GenericScheduler:
 
             if compute_placements_with_engine(self, destructive, place) is True:
                 _trace_lc.set_path(self.eval.id, "device")
+                # device-built plan: eligible for the async eval-lifecycle
+                # pipeline (the worker may hand commit + ack to the async
+                # applier instead of blocking on the plan future)
+                self.plan.async_ok = True
                 return
 
         # falling through = the python iterator stack places this eval

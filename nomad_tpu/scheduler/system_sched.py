@@ -225,6 +225,10 @@ class SystemScheduler:
             res = compute_system_placements_with_engine(self, place, sched_config)
             if res is True:
                 _trace_lc.set_path(self.eval.id, "device")
+                # device-built system plan: async-pipeline eligible (the
+                # applier's eligibility shape-check still excludes plans
+                # carrying stops/preemptions)
+                self.plan.async_ok = True
                 return
             if isinstance(res, list):
                 # the device committed every clean placement; only the

@@ -197,16 +197,28 @@ class NomadFSM:
         apply waiter so it can respond to each plan accurately. The
         errors are data-deterministic, so every replica partitions the
         batch identically."""
+        from ..utils import metrics
+
         errors = []
+        committed_dense = 0
         for payload in payloads:
             try:
                 self._apply_plan_results(index, payload)
                 errors.append(None)
+                for block in payload.get("dense_placements", []):
+                    committed_dense += len(block.ids)
             except Exception as e:  # noqa: BLE001 — isolate to this plan
                 logging.getLogger("nomad_tpu.fsm").exception(
                     "plan payload in batch failed to apply"
                 )
                 errors.append(str(e) or e.__class__.__name__)
+        if committed_dense:
+            # commit-side ground truth for the async pipeline: placements
+            # that actually landed in the FSM, as opposed to the
+            # dispatch-side "submitted" counters upstream
+            metrics.incr_counter(
+                "nomad.fsm.dense_placements_committed", committed_dense
+            )
         return errors
 
     def _apply_deployment_status_update(self, index: int, payload):

@@ -420,80 +420,93 @@ class Planner:
         # Per-node ALL-OR-NOTHING across the WHOLE plan (the object
         # path's evaluateNodePlan semantics): aggregate every block's
         # asks per node first, check each node once against the combined
-        # addition, then trim every block by the failing-node set.
+        # addition, then trim every block by the failing-node set. Every
+        # per-placement step here is vectorized numpy over the blocks'
+        # parallel arrays — the evaluate stage of the eval-lifecycle
+        # pipeline shares one interpreter with encode/apply, so a Python
+        # loop over 1M placements would serialize the whole pipeline.
         zero4 = (0.0, 0.0, 0.0, 0.0)
-        plan_add: Dict[str, List[float]] = {}
-        for block in plan.dense_placements:
-            ask = block.ask_vec
-            for node_id, idxs in block.node_index_map().items():
-                cnt = len(idxs)
-                row = plan_add.setdefault(node_id, [0.0, 0.0, 0.0, 0.0])
-                for d in range(4):
-                    row[d] += cnt * ask[d]
+        # freed/pending are empty for pure dense plans (the C1M commit
+        # shape): skip their lookups entirely on that path
+        has_adj = bool(freed) or bool(pending)
+
+        blocks = plan.dense_placements
+        id_arrs = [np.asarray(b.node_ids) for b in blocks]
+        counts = np.array([a.shape[0] for a in id_arrs], np.int64)
+        offs = np.zeros(len(blocks) + 1, np.int64)
+        np.cumsum(counts, out=offs[1:])
+        all_ids = np.concatenate(id_arrs)
+        # inv maps placement row -> unique-node row; the per-node added
+        # load is one scatter-add of count x ask_vec
+        uids, inv = np.unique(all_ids, return_inverse=True)
+        asks = np.repeat(
+            np.array([b.ask_vec for b in blocks], np.float64).reshape(-1, 4),
+            counts, axis=0,
+        )
+        k = int(uids.shape[0])
+        add = np.zeros((k, 4), np.float64)
+        np.add.at(add, inv, asks)
 
         from ..structs.funcs import node_capacity_vecs
 
-        bad: set = set()
-        # freed/pending are empty for pure dense plans (the C1M commit
-        # shape): skip their lookups entirely on that path, and keep the
-        # comparison unrolled — a genexpr per node costs more than the
-        # arithmetic at C1M commit rates (~1K touched nodes per plan)
-        has_adj = bool(freed) or bool(pending)
+        # per-unique-node rows: node objects live behind Python dicts, so
+        # this loop is O(touched nodes), not O(placements) — the capacity
+        # vecs are memoized per node (structs.funcs)
+        totals = np.zeros((k, 4), np.float64)
+        res = np.zeros((k, 4), np.float64)
+        used = np.zeros((k, 4), np.float64)
+        adj = np.zeros((k, 4), np.float64) if has_adj else None
+        alive = np.ones(k, bool)
         nodes_tbl = snapshot.nodes_table
-        for node_id, add in plan_add.items():
+        for i in range(k):
+            node_id = uids[i]
             node = nodes_tbl.get(node_id)
             if node is None or node.drain or not node.ready():
-                bad.add(node_id)
+                alive[i] = False
                 continue
-            totals, res = node_capacity_vecs(node)
-            used = mirror.get(node_id, zero4)
+            totals[i], res[i] = node_capacity_vecs(node)
+            used[i] = mirror.get(node_id, zero4)
             if has_adj:
                 fr = freed.get(node_id, zero4)
                 pend = pending.get(node_id, zero4)
-                ok = (
-                    used[0] + pend[0] - fr[0] + res[0] + add[0] <= totals[0]
-                    and used[1] + pend[1] - fr[1] + res[1] + add[1] <= totals[1]
-                    and used[2] + pend[2] - fr[2] + res[2] + add[2] <= totals[2]
-                    and used[3] + pend[3] - fr[3] + res[3] + add[3] <= totals[3]
-                )
-            else:
-                ok = (
-                    used[0] + res[0] + add[0] <= totals[0]
-                    and used[1] + res[1] + add[1] <= totals[1]
-                    and used[2] + res[2] + add[2] <= totals[2]
-                    and used[3] + res[3] + add[3] <= totals[3]
-                )
-            if not ok:
-                self.logger.debug(
-                    "dense re-check rejected node %s: used=%s add=%s totals=%s",
-                    node_id[:8], used, add, totals,
-                )
-                bad.add(node_id)
+                adj[i] = (pend[0] - fr[0], pend[1] - fr[1],
+                          pend[2] - fr[2], pend[3] - fr[3])
+
+        load = used + res + add if not has_adj else used + adj + res + add
+        ok = alive & np.all(load <= totals, axis=1)
+        bad_mask = ~ok
 
         out = []
-        partial = bool(bad)
-        if bad:
-            metrics.incr_counter("nomad.plan.dense_nodes_rejected", len(bad))
+        partial = bool(bad_mask.any())
+        if partial:
+            metrics.incr_counter(
+                "nomad.plan.dense_nodes_rejected", int(bad_mask.sum())
+            )
+            if self.logger.isEnabledFor(logging.DEBUG):
+                for i in np.nonzero(bad_mask & alive)[0]:
+                    self.logger.debug(
+                        "dense re-check rejected node %s: used=%s add=%s totals=%s",
+                        str(uids[i])[:8], used[i], add[i], totals[i],
+                    )
         # Commit dense-node preemptions only when the node's dense
         # placements survived (per-node all-or-nothing, same as the
         # object path: a rejected node keeps its victims running).
-        for nid, allocs in dense_pre.items():
-            if nid in plan_add and nid not in bad:
-                result.node_preemptions[nid] = allocs
-        for block in plan.dense_placements:
-            if not bad:
+        if dense_pre:
+            uid_ok = {str(uids[i]): bool(ok[i]) for i in range(k)}
+            for nid, allocs in dense_pre.items():
+                if uid_ok.get(nid):
+                    result.node_preemptions[nid] = allocs
+        for bi, block in enumerate(blocks):
+            if not partial:
                 out.append(block)
                 continue
-            nim = block.node_index_map()
-            if not any(nid in bad for nid in nim):
+            bmask = bad_mask[inv[offs[bi]:offs[bi + 1]]]
+            if not bmask.any():
                 out.append(block)
                 continue
-            keep = [
-                i for nid, idxs in nim.items() if nid not in bad for i in idxs
-            ]
-            if keep:
-                keep.sort()
-                out.append(block.select(keep))
+            keep = np.nonzero(~bmask)[0]
+            if keep.size:
+                out.append(block.select([int(x) for x in keep]))
         return out, partial
 
     @staticmethod
@@ -630,7 +643,9 @@ class Planner:
         for bi, pending in enumerate(batch):
             try:
                 start = metrics.now()
-                with phases.track("plan_evaluate"):
+                with phases.track("plan_evaluate"), \
+                        _lifecycle.pipeline_stage("evaluate",
+                                                  pending.plan.eval_id):
                     result = self.evaluate_plan(snap, pending.plan)
                 metrics.measure_since("nomad.plan.evaluate", start)
                 if result.is_noop():
@@ -712,12 +727,18 @@ class Planner:
         def waiter() -> None:
             try:
                 start = metrics.now()
+                commit_t0 = _lifecycle.pipeline_now()
                 with phases.track("raft_fsm"):
                     index, errors = self.raft.apply(
                         self.peer, APPLY_PLAN_RESULTS_BATCH, payloads
                     )
                 metrics.measure_since("nomad.plan.apply", start)
+                commit_t1 = _lifecycle.pipeline_now()
                 for i, (pending, result, payload) in enumerate(items):
+                    # one commit-stage span per wave in the batched entry
+                    _lifecycle.pipeline_record(
+                        "commit", payload["eval_id"], commit_t0, commit_t1
+                    )
                     # per-payload isolation (fsm._apply_plan_results_batch):
                     # a failed payload must not be reported as committed,
                     # and committed ones must not be reported as failed

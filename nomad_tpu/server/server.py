@@ -128,6 +128,21 @@ class ServerConfig:
     # shard the eval batch over an ("evals", "nodes") jax device mesh when
     # multiple accelerator devices are visible (multi-chip)
     device_mesh: bool = False
+    # -- asynchronous eval-lifecycle pipeline (nomad_tpu/pipeline) -----
+    # master switch: leader-local workers hand device-built dense plans
+    # to the async applier (commit + ack off the dispatch thread) so
+    # eval waves overlap instead of convoying
+    pipeline_async: bool = True
+    # async waves in flight before workers fall back to the classic
+    # synchronous submit (bounds applier memory and completion-queue
+    # depth)
+    pipeline_inflight: int = 128
+    # device re-entries per wave on partial OCC commit (redispatch from
+    # the wave's remembered encode) before nacking back to the broker
+    pipeline_redispatch_max: int = 2
+    # watchdog bound: an accepted wave unacked this long after its last
+    # (re)enqueue is force-nacked — no eval strands in the pipeline
+    pipeline_ack_timeout_s: float = 30.0
     # federation (reference leader.go:997/:1138): non-authoritative
     # regions' leaders mirror ACL policies and GLOBAL tokens from the
     # authoritative region. Empty authoritative_region (or equal to our
@@ -223,6 +238,20 @@ class Server:
                 mesh=mesh,
             )
 
+        # Asynchronous eval-lifecycle pipeline (nomad_tpu/pipeline):
+        # leader-only applier that owns commit + ack of device-built
+        # dense plans; enabled/disabled with leadership below.
+        self.pipeline = None
+        if self.config.pipeline_async:
+            from ..pipeline import AsyncApplier
+
+            self.pipeline = AsyncApplier(
+                self,
+                inflight_max=self.config.pipeline_inflight,
+                redispatch_max=self.config.pipeline_redispatch_max,
+                ack_timeout_s=self.config.pipeline_ack_timeout_s,
+            )
+
         # Cross-region RPC hook (set by the agent): callable
         # (method, region, *args) routed through the gossip region map.
         self.region_rpc = None
@@ -313,6 +342,8 @@ class Server:
         self.deployment_watcher.set_enabled(True)
         self.node_drainer.set_enabled(True)
         self.periodic_dispatcher.set_enabled(True)
+        if self.pipeline is not None:
+            self.pipeline.set_enabled(True)
         self.fsm.on_eval_upserted = self._handle_upserted_eval
         self.fsm.on_capacity_change = self.blocked_evals.unblock
         self._restore_evals()
@@ -365,6 +396,9 @@ class Server:
         metrics.set_gauge(
             "nomad.plan.queue_depth", self.plan_queue.stats().get("depth", 0)
         )
+        if self.pipeline is not None:
+            for key, value in self.pipeline.stats().items():
+                metrics.set_gauge(f"nomad.pipeline.{key}", value)
         metrics.set_gauge(
             "nomad.heartbeat.active", self.heartbeaters.num_active()
         )
@@ -391,6 +425,8 @@ class Server:
         self.deployment_watcher.set_enabled(False)
         self.node_drainer.set_enabled(False)
         self.periodic_dispatcher.set_enabled(False)
+        if self.pipeline is not None:
+            self.pipeline.set_enabled(False)
         self._leader_generation += 1  # invalidates in-flight leader timers
         with self._lock:
             for t in self._leader_timers:

@@ -34,6 +34,10 @@ class Worker:
         # set per-eval while scheduling
         self._eval_token = ""
         self._snapshot_index = 0
+        # True when submit_plan handed commit + ack to the async
+        # applier (nomad_tpu/pipeline): the run loop must NOT ack —
+        # the applier acks after the raft commit lands
+        self._handed_off = False
         # follower mode: RPC connection to the leader's broker/plan queue
         from ..rpc.transport import LeaderConn
 
@@ -139,13 +143,15 @@ class Worker:
             metrics.incr_counter("nomad.worker.dequeue_eval")
             _lifecycle.on_worker(evaluation.id, self.id)
             self._eval_token = token
+            self._handed_off = False
             try:
                 # worker_busy is the coverage denominator: everything the
                 # worker does between dequeue and ack should be explained
                 # by some fine phase (phases.coverage)
                 with phases.track("worker_busy"):
                     self._process(evaluation, token)
-                self._ack(evaluation.id, token)
+                if not self._handed_off:
+                    self._ack(evaluation.id, token)
                 self.stats["evals_processed"] += 1
             except (NotOutstandingError, TokenMismatchError):
                 pass
@@ -242,6 +248,16 @@ class Worker:
         local; only plan submission crosses the wire)."""
         return getattr(self.server, "device_batcher", None)
 
+    @property
+    def pipeline(self):
+        """The leader-local async applier (nomad_tpu/pipeline), or None
+        in follower mode — a follower's plan submission crosses the wire
+        and must stay synchronous (the leader-side handler owns the
+        response)."""
+        if self._active_remote is not None:
+            return None
+        return getattr(self.server, "pipeline", None)
+
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         plan.eval_token = self._eval_token
         # stamp the snapshot the scheduler actually saw (worker.go:277), not
@@ -257,19 +273,35 @@ class Worker:
                 "Plan.Submit", plan, no_forward=True, timeout=90.0, no_retry=True
             )
         else:
-            self.server.eval_broker.pause_nack_timeout(plan.eval_id, self._eval_token)
-            try:
-                with self._span("submit_plan", plan.eval_id):
-                    with phases.track("plan_submit"):
-                        pending = self.server.plan_queue.enqueue(plan)
-                        result = pending.future.result(timeout=60)
-            finally:
+            pipe = self.pipeline
+            if pipe is not None and pipe.try_submit(plan, self._eval_token):
+                # Async handoff (nomad_tpu/pipeline): the applier owns
+                # commit + ack from here; this worker thread goes straight
+                # back to the broker so wave N+1's encode overlaps wave
+                # N's evaluate/commit tail. The scheduler sees the plan's
+                # own placements as a full-commit result — the optimistic
+                # contract; a partial commit comes back later as a
+                # re-dispatch or broker redelivery, both of which
+                # reconcile against fresh state.
+                self._handed_off = True
+                metrics.incr_counter("nomad.worker.async_handoff")
+                result = PlanResult(dense_placements=plan.dense_placements)
+            else:
+                self.server.eval_broker.pause_nack_timeout(
+                    plan.eval_id, self._eval_token
+                )
                 try:
-                    self.server.eval_broker.resume_nack_timeout(
-                        plan.eval_id, self._eval_token
-                    )
-                except (NotOutstandingError, TokenMismatchError):
-                    pass
+                    with self._span("submit_plan", plan.eval_id):
+                        with phases.track("plan_submit"):
+                            pending = self.server.plan_queue.enqueue(plan)
+                            result = pending.future.result(timeout=60)
+                finally:
+                    try:
+                        self.server.eval_broker.resume_nack_timeout(
+                            plan.eval_id, self._eval_token
+                        )
+                    except (NotOutstandingError, TokenMismatchError):
+                        pass
         self.stats["plans_submitted"] += 1
 
         srv = self.server

@@ -1563,6 +1563,12 @@ class Plan:
     # never materialize per-alloc objects on the commit path
     dense_placements: List[DenseTGPlacements] = field(default_factory=list)
     snapshot_index: int = 0
+    # Scheduler opt-in to the asynchronous eval-lifecycle pipeline
+    # (nomad_tpu/pipeline): the submitting worker may hand commit + ack
+    # to the async applier instead of blocking on the plan future. Only
+    # set on device-built plans whose success the scheduler does not
+    # need to inspect before completing the eval.
+    async_ok: bool = False
 
     def dense_count(self) -> int:
         return sum(len(b.ids) for b in self.dense_placements)

@@ -246,7 +246,8 @@ class DeviceBatcher:
     """
 
     def __init__(self, max_batch: int = 8, window_ms: float = 1.0,
-                 mesh=None, idle_ms: float = 0.0) -> None:
+                 mesh=None, idle_ms: float = 0.0,
+                 queue_max: int = 4096) -> None:
         self.max_batch = max(1, int(max_batch))
         self.window_s = max(0.0, float(window_ms)) / 1000.0
         # Adaptive gather: with idle_ms > 0 the batch keeps growing while
@@ -256,7 +257,15 @@ class DeviceBatcher:
         # tuned constant. 0 = fixed-window behavior.
         self.idle_s = max(0.0, float(idle_ms)) / 1000.0
         self.mesh = mesh
-        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # Bounded request queue: the async pipeline lets encode run ahead
+        # of dispatch, so the gather queue needs a ceiling — a wedged
+        # dispatcher must surface as worker backpressure (blocking put),
+        # not unbounded growth. The default is generous (orders of
+        # magnitude above worker count); queue_max <= 0 means unbounded.
+        self.queue_max = int(queue_max)
+        self._queue: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=max(0, self.queue_max)
+        )
         self._scan = None
         self._scan_lock = threading.Lock()  # prewarm + dispatcher race
         # padded-shape key -> set of batch buckets already compiled/warming
@@ -327,6 +336,12 @@ class DeviceBatcher:
             req.event.set()
 
     # -- worker-facing ---------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Requests gathered but not yet dispatched — the pipeline's
+        dispatch-stage depth gauge (published as
+        nomad.pipeline.batcher_queue_depth in the server stats sweep)."""
+        return self._queue.qsize()
 
     def has_warmed(self) -> bool:
         """True once at least one batch has dispatched — i.e. compile

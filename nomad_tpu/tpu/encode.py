@@ -419,6 +419,24 @@ def epoch_usage_arrays(ctx, fleet: dict, n_pad: int, int_mode: bool, fdtype):
         return used0, e_base0
 
 
+def subset_encoded_rows(xs: tuple, missing_list: list, rows) -> tuple:
+    """Row-subset of an eval's per-placement scan inputs.
+
+    Every array in an EncodedEval's ``xs`` tuple carries the placement
+    axis as its LEADING dim (batcher.pad_encoded relies on the same
+    invariant to pad it), so a partial-OCC re-dispatch
+    (pipeline/redispatch.py) can keep just the failed placements' rows:
+    the scan replays only those steps against freshly patched usage
+    (epoch_usage_arrays), skipping snapshot/encode entirely. Returns
+    (xs_subset, missing_subset); node-axis arrays (static/carry) are
+    untouched by construction.
+    """
+    sel = np.asarray(list(rows), np.int64)
+    xs_sub = tuple(np.ascontiguousarray(a[sel]) for a in xs)
+    ml_sub = [missing_list[int(k)] for k in sel]
+    return xs_sub, ml_sub
+
+
 def build_node_table(ctx, job: Job, nodes: List[Node],
                      fleet: Optional[dict] = None) -> NodeTable:
     """Encode nodes + proposed allocs into dense arrays.

@@ -1237,12 +1237,14 @@ class TpuPlacementEngine:
                 return NotImplemented
             _metrics.incr_counter("nomad.tpu_engine.small_eval_device_retry")
 
+        from ..trace import lifecycle as _tlc
         from ..utils import phases as _phases
 
+        wave_id = sched.eval.id
         t0 = _metrics.now()
         with _HOST_WORK_SEM:
             t1 = _metrics.now()
-            with _phases.track("encode"):
+            with _phases.track("encode"), _tlc.pipeline_stage("encode", wave_id):
                 enc = self.encode_eval(sched, destructive, place)
             _metrics.measure_since("nomad.tpu_engine.encode_work", t1)
         _metrics.measure_since("nomad.tpu_engine.encode", t0)
@@ -1250,12 +1252,14 @@ class TpuPlacementEngine:
             return NotImplemented
         if enc is True:
             return True
+        self._pipeline_remember(sched, enc)
         t0 = _metrics.now()
         batcher = getattr(sched.planner, "device_batcher", None)
-        if batcher is not None:
-            chosen, scores, pulls, skipped_steps, evict = batcher.run(enc)
-        else:
-            chosen, scores, pulls, skipped_steps, evict = self.run_scan_single(enc)
+        with _tlc.pipeline_stage("dispatch", wave_id):
+            if batcher is not None:
+                chosen, scores, pulls, skipped_steps, evict = batcher.run(enc)
+            else:
+                chosen, scores, pulls, skipped_steps, evict = self.run_scan_single(enc)
         _metrics.measure_since("nomad.tpu_engine.device_wait", t0)
         t0 = _metrics.now()
         with _HOST_WORK_SEM:
@@ -1856,6 +1860,24 @@ class TpuPlacementEngine:
             np.asarray(pulls), np.asarray(skipped), np.asarray(evict),
         )
 
+    @staticmethod
+    def _pipeline_remember(sched, enc: "EncodedEval") -> None:
+        """Hand this wave's encode to the pipeline's re-dispatch registry
+        (pipeline/redispatch.py) before the device dispatch: on a partial
+        OCC commit, the async applier re-enters the device stage from the
+        remembered encode (row-subset + usage-epoch patch) instead of
+        re-running snapshot/encode. No-op outside the pipelined server."""
+        pipe = getattr(sched.planner, "pipeline", None)
+        if pipe is None:
+            return
+        try:
+            pipe.remember_wave(
+                sched.eval.id, enc, sched.job,
+                getattr(sched.ctx.state, "node_epoch", -1),
+            )
+        except Exception:  # noqa: BLE001 — observability hook, never fatal
+            logger.debug("pipeline remember_wave failed", exc_info=True)
+
     # ------------------------------------------------------------------
     # System scheduler path: one alloc per ELIGIBLE node — each placement
     # names its node up front (system_sched.go:268-286), so the dense pass
@@ -1899,10 +1921,13 @@ class TpuPlacementEngine:
 
         from ..utils import phases as _phases
 
+        from ..trace import lifecycle as _tlc
+
         tg_specs: Dict[str, TGSpec] = {}
         port_cache: Dict[str, object] = {}
         try:
-            with _phases.track("encode"):
+            with _phases.track("encode"), \
+                    _tlc.pipeline_stage("encode", sched.eval.id):
                 for tup in place:
                     tg = tup.task_group
                     if tg.name not in tg_specs:
@@ -2075,23 +2100,24 @@ class TpuPlacementEngine:
         # allocs on one node) interact through used/tg_counts and keep
         # the sequential scan.
         batcher = getattr(sched.planner, "device_batcher", None)
-        if len(set(forced.tolist())) == p and pre_tables is None:
-            # (the forced fast path never encodes preemption — a preempt
-            # pass always takes the sequential scan below)
-            chosen, scores, pulls, skipped, evict = self.run_forced(enc)
-            if batcher is not None:
-                # the forced kernel bypasses the gather queue; count it in
-                # the batcher's stats so dispatch accounting stays whole.
-                # This read-modify-write runs on scheduler worker threads
-                # concurrently with the dispatcher thread's own updates —
-                # both sides take the batcher's lock (guarded-by _lock).
-                with batcher._lock:
-                    batcher.stats["dispatches"] = batcher.stats.get("dispatches", 0) + 1
-                    batcher.stats["evals"] = batcher.stats.get("evals", 0) + 1
-        elif batcher is not None:
-            chosen, scores, pulls, skipped, evict = batcher.run(enc)
-        else:
-            chosen, scores, pulls, skipped, evict = self.run_scan_single(enc)
+        with _tlc.pipeline_stage("dispatch", sched.eval.id):
+            if len(set(forced.tolist())) == p and pre_tables is None:
+                # (the forced fast path never encodes preemption — a preempt
+                # pass always takes the sequential scan below)
+                chosen, scores, pulls, skipped, evict = self.run_forced(enc)
+                if batcher is not None:
+                    # the forced kernel bypasses the gather queue; count it in
+                    # the batcher's stats so dispatch accounting stays whole.
+                    # This read-modify-write runs on scheduler worker threads
+                    # concurrently with the dispatcher thread's own updates —
+                    # both sides take the batcher's lock (guarded-by _lock).
+                    with batcher._lock:
+                        batcher.stats["dispatches"] = batcher.stats.get("dispatches", 0) + 1
+                        batcher.stats["evals"] = batcher.stats.get("evals", 0) + 1
+            elif batcher is not None:
+                chosen, scores, pulls, skipped, evict = batcher.run(enc)
+            else:
+                chosen, scores, pulls, skipped, evict = self.run_scan_single(enc)
 
         # Preemption is a host-side greedy search per node. When enabled
         # and a forced node failed on CAPACITY (feasible by constraints

@@ -23,7 +23,8 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
 
 from ..utils import metrics
 
@@ -98,6 +99,25 @@ _inflight: Dict[str, EvalTrace] = {}
 _done: "deque[EvalTrace]" = deque(maxlen=_DONE_CAP)
 _counts: Dict[str, int] = {"ack": 0, "nack": 0, "failed": 0, "flush": 0}
 
+# -- pipeline stage spans ---------------------------------------------------
+#
+# The eval-lifecycle pipeline (nomad_tpu/pipeline/) decomposes the leader's
+# placement path into stages; each stage execution for one wave (wave id ==
+# eval id) records a [start, end) span here. Unlike utils/phases (union wall
+# shares, bench-window only), these are per-wave and always on: the overlap
+# stress test reads raw spans to prove wave N+1's encode interleaves wave
+# N's device dispatch, and the OCC-storm test counts encode spans per wave
+# to prove re-dispatch skipped the encode stage.
+
+PIPELINE_STAGES = ("encode", "dispatch", "evaluate", "commit")
+_PIPE_CAP = 4096
+
+_pipe_open: Dict[str, int] = {s: 0 for s in PIPELINE_STAGES}
+_pipe_done: Dict[str, "deque"] = {
+    s: deque(maxlen=_PIPE_CAP) for s in PIPELINE_STAGES
+}
+_pipe_counts: Dict[str, int] = {s: 0 for s in PIPELINE_STAGES}
+
 
 def reset() -> None:
     """Drop all records (tests / broker re-enable)."""
@@ -106,6 +126,10 @@ def reset() -> None:
         _done.clear()
         for k in _counts:
             _counts[k] = 0
+        for s in PIPELINE_STAGES:
+            _pipe_open[s] = 0
+            _pipe_done[s].clear()
+            _pipe_counts[s] = 0
 
 
 # -- stamping API (call sites: broker, worker, scheduler, applier) ---------
@@ -210,6 +234,77 @@ def on_flush() -> None:
         _inflight.clear()
 
 
+# -- pipeline stage stamping -----------------------------------------------
+
+
+def pipeline_now() -> float:
+    """The clock pipeline spans are recorded on (time.monotonic)."""
+    return _clock()
+
+
+@contextmanager
+def pipeline_stage(stage: str, wave_id: str):
+    """Record one stage execution for one wave. Depth (open count) is
+    visible to gauges while the stage runs; the completed span lands in
+    the per-stage ring on exit."""
+    t0 = _clock()
+    with _lock:
+        _pipe_open[stage] = _pipe_open.get(stage, 0) + 1
+    try:
+        yield
+    finally:
+        t1 = _clock()
+        with _lock:
+            _pipe_open[stage] = max(0, _pipe_open.get(stage, 0) - 1)
+            _pipe_done.setdefault(stage, deque(maxlen=_PIPE_CAP)).append(
+                (wave_id, t0, t1)
+            )
+            _pipe_counts[stage] = _pipe_counts.get(stage, 0) + 1
+
+
+def pipeline_record(stage: str, wave_id: str, t0: float, t1: float) -> None:
+    """Record an externally-timed stage span (times from pipeline_now());
+    used by the applier's waiter thread, which times per-payload commits
+    inside one batched raft entry."""
+    with _lock:
+        _pipe_done.setdefault(stage, deque(maxlen=_PIPE_CAP)).append(
+            (wave_id, t0, t1)
+        )
+        _pipe_counts[stage] = _pipe_counts.get(stage, 0) + 1
+
+
+def pipeline_spans(stage: Optional[str] = None) -> List[Tuple[str, str, float, float]]:
+    """Completed (stage, wave_id, t0, t1) spans, oldest first. The overlap
+    and retry-reuse tests read these raw."""
+    with _lock:
+        stages = [stage] if stage is not None else list(_pipe_done)
+        out = []
+        for s in stages:
+            out.extend((s, w, a, b) for (w, a, b) in _pipe_done.get(s, ()))
+    out.sort(key=lambda r: r[2])
+    return out
+
+
+def pipeline_summary() -> Dict[str, Dict[str, object]]:
+    """Per-stage depth / throughput / latency percentiles."""
+    with _lock:
+        snap = {
+            s: (list(_pipe_done.get(s, ())), _pipe_open.get(s, 0),
+                _pipe_counts.get(s, 0))
+            for s in set(PIPELINE_STAGES) | set(_pipe_done)
+        }
+    out: Dict[str, Dict[str, object]] = {}
+    for s, (spans, depth, count) in snap.items():
+        lat = sorted((b - a) * 1000.0 for (_, a, b) in spans)
+        out[s] = {
+            "depth": depth,
+            "count": count,
+            "latency_ms_p50": round(_percentile(lat, 0.50), 3),
+            "latency_ms_p95": round(_percentile(lat, 0.95), 3),
+        }
+    return out
+
+
 # -- read side -------------------------------------------------------------
 
 
@@ -256,6 +351,7 @@ def snapshot(recent: int = 64) -> Dict[str, object]:
     out = summary()
     out["inflight_evals"] = inflight
     out["recent"] = done
+    out["pipeline"] = pipeline_summary()
     return out
 
 
@@ -270,3 +366,8 @@ def publish_gauges() -> None:
     metrics.set_gauge("nomad.trace.slowest_inflight_ms",
                       s["slowest_inflight_ms"])
     metrics.set_gauge("nomad.trace.inflight", s["inflight"])
+    for stage, row in pipeline_summary().items():
+        base = f"nomad.trace.pipeline.{stage}"
+        metrics.set_gauge(f"{base}.depth", row["depth"])
+        metrics.set_gauge(f"{base}.count", row["count"])
+        metrics.set_gauge(f"{base}.latency_ms_p95", row["latency_ms_p95"])

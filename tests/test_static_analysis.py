@@ -400,6 +400,101 @@ def test_trace_span_accepts_with_and_enter_context():
 
 
 # ---------------------------------------------------------------------------
+# fixture units — pipeline-stage-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_flags_raft_apply_from_pipeline_code():
+    # the bug shape the rule exists to forbid: the dispatch-stage thread
+    # committing around the plan queue
+    src = dedent("""
+        class Applier:
+            def commit(self, entry_type, payload):
+                return self.server.raft_apply(entry_type, payload)
+    """)
+    fs = run_source(src, "nomad_tpu/pipeline/applier.py")
+    assert [f.rule for f in fs] == ["pipeline-stage-discipline"]
+    assert "raft apply" in fs[0].message
+
+
+def test_pipeline_flags_raft_dot_apply_chain():
+    src = dedent("""
+        class Applier:
+            def commit(self, entry_type, payload):
+                return self.server.raft.apply(self.server.peer, entry_type, payload)
+    """)
+    fs = run_source(src, "nomad_tpu/pipeline/redispatch.py")
+    assert [f.rule for f in fs] == ["pipeline-stage-discipline"]
+    assert "raft apply" in fs[0].message
+
+
+def test_pipeline_flags_state_store_write():
+    src = dedent("""
+        class Applier:
+            def commit(self, index, allocs):
+                self.server.fsm.state.upsert_allocs(index, allocs)
+    """)
+    fs = run_source(src, "nomad_tpu/pipeline/applier.py")
+    assert [f.rule for f in fs] == ["pipeline-stage-discipline"]
+    assert "state-store write" in fs[0].message
+
+
+def test_pipeline_flags_unbounded_handoff_queue():
+    src = dedent("""
+        import queue
+        class Stage:
+            def __init__(self):
+                self.out = queue.Queue()
+    """)
+    fs = run_source(src, "nomad_tpu/pipeline/queues.py")
+    assert [f.rule for f in fs] == ["pipeline-stage-discipline"]
+    assert "unbounded stage queue" in fs[0].message
+
+
+def test_pipeline_accepts_bounded_handoff_and_plan_queue():
+    # the fixed shape: commits via plan_queue.enqueue, handoff via a
+    # bounded queue; state READS (snapshot) are fine
+    src = dedent("""
+        import queue
+        class Applier:
+            def __init__(self, maxsize):
+                self.out = queue.Queue(maxsize=maxsize)
+            def submit(self, plan):
+                snap = self.server.fsm.state.snapshot()
+                pending = self.server.plan_queue.enqueue(plan)
+                self.out.put(pending)
+    """)
+    assert run_source(src, "nomad_tpu/pipeline/applier.py") == []
+
+
+def test_pipeline_rule_scoped_to_pipeline_package():
+    # raft applies outside nomad_tpu/pipeline/ are the normal commit path
+    src = dedent("""
+        class Planner:
+            def commit(self, entry_type, payload):
+                return self.server.raft_apply(entry_type, payload)
+    """)
+    assert run_source(src, "server/plan_apply.py") == []
+
+
+def test_pipeline_real_package_is_clean():
+    from nomad_tpu.analysis.core import parse_file
+    from nomad_tpu.analysis.pipeline_stage_discipline import (
+        PipelineStageDisciplineChecker,
+    )
+
+    checker = PipelineStageDisciplineChecker()
+    pkg = os.path.join(PKG, "pipeline")
+    for fn in sorted(os.listdir(pkg)):
+        if not fn.endswith(".py"):
+            continue
+        module, err = parse_file(
+            os.path.join(pkg, fn), f"nomad_tpu/pipeline/{fn}")
+        assert err is None
+        assert checker.check(module) == [], fn
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline mechanics
 # ---------------------------------------------------------------------------
 

@@ -214,3 +214,44 @@ def test_v1_trace_endpoint_end_to_end():
         assert "workers" in out
     finally:
         agent.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pipeline stage spans (nomad-pipeline rides on nomad-trace)
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_stage_spans_and_summary():
+    lifecycle.reset()
+    with lifecycle.pipeline_stage("encode", "wave-1"):
+        # depth is visible while the stage is open
+        assert lifecycle.pipeline_summary()["encode"]["depth"] == 1
+        time.sleep(0.01)
+    t0 = lifecycle.pipeline_now()
+    lifecycle.pipeline_record("commit", "wave-1", t0, t0 + 0.005)
+
+    spans = lifecycle.pipeline_spans()
+    assert ("encode", "wave-1") in {(s, w) for (s, w, _, _) in spans}
+    assert lifecycle.pipeline_spans("commit") and \
+        not lifecycle.pipeline_spans("evaluate")
+
+    summ = lifecycle.pipeline_summary()
+    assert summ["encode"]["depth"] == 0
+    assert summ["encode"]["count"] == 1
+    assert summ["commit"]["count"] == 1
+    assert summ["commit"]["latency_ms_p95"] >= 4.0
+    # every declared stage reports, populated or not
+    assert set(lifecycle.PIPELINE_STAGES) <= set(summ)
+    # the /v1/trace payload carries the same block
+    assert lifecycle.snapshot()["pipeline"]["encode"]["count"] == 1
+
+
+def test_pipeline_gauges_published():
+    lifecycle.reset()
+    with lifecycle.pipeline_stage("dispatch", "wave-g"):
+        pass
+    lifecycle.publish_gauges()
+    g = _gauges()
+    assert g["nomad.trace.pipeline.dispatch.count"] == 1
+    assert g["nomad.trace.pipeline.dispatch.depth"] == 0
+    assert "nomad.trace.pipeline.dispatch.latency_ms_p95" in g
