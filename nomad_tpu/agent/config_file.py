@@ -13,7 +13,8 @@ Nomad config files map over:
     advertise { rpc }
     server { enabled bootstrap_expect num_schedulers encrypt
              authoritative_region raft_protocol(ignored)
-             default_scheduler_config { scheduler_algorithm } }
+             default_scheduler_config { scheduler_algorithm chunk_k
+                                        parity_sample_rate } }
     client { enabled node_class servers meta {} host_volume "n" { path } }
     acl { enabled replication_token }
     telemetry { statsd_address statsite_address datadog_address
@@ -197,6 +198,10 @@ def apply_file_config(cfg: AgentConfig, data: Dict[str, Any]) -> AgentConfig:
     dsc = srv.get("default_scheduler_config") or {}
     if "scheduler_algorithm" in dsc:
         cfg.scheduler_algorithm = str(dsc["scheduler_algorithm"])
+    if "chunk_k" in dsc:
+        cfg.chunk_k = int(dsc["chunk_k"])
+    if "parity_sample_rate" in dsc:
+        cfg.parity_sample_rate = float(dsc["parity_sample_rate"])
 
     cli = data.get("client") or {}
     _check_keys(cli, _CLIENT_KEYS, "client")

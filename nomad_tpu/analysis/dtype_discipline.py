@@ -18,6 +18,20 @@ sub-patterns:
   B. binary arithmetic where one operand is provably float64 — a literal
      ``np.float64(...)`` call or a variable assigned from an allocation
      with an explicit ``np.float64`` dtype — without an ``.astype`` cast.
+
+Packed-mask layouts (sub-pattern C, its OWN wider target list — the
+kernel modules that consume packed planes, ``tpu/engine.py`` and
+``tpu/batcher.py`` included): the fused scan packs boolean planes into
+uint8 feature lanes and 16-bit count lanes inside int32 (intscore
+"Packed-mask lanes"). Crossing a packed boundary is only exact through
+the blessed helpers, so the rule flags
+
+  C1. raw ``>>`` / ``&`` bit surgery on a ``*packed*``-named array
+      outside the ``pack_*``/``unpack_*`` helpers themselves — a
+      hand-rolled unpack silently breaks when the lane layout moves;
+  C2. float promotion of a ``*packed*``-named plane (``.astype`` to a
+      float dtype, or arithmetic against a float literal) — packed
+      lanes are integral bit patterns, not numbers.
 """
 from __future__ import annotations
 
@@ -29,6 +43,19 @@ from .core import Finding, ParsedModule, dotted_name, resolve_call_name
 RULE = "dtype-discipline"
 
 TARGET_SUFFIXES = ("tpu/encode.py", "tpu/intscore.py")
+
+# sub-pattern C applies wherever packed planes travel: the encode that
+# emits them, the scan/batcher modules that consume them
+PACKED_TARGET_SUFFIXES = (
+    "tpu/encode.py", "tpu/intscore.py", "tpu/engine.py", "tpu/batcher.py",
+)
+
+_FLOAT_DTYPES = {
+    "numpy.float16", "numpy.float32", "numpy.float64",
+    "np.float16", "np.float32", "np.float64",
+    "jnp.float16", "jnp.float32", "jnp.float64", "jnp.bfloat16",
+    "jax.numpy.float32", "jax.numpy.float64",
+}
 
 _ALLOC_FNS = {
     "numpy.zeros", "numpy.ones", "numpy.full", "numpy.empty",
@@ -99,15 +126,59 @@ def _float64_alloc(call: ast.Call, aliases: Dict[str, str]) -> bool:
     return any(_is_float64_ref(a, aliases) for a in call.args)
 
 
+def _is_float_dtype_ref(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith(("float", "bfloat")):
+        return True
+    name = dotted_name(node)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    return (aliases.get(head, head) + ("." + rest if rest else "")) in \
+        _FLOAT_DTYPES or name in _FLOAT_DTYPES
+
+
+def _packed_operand(node: ast.AST):
+    """The ``*packed*``-named Name/Attribute inside an expression (the
+    packed plane crossing a boundary), or None. Does NOT descend into
+    ``pack_*``/``unpack_*`` calls: a plane passed THROUGH a blessed
+    helper has already crossed the boundary legally."""
+    stack = [node]
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if fname.startswith(("pack_", "unpack_")):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+            continue
+        if isinstance(sub, ast.Name) and "packed" in sub.id.lower():
+            return sub.id
+        if isinstance(sub, ast.Attribute) and "packed" in sub.attr.lower():
+            return sub.attr
+        stack.extend(ast.iter_child_nodes(sub))
+    return None
+
+
 class DtypeDisciplineChecker:
     rule = RULE
 
-    def __init__(self, restrict_to=TARGET_SUFFIXES):
+    def __init__(self, restrict_to=TARGET_SUFFIXES,
+                 packed_targets=PACKED_TARGET_SUFFIXES):
         self.restrict_to = tuple(restrict_to)
+        self.packed_targets = tuple(packed_targets)
 
     def check(self, module: ParsedModule) -> List[Finding]:
-        if self.restrict_to and not module.rel.endswith(self.restrict_to):
-            return []
+        findings: List[Finding] = []
+        if self.restrict_to and module.rel.endswith(self.restrict_to):
+            findings.extend(self._check_float64_creep(module))
+        if self.packed_targets and module.rel.endswith(self.packed_targets):
+            findings.extend(self._check_packed_lanes(module))
+        return findings
+
+    def _check_float64_creep(self, module: ParsedModule) -> List[Finding]:
         from .core import body_walk, import_aliases
 
         aliases = import_aliases(module.tree)
@@ -176,3 +247,70 @@ class DtypeDisciplineChecker:
         if isinstance(node, ast.Call) and _is_float64_ref(node.func, aliases):
             return True
         return False
+
+    # -- sub-pattern C: packed-lane discipline --------------------------
+
+    def _check_packed_lanes(self, module: ParsedModule) -> List[Finding]:
+        from .core import import_aliases
+
+        aliases = import_aliases(module.tree)
+        # the blessed helpers themselves ARE the raw bit surgery; skip
+        # every node inside a pack_*/unpack_* def
+        blessed = [
+            (fn.lineno, fn.end_lineno or fn.lineno)
+            for fn in ast.walk(module.tree)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and fn.name.startswith(("pack_", "unpack_"))
+        ]
+
+        def in_blessed(node: ast.AST) -> bool:
+            ln = getattr(node, "lineno", None)
+            return ln is not None and any(a <= ln <= b for a, b in blessed)
+
+        findings: List[Finding] = []
+        seen_raw: Set[tuple] = set()  # (line, name): nested >>/& report once
+        for node in ast.walk(module.tree):
+            if in_blessed(node):
+                continue
+            # C1: raw >> / & surgery on a packed plane
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, (ast.RShift, ast.BitAnd)):
+                name = _packed_operand(node.left) or _packed_operand(node.right)
+                if name:
+                    if (node.lineno, name) not in seen_raw:
+                        seen_raw.add((node.lineno, name))
+                        findings.append(Finding(
+                            RULE, module.rel, node.lineno,
+                            f"raw bit unpack of packed plane '{name}' outside "
+                            "the blessed intscore helpers (use unpack_feat_lane"
+                            "/unpack_count_lo/unpack_count_hi)",
+                        ))
+                    continue
+            # C2a: .astype(<float dtype>) on a packed plane
+            if _is_astype_call(node) \
+                    and any(_is_float_dtype_ref(a, aliases) for a in node.args):
+                name = _packed_operand(node.func.value)
+                if name:
+                    findings.append(Finding(
+                        RULE, module.rel, node.lineno,
+                        f"float promotion of packed plane '{name}' "
+                        "(packed lanes are integral bit patterns; unpack "
+                        "through the blessed helpers before float math)",
+                    ))
+                    continue
+            # C2b: arithmetic between a packed plane and a float literal
+            if isinstance(node, ast.BinOp) and isinstance(node.op, _ARITH_OPS):
+                for lhs, rhs in ((node.left, node.right),
+                                 (node.right, node.left)):
+                    if isinstance(rhs, ast.Constant) \
+                            and isinstance(rhs.value, float):
+                        name = _packed_operand(lhs)
+                        if name:
+                            findings.append(Finding(
+                                RULE, module.rel, node.lineno,
+                                "float promotion of packed plane "
+                                f"'{name}' in arithmetic with a float "
+                                "literal (unpack the lane first)",
+                            ))
+                            break
+        return findings

@@ -55,9 +55,8 @@ def batched_scan_shardings(mesh):
         ns(e, "nodes", None),        # totals [B, N, D]
         ns(e, "nodes", None),        # reserved [B, N, D]
         ns(e, None, None),           # asks [B, G, D]
-        ns(e, None, "nodes"),        # feas [B, G, N]
+        ns(e, None, "nodes"),        # feat_packed [B, G, N] (uint8 lanes)
         ns(e, None, "nodes"),        # aff_score [B, G, N]
-        ns(e, None, "nodes"),        # aff_present [B, G, N]
         ns(e, None),                 # desired_counts [B, G]
         ns(e, None),                 # dh_job [B, G]
         ns(e, None),                 # dh_tg [B, G]

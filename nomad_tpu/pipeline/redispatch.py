@@ -109,12 +109,12 @@ def _retry_eligible(enc) -> Optional[str]:
         return "device dims"
     # distinct_hosts / distinct_property counts in the carry are stale
     # once part of the wave committed
-    if bool(np.asarray(static[7]).any()) or bool(np.asarray(static[8]).any()):
+    if bool(np.asarray(static[6]).any()) or bool(np.asarray(static[7]).any()):
         return "distinct_hosts"
-    if static[18].shape[0] > 0:
+    if static[17].shape[0] > 0:
         return "distinct_property"
     # spread bucket counts are wave-relative state too
-    if bool(np.asarray(static[14]).any()):
+    if bool(np.asarray(static[13]).any()):
         return "spread"
     # eviction steps must be absent (no destructive placements rode
     # along); evict_node is (p,) with -1 = no eviction for that row

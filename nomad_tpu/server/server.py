@@ -109,6 +109,11 @@ class ServerConfig:
     watchdog_interval: float = 10.0
     watchdog_stall_s: float = 30.0
     scheduler_algorithm: str = "tpu_binpack"
+    # chunked throughput tier (scheduler_algorithm = "tpu_binpack_chunked"):
+    # top-K chunk size per scan step, and the fraction of chunk-placed
+    # evals re-run through the bit-parity scan as a divergence spot-check
+    chunk_k: int = 128
+    parity_sample_rate: float = 0.05
     vault: Optional[object] = None  # integrations.vault.VaultConfig
     # Eval-batched device scheduling (SURVEY §2.6 row 1): up to this many
     # concurrently-scheduling evals share ONE device dispatch of the
@@ -351,7 +356,11 @@ class Server:
         if self.fsm.state.scheduler_config()[1] is None:
             self.raft_apply(
                 SCHEDULER_CONFIG,
-                SchedulerConfiguration(scheduler_algorithm=self.config.scheduler_algorithm),
+                SchedulerConfiguration(
+                    scheduler_algorithm=self.config.scheduler_algorithm,
+                    chunk_k=self.config.chunk_k,
+                    parity_sample_rate=self.config.parity_sample_rate,
+                ),
             )
         self._leader_generation += 1
         gen = self._leader_generation

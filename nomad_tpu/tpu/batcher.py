@@ -54,6 +54,27 @@ def _pow2ceil(x: int) -> int:
     return p
 
 
+def assert_chunk_gate(enc: EncodedEval) -> None:
+    """Dispatch-side re-assertion of the chunked tier's eligibility gate
+    (engine._chunk_eligible decides routing; this catches a bypass).
+
+    The chunk step has NO eviction scoring and passes the preemption
+    carry through untouched, so a preempting or destructive eval reaching
+    chunked dispatch would silently drop its evictions — the
+    deficit-carry would then re-ask for capacity the preemption was
+    supposed to free, over-placing on retry rounds. Such evals must fall
+    back to the bit-parity scan.
+    """
+    assert enc.pre_allocs is None, (
+        "chunked tier dispatched a preempting eval (pre_allocs present); "
+        "preemption must take the bit-parity scan"
+    )
+    assert not (np.asarray(enc.xs[2]) >= 0).any(), (
+        "chunked tier dispatched an eval with eviction steps; "
+        "destructive updates must take the bit-parity scan"
+    )
+
+
 def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
                 v_pad: int, p_pad: int, dtype,
                 d_pad: int = 0, k_pad: Optional[int] = None,
@@ -77,7 +98,7 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
       - capacity dims beyond the eval's own (device dims of co-batched
         device jobs) pad zero ask against zero totals: 0 <= 0 fits
     """
-    (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
+    (totals, reserved, asks, feat_packed, aff_score, desired_counts,
      dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
      spread_has_targets, spread_active, sum_spread_weights, n_real,
      e_ask, dp_vids, dp_limit, dp_applies,
@@ -135,13 +156,14 @@ def pad_encoded(enc: EncodedEval, n_pad: int, g_pad: int, s_pad: int,
         # (rows only — the D axis must still pad so the batch stacks)
         pad(f(reserved), ((0, dn if reserved.shape[0] else 0), (0, dd))),
         pad(f(asks), ((0, dg), (0, dd))),
-        pad(feas, ((0, dg), (0, dn)), False),
-        # aff arrays may have a ZERO G axis (shape-specialized absent
+        # packed feature plane (intscore.pack_feat_planes): padded TG rows
+        # and padded nodes get 0 = infeasible with no affinity lane
+        pad(feat_packed, ((0, dg), (0, dn)), 0),
+        # aff_score may have a ZERO G axis (shape-specialized absent
         # affinities): the batch target is 0 when every co-batched eval
         # lacks affinities (keeping the specialization), else g_pad —
         # padded zero rows are inert either way
         pad(f(aff_score), ((0, aff_pad - aff_score.shape[0]), (0, dn))),
-        pad(aff_present, ((0, aff_pad - aff_present.shape[0]), (0, dn)), False),
         pad(desired_counts, ((0, dg),), 1),
         pad(dh_job, ((0, dg),), False),
         pad(dh_tg, ((0, dg),), False),
@@ -592,11 +614,11 @@ class DeviceBatcher:
         evd_raw = max(e.xs[3].shape[1] for e in encs)
         evd_pad = d_pad if evd_raw else 0
         fac_pad = max(e.xs[7].shape[1] for e in encs)
-        dpd_pad = max(e.static[18].shape[0] for e in encs)
+        dpd_pad = max(e.static[17].shape[0] for e in encs)
         dpv_pad = max(e.carry[8].shape[1] for e in encs)
         fnd_pad = max(e.xs[9].shape[1] for e in encs)
         # preemption candidate axis: zero when no co-batched eval preempts
-        prec_raw = max(e.static[21].shape[1] for e in encs)
+        prec_raw = max(e.static[20].shape[1] for e in encs)
         prec_pad = _pow2ceil(prec_raw) if prec_raw else 0
         pregp_pad = (
             _pow2ceil(max(max(e.carry[11].shape[0] for e in encs), 1))

@@ -59,6 +59,14 @@ logger = logging.getLogger("nomad_tpu.tpu.engine")
 
 MAX_SKIP = 3
 
+# Deterministic sampler for the chunked tier's parity spot checks: tests
+# reseed it to make the sampling decision reproducible. The chunked tier
+# only runs on float-mode (non-deterministic) encodes, so the RNG never
+# influences a deterministic-mode plan.
+import random as _random
+
+_PARITY_SAMPLE_RNG = _random.Random(0xC47A)
+
 # Partial OCC retries below device_min_placements still ride the device
 # when their compile bucket is already warm (see compute_placements) —
 # but only above this floor; 1-2 placement stragglers stay on the host.
@@ -223,9 +231,21 @@ def _make_step():
     indexed formulation — fuzz-asserted against the host pipeline in
     tests/test_tpu_parity.py."""
     import jax.numpy as jnp
+    from jax import lax as jlax
+
+    from .intscore import (
+        FEAT_AFF_BIT,
+        FEAT_FEAS_BIT,
+        PACK_COUNT_MAX,
+        pack_count_lanes,
+        pack_presence_lanes,
+        unpack_count_hi,
+        unpack_count_lo,
+        unpack_feat_lane,
+    )
 
     def step(static, carry, x):
-        (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
+        (totals, reserved, asks, feat_packed, aff_score, desired_counts,
          dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
          spread_has_targets, spread_active, sum_spread_weights, n_real,
          e_ask, dp_vids, dp_limit, dp_applies,
@@ -312,20 +332,25 @@ def _make_step():
 
         # -- row selects ---------------------------------------------------
         ask = pick_g(asks)                               # [D]
-        feas_g = pick_g(feas, False)                     # [N]
+        # ONE packed uint8 feature plane carries feasibility and affinity
+        # presence (intscore.pack_feat_planes): one pick_g pass where the
+        # unpacked layout needed two
+        feat_g = pick_g(feat_packed)                     # [N] uint8
+        feas_g = unpack_feat_lane(feat_g, FEAT_FEAS_BIT)
         tg_counts_g = pick_g(tg_counts)                  # [N]
         desired_g = pick_g(desired_counts).astype(fdt)
         dh_job_g = jnp.any(sel_g & dh_job)
         dh_tg_g = jnp.any(sel_g & dh_tg)
         # shape specialization (compile-time): a job without affinities
-        # encodes aff arrays with a ZERO G axis, so the f64 pick and the
-        # score term vanish from the compiled step entirely
+        # encodes aff_score with a ZERO G axis, so the f64 pick and the
+        # score term vanish from the compiled step entirely (the packed
+        # plane's affinity lane is all-zero and never read)
         if aff_score.shape[0] == 0:
             aff = jnp.zeros(n_pad, fdt)
             aff_p = jnp.zeros(n_pad, bool)
         else:
             aff = pick_g(aff_score)
-            aff_p = pick_g(aff_present, False)
+            aff_p = unpack_feat_lane(feat_g, FEAT_AFF_BIT)
 
         # -- feasibility ---------------------------------------------------
         # int mode folds reserved into totals at encode (the scoring
@@ -532,13 +557,12 @@ def _make_step():
             spread_total = jnp.sum(per_spread, axis=0)  # [N] int64
             spread_p = spread_total != 0
 
-            num_terms = (
-                1
-                + anti_present.astype(jnp.int32)
-                + pmask.astype(jnp.int32)
-                + aff_p.astype(jnp.int32)
-                + spread_p.astype(jnp.int32)
-            )
+            # term-presence bits packed into ONE uint8 plane: num_terms is
+            # 1 + popcount instead of four astype(int32) planes and adds —
+            # the whole (presence -> factor -> final) chain is a single
+            # fused elementwise expression over [N]
+            presence = pack_presence_lanes(anti_present, pmask, aff_p, spread_p)
+            num_terms = 1 + jlax.population_count(presence).astype(jnp.int32)
             # mean of terms via EXACT scale-by-60 (all of 1..5 divide 60)
             factor = jnp.floor_divide(60, num_terms).astype(i64)
             final = (
@@ -604,13 +628,11 @@ def _make_step():
             spread_total = jnp.sum(per_spread, axis=0)  # [N]
             spread_p = spread_total != 0.0
 
-            num_terms = (
-                1.0
-                + anti_present.astype(fdt)
-                + pmask.astype(fdt)
-                + aff_p.astype(fdt)
-                + spread_p.astype(fdt)
-            )
+            # same popcount fusion as int mode (small counts are exact in
+            # any float dtype, so the quotient is bit-identical to the
+            # astype-chain form)
+            presence = pack_presence_lanes(anti_present, pmask, aff_p, spread_p)
+            num_terms = (1 + jlax.population_count(presence)).astype(fdt)
             final = (binpack + anti + resched + jnp.where(aff_p, aff, 0.0) + spread_total) / num_terms
             neg_inf = -jnp.inf
             score_zero = jnp.asarray(0.0, fdt)
@@ -621,12 +643,19 @@ def _make_step():
         # S(i) - S(o-1) for i >= o and S(i) + (T - S(o-1)) for i < o —
         # elementwise, so the LimitIterator emulation needs no gathers.
         #
-        # TWO int32 ring cumsums (low, feas) carry everything: the skip
-        # prefix is min(low_cum, MAX_SKIP) (skipped = the first MAX_SKIP
-        # low entries in ring order) and the source prefix is
-        # feas_cum - skip_cum — one cumsum fewer than the direct form.
-        # (int64 field-packing would make it ONE, but int64 prefix sums
-        # are pathologically slow on this backend.)
+        # ONE packed int32 ring cumsum carries everything: the low-score
+        # and feasible count planes ride 16-bit lanes of one int32 plane
+        # (intscore.pack_count_lanes). Lane exactness: both totals are
+        # bounded by n_pad < 2**15, so the low lane never carries into the
+        # high lane, and every SELECTED ring branch is lane-wise
+        # non-negative (i >= o selects S(i) - S(o-1) with [0..o-1] a
+        # subset of [0..i]; i < o selects S(i) + the suffix sum — both
+        # >= 0 per lane), so no borrow crosses lanes either. The skip
+        # prefix is then min(low_cum, MAX_SKIP) (skipped = the first
+        # MAX_SKIP low entries in ring order) and the source prefix is
+        # feas_cum - skip_cum. (int64 field-packing would lift the 2**15
+        # bound, but int64 prefix sums are pathologically slow on this
+        # backend — int32 lanes are free.)
         valid = iota < n_real
         nr = jnp.maximum(n_real, 1)
 
@@ -638,14 +667,23 @@ def _make_step():
         def ring_cumsum(a_int):
             s_nat = jnp.cumsum(a_int)
             total = s_nat[-1]
-            before = jnp.sum(jnp.where(iota < offset, a_int, 0))
+            before = jnp.sum(jnp.where(iota < offset, a_int, 0),
+                             dtype=jnp.int32)
             ring = jnp.where(
                 iota >= offset, s_nat - before, s_nat + (total - before)
             )
             return ring, total
 
-        low_cum, low_total = ring_cumsum(low.astype(jnp.int32))
-        feas_cum, feas_total = ring_cumsum(feas_v.astype(jnp.int32))
+        if n_pad < PACK_COUNT_MAX:
+            packed_cum, packed_total = ring_cumsum(pack_count_lanes(low, feas_v))
+            low_cum = unpack_count_lo(packed_cum)
+            feas_cum = unpack_count_hi(packed_cum)
+            low_total = unpack_count_lo(packed_total)
+            feas_total = unpack_count_hi(packed_total)
+        else:
+            # lanes would overflow on a >32K-node pad: two plain cumsums
+            low_cum, low_total = ring_cumsum(low.astype(jnp.int32))
+            feas_cum, feas_total = ring_cumsum(feas_v.astype(jnp.int32))
 
         skipped = low & (low_cum <= MAX_SKIP)
         skip_cum = jnp.minimum(low_cum, MAX_SKIP)
@@ -867,8 +905,10 @@ def _build_forced_kernel():
     _enable_persistent_compile_cache()
     import jax.numpy as jnp
 
+    from .intscore import FEAT_FEAS_BIT, unpack_feat_lane
+
     def forced_eval(static, carry, xs):
-        (totals, reserved, asks, feas, _aff_score, _aff_present,
+        (totals, reserved, asks, feat_packed, _aff_score,
          desired_counts, dh_job, dh_tg, _limits, _spread_vids,
          _spread_desired, _spread_weights, _spread_has_targets,
          _spread_active, _sum_spread_weights, n_real, e_ask,
@@ -903,7 +943,8 @@ def _build_forced_kernel():
             jnp.where(dh_tg[g], ~((tgc > 0) & (jc > 0)), True),
         )
         feasible = (
-            feas[g, j] & fits & dh_mask & (j >= 0) & (j < n_real)
+            unpack_feat_lane(feat_packed[g, j], FEAT_FEAS_BIT)
+            & fits & dh_mask & (j >= 0) & (j < n_real)
             & ~failed0[g]
         )
 
@@ -1110,6 +1151,18 @@ class TpuPlacementEngine:
     def __init__(self) -> None:
         self._place_scan = None
         self._forced_kernel = None
+        # chunked throughput tier: compiled chunk scans keyed by chunk
+        # size, plus the sampled-parity divergence tally every bench /
+        # server artifact reads (parity_sample_stats)
+        self._chunk_scans: Dict[int, object] = {}
+        import threading as _threading
+
+        self._parity_lock = _threading.Lock()
+        self._parity_samples = {
+            "evals_sampled": 0,
+            "placements_checked": 0,
+            "placements_diverged": 0,
+        }
 
     @classmethod
     def shared(cls) -> "TpuPlacementEngine":
@@ -1140,6 +1193,7 @@ class TpuPlacementEngine:
         if eng is not None:
             eng._place_scan = None
             eng._forced_kernel = None
+            eng._chunk_scans.clear()
 
     def _scan_fn(self):
         if self._place_scan is None:
@@ -1191,6 +1245,169 @@ class TpuPlacementEngine:
             np.asarray(pulls)[:p], np.asarray(skipped)[:p],
             np.asarray(evict)[:p],
         )
+
+    # -- chunked throughput tier ---------------------------------------
+
+    @staticmethod
+    def _chunk_eligible(enc: "EncodedEval") -> Optional[str]:
+        """None when the encode may run on the chunked top-K tier; else
+        the reason it must take the bit-parity scan. The chunk step
+        models fresh, non-destructive, float-mode placements only — in
+        particular it has NO eviction scoring, so preempting evals (the
+        deficit-carry / preemption interaction) are hard-gated here and
+        re-asserted at dispatch (batcher.assert_chunk_gate)."""
+        if np.dtype(enc.dtype).kind != "f":
+            return "int mode"  # deterministic encodes carry score60s
+        if not enc.dense_ok:
+            return "not dense"
+        if enc.pre_allocs is not None:
+            return "preemption tables"
+        if enc.static[1].shape[0] != enc.n_pad:
+            return "folded reserved"  # chunk util needs full-height reserved
+        if enc.xs[1].shape[1] > 0 and bool((np.asarray(enc.xs[1]) >= 0).any()):
+            return "reschedule penalties"
+        if bool((np.asarray(enc.xs[2]) >= 0).any()):
+            return "eviction axis"
+        if enc.xs[9].ndim == 2 and enc.xs[9].shape[1] > 0:
+            return "forced nodes"
+        return None
+
+    def _chunk_fn(self, chunk: int):
+        fn = self._chunk_scans.get(chunk)
+        if fn is None:
+            fn = _build_chunk_scan(chunk)
+            self._chunk_scans[chunk] = fn
+        return fn
+
+    def run_chunked(self, enc: "EncodedEval", chunk_k: int = 128,
+                    retry_rounds: int = 2):
+        """Run one chunk-eligible eval through the top-K throughput scan
+        and expand the per-chunk outputs back to per-placement arrays of
+        the parity scan's result shape (chosen, scores, pulls, skipped,
+        evict) so both tiers share the apply path.
+
+        Placements of one task group are interchangeable here — the
+        eligibility gate rejects every per-row feature (penalties,
+        evictions, forced nodes) — so each TG's rows fill in order from
+        its chunks' valid picks; rows left unfilled after the retry
+        rounds come back as chosen = -1 (recorded as failed placements,
+        never silently dropped).
+        """
+        from .batcher import assert_chunk_gate
+
+        assert_chunk_gate(enc)
+        import jax.numpy as jnp
+
+        from ..utils import phases as _phases
+
+        tg_idx_p = np.asarray(enc.xs[0])[: enc.p]
+        counts: Dict[int, int] = {}
+        for gi in tg_idx_p.tolist():
+            counts[int(gi)] = counts.get(int(gi), 0) + 1
+        counts_by_tg = list(counts.items())
+        chunk = int(max(1, min(chunk_k, enc.n_pad)))
+        steps_tg, want = chunk_schedule(counts_by_tg, chunk,
+                                        retry_rounds=retry_rounds)
+        fn = self._chunk_fn(chunk)
+        static = tuple(jnp.asarray(a) for a in enc.static)
+        carry = tuple(jnp.asarray(a) for a in enc.carry)
+        xs = (jnp.asarray(steps_tg), jnp.asarray(want))
+        with _phases.track("device"):
+            _carry, _deficit, (top_idx, top_scores, valid, _placed) = fn(
+                enc.n_pad, static, carry, xs)
+            top_idx = np.asarray(top_idx)
+        top_scores = np.asarray(top_scores)
+        valid = np.asarray(valid)
+
+        # per-TG FIFO of the picked (node, score) pairs, chunk order
+        picked: Dict[int, list] = {gi: [] for gi, _ in counts_by_tg}
+        for si in range(steps_tg.shape[0]):
+            vs = np.nonzero(valid[si])[0]
+            if vs.size:
+                picked[int(steps_tg[si])].append(
+                    (top_idx[si, vs], top_scores[si, vs]))
+        p = enc.p
+        chosen = np.full(p, -1, np.int32)
+        scores = np.zeros(p, np.float32)
+        heads = {gi: 0 for gi in picked}
+        queues = {
+            gi: (
+                np.concatenate([n for n, _ in lst])
+                if lst else np.empty(0, np.int32),
+                np.concatenate([s for _, s in lst])
+                if lst else np.empty(0, np.float32),
+            )
+            for gi, lst in picked.items()
+        }
+        for pi in range(p):
+            gi = int(tg_idx_p[pi])
+            nodes_q, scores_q = queues[gi]
+            h = heads[gi]
+            if h < nodes_q.shape[0]:
+                chosen[pi] = nodes_q[h]
+                scores[pi] = scores_q[h]
+                heads[gi] = h + 1
+        # every chunk scores the full real node axis — report it, unlike
+        # the ring-limited parity scan's per-placement pull counts
+        pulls = np.full(p, int(enc.n_real), np.int32)
+        skipped = np.zeros(p, bool)
+        evict = np.zeros((p, 0), np.int32)
+        return chosen, scores, pulls, skipped, evict
+
+    def _maybe_sample_parity(self, enc: "EncodedEval", chosen,
+                             rate: float) -> None:
+        """Sampled-parity spot check for the chunked tier: with
+        probability ``rate`` re-run the eval through the bit-parity scan
+        and tally per-TG multiset divergence of the chosen nodes. The
+        chunked tier is NOT bit-identical by design; this bounds the
+        drift and surfaces regressions in every bench/server artifact
+        (parity_sample_stats)."""
+        from ..utils import metrics as _metrics
+
+        if rate <= 0.0 or _PARITY_SAMPLE_RNG.random() >= rate:
+            return
+        try:
+            ref_chosen = np.asarray(self.run_scan_single(enc)[0])[: enc.p]
+        except Exception:  # noqa: BLE001 — a failed spot check never
+            # fails the eval; the chunked plan already applied
+            logger.exception("sampled-parity reference scan failed")
+            return
+        got = np.asarray(chosen)[: enc.p]
+        tg_idx = np.asarray(enc.xs[0])[: enc.p]
+        from collections import Counter
+
+        diverged = 0
+        for gi in np.unique(tg_idx):
+            sel = tg_idx == gi
+            diverged += sum(
+                (Counter(got[sel].tolist())
+                 - Counter(ref_chosen[sel].tolist())).values()
+            )
+        with self._parity_lock:
+            self._parity_samples["evals_sampled"] += 1
+            self._parity_samples["placements_checked"] += int(enc.p)
+            self._parity_samples["placements_diverged"] += int(diverged)
+        _metrics.incr_counter("nomad.tpu_engine.parity_sampled")
+        if diverged:
+            _metrics.incr_counter("nomad.tpu_engine.parity_diverged",
+                                  float(diverged))
+
+    def parity_sample_stats(self) -> Dict[str, float]:
+        """Snapshot of the chunked tier's sampled-parity tally, with the
+        derived divergence rate. Recorded into every bench artifact that
+        exercises the chunked tier."""
+        with self._parity_lock:
+            out = dict(self._parity_samples)
+        checked = out["placements_checked"]
+        out["divergence_rate"] = (
+            out["placements_diverged"] / checked if checked else 0.0
+        )
+        return out
+
+    def reset_parity_samples(self) -> None:
+        with self._parity_lock:
+            for k in self._parity_samples:
+                self._parity_samples[k] = 0
 
     # ------------------------------------------------------------------
 
@@ -1255,12 +1472,33 @@ class TpuPlacementEngine:
         self._pipeline_remember(sched, enc)
         t0 = _metrics.now()
         batcher = getattr(sched.planner, "device_batcher", None)
+        # tpu_binpack_chunked: chunk-eligible evals take the top-K
+        # throughput scan; everything else — preempting, destructive,
+        # int-mode, penalized — falls back to the bit-parity dispatch
+        # below exactly as under tpu_binpack
+        use_chunked = False
+        if getattr(sched, "chunked_tier", False):
+            chunk_reason = self._chunk_eligible(enc)
+            use_chunked = chunk_reason is None
+            if not use_chunked:
+                _metrics.incr_counter("nomad.tpu_engine.chunk_fallback")
+                logger.debug("chunked tier ineligible (%s): %s",
+                             wave_id[:8], chunk_reason)
         with _tlc.pipeline_stage("dispatch", wave_id):
-            if batcher is not None:
+            if use_chunked:
+                chosen, scores, pulls, skipped_steps, evict = self.run_chunked(
+                    enc, chunk_k=int(getattr(sched, "chunk_k", 128)))
+            elif batcher is not None:
                 chosen, scores, pulls, skipped_steps, evict = batcher.run(enc)
             else:
                 chosen, scores, pulls, skipped_steps, evict = self.run_scan_single(enc)
         _metrics.measure_since("nomad.tpu_engine.device_wait", t0)
+        if use_chunked:
+            _metrics.incr_counter("nomad.tpu_engine.chunk_dispatch")
+            self._maybe_sample_parity(
+                enc, chosen,
+                float(getattr(sched, "parity_sample_rate", 0.0)),
+            )
         t0 = _metrics.now()
         with _HOST_WORK_SEM:
             t1 = _metrics.now()
@@ -1747,6 +1985,11 @@ class TpuPlacementEngine:
         if not aff_present.any():
             aff_score = aff_score[:0]
             aff_present = aff_present[:0]
+        # pack feasibility + affinity presence into ONE uint8 plane,
+        # emitted once per eval — cached-encode re-dispatches reuse it
+        from .intscore import pack_feat_planes
+
+        feat_packed = pack_feat_planes(feas, aff_present)
         if (penalty_idx == -1).all():
             penalty_idx = penalty_idx[:, :0]
         if (evict_node == -1).all():
@@ -1791,7 +2034,7 @@ class TpuPlacementEngine:
             pre_tables, n_pad, n_real, node_c2 if int_mode else None)
 
         static = (
-            totals, reserved, asks, feas, aff_score, aff_present,
+            totals, reserved, asks, feat_packed, aff_score,
             desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
             spread_weights, spread_has_targets, spread_active,
             sum_spread_weights, np.int32(n_real), e_ask,
@@ -2008,8 +2251,11 @@ class TpuPlacementEngine:
 
         # SystemStack has no spread/affinity/limit/anti-affinity iterators:
         # encode them inert (zero/absent) so those score terms vanish.
+        # (the packed feature plane's affinity lane stays zero)
+        from .intscore import pack_feat_planes
+
+        feat_packed = pack_feat_planes(feas)
         aff_score = np.zeros((0, n_pad), np.int64 if int_mode else fdtype)
-        aff_present = np.zeros((0, n_pad), bool)
         desired_counts = np.ones(g_count, np.int32)
         dh_job = np.zeros(g_count, bool)
         dh_tg = np.zeros(g_count, bool)
@@ -2062,7 +2308,7 @@ class TpuPlacementEngine:
             pre_tables, n_pad, n_real, node_c2 if int_mode else None)
 
         static = (
-            totals, reserved, asks, feas, aff_score, aff_present,
+            totals, reserved, asks, feat_packed, aff_score,
             desired_counts, dh_job, dh_tg, limits, spread_vids, spread_desired,
             spread_weights, spread_has_targets, spread_active,
             sum_spread_weights, np.int32(n_real), e_ask,
@@ -2649,10 +2895,12 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
 
     feas = np.zeros((g, n_pad), bool)
     feas[:, :n_nodes] = rng.random((g, n_nodes)) < 0.9
+    from .intscore import pack_feat_planes
+
+    feat_packed = pack_feat_planes(feas)
     # no affinities in the synthetic workload: zero G axis (the step
     # compiles the affinity term away — matching production encode)
     aff_score = np.zeros((0, n_pad), dtype)
-    aff_present = np.zeros((0, n_pad), bool)
     desired_counts = np.full(g, max(n_placements // g, 1), np.int32)
     dh_job = np.zeros(g, bool)
     dh_tg = np.zeros(g, bool)
@@ -2695,7 +2943,7 @@ def example_scan_inputs(n_nodes: int = 64, n_tgs: int = 2, n_placements: int = 1
         e_base0 = np.zeros((0, 2), np.int32)
         e_ask = np.zeros((0, 0, 2), np.int32)
 
-    static = (totals, reserved, asks, feas, aff_score, aff_present,
+    static = (totals, reserved, asks, feat_packed, aff_score,
               desired_counts, dh_job, dh_tg, limits, spread_vids,
               spread_desired, spread_weights, spread_has_targets,
               spread_active, sum_spread_weights, np.int32(n_nodes), e_ask,
@@ -2754,6 +3002,13 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
     import jax
     import jax.numpy as jnp
 
+    from .intscore import (
+        FEAT_AFF_BIT,
+        FEAT_FEAS_BIT,
+        pack_presence_lanes,
+        unpack_feat_lane,
+    )
+
     jax.config.update("jax_enable_x64", True)
     _enable_persistent_compile_cache()
     CHUNK = int(chunk_k)
@@ -2765,7 +3020,7 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
         # select; top_k indices are distinct) and ~10x faster on this
         # backend than dynamic-index gathers/scatters in a scan body.
         carry, deficit = carry_and_deficit
-        (totals, reserved, asks, feas, aff_score, aff_present, desired_counts,
+        (totals, reserved, asks, feat_packed, aff_score, desired_counts,
          dh_job, dh_tg, limits, spread_vids, spread_desired, spread_weights,
          spread_has_targets, spread_active, sum_spread_weights, n_real,
          *_extra) = static
@@ -2787,7 +3042,10 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
             return jnp.sum(jnp.where(sel_g.reshape(shape), arr, fill), axis=0)
 
         ask = pick_g(asks)                               # [D]
-        feas_g = pick_g(feas, False)                     # [N]
+        # one packed uint8 plane carries feasibility + affinity presence
+        # (intscore.pack_feat_planes), same layout as the parity step
+        feat_g = pick_g(feat_packed)                     # [N] uint8
+        feas_g = unpack_feat_lane(feat_g, FEAT_FEAS_BIT)
         tg_counts_g = pick_g(tg_counts)                  # [N]
         dh_job_g = jnp.any(sel_g & dh_job)
         dh_tg_g = jnp.any(sel_g & dh_tg)
@@ -2820,7 +3078,7 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
             aff_p = jnp.zeros(n_pad, bool)
         else:
             aff = pick_g(aff_score)
-            aff_p = pick_g(aff_present, False)
+            aff_p = unpack_feat_lane(feat_g, FEAT_AFF_BIT)
 
         vids = pick_g(spread_vids)                       # [S, N]
         s_counts = pick_g(spread_counts)                 # [S, V]
@@ -2847,7 +3105,12 @@ def _build_chunk_scan(chunk_k: int = CHUNK_K):
         spread_total = jnp.sum(per_spread, axis=0)
         spread_p = spread_total != 0.0
 
-        num_terms = 1.0 + anti_present.astype(fdt) + aff_p.astype(fdt) + spread_p.astype(fdt)
+        # popcount num_terms over one packed presence plane (no reschedule
+        # penalties in chunked mode: that lane rides constant-false)
+        presence = pack_presence_lanes(
+            anti_present, jnp.zeros(n_pad, bool), aff_p, spread_p
+        )
+        num_terms = (1 + jax.lax.population_count(presence)).astype(fdt)
         final = (binpack + anti + jnp.where(aff_p, aff, 0.0) + spread_total) / num_terms
 
         neg_inf = -jnp.inf

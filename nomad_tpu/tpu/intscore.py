@@ -237,3 +237,85 @@ def score60_py(terms_sum: int, num_terms: int) -> int:
 def score60_to_float(score60) -> float:
     """Display conversion (metrics only — never used in comparisons)."""
     return float(score60) / (60.0 * TERM_ONE)
+
+
+# ---------------------------------------------------------------------------
+# Packed-mask lanes (the roofline pass-reduction layout).
+#
+# The scan step's per-node boolean planes ride PACKED layouts so the step
+# touches fewer [B, N] arrays per placement:
+#
+#   feature plane  uint8 [G, N], emitted once per eval by encode:
+#                  bit FEAT_FEAS_BIT = class/constraint feasibility,
+#                  bit FEAT_AFF_BIT  = affinity presence. One static plane
+#                  (and one pick_g pass) instead of two.
+#   presence plane uint8 [N], built per step: one bit per optional score
+#                  term; num_terms = 1 + population_count(plane) replaces
+#                  the chain of four astype(int32) adds.
+#   count lanes    int32 [N]: two boolean count planes packed into 16-bit
+#                  fields so ONE ring cumsum serves both. int64 packing
+#                  would fit wider counts, but int64 prefix sums are
+#                  pathologically slow on this backend — int32 lanes are
+#                  free and exact while each lane's total stays below
+#                  2**15 (n_pad < PACK_COUNT_MAX, asserted by callers).
+#
+# These helpers are the ONLY sanctioned way to cross a packed boundary
+# (nomad-lint's dtype-discipline rule flags raw shift/mask unpacking and
+# float promotion of packed planes). They are backend-agnostic: numpy
+# arrays at encode time, jax arrays inside the jit'd step.
+# ---------------------------------------------------------------------------
+
+PACK_LANE_BITS = 16
+PACK_LANE_MASK = (1 << PACK_LANE_BITS) - 1
+# counts packed per lane must stay strictly below this (the high lane's
+# shifted total must fit int32, and the low lane must never carry)
+PACK_COUNT_MAX = 1 << (PACK_LANE_BITS - 1)
+
+FEAT_FEAS_BIT = 0   # class/constraint feasibility
+FEAT_AFF_BIT = 1    # affinity presence
+
+
+def pack_feat_planes(feas, aff_present=None):
+    """Pack the per-TG feasibility plane (and, when the eval carries
+    affinities, the affinity-presence plane) into ONE uint8 [G, N] bit
+    plane. Emitted once per eval at encode time; the cached-encode
+    re-dispatch path reuses the packed plane as-is."""
+    packed = feas.astype("uint8")
+    if aff_present is not None and aff_present.shape[0]:
+        packed = packed | (aff_present.astype("uint8") << FEAT_AFF_BIT)
+    return packed
+
+
+def unpack_feat_lane(packed, bit):
+    """Boolean lane ``bit`` of a packed feature plane."""
+    return ((packed >> bit) & 1).astype(bool)
+
+
+def pack_presence_lanes(m0, m1, m2, m3):
+    """Pack four boolean term-presence planes into one uint8 bit plane;
+    ``1 + population_count(plane)`` is the score's num_terms."""
+    return (
+        m0.astype("uint8")
+        | (m1.astype("uint8") << 1)
+        | (m2.astype("uint8") << 2)
+        | (m3.astype("uint8") << 3)
+    )
+
+
+def pack_count_lanes(lo_mask, hi_mask):
+    """Pack two boolean count planes into one int32 plane: ``lo`` in bits
+    0..15, ``hi`` in bits 16..30. Prefix sums over the packed plane are
+    exact per lane while both totals stay below PACK_COUNT_MAX: neither
+    lane can carry into the other, and every ring-cumsum branch is
+    lane-wise non-negative."""
+    return lo_mask.astype("int32") | (hi_mask.astype("int32") << PACK_LANE_BITS)
+
+
+def unpack_count_lo(packed):
+    """Low 16-bit count lane of a packed (cumsummed) count plane."""
+    return packed & PACK_LANE_MASK
+
+
+def unpack_count_hi(packed):
+    """High count lane of a packed (cumsummed) count plane."""
+    return packed >> PACK_LANE_BITS

@@ -69,6 +69,46 @@ def test_hcl_file_maps_reference_keys(tmp_path):
     assert cfg.telemetry_prefix == "np"
 
 
+def test_chunked_tier_config_keys(tmp_path):
+    f = tmp_path / "agent.hcl"
+    f.write_text(
+        """
+server {
+  enabled = true
+  default_scheduler_config {
+    scheduler_algorithm = "tpu_binpack_chunked"
+    chunk_k             = 256
+    parity_sample_rate  = 0.25
+  }
+}
+"""
+    )
+    cfg = load_agent_config([str(f)])
+    assert cfg.scheduler_algorithm == "tpu_binpack_chunked"
+    assert cfg.chunk_k == 256
+    assert cfg.parity_sample_rate == 0.25
+
+
+def test_chunked_tier_knobs_reach_scheduler_config():
+    # ServerConfig -> leader-seeded SchedulerConfiguration plumbing
+    from nomad_tpu.server.server import Server, ServerConfig
+
+    srv = Server(ServerConfig(
+        scheduler_algorithm="tpu_binpack_chunked",
+        chunk_k=64,
+        parity_sample_rate=0.5,
+        num_schedulers=0,
+    ))
+    try:
+        srv.start()
+        _, sc = srv.fsm.state.scheduler_config()
+        assert sc.scheduler_algorithm == "tpu_binpack_chunked"
+        assert sc.chunk_k == 64
+        assert sc.parity_sample_rate == 0.5
+    finally:
+        srv.stop()
+
+
 def test_json_file_and_directory_merge_order(tmp_path):
     d = tmp_path / "conf.d"
     d.mkdir()

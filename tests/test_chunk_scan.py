@@ -123,7 +123,7 @@ def test_chunk_scan_distinct_hosts():
     static = list(static)
     dh_job = np.zeros(2, bool)
     dh_job[:] = True
-    static[7] = dh_job  # job-level distinct_hosts
+    static[6] = dh_job  # job-level distinct_hosts
     static = tuple(static)
     scan = _build_chunk_scan(8)
     tg_idx, want = chunk_schedule([(0, 10), (1, 10)], chunk=8, retry_rounds=1)
@@ -133,6 +133,146 @@ def test_chunk_scan_distinct_hosts():
     job_counts = np.asarray(carry_out[2])
     assert job_counts.max() <= 1  # never two allocs of the job on one node
     assert int(np.asarray(placed).sum()) == 16  # bound by 16 distinct nodes
+
+
+# ---------------------------------------------------------------------------
+# Chunked production tier (engine.run_chunked + sampled parity)
+# ---------------------------------------------------------------------------
+
+
+def _chunk_enc(n_nodes=64, n_tgs=2, p=40, seed=3, open_feas=True,
+               dtype=np.float32):
+    """A chunk-eligible EncodedEval shaped like a fresh C1M-style eval."""
+    import time
+
+    from nomad_tpu.tpu.engine import EncodedEval, example_scan_inputs
+
+    n_pad, static, carry, xs = example_scan_inputs(
+        n_nodes=n_nodes, n_tgs=n_tgs, n_placements=p, seed=seed
+    )
+    static = list(static)
+    if open_feas:
+        static[3] = np.ones_like(static[3])
+
+    def cast(t):
+        return tuple(
+            np.asarray(a).astype(dtype)
+            if np.asarray(a).dtype.kind == "f" else np.asarray(a)
+            for a in t
+        )
+
+    return EncodedEval(
+        n_real=n_nodes, n_pad=n_pad, g=n_tgs, s=static[9].shape[1],
+        v=static[10].shape[2], p=p, dtype=dtype,
+        static=cast(tuple(static)), carry=cast(carry), xs=xs,
+        missing_list=[None] * p, nodes=[], table=None,
+        start_ns=time.monotonic_ns(), dense_ok=True,
+    )
+
+
+def test_chunk_eligibility_gates():
+    from nomad_tpu.tpu.engine import TpuPlacementEngine
+
+    enc = _chunk_enc()
+    assert TpuPlacementEngine._chunk_eligible(enc) is None
+
+    enc.pre_allocs = {0: []}
+    assert TpuPlacementEngine._chunk_eligible(enc) == "preemption tables"
+    enc.pre_allocs = None
+
+    enc.dense_ok = False
+    assert TpuPlacementEngine._chunk_eligible(enc) == "not dense"
+    enc.dense_ok = True
+
+    enc.dtype = np.int32
+    assert TpuPlacementEngine._chunk_eligible(enc) == "int mode"
+    enc.dtype = np.float32
+
+    xs = list(enc.xs)
+    evict = np.asarray(xs[2]).copy()
+    evict[0] = 5
+    xs[2] = evict
+    enc.xs = tuple(xs)
+    assert TpuPlacementEngine._chunk_eligible(enc) == "eviction axis"
+
+
+def test_batcher_asserts_chunk_gate_on_preempting_eval():
+    from nomad_tpu.tpu.batcher import assert_chunk_gate
+    from nomad_tpu.tpu.engine import TpuPlacementEngine
+
+    enc = _chunk_enc()
+    assert_chunk_gate(enc)  # clean eval passes
+
+    enc.pre_allocs = {0: []}
+    with pytest.raises(AssertionError, match="preempting"):
+        assert_chunk_gate(enc)
+    enc.pre_allocs = None
+    # and the engine refuses to run it through the chunked scan at all
+    enc.pre_allocs = {0: []}
+    engine = TpuPlacementEngine.shared()
+    with pytest.raises(AssertionError):
+        engine.run_chunked(enc)
+
+
+def test_run_chunked_places_all_in_parity_result_shape():
+    from nomad_tpu.tpu.engine import TpuPlacementEngine
+
+    enc = _chunk_enc()
+    engine = TpuPlacementEngine.shared()
+    chosen, scores, pulls, skipped, evict = engine.run_chunked(enc, chunk_k=16)
+    assert chosen.shape == (enc.p,) and (chosen >= 0).all()
+    assert scores.shape == (enc.p,)
+    assert (pulls == enc.n_real).all()
+    assert not skipped.any()
+    assert evict.shape == (enc.p, 0)
+    # per-TG demand exactly met, chosen nodes are real
+    tg_idx = np.asarray(enc.xs[0])[: enc.p]
+    for gi in np.unique(tg_idx):
+        assert (chosen[tg_idx == gi] >= 0).all()
+    assert chosen.max() < enc.n_real
+
+
+def test_sampled_parity_catches_injected_perturbation():
+    from nomad_tpu.tpu import engine as eng_mod
+    from nomad_tpu.tpu.engine import TpuPlacementEngine
+
+    enc = _chunk_enc()
+    engine = TpuPlacementEngine.shared()
+    chosen, *_ = engine.run_chunked(enc, chunk_k=16)
+
+    eng_mod._PARITY_SAMPLE_RNG.seed(0)
+    engine.reset_parity_samples()
+    engine._maybe_sample_parity(enc, chosen, rate=1.0)
+    baseline = engine.parity_sample_stats()
+    assert baseline["evals_sampled"] == 1
+    assert baseline["placements_checked"] == enc.p
+
+    # inject a score-perturbation-style divergence: rebind one placement
+    # to a node the bit-parity scan did not pick for its task group
+    ref = np.asarray(engine.run_scan_single(enc)[0])[: enc.p]
+    tg_idx = np.asarray(enc.xs[0])[: enc.p]
+    ref_nodes = set(ref[tg_idx == tg_idx[0]].tolist())
+    bad = next(n for n in range(enc.n_real) if n not in ref_nodes)
+    perturbed = chosen.copy()
+    perturbed[0] = bad
+
+    eng_mod._PARITY_SAMPLE_RNG.seed(0)
+    engine.reset_parity_samples()
+    engine._maybe_sample_parity(enc, perturbed, rate=1.0)
+    stats = engine.parity_sample_stats()
+    assert stats["placements_diverged"] > baseline["placements_diverged"]
+    assert stats["divergence_rate"] > baseline["divergence_rate"]
+
+
+def test_sampled_parity_rate_zero_records_nothing():
+    from nomad_tpu.tpu.engine import TpuPlacementEngine
+
+    enc = _chunk_enc()
+    engine = TpuPlacementEngine.shared()
+    chosen, *_ = engine.run_chunked(enc, chunk_k=16)
+    engine.reset_parity_samples()
+    engine._maybe_sample_parity(enc, chosen, rate=0.0)
+    assert engine.parity_sample_stats()["evals_sampled"] == 0
 
 
 def test_chunk_scan_spread_prefers_undersubscribed_values():

@@ -39,8 +39,8 @@ def synthetic_enc(n_nodes, n_tgs, n_placements, n_spreads=1, seed=0,
         n_spreads=n_spreads, seed=seed, dtype=dtype,
     )
     return EncodedEval(
-        n_real=n_nodes, n_pad=n_pad, g=n_tgs, s=static[10].shape[1],
-        v=static[11].shape[2], p=n_placements, dtype=dtype,
+        n_real=n_nodes, n_pad=n_pad, g=n_tgs, s=static[9].shape[1],
+        v=static[10].shape[2], p=n_placements, dtype=dtype,
         static=static, carry=carry, xs=xs,
         missing_list=[], nodes=[], table=None, start_ns=0,
     )
@@ -122,16 +122,16 @@ class TestBatchedScanParity:
         )
         d = enc.static[0].shape[1]  # per-job capacity dims (4 + devices)
         assert static[0].shape == (32, d)          # totals
-        assert static[3].shape == (4, 32)          # feas
-        assert static[10].shape == (4, 2, 32)      # spread_vids
-        assert static[11].shape == (4, 2, 8)       # spread_desired
+        assert static[3].shape == (4, 32)          # feat_packed (uint8 lanes)
+        assert static[9].shape == (4, 2, 32)       # spread_vids
+        assert static[10].shape == (4, 2, 8)       # spread_desired
         assert carry[6].shape == (4,)              # failed
         assert carry[6][enc.g:].all()              # padded TGs pre-failed
         assert xs[0].shape == (8,)
         assert (xs[0][enc.p:] == enc.g).all()      # padded steps -> failed TG
         # remapped invalid vocab bucket
-        assert (static[10] <= 7).all()
-        assert (static[10][:, :, enc.n_pad:] == 7).all()
+        assert (static[9] <= 7).all()
+        assert (static[9][:, :, enc.n_pad:] == 7).all()
 
     def test_mixed_capacity_dims_batch(self):
         """A device job (6 capacity dims) co-batched with deviceless jobs
@@ -153,8 +153,8 @@ class TestBatchedScanParity:
         st[0][:, 4] = 2.0  # 2 free devices per node on dim 4
         st[2][:, 4] = 1.0  # each placement takes one
         wide = EncodedEval(
-            n_real=24, n_pad=n_pad, g=2, s=st[10].shape[1],
-            v=st[11].shape[2], p=5, dtype=np.float64,
+            n_real=24, n_pad=n_pad, g=2, s=st[9].shape[1],
+            v=st[10].shape[2], p=5, dtype=np.float64,
             static=tuple(st), carry=ca, xs=xs,
             missing_list=[], nodes=[], table=None, start_ns=0,
         )

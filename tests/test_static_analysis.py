@@ -114,6 +114,60 @@ def test_dtype_flags_float64_allocation_arithmetic():
     assert "float64 operand" in fs[0].message
 
 
+def test_packed_lane_flags_raw_bit_unpack():
+    # hand-rolled unpack of a packed plane in a consumer module (the
+    # scan step) must go through the blessed intscore helpers
+    src = dedent("""
+        import jax.numpy as jnp
+        def step(static):
+            feat_packed = static[3]
+            feas = (feat_packed >> 0) & 1
+            return feas
+    """)
+    fs = run_source(src, "tpu/engine.py")
+    assert [f.rule for f in fs] == ["dtype-discipline"]
+    assert "raw bit unpack" in fs[0].message
+    assert "feat_packed" in fs[0].message
+
+
+def test_packed_lane_accepts_blessed_helpers():
+    # the helpers themselves are the sanctioned bit surgery — both their
+    # definitions and calls through them are clean
+    src = dedent("""
+        import jax.numpy as jnp
+        def unpack_feat_lane(packed, bit):
+            return ((packed >> bit) & 1).astype(bool)
+        def step(static):
+            feat_packed = static[3]
+            return unpack_feat_lane(feat_packed, 0)
+    """)
+    assert run_source(src, "tpu/engine.py") == []
+
+
+def test_packed_lane_flags_float_promotion():
+    src = dedent("""
+        import numpy as np
+        def bad_cast(feat_packed):
+            return feat_packed.astype(np.float32)
+        def bad_arith(count_packed):
+            return count_packed * 0.5
+    """)
+    fs = run_source(src, "tpu/batcher.py")
+    assert [f.rule for f in fs] == ["dtype-discipline"] * 2
+    assert "float promotion" in fs[0].message
+    assert "float promotion" in fs[1].message
+
+
+def test_packed_lane_scoped_to_kernel_modules():
+    # packed-named arrays elsewhere (host code, tests) are not the
+    # kernel's lane layout; no findings outside the packed target list
+    src = dedent("""
+        def f(msg_packed):
+            return (msg_packed >> 8) & 0xFF
+    """)
+    assert run_source(src, "server/worker.py") == []
+
+
 def test_dtype_scoped_to_parity_modules():
     # the same pattern outside encode/intscore is host-path float64 by
     # design and not flagged

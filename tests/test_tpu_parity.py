@@ -812,3 +812,130 @@ def test_parity_epoch_patched_with_spread_affinity(monkeypatch):
     plans = run_pair(nodes, jobs, lambda j: "service")
     assert "nomad.tpu_engine.encode_cache_patch" in calls
     assert_parity(plans)
+
+
+# ---------------------------------------------------------------------------
+# Packed-mask layout (intscore packed lanes): fuzz the lane algebra the
+# fused scan step relies on, and the chunked algorithm's deterministic
+# fallback (bit-identical plans when every eval is chunk-ineligible).
+# ---------------------------------------------------------------------------
+
+
+def test_packed_lane_ring_cumsum_fuzz():
+    """The fused scan's ONE packed ring cumsum must be bit-identical to
+    the two separate int32 ring cumsums it replaced, for any masks and
+    ring offset (totals bounded by n_pad < 2^15 => no inter-lane carry,
+    and both selected ring branches are lane-wise non-negative)."""
+    import numpy as np
+
+    from nomad_tpu.tpu.intscore import (
+        pack_count_lanes,
+        unpack_count_hi,
+        unpack_count_lo,
+    )
+
+    rng = random.Random(77)
+    for trial in range(200):
+        n = rng.choice([4, 16, 64, 256, 1024])
+        low = np.asarray([rng.random() < 0.4 for _ in range(n)])
+        feas = np.asarray([rng.random() < 0.7 for _ in range(n)])
+        offset = rng.randrange(n)
+        iota = np.arange(n, dtype=np.int32)
+
+        def ring_cumsum(a_int):
+            s_nat = np.cumsum(a_int, dtype=np.int32)
+            total = s_nat[-1]
+            before = np.sum(np.where(iota < offset, a_int, 0),
+                            dtype=np.int32)
+            return (
+                np.where(iota >= offset, s_nat - before,
+                         s_nat + (total - before)),
+                total,
+            )
+
+        packed_cum, packed_total = ring_cumsum(pack_count_lanes(low, feas))
+        low_cum, low_total = ring_cumsum(low.astype(np.int32))
+        feas_cum, feas_total = ring_cumsum(feas.astype(np.int32))
+        assert (unpack_count_lo(packed_cum) == low_cum).all()
+        assert (unpack_count_hi(packed_cum) == feas_cum).all()
+        assert unpack_count_lo(packed_total) == low_total
+        assert unpack_count_hi(packed_total) == feas_total
+
+
+def test_packed_feat_plane_roundtrip_fuzz():
+    """pack_feat_planes/pack_presence_lanes round-trip bit-exactly: the
+    unpacked lanes and the popcount num_terms match the unpacked int32
+    arithmetic they fused away."""
+    import numpy as np
+
+    from nomad_tpu.tpu.intscore import (
+        FEAT_AFF_BIT,
+        FEAT_FEAS_BIT,
+        pack_feat_planes,
+        pack_presence_lanes,
+        unpack_feat_lane,
+    )
+
+    rng = random.Random(13)
+    for _ in range(100):
+        g, n = rng.randint(1, 6), rng.choice([8, 64, 512])
+        feas = np.asarray(
+            [[rng.random() < 0.5 for _ in range(n)] for _ in range(g)])
+        aff = np.asarray(
+            [[rng.random() < 0.5 for _ in range(n)] for _ in range(g)])
+        packed = pack_feat_planes(feas, aff)
+        assert packed.dtype == np.uint8
+        assert (unpack_feat_lane(packed, FEAT_FEAS_BIT) == feas).all()
+        assert (unpack_feat_lane(packed, FEAT_AFF_BIT) == aff).all()
+        # zero-G affinity specialization: bit1 lane stays all-zero
+        sparse = pack_feat_planes(feas, np.zeros((0, n), bool))
+        assert (unpack_feat_lane(sparse, FEAT_AFF_BIT) == False).all()  # noqa: E712
+
+        masks = [np.asarray([rng.random() < 0.5 for _ in range(n)])
+                 for _ in range(4)]
+        presence = pack_presence_lanes(*masks)
+        popcounts = np.asarray(
+            [bin(int(v)).count("1") for v in presence.reshape(-1)]
+        ).reshape(presence.shape)
+        expected = sum(m.astype(np.int32) for m in masks)
+        assert (popcounts == expected).all()
+
+
+def test_parity_chunked_algorithm_deterministic_fallback():
+    """tpu_binpack_chunked on the deterministic harness: every eval is
+    chunk-INELIGIBLE (int-mode encode), so the tier must fall back to
+    the bit-parity scan and produce plans identical to the host oracle
+    — the preemption/deficit-carry gate exercised end to end."""
+    nodes = make_nodes(25, seed=21)
+    jobs = []
+    for i in range(3):
+        j = mock.job()
+        j.id = f"chunked-fb-{i}"
+        j.task_groups[0].count = 10
+        jobs.append(j)
+
+    plans = {}
+    for alg in ("binpack", "tpu_binpack_chunked"):
+        h = Harness()
+        h.state.scheduler_set_config(
+            h.next_index(), SchedulerConfiguration(scheduler_algorithm=alg)
+        )
+        for n in nodes:
+            h.state.upsert_node(h.next_index(), copy.deepcopy(n))
+        for job in jobs:
+            h.state.upsert_job(h.next_index(), copy.deepcopy(job))
+        for job in jobs:
+            ev = Evaluation(
+                priority=job.priority,
+                type=job.type,
+                triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                job_id=job.id,
+                namespace=job.namespace,
+            )
+            h.process("service", ev)
+        plans[alg] = (h.plans, h.evals, h.create_evals)
+
+    host_plans, _, _ = plans["binpack"]
+    ch_plans, _, _ = plans["tpu_binpack_chunked"]
+    assert len(host_plans) == len(ch_plans)
+    assert plan_assignments(host_plans) == plan_assignments(ch_plans)
