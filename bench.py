@@ -1019,6 +1019,98 @@ def system_benches():
     return results
 
 
+# ---------------------------------------------------------------------------
+# chaos-churn-5K: sustained churn + injected faults + leader kill, with
+# pass/fail SLO gates (tail latency, throughput floor, state invariants)
+# ---------------------------------------------------------------------------
+
+def bench_chaos_churn(name="chaos-churn-5K", seed=0, duration_s=30.0,
+                      n_nodes=250, settle_timeout_s=90.0):
+    """Replay the default-seed churn trace against a live 3-server
+    cluster: ~5K placements created across overlapping registration/stop
+    waves, destructive rollouts, drains, heartbeat TTL expiries, armed
+    fault windows on every injection point, and a mid-run leader kill.
+    The SLO gate turns the run's nomad-trace gauges, throughput, and
+    post-run invariant sweep into a recorded pass/fail — tail latency
+    under churn, where the BENCH_r* burst configs measure cold-start
+    throughput only."""
+    from nomad_tpu.chaos import ChurnReplay, SLOGate, SLOThresholds
+    from nomad_tpu.chaos.trace import generate_trace, trace_to_jsonable
+    from nomad_tpu.server import ServerConfig
+
+    trace = generate_trace(
+        seed=seed, duration_s=duration_s, n_nodes=n_nodes,
+        n_jobs=60, tg_count=50, stop_frac=0.3, rollout_frac=0.25,
+        n_drains=3, n_expiries=2, n_hipri=2, n_fault_windows=4,
+        leader_kill=True,
+    )
+    log(f"{name}: {len(trace)} trace events over {duration_s:.0f}s, "
+        f"{n_nodes} nodes, seed {seed}")
+    replay = ChurnReplay(
+        seed=seed, trace=trace, n_servers=3, n_nodes=n_nodes,
+        config=ServerConfig(
+            num_schedulers=2,
+            heartbeat_min_ttl=1.5,
+            heartbeat_max_ttl=2.5,
+            eval_gc_interval=3600.0,
+            watchdog_stall_s=10.0,
+        ),
+        settle_timeout_s=settle_timeout_s,
+        # pre-compile the trace's padded eval shapes (tg counts 50 and
+        # the 25-count hipri arrivals) outside the measured window
+        warmup_counts=(50, 25),
+    )
+    t0 = time.monotonic()
+    result = replay.run()
+    wall = time.monotonic() - t0
+
+    # calibrated against the CPU-backend floor of this config (a tunneled
+    # chip's dispatch RTT dominates eval_ms the same way): p99 well under
+    # the broker's nack timeout, no in-flight eval older than the
+    # pipeline ack bound, and a sustained placement floor that a wedged
+    # broker or hot-looping retry path cannot meet
+    gate = SLOGate(SLOThresholds(
+        eval_ms_p99_max=5_000.0,
+        slowest_inflight_ms_max=30_000.0,
+        throughput_min_allocs_per_s=25.0,
+    ))
+    slo = gate.evaluate(result)
+    record = {
+        "config": name,
+        "seed": seed,
+        "wall_s": round(wall, 2),
+        "slo": slo,
+        "result": result,
+        "trace": trace_to_jsonable(trace),
+    }
+    write_artifact(name, record)
+    status = "PASS" if slo["passed"] else "FAIL"
+    log(f"{name}: {status} — {result['total_allocs']} allocs "
+        f"({result['throughput_allocs_per_s']}/s), p99 "
+        f"{result['trace_summary'].get('eval_ms_p99')}ms, "
+        f"{result['events_degraded']} degraded events, "
+        f"{result['leader_kills']} leader kill(s), faults "
+        f"{result['fault_fires']}")
+    for check in slo["checks"]:
+        log(f"  slo[{check['name']}]: observed={check['observed']} "
+            f"bound={check['bound']} passed={check['passed']}")
+    # headline-record summary (the full result lives in the artifact)
+    return {
+        "config": name,
+        "slo_passed": slo["passed"],
+        "total_allocs": result["total_allocs"],
+        "throughput_allocs_per_s": result["throughput_allocs_per_s"],
+        "eval_ms_p99": result["trace_summary"].get("eval_ms_p99"),
+        "slowest_inflight_ms": result["trace_summary"].get(
+            "slowest_inflight_ms"),
+        "invariants": result["invariants"],
+        "fault_fires": result["fault_fires"],
+        "leader_kills": result["leader_kills"],
+        "events_degraded": result["events_degraded"],
+        "wall_s": round(wall, 2),
+    }
+
+
 def _diagnostic(fn, *args, **kwargs):
     """Run one diagnostic bench in isolation: a failure is reported but
     never skips later diagnostics or breaks the headline JSON line. The
@@ -1055,6 +1147,10 @@ def main():
     _diagnostic(bench_parity_scan_single)
     _diagnostic(bench_kernel_roofline)
     sys_results = _diagnostic(system_benches) or []
+    # churn/chaos SLO config rides the diagnostics tier: a chaos
+    # regression (gate FAIL or crash) still yields its own artifact and a
+    # complete headline record
+    chaos_churn = _diagnostic(bench_chaos_churn)
 
     # HEADLINE: end-to-end system C1M replay (jobs -> broker -> workers ->
     # eval-batched engine -> plan queue -> raft/FSM), one chip.
@@ -1121,6 +1217,7 @@ def main():
             "chunked_tier_placements_per_s": round(chunked_rate or 0.0, 1),
             "plan_queue_drain_10k_nodes": drain,
             "system_configs": sys_results,
+            "chaos_churn": chaos_churn,
         },
     }
     write_artifact("headline", record)

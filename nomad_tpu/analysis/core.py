@@ -142,6 +142,7 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def default_checkers() -> list:
     from .dtype_discipline import DtypeDisciplineChecker
+    from .fault_injection_discipline import FaultInjectionDisciplineChecker
     from .fsm_determinism import FsmDeterminismChecker
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
@@ -155,6 +156,7 @@ def default_checkers() -> list:
         FsmDeterminismChecker(),
         TraceSpanDisciplineChecker(),
         PipelineStageDisciplineChecker(),
+        FaultInjectionDisciplineChecker(),
     ]
 
 

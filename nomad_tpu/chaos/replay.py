@@ -1,0 +1,463 @@
+"""Churn trace-replay driver: run a live cluster through a chaos trace.
+
+``ChurnReplay`` boots an in-proc cluster (shared ``InProcRaft``, N
+servers, mock nodes with real heartbeat TTL timers and a background
+heartbeat pump), then plays a :mod:`nomad_tpu.chaos.trace` schedule
+against the current leader in real time: registrations, stops,
+destructive rollouts, high-priority arrivals, drains, heartbeat mutes
+(TTL expiry), fault windows armed on the :mod:`~nomad_tpu.chaos.injector`
+registry, and a mid-run leader kill (``raft.transfer_leadership`` — the
+in-proc equivalent of SIGKILLing the leader: abrupt, mid-write, with
+the broker flushed and the new leader restoring evals and heartbeats).
+
+Every event application has bounded retries with backoff — injected
+faults (``ChaosFault``) and leadership races (``NotLeaderError``) are
+expected weather, not errors. After the last event the driver quiesces:
+disarms everything (in a ``finally``), restores muted/drained nodes,
+and waits for the cluster to converge before running the post-run
+state-store invariant sweep that the SLO gate consumes.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import mock
+from ..server.raft import InProcRaft, NotLeaderError
+from ..server.server import Server, ServerConfig
+from ..trace import lifecycle
+from .injector import ChaosFault, ChaosInjector
+from .trace import ChaosEvent, generate_trace, trace_kind_counts
+
+# bounded per-event retry: flapping faults degrade an event to "late",
+# never to a hot loop or a wedged replay
+_EVENT_RETRIES = 6
+_EVENT_BACKOFF_S = 0.05
+
+
+def invariant_sweep(
+    state,
+    expected: Dict[Tuple[str, str], int],
+    stopped: Set[Tuple[str, str]],
+) -> Dict[str, object]:
+    """Post-run state-store sweep: zero lost / duplicated allocations.
+
+    - *duplicated*: an alloc id present twice, or two desired-run allocs
+      holding the same (job, name) slot — the OCC/redispatch machinery
+      double-placed an index.
+    - *lost*: a live job whose desired-run alloc count is below its
+      task-group count — churn ate a placement and nothing rescheduled it.
+    - *orphaned*: desired-run allocs belonging to a stopped job.
+    """
+    from ..structs.structs import ALLOC_DESIRED_RUN
+
+    violations: List[str] = []
+    allocs = state.allocs()
+
+    id_counts = Counter(a.id for a in allocs)
+    dup_ids = {aid: n for aid, n in id_counts.items() if n > 1}
+    for aid, n in sorted(dup_ids.items()):
+        violations.append(f"alloc id {aid} appears {n} times")
+
+    run_by_job: Dict[Tuple[str, str], List] = {}
+    for a in allocs:
+        if a.desired_status == ALLOC_DESIRED_RUN:
+            run_by_job.setdefault((a.namespace, a.job_id), []).append(a)
+
+    lost = 0
+    dup_slots = 0
+    for key, want in sorted(expected.items()):
+        have = run_by_job.get(key, [])
+        if len(have) < want:
+            lost += want - len(have)
+            violations.append(
+                f"job {key[1]}: {len(have)}/{want} desired-run allocs"
+            )
+        name_counts = Counter(a.name for a in have)
+        for name, n in sorted(name_counts.items()):
+            if n > 1:
+                dup_slots += n - 1
+                violations.append(f"slot {name} held by {n} run allocs")
+
+    orphaned = 0
+    for key in sorted(stopped):
+        n = len(run_by_job.get(key, []))
+        if n:
+            orphaned += n
+            violations.append(f"stopped job {key[1]} still has {n} run allocs")
+
+    return {
+        "lost": lost,
+        "duplicated": len(dup_ids) + dup_slots,
+        "orphaned": orphaned,
+        "converged": not violations,
+        "violations": violations[:20],
+    }
+
+
+class ChurnReplay:
+    """Replay a chaos trace against a fresh in-proc cluster.
+
+    ``run()`` returns the result dict :class:`nomad_tpu.chaos.slo.SLOGate`
+    evaluates: lifecycle trace summary, measured placement throughput,
+    the invariant sweep, per-point fault fire counts, and replay
+    bookkeeping (events applied, degraded events, leader kills).
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        trace: Optional[List[ChaosEvent]] = None,
+        n_servers: int = 3,
+        n_nodes: int = 100,
+        config: Optional[ServerConfig] = None,
+        time_scale: float = 1.0,
+        settle_timeout_s: float = 30.0,
+        trace_kwargs: Optional[dict] = None,
+        warmup_counts: Tuple[int, ...] = (),
+    ) -> None:
+        self.seed = int(seed)
+        kw = dict(trace_kwargs or {})
+        kw.setdefault("n_nodes", n_nodes)
+        self.trace = trace if trace is not None else generate_trace(self.seed, **kw)
+        self.n_servers = n_servers
+        self.n_nodes = n_nodes
+        self.config = config or ServerConfig(
+            heartbeat_min_ttl=1.5,
+            heartbeat_max_ttl=2.5,
+            eval_gc_interval=3600.0,
+        )
+        self.time_scale = float(time_scale)
+        self.settle_timeout_s = float(settle_timeout_s)
+        self.warmup_counts = tuple(warmup_counts)
+
+        self.servers: List[Server] = []
+        self.node_ids: List[str] = []
+        self.injector = ChaosInjector(seed=self.seed)
+
+        self._muted: Set[str] = set()
+        self._mute_lock = threading.Lock()
+        self._pump_stop = threading.Event()
+        self._pump_thread: Optional[threading.Thread] = None
+
+        # convergence bookkeeping fed to the invariant sweep
+        self._expected: Dict[Tuple[str, str], int] = {}
+        self._stopped: Set[Tuple[str, str]] = set()
+        self._drained: Set[str] = set()
+
+        self.events_applied = 0
+        self.events_degraded = 0   # exhausted retries; logged, not fatal
+        self.leader_kills = 0
+        self._boot_allocs = 0
+        self.errors: List[str] = []
+        self.fault_fires: Dict[str, int] = {}
+
+    # -- cluster plumbing ------------------------------------------------
+
+    def _leader(self, timeout: float = 5.0) -> Server:
+        deadline = time.monotonic() + timeout
+        while True:
+            for s in self.servers:
+                if s.is_leader:
+                    return s
+            if time.monotonic() > deadline:
+                raise RuntimeError("no leader within timeout")
+            time.sleep(0.01)
+
+    def _pump_heartbeats(self) -> None:
+        """Background client stand-in: heartbeat every live node well
+        inside its TTL. Muted nodes are skipped (that IS the TTL-expiry
+        fault); injected heartbeat faults surface here as ChaosFault and
+        are simply dropped heartbeats."""
+        interval = max(0.05, self.config.heartbeat_min_ttl / 3.0)
+        while not self._pump_stop.wait(interval):
+            try:
+                leader = self._leader(timeout=1.0)
+            except RuntimeError:
+                continue
+            with self._mute_lock:
+                muted = set(self._muted)
+            for node_id in self.node_ids:
+                if node_id in muted:
+                    continue
+                try:
+                    leader.heartbeat(node_id)
+                except (ChaosFault, NotLeaderError, KeyError):
+                    continue
+                except Exception as e:  # noqa: BLE001 — pump must survive
+                    self.errors.append(f"heartbeat pump: {e!r}")
+
+    def _boot(self) -> None:
+        raft = InProcRaft()
+        for i in range(self.n_servers):
+            self.servers.append(
+                Server(self.config, raft=raft, name=f"chaos-s{i + 1}")
+            )
+        for s in self.servers:
+            s.start()
+        leader = self._leader()
+        for _ in range(self.n_nodes):
+            n = mock.node()
+            self.node_ids.append(n.id)
+            leader.register_node(n)
+        self._warmup(leader)
+        # gauges measure the churn run, not boot/warmup
+        lifecycle.reset()
+        self._pump_thread = threading.Thread(
+            target=self._pump_heartbeats, name="chaos-heartbeat-pump",
+            daemon=True,
+        )
+        self._pump_thread.start()
+
+    def _warmup(self, leader: Server) -> None:
+        """Pre-trace compile warmup: place (then purge) one throwaway job
+        per requested task-group count, so the device engine's padded
+        compile buckets for the trace's eval shapes are built OUTSIDE the
+        measured window (per-process first dispatch costs seconds — the
+        same reason bench_system warms its shapes)."""
+        from ..structs.structs import ALLOC_DESIRED_RUN
+
+        for i, count in enumerate(self.warmup_counts):
+            job = self._make_job(f"chaos-warmup-{i}", count, 100, 64, 50)
+            leader.register_job(job)
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                run = [
+                    a for a in leader.fsm.state.allocs_by_job(
+                        job.namespace, job.id, True)
+                    if a.desired_status == ALLOC_DESIRED_RUN
+                ]
+                if len(run) >= count:
+                    break
+                time.sleep(0.05)
+            leader.deregister_job(job.namespace, job.id, purge=True)
+        if self.warmup_counts:
+            leader.drain_evals(timeout=30.0)
+        # warmup rows stay in the store (GC is off): exclude them from
+        # the run's placement-throughput numerator
+        self._boot_allocs = len(leader.fsm.state.allocs())
+
+    # -- event application -----------------------------------------------
+
+    def _make_job(self, job_id: str, count: int, cpu: int, memory_mb: int,
+                  priority: int):
+        job = mock.job()
+        job.id = job_id
+        job.name = job_id
+        job.priority = priority
+        tg = job.task_groups[0]
+        tg.count = count
+        res = tg.tasks[0].resources
+        res.cpu = cpu
+        res.memory_mb = memory_mb
+        res.networks = []   # churn jobs don't contend on ports
+        return job
+
+    def _apply_event(self, ev: ChaosEvent) -> None:
+        a = ev.args
+        if ev.kind == "register_job" or ev.kind == "hipri_job":
+            prio = a.get("priority", 80 if ev.kind == "hipri_job" else 50)
+            job = self._make_job(a["job_id"], a["count"], a["cpu"],
+                                 a["memory_mb"], prio)
+            self._leader().register_job(job)
+            self._expected[(job.namespace, job.id)] = a["count"]
+            self._stopped.discard((job.namespace, job.id))
+        elif ev.kind == "stop_job":
+            leader = self._leader()
+            key = None
+            for k in self._expected:
+                if k[1] == a["job_id"]:
+                    key = k
+                    break
+            if key is None:
+                return   # registration degraded earlier; nothing to stop
+            leader.deregister_job(key[0], key[1], purge=False)
+            self._expected.pop(key, None)
+            self._stopped.add(key)
+        elif ev.kind == "rollout":
+            leader = self._leader()
+            for (ns, jid), _count in list(self._expected.items()):
+                if jid != a["job_id"]:
+                    continue
+                stored = leader.fsm.state.job_by_id(ns, jid)
+                if stored is None:
+                    return
+                job = copy.deepcopy(stored)
+                job.task_groups[0].tasks[0].resources.cpu = a["cpu"]
+                leader.register_job(job)
+                return
+        elif ev.kind == "drain_node":
+            node_id = self.node_ids[a["node_idx"] % len(self.node_ids)]
+            self._leader().update_node_drain(node_id, True)
+            self._drained.add(node_id)
+        elif ev.kind == "undrain_node":
+            node_id = self.node_ids[a["node_idx"] % len(self.node_ids)]
+            self._leader().update_node_drain(node_id, None)
+            self._drained.discard(node_id)
+        elif ev.kind == "mute_node":
+            node_id = self.node_ids[a["node_idx"] % len(self.node_ids)]
+            with self._mute_lock:
+                self._muted.add(node_id)
+        elif ev.kind == "unmute_node":
+            node_id = self.node_ids[a["node_idx"] % len(self.node_ids)]
+            with self._mute_lock:
+                self._muted.discard(node_id)
+        elif ev.kind == "arm_fault":
+            self.injector.arm(
+                a["point"], mode=a.get("mode", "fail"),
+                prob=a.get("prob", 1.0), delay_s=a.get("delay_s", 0.0),
+                max_fires=a.get("max_fires"),
+            )
+        elif ev.kind == "disarm_fault":
+            point = a["point"]
+            self.fault_fires[point] = (
+                self.fault_fires.get(point, 0) + self.injector.fires(point)
+            )
+            self.injector.disarm(point)
+        elif ev.kind == "leader_kill":
+            leader = self._leader()
+            raft = leader.raft
+            peers = [s.peer for s in self.servers if s is not leader]
+            if peers:
+                raft.transfer_leadership(peers[0])
+                self.leader_kills += 1
+        else:
+            raise ValueError(f"unknown trace event kind {ev.kind!r}")
+
+    def _apply_with_retries(self, ev: ChaosEvent) -> None:
+        delay = _EVENT_BACKOFF_S
+        for attempt in range(_EVENT_RETRIES):
+            try:
+                self._apply_event(ev)
+                self.events_applied += 1
+                return
+            except (ChaosFault, NotLeaderError, RuntimeError, KeyError) as e:
+                if attempt == _EVENT_RETRIES - 1:
+                    self.events_degraded += 1
+                    self.errors.append(f"{ev.kind}@{ev.t:.2f}: {e!r}")
+                    return
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    # -- quiesce + measurement --------------------------------------------
+
+    def _live_jobs_converged(self, state) -> bool:
+        from ..structs.structs import ALLOC_DESIRED_RUN
+
+        for (ns, jid), want in self._expected.items():
+            run = [
+                x for x in state.allocs_by_job(ns, jid, True)
+                if x.desired_status == ALLOC_DESIRED_RUN
+            ]
+            if len(run) != want or len({x.name for x in run}) != want:
+                return False
+        return True
+
+    def _settle(self) -> bool:
+        """Restore every disturbance, then wait for convergence."""
+        with self._mute_lock:
+            self._muted.clear()
+        for node_id in list(self._drained):
+            try:
+                self._leader().update_node_drain(node_id, None)
+            except Exception as e:  # noqa: BLE001
+                self.errors.append(f"undrain {node_id}: {e!r}")
+        self._drained.clear()
+
+        deadline = time.monotonic() + self.settle_timeout_s
+        nudge_at = time.monotonic() + self.settle_timeout_s / 2.0
+        nudged = False
+        while time.monotonic() < deadline:
+            leader = self._leader()
+            stats = leader.eval_broker.stats()
+            broker_idle = (
+                stats["total_ready"] == 0
+                and stats["total_unacked"] == 0
+                and stats["total_waiting"] == 0
+            )
+            if broker_idle and self._live_jobs_converged(leader.fsm.state):
+                return True
+            # drain/migrate health gating has no real clients here: one
+            # re-evaluation nudge per straggler halfway through the window
+            if not nudged and time.monotonic() >= nudge_at:
+                nudged = True
+                for (ns, jid) in list(self._expected):
+                    try:
+                        leader.evaluate_job(ns, jid)
+                    except Exception:  # noqa: BLE001 — stopped mid-nudge
+                        pass
+            time.sleep(0.05)
+        return False
+
+    def run(self) -> Dict[str, object]:
+        t0 = time.monotonic()
+        t_run = t0
+        try:
+            self._boot()
+            t_run = time.monotonic()
+            start = t_run
+            for ev in self.trace:
+                target = start + ev.t * self.time_scale
+                sleep_for = target - time.monotonic()
+                if sleep_for > 0:
+                    time.sleep(sleep_for)
+                self._apply_with_retries(ev)
+            # roll any still-armed fire counts into the tally before the
+            # finally-disarm wipes them
+            for point, st in self.injector.stats().items():
+                self.fault_fires[point] = (
+                    self.fault_fires.get(point, 0) + st["fires"]
+                )
+            settled = self._settle()
+        finally:
+            self.injector.disarm_all()
+            self._pump_stop.set()
+            if self._pump_thread is not None:
+                self._pump_thread.join(timeout=2.0)
+            for s in self.servers:
+                s.stop()
+
+        duration = time.monotonic() - t0
+        # throughput over the churn window itself (boot + compile warmup
+        # excluded — they are setup, not the workload under measurement)
+        run_duration = time.monotonic() - t_run
+        leader_state = self._leader().fsm.state
+        inv = invariant_sweep(leader_state, self._expected, self._stopped)
+        if not settled:
+            inv["converged"] = False
+            inv["violations"] = (["settle timeout"] + inv["violations"])[:20]
+
+        # replica consistency: every FSM saw the same applied log
+        counts = {
+            s.name: s.fsm.state.count_allocs_desired_run()
+            for s in self.servers
+        }
+        if len(set(counts.values())) > 1:
+            inv["converged"] = False
+            inv["violations"].append(f"replica divergence: {counts}")
+
+        # allocs() retains stopped/superseded rows until GC (disabled for
+        # the run), so its length approximates placements ever created;
+        # boot-time warmup rows are excluded
+        total_allocs = max(0, len(leader_state.allocs()) - self._boot_allocs)
+        return {
+            "seed": self.seed,
+            "duration_s": round(duration, 3),
+            "trace_events": len(self.trace),
+            "trace_kinds": trace_kind_counts(self.trace),
+            "events_applied": self.events_applied,
+            "events_degraded": self.events_degraded,
+            "leader_kills": self.leader_kills,
+            "fault_fires": dict(sorted(self.fault_fires.items())),
+            "total_allocs": total_allocs,
+            "desired_run_allocs": leader_state.count_allocs_desired_run(),
+            "replica_run_counts": counts,
+            "throughput_allocs_per_s": round(total_allocs / run_duration, 2)
+            if run_duration > 0 else None,
+            "trace_summary": lifecycle.summary(),
+            "invariants": inv,
+            "errors": self.errors[:20],
+        }

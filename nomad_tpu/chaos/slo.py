@@ -1,0 +1,107 @@
+"""SLO gate: pass/fail evaluation of a chaos-replay run.
+
+The gate reads the same surfaces production observability exposes — the
+nomad-trace lifecycle summary (``nomad.trace.eval_ms.p99``,
+``slowest_inflight_ms``), the replay driver's measured placement
+throughput, and the post-run state-store invariant sweep — and reduces
+them to a list of named checks plus a single ``passed`` bit. A chaos
+run without a gate is an anecdote; with one it is a regression test.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass
+class SLOThresholds:
+    """Bounds a chaos run must stay inside to pass.
+
+    ``None`` disables a check (it reports as skipped, not passed —
+    the artifact still shows the observed value).
+    """
+    eval_ms_p99_max: Optional[float] = 2000.0
+    slowest_inflight_ms_max: Optional[float] = 10_000.0
+    throughput_min_allocs_per_s: Optional[float] = 10.0
+    require_zero_lost: bool = True
+    require_zero_duplicated: bool = True
+    require_converged: bool = True
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "eval_ms_p99_max": self.eval_ms_p99_max,
+            "slowest_inflight_ms_max": self.slowest_inflight_ms_max,
+            "throughput_min_allocs_per_s": self.throughput_min_allocs_per_s,
+            "require_zero_lost": self.require_zero_lost,
+            "require_zero_duplicated": self.require_zero_duplicated,
+            "require_converged": self.require_converged,
+        }
+
+
+class SLOGate:
+    """Evaluate a replay result dict against thresholds.
+
+    Expects the shape ``ChurnReplay.run`` produces:
+
+    - ``trace_summary``: lifecycle ``summary()`` dict (``eval_ms_p99``,
+      ``slowest_inflight_ms``, ...)
+    - ``throughput_allocs_per_s``: allocs ever created / replay seconds
+    - ``invariants``: the sweep dict (``lost``, ``duplicated``,
+      ``orphaned``, ``converged``, ``violations`` list)
+    """
+
+    def __init__(self, thresholds: Optional[SLOThresholds] = None) -> None:
+        self.thresholds = thresholds or SLOThresholds()
+
+    def evaluate(self, result: Dict[str, object]) -> Dict[str, object]:
+        th = self.thresholds
+        summary = result.get("trace_summary") or {}
+        inv = result.get("invariants") or {}
+        checks: List[Dict[str, object]] = []
+
+        def check(name: str, observed, bound, ok: Optional[bool]) -> None:
+            checks.append({
+                "name": name,
+                "observed": observed,
+                "bound": bound,
+                "passed": ok,      # None == skipped (no bound configured)
+            })
+
+        p99 = summary.get("eval_ms_p99")
+        if th.eval_ms_p99_max is None:
+            check("eval_ms_p99", p99, None, None)
+        else:
+            check("eval_ms_p99", p99, th.eval_ms_p99_max,
+                  p99 is not None and p99 <= th.eval_ms_p99_max)
+
+        slowest = summary.get("slowest_inflight_ms")
+        if th.slowest_inflight_ms_max is None:
+            check("slowest_inflight_ms", slowest, None, None)
+        else:
+            # no in-flight work at read time reads as 0/None: that passes
+            check("slowest_inflight_ms", slowest, th.slowest_inflight_ms_max,
+                  slowest is None or slowest <= th.slowest_inflight_ms_max)
+
+        tput = result.get("throughput_allocs_per_s")
+        if th.throughput_min_allocs_per_s is None:
+            check("placement_throughput", tput, None, None)
+        else:
+            check("placement_throughput", tput, th.throughput_min_allocs_per_s,
+                  tput is not None and tput >= th.throughput_min_allocs_per_s)
+
+        if th.require_zero_lost:
+            lost = inv.get("lost")
+            check("zero_lost_allocations", lost, 0, lost == 0)
+        if th.require_zero_duplicated:
+            dup = inv.get("duplicated")
+            check("zero_duplicated_allocations", dup, 0, dup == 0)
+        if th.require_converged:
+            conv = inv.get("converged")
+            check("converged", conv, True, bool(conv))
+
+        passed = all(c["passed"] is not False for c in checks)
+        return {
+            "passed": passed,
+            "checks": checks,
+            "thresholds": th.to_dict(),
+        }

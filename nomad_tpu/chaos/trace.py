@@ -1,0 +1,197 @@
+"""Deterministic churn/chaos traces: timestamped events, reproducible by seed.
+
+A trace is a sorted list of ``ChaosEvent`` records — the full schedule of
+everything the replay driver will do to the cluster: job registrations
+and stops, destructive rollouts, high-priority arrivals, node drains and
+restores, heartbeat mutes (TTL expiries), fault-window arms/disarms, and
+at most one mid-run leader kill. ``generate_trace(seed)`` is a pure
+function of its arguments (``random.Random(seed)`` only, no wall clock),
+so the same seed always yields the same event trace — the property
+``tests/test_chaos.py::test_trace_deterministic_by_seed`` pins.
+
+Shape invariants the generator maintains (so a replay can settle):
+
+- every ``drain_node`` has a matching ``undrain_node`` before the
+  recovery tail;
+- every ``mute_node`` (heartbeat expiry) has a matching ``unmute_node``;
+- every ``arm_fault`` has a matching ``disarm_fault``;
+- all disruption ends by ``recovery_frac * duration_s`` — the tail is
+  clean air for the cluster to converge in before the SLO gate reads it.
+
+Event kinds and their args:
+
+====================  =====================================================
+``register_job``      job_id, count, cpu, memory_mb, priority
+``stop_job``          job_id (deregister, purge=False)
+``rollout``           job_id, cpu (destructive update: resource bump
+                      replaces every alloc)
+``hipri_job``         job_id, count, cpu, memory_mb (priority-80 arrival)
+``drain_node``        node_idx
+``undrain_node``      node_idx
+``mute_node``         node_idx (stop heartbeating it: TTL expires, node
+                      marked down, allocs lost + rescheduled)
+``unmute_node``       node_idx (resume heartbeats: node returns READY)
+``arm_fault``         point, mode, prob, delay_s, max_fires
+``disarm_fault``      point
+``leader_kill``       (none) — abrupt leadership transfer away from the
+                      current leader, mid-run
+====================  =====================================================
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from random import Random
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    t: float           # seconds from replay start
+    kind: str
+    args: dict = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"t": round(self.t, 4), "kind": self.kind, "args": dict(self.args)}
+
+
+def trace_to_jsonable(trace: List[ChaosEvent]) -> List[Dict[str, object]]:
+    return [ev.to_dict() for ev in trace]
+
+
+def trace_kind_counts(trace: List[ChaosEvent]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for ev in trace:
+        out[ev.kind] = out.get(ev.kind, 0) + 1
+    return dict(sorted(out.items()))
+
+
+# fault windows the generator draws from: (point, mode, prob, delay_s)
+_FAULT_MENU = (
+    ("device_dispatch", "fail", 0.5, 0.0),
+    ("device_dispatch", "delay", 0.5, 0.05),
+    ("plan_apply", "fail", 0.3, 0.0),
+    ("broker_ack", "fail", 0.25, 0.0),
+    ("raft_apply", "fail", 0.05, 0.0),
+    ("heartbeat", "fail", 0.5, 0.0),
+)
+
+
+def generate_trace(
+    seed: int = 0,
+    duration_s: float = 30.0,
+    n_nodes: int = 100,
+    n_jobs: int = 20,
+    tg_count: int = 8,
+    stop_frac: float = 0.25,
+    rollout_frac: float = 0.2,
+    n_drains: int = 2,
+    n_expiries: int = 2,
+    n_hipri: int = 1,
+    n_fault_windows: int = 3,
+    leader_kill: bool = True,
+    recovery_frac: float = 0.8,
+    cpu: int = 200,
+    memory_mb: int = 128,
+) -> List[ChaosEvent]:
+    """Build a seeded churn schedule over ``duration_s`` trace-seconds.
+
+    Phases: an initial registration wave over the first 20% of the
+    window, overlapping churn (stops+replacements, rollouts, drains,
+    TTL expiries, high-priority arrivals, fault windows, the leader
+    kill) through ``recovery_frac``, then a clean recovery tail.
+    """
+    rng = Random(seed)
+    events: List[ChaosEvent] = []
+    recover_by = duration_s * recovery_frac
+
+    def jitter(lo: float, hi: float) -> float:
+        return lo + rng.random() * (hi - lo)
+
+    # -- initial wave: the steady-state fleet --------------------------
+    job_ids: List[str] = []
+    for i in range(n_jobs):
+        jid = f"churn-{i}"
+        job_ids.append(jid)
+        events.append(ChaosEvent(
+            jitter(0.0, duration_s * 0.2), "register_job",
+            {"job_id": jid, "count": tg_count, "cpu": cpu,
+             "memory_mb": memory_mb, "priority": 50},
+        ))
+
+    churn_lo, churn_hi = duration_s * 0.2, recover_by
+
+    # -- stop + replacement churn --------------------------------------
+    n_stops = int(n_jobs * stop_frac)
+    stopped = rng.sample(job_ids, n_stops) if n_stops else []
+    for si, jid in enumerate(stopped):
+        t = jitter(churn_lo, churn_hi * 0.9)
+        events.append(ChaosEvent(t, "stop_job", {"job_id": jid}))
+        # replacement keeps fleet load roughly level
+        events.append(ChaosEvent(
+            min(t + jitter(0.3, 1.5), recover_by), "register_job",
+            {"job_id": f"churn-r{si}", "count": tg_count, "cpu": cpu,
+             "memory_mb": memory_mb, "priority": 50},
+        ))
+
+    # -- destructive rollouts ------------------------------------------
+    rollable = [j for j in job_ids if j not in stopped]
+    for jid in rng.sample(rollable, min(len(rollable), int(n_jobs * rollout_frac))):
+        events.append(ChaosEvent(
+            jitter(churn_lo, churn_hi), "rollout",
+            {"job_id": jid, "cpu": cpu + 50},
+        ))
+
+    # -- high-priority arrivals ----------------------------------------
+    for i in range(n_hipri):
+        events.append(ChaosEvent(
+            jitter(churn_lo, churn_hi), "hipri_job",
+            {"job_id": f"hipri-{i}", "count": max(2, tg_count // 2),
+             "cpu": cpu * 2, "memory_mb": memory_mb * 2},
+        ))
+
+    # -- node drains (paired restore) ----------------------------------
+    drain_pool = list(range(n_nodes))
+    rng.shuffle(drain_pool)
+    for i in range(min(n_drains, len(drain_pool))):
+        idx = drain_pool[i]
+        t = jitter(churn_lo, churn_hi * 0.85)
+        events.append(ChaosEvent(t, "drain_node", {"node_idx": idx}))
+        events.append(ChaosEvent(
+            min(t + jitter(0.5, 2.0), recover_by),
+            "undrain_node", {"node_idx": idx},
+        ))
+
+    # -- heartbeat TTL expiries (paired resume) ------------------------
+    for i in range(min(n_expiries, max(0, len(drain_pool) - n_drains))):
+        idx = drain_pool[n_drains + i]
+        t = jitter(churn_lo, churn_hi * 0.8)
+        events.append(ChaosEvent(t, "mute_node", {"node_idx": idx}))
+        events.append(ChaosEvent(
+            min(t + jitter(1.0, 3.0), recover_by),
+            "unmute_node", {"node_idx": idx},
+        ))
+
+    # -- fault windows (paired disarm) ---------------------------------
+    menu = list(_FAULT_MENU)
+    for i in range(n_fault_windows):
+        point, mode, prob, delay_s = menu[i % len(menu)] if i < len(menu) \
+            else rng.choice(menu)
+        t = jitter(churn_lo, churn_hi * 0.8)
+        events.append(ChaosEvent(t, "arm_fault", {
+            "point": point, "mode": mode, "prob": prob,
+            "delay_s": delay_s, "max_fires": None,
+        }))
+        events.append(ChaosEvent(
+            min(t + jitter(1.0, 3.0), recover_by),
+            "disarm_fault", {"point": point},
+        ))
+
+    # -- the leader kill -----------------------------------------------
+    if leader_kill:
+        events.append(ChaosEvent(
+            jitter(duration_s * 0.4, duration_s * 0.6), "leader_kill", {},
+        ))
+
+    # stable order: time, then kind/args for deterministic ties
+    events.sort(key=lambda ev: (ev.t, ev.kind, sorted(ev.args.items())))
+    return events

@@ -42,7 +42,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..server.eval_broker import NotOutstandingError, TokenMismatchError
 from ..structs.structs import Plan, PlanResult
@@ -56,13 +56,15 @@ logger = logging.getLogger("nomad_tpu.pipeline.applier")
 class _Wave:
     """One eval's dense plan in flight between submit and ack."""
 
-    __slots__ = ("plan", "token", "attempts", "deadline", "done")
+    __slots__ = ("plan", "token", "attempts", "deadline", "not_before",
+                 "done")
 
     def __init__(self, plan: Plan, token: str, deadline: float) -> None:
         self.plan = plan
         self.token = token
         self.attempts = 0
         self.deadline = deadline
+        self.not_before = 0.0   # redispatch backoff gate (monotonic)
         self.done = False
 
 
@@ -79,11 +81,16 @@ class AsyncApplier:
 
     def __init__(self, server, inflight_max: int = 128,
                  redispatch_max: int = 2,
-                 ack_timeout_s: float = 30.0) -> None:
+                 ack_timeout_s: float = 30.0,
+                 redispatch_backoff_s: float = 0.05,
+                 redispatch_backoff_max_s: float = 1.0) -> None:
         self.server = server
         self.inflight_max = max(1, int(inflight_max))
         self.redispatch_max = max(0, int(redispatch_max))
         self.ack_timeout_s = float(ack_timeout_s)
+        self.redispatch_backoff_s = max(0.0, float(redispatch_backoff_s))
+        self.redispatch_backoff_max_s = max(
+            self.redispatch_backoff_s, float(redispatch_backoff_max_s))
 
         self.registry = WaveEncodeRegistry()
         self.redispatcher = Redispatcher(server, self.registry)
@@ -96,6 +103,8 @@ class AsyncApplier:
             self.inflight_max + 1, name="wave-completions")
         self._lock = threading.Lock()
         self._waves: Dict[str, _Wave] = {}
+        # waves parked between redispatches (backoff); drained by _sweep
+        self._deferred: List[_Wave] = []
         self._enabled = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -118,13 +127,14 @@ class AsyncApplier:
                     return
                 self._enabled = False
                 waves = list(self._waves.values())
-                self._waves.clear()
+                self._deferred.clear()
             self._stop.set()
             # leadership is gone: the broker flush already closed the
-            # unacks; just release the slots and drop the bookkeeping
+            # unacks; just release the slots and drop the bookkeeping.
+            # _mark_done arbitrates with a racing _finish so each slot
+            # is released exactly once.
             for rec in waves:
-                if not rec.done:
-                    rec.done = True
+                if self._mark_done(rec):
                     self._slots.release()
             self.registry.clear()
             t = self._thread
@@ -170,10 +180,8 @@ class AsyncApplier:
                 return False
             self._waves[plan.eval_id] = rec
         if not self._enqueue(rec):
-            with self._lock:
-                self._waves.pop(plan.eval_id, None)
-            rec.done = True
-            self._slots.release()
+            if self._mark_done(rec):
+                self._slots.release()
             return False
         metrics.incr_counter("nomad.pipeline.submitted")
         return True
@@ -239,7 +247,23 @@ class AsyncApplier:
             return
         rec.plan = retry
         rec.attempts += 1
-        rec.deadline = time.monotonic() + self.ack_timeout_s
+        # exponential backoff between redispatches: a flapping apply path
+        # (OCC livelock, injected faults) degrades to spaced retries
+        # instead of hot-looping device dispatches. The ack-timeout clock
+        # restarts AFTER the backoff so the watchdog bound stays
+        # per-attempt, not per-wave.
+        delay = min(self.redispatch_backoff_s * (2 ** (rec.attempts - 1)),
+                    self.redispatch_backoff_max_s)
+        now = time.monotonic()
+        rec.deadline = now + delay + self.ack_timeout_s
+        if delay > 0:
+            rec.not_before = now + delay
+            metrics.incr_counter("nomad.pipeline.redispatch_deferred")
+            with self._lock:
+                if not self._enabled or rec.done:
+                    return
+                self._deferred.append(rec)
+            return
         if not self._enqueue(rec):
             self._finish(rec, ack=False, why="queue_disabled")
 
@@ -256,12 +280,21 @@ class AsyncApplier:
                 pass           # re-wait via shared_snapshot_min_index
         self._finish(rec, ack=True)
 
-    def _finish(self, rec: _Wave, ack: bool, why: str = "") -> None:
+    def _mark_done(self, rec: _Wave) -> bool:
+        """Exactly-once done transition, arbitrated under the lock. The
+        caller that wins owns the wave's slot release / broker token —
+        every other path (watchdog, shutdown, completion) loses the race
+        cleanly instead of double-releasing."""
         with self._lock:
             if rec.done:
-                return
+                return False
             rec.done = True
             self._waves.pop(rec.plan.eval_id, None)
+            return True
+
+    def _finish(self, rec: _Wave, ack: bool, why: str = "") -> None:
+        if not self._mark_done(rec):
+            return
         self.registry.forget(rec.plan.eval_id)
         broker = self.server.eval_broker
         try:
@@ -282,9 +315,20 @@ class AsyncApplier:
             self._slots.release()
 
     def _sweep(self) -> None:
-        """Watchdog: no accepted wave may sit unacked past its deadline —
-        force-nack it back to the broker's classic retry path."""
+        """Watchdog + backoff pump: re-enqueue deferred redispatches whose
+        backoff has elapsed, then force-nack any accepted wave sitting
+        unacked past its deadline back to the broker's classic retry
+        path. Runs at least every 0.25s (the completion-get timeout), so
+        that is the effective backoff granularity."""
         now = time.monotonic()
+        with self._lock:
+            due = [r for r in self._deferred
+                   if not r.done and r.not_before <= now]
+            self._deferred = [r for r in self._deferred
+                              if not r.done and r.not_before > now]
+        for rec in due:
+            if not self._enqueue(rec):
+                self._finish(rec, ack=False, why="queue_disabled")
         with self._lock:
             overdue = [r for r in self._waves.values()
                        if not r.done and now > r.deadline]
@@ -299,8 +343,10 @@ class AsyncApplier:
     def stats(self) -> Dict[str, int]:
         with self._lock:
             inflight = len(self._waves)
+            deferred = len(self._deferred)
         out = {
             "inflight": inflight,
+            "deferred": deferred,
             "completion_depth": self._completions.depth(),
             "encode_registry": len(self.registry),
             "slots_free": self.inflight_max - inflight,

@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..chaos.injector import fire as chaos_fire
 from ..structs.structs import Evaluation, generate_uuid
 from ..trace import lifecycle as _trace
 
@@ -246,6 +247,10 @@ class EvalBroker:
             return unack.token if unack else None
 
     def ack(self, eval_id: str, token: str) -> None:
+        # chaos hook: a fault here is a LOST ack — the delivery stays
+        # unacked and the nack timer redelivers it (every caller survives
+        # an ack exception; the applier releases its slot in a finally)
+        chaos_fire("broker_ack", eval_id=eval_id)
         with self._lock:
             unack = self.unack.get(eval_id)
             if unack is None:

@@ -11,6 +11,7 @@ import random
 import threading
 from typing import Dict
 
+from ..chaos.injector import fire as chaos_fire
 from ..structs.structs import (
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_NODE_UPDATE,
@@ -40,6 +41,10 @@ class HeartbeatTimers:
 
     def reset_heartbeat_timer(self, node_id: str) -> float:
         """(Re)arm a node's TTL; returns the TTL handed back to the client."""
+        # chaos hook: a fault here is a DROPPED heartbeat — the node's
+        # TTL timer keeps its old deadline; enough drops in a row and it
+        # expires, marking the node down (the real failure this models)
+        chaos_fire("heartbeat", node_id=node_id)
         ttl = self.min_ttl + random.random() * (self.max_ttl - self.min_ttl)
         with self._lock:
             if not self.enabled:

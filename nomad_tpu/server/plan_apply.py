@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..chaos.injector import fire as chaos_fire
 from ..structs.funcs import remove_allocs
 from ..structs.network import NetworkIndex
 from ..trace import lifecycle as _lifecycle
@@ -288,6 +289,10 @@ class Planner:
         (the vectorized analog of plan_apply_pool.go's goroutine fan-out);
         only nodes that pass capacity run the discrete port-collision and
         device checks host-side."""
+        # chaos hook: a fault here is THIS plan's failure only — the
+        # batched waiter's per-payload isolation resolves this plan's
+        # future with the error while its batch-mates commit normally
+        chaos_fire("plan_apply", eval_id=getattr(plan, "eval_id", None))
         result = PlanResult(
             node_update=plan.node_update,
             node_allocation={},

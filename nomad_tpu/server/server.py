@@ -32,6 +32,7 @@ from ..structs.structs import (
     SchedulerConfiguration,
     generate_uuid,
 )
+from ..chaos.injector import fire as chaos_fire
 from .blocked_evals import BlockedEvals
 from .eval_broker import EvalBroker
 from .fsm import (
@@ -148,6 +149,11 @@ class ServerConfig:
     # watchdog bound: an accepted wave unacked this long after its last
     # (re)enqueue is force-nacked — no eval strands in the pipeline
     pipeline_ack_timeout_s: float = 30.0
+    # backoff between a wave's partial-commit redispatches (exponential
+    # from this base, capped at the max): a flapping apply path degrades
+    # to spaced retries instead of hot-looping device dispatches
+    pipeline_redispatch_backoff_s: float = 0.05
+    pipeline_redispatch_backoff_max_s: float = 1.0
     # federation (reference leader.go:997/:1138): non-authoritative
     # regions' leaders mirror ACL policies and GLOBAL tokens from the
     # authoritative region. Empty authoritative_region (or equal to our
@@ -255,6 +261,8 @@ class Server:
                 inflight_max=self.config.pipeline_inflight,
                 redispatch_max=self.config.pipeline_redispatch_max,
                 ack_timeout_s=self.config.pipeline_ack_timeout_s,
+                redispatch_backoff_s=self.config.pipeline_redispatch_backoff_s,
+                redispatch_backoff_max_s=self.config.pipeline_redispatch_backoff_max_s,
             )
 
         # Cross-region RPC hook (set by the agent): callable
@@ -303,6 +311,7 @@ class Server:
         # otherwise show up as unexplained worker_busy time
         from ..utils import phases
 
+        chaos_fire("raft_apply", entry_type=entry_type)
         with phases.track("raft_fsm"):
             return self.raft.apply(self.peer, entry_type, payload)
 

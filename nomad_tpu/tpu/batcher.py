@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..chaos.injector import fire as chaos_fire
 from .engine import EncodedEval, _build_batched_scan, _round_up
 from .intscore import E27_ONE as _E27_NEUTRAL
 
@@ -382,6 +383,10 @@ class DeviceBatcher:
         dispatcher is alive, so a request that slipped into the queue
         after stop() drained it is picked up by the restarted thread
         rather than parking its worker forever."""
+        # chaos hook: a fault here is a failed/slow device round trip for
+        # THIS eval — the engine's dispatch guard reroutes it to the host
+        # iterator path (parity-identical placements, reference latency)
+        chaos_fire("device_dispatch", evals=enc.p)
         self._ensure_started()
         req = _Request(enc)
         self._queue.put(req)

@@ -1484,14 +1484,25 @@ class TpuPlacementEngine:
                 _metrics.incr_counter("nomad.tpu_engine.chunk_fallback")
                 logger.debug("chunked tier ineligible (%s): %s",
                              wave_id[:8], chunk_reason)
-        with _tlc.pipeline_stage("dispatch", wave_id):
-            if use_chunked:
-                chosen, scores, pulls, skipped_steps, evict = self.run_chunked(
-                    enc, chunk_k=int(getattr(sched, "chunk_k", 128)))
-            elif batcher is not None:
-                chosen, scores, pulls, skipped_steps, evict = batcher.run(enc)
-            else:
-                chosen, scores, pulls, skipped_steps, evict = self.run_scan_single(enc)
+        try:
+            with _tlc.pipeline_stage("dispatch", wave_id):
+                if use_chunked:
+                    chosen, scores, pulls, skipped_steps, evict = self.run_chunked(
+                        enc, chunk_k=int(getattr(sched, "chunk_k", 128)))
+                elif batcher is not None:
+                    chosen, scores, pulls, skipped_steps, evict = batcher.run(enc)
+                else:
+                    chosen, scores, pulls, skipped_steps, evict = self.run_scan_single(enc)
+        except Exception:  # noqa: BLE001 — device dispatch failed
+            # A failed/poisoned device round trip must not fail the eval:
+            # the host iterator stack computes the identical placements
+            # (bit-parity contract), so degrade this eval to the host
+            # path and let the caller's fall-through handle it.
+            logger.warning("device dispatch failed for %s; host fallback",
+                           wave_id[:8], exc_info=True)
+            _metrics.incr_counter("nomad.tpu_engine.dispatch_fallback_host")
+            self._pipeline_forget(sched)
+            return NotImplemented
         _metrics.measure_since("nomad.tpu_engine.device_wait", t0)
         if use_chunked:
             _metrics.incr_counter("nomad.tpu_engine.chunk_dispatch")
@@ -2120,6 +2131,19 @@ class TpuPlacementEngine:
             )
         except Exception:  # noqa: BLE001 — observability hook, never fatal
             logger.debug("pipeline remember_wave failed", exc_info=True)
+
+    @staticmethod
+    def _pipeline_forget(sched) -> None:
+        """Drop a remembered encode when the wave degrades to the host
+        path (failed device dispatch) — the registry entry would
+        otherwise strand until the eval acks."""
+        pipe = getattr(sched.planner, "pipeline", None)
+        if pipe is None:
+            return
+        try:
+            pipe.registry.forget(sched.eval.id)
+        except Exception:  # noqa: BLE001
+            logger.debug("pipeline forget failed", exc_info=True)
 
     # ------------------------------------------------------------------
     # System scheduler path: one alloc per ELIGIBLE node — each placement

@@ -16,7 +16,12 @@ is nonzero, it logs ONE dump — broker stats, per-worker current span,
 the slowest in-flight eval traces, and a full thread stack dump — to the
 framework logger, which the agent monitor's ring buffer captures for
 ``/v1/agent/monitor`` pollers. Repeat dumps are rate-limited to one per
-``stall_after`` window so a long stall doesn't flood the buffer.
+``stall_after`` window so a long stall doesn't flood the buffer — and
+deduplicated within a stall episode: the FIRST alarm gets the full dump
+(thread stacks and all); while the same flatline persists, later alarms
+emit one compact heartbeat line each (a chaos run's long injected stall
+would otherwise fill the ring buffer with identical stack dumps). Any
+progress starts a fresh episode with a fresh full dump.
 """
 from __future__ import annotations
 
@@ -39,6 +44,9 @@ class LivenessWatchdog:
         self._last_placed: Optional[int] = None
         self._last_progress_t: Optional[float] = None
         self._dumped_at: Optional[float] = None
+        # alarms emitted for the CURRENT stall episode; >0 means the full
+        # dump already went out and repeats degrade to heartbeat lines
+        self._episode_alarms = 0
 
     # -- probes ----------------------------------------------------------
 
@@ -75,11 +83,13 @@ class LivenessWatchdog:
             self._last_placed = placed
             self._last_progress_t = now
             self._dumped_at = None
+            self._episode_alarms = 0
             return False
         if in_flight == 0:
             # flat but empty: nothing owed, not a stall
             self._last_progress_t = now
             self._dumped_at = None
+            self._episode_alarms = 0
             return False
         stalled = now - (self._last_progress_t or now)
         metrics.set_gauge("nomad.watchdog.stalled_s", round(stalled, 1))
@@ -89,8 +99,19 @@ class LivenessWatchdog:
             return False
         self._dumped_at = now
         self.fired += 1
+        self._episode_alarms += 1
         metrics.incr_counter("nomad.watchdog.fired")
-        self._dump(stalled, placed, broker)
+        if self._episode_alarms == 1:
+            self._dump(stalled, placed, broker)
+        else:
+            # same flatline, dump already on record: one compact line
+            metrics.incr_counter("nomad.watchdog.heartbeat")
+            self.logger.warning(
+                "liveness watchdog: still stalled (%.1fs flat at %s "
+                "desired-run allocs, %d in flight; alarm %d of this "
+                "episode, suppressing repeat dumps)",
+                stalled, placed, in_flight, self._episode_alarms,
+            )
         return True
 
     def _dump(self, stalled: float, placed: Optional[int],

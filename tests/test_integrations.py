@@ -386,12 +386,15 @@ class TestScriptChecks:
             assert consul.checks[cid]["ServiceID"].startswith("_nomad-task-")
             assert consul.checks[cid]["TTL"]
 
-            # stop -> checks deregister
+            # stop -> the stopped task's checks deregister. Match on the
+            # captured check ID (it embeds the alloc id), not the check
+            # name: stop_alloc is a migrate, so the replacement alloc
+            # re-registers the same names and can overlap the old
+            # task's kill window.
             allocs = server.fsm.state.allocs_by_job("default", job.id, True)
             server.stop_alloc(allocs[0].id)
-            wait_until(lambda: not any(alloc_chk["Name"] == "ok-check"
-                                       for alloc_chk in consul.checks.values()),
-                       msg="script checks deregistered")
+            wait_until(lambda: cid not in consul.checks,
+                       msg="stopped task's script checks deregistered")
         finally:
             client.shutdown()
             server.stop()

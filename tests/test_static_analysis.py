@@ -705,3 +705,119 @@ def test_stale_claim_timeout_wakes_waiter_cohort():
     assert kinds == ["healed", "healed", "healed", "timeout"]
     # the cohort healed promptly (well under its own 30s grace)
     assert all(dt < 2.0 for k, dt in results if k == "healed")
+
+
+# ---------------------------------------------------------------------------
+# fault-injection-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injection_blessed_fire_hook_is_clean():
+    # the ONE production shape the chaos harness allows
+    src = dedent("""
+        from ..chaos.injector import fire as chaos_fire
+
+        class EvalBroker:
+            def ack(self, eval_id, token):
+                chaos_fire("broker_ack", eval_id=eval_id)
+                return self._ack_locked(eval_id, token)
+    """)
+    assert run_source(src, "nomad_tpu/server/eval_broker.py") == []
+
+
+def test_fault_injection_flags_adhoc_chaos_flag():
+    # the bug shape rule 1 forbids: a second, registry-invisible fault path
+    src = dedent("""
+        CHAOS_ENABLED = False
+
+        class Batcher:
+            def run(self, enc):
+                if CHAOS_ENABLED:
+                    raise RuntimeError("injected")
+                return self._dispatch(enc)
+    """)
+    fs = run_source(src, "nomad_tpu/tpu/batcher.py")
+    assert fs and all(f.rule == "fault-injection-discipline" for f in fs)
+    assert any("ad-hoc chaos" in f.message for f in fs)
+
+
+def test_fault_injection_flags_env_gated_chaos():
+    src = dedent("""
+        import os
+
+        class Planner:
+            def evaluate_plan(self, snapshot, plan):
+                if os.getenv("NOMAD_CHAOS_PLAN"):
+                    raise RuntimeError("injected")
+    """)
+    fs = run_source(src, "nomad_tpu/server/plan_apply.py")
+    assert [f.rule for f in fs] == ["fault-injection-discipline"]
+    assert "environment-gated" in fs[0].message
+
+
+def test_fault_injection_flags_production_injector_import():
+    # production may import the fire hook only, never the arming surface
+    src = dedent("""
+        from ..chaos.injector import ChaosInjector
+
+        class Server:
+            pass
+    """)
+    fs = run_source(src, "nomad_tpu/server/server.py")
+    assert [f.rule for f in fs] == ["fault-injection-discipline"]
+    assert "only the 'fire' hook" in fs[0].message
+
+
+def test_fault_injection_flags_unknown_fire_point():
+    src = dedent("""
+        from ..chaos.injector import fire as chaos_fire
+
+        def apply(entry):
+            chaos_fire("raft_aply")
+    """)
+    fs = run_source(src, "nomad_tpu/server/server.py")
+    assert [f.rule for f in fs] == ["fault-injection-discipline"]
+    assert "unknown injection point" in fs[0].message
+
+
+def test_fault_injection_arm_with_finally_disarm_is_clean():
+    src = dedent("""
+        from nomad_tpu.chaos import ChaosInjector
+
+        def test_device_fault():
+            inj = ChaosInjector(seed=1)
+            inj.arm("device_dispatch", prob=1.0)
+            try:
+                run_replay()
+            finally:
+                inj.disarm_all()
+    """)
+    assert run_source(src, "tests/test_chaos.py") == []
+
+
+def test_fault_injection_flags_arm_without_finally():
+    # the leak shape rule 2 forbids: an armed injector outliving its test
+    src = dedent("""
+        from nomad_tpu.chaos import ChaosInjector
+
+        def test_device_fault():
+            inj = ChaosInjector(seed=1)
+            inj.arm("device_dispatch", prob=1.0)
+            run_replay()
+            inj.disarm_all()
+    """)
+    fs = run_source(src, "tests/test_chaos.py")
+    assert [f.rule for f in fs] == ["fault-injection-discipline"]
+    assert "finally" in fs[0].message
+
+
+def test_fault_injection_flags_module_scope_arm():
+    src = dedent("""
+        from nomad_tpu.chaos import ChaosInjector
+
+        INJ = ChaosInjector(seed=1)
+        INJ.arm("heartbeat", prob=0.5)
+    """)
+    fs = run_source(src, "tests/test_chaos.py")
+    assert [f.rule for f in fs] == ["fault-injection-discipline"]
+    assert "module scope" in fs[0].message
