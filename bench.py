@@ -1042,6 +1042,7 @@ def bench_chaos_churn(name="chaos-churn-5K", seed=0, duration_s=30.0,
         seed=seed, duration_s=duration_s, n_nodes=n_nodes,
         n_jobs=60, tg_count=50, stop_frac=0.3, rollout_frac=0.25,
         n_drains=3, n_expiries=2, n_hipri=2, n_fault_windows=4,
+        canary_frac=0.25, n_preempt_waves=1,
         leader_kill=True,
     )
     log(f"{name}: {len(trace)} trace events over {duration_s:.0f}s, "
@@ -1111,6 +1112,96 @@ def bench_chaos_churn(name="chaos-churn-5K", seed=0, duration_s=30.0,
     }
 
 
+# ---------------------------------------------------------------------------
+# chaos-crash-5K: real-process SIGKILL failover under churn load, with
+# MTTR SLO gates (new-leader election, first post-failover commit) and a
+# forced snapshot-install rejoin of the killed server
+# ---------------------------------------------------------------------------
+
+def bench_chaos_crash(name="chaos-crash-5K", seed=0, duration_s=25.0,
+                      n_nodes=120, settle_timeout_s=150.0):
+    """Replay a churn trace against three REAL server OS processes (each
+    with its own durable data dir), SIGKILL -9 the leader mid-trace, and
+    gate on recovery: time to a new leader, time to the first committed
+    write through it, and the killed server restarting into a
+    snapshot-install rejoin (the leader compacts its log while the node
+    is down, so catch-up must take the InstallSnapshot path, not plain
+    log replay). The invariant sweep then runs per-replica over RPC —
+    identical desired-run counts on all three data dirs is the whole
+    point. chaos-churn-5K measures degradation under in-proc faults;
+    this config measures process-death recovery with nothing shared."""
+    from nomad_tpu.chaos import CrashReplay, SLOGate, SLOThresholds
+    from nomad_tpu.chaos.trace import generate_trace, trace_to_jsonable
+
+    # fault windows are per-process (the injector can't reach into the
+    # children) and canaried rollouts need the in-proc deployment nurse,
+    # so the crash trace runs with both off; the leader kill is the fault
+    trace = generate_trace(
+        seed=seed, duration_s=duration_s, n_nodes=n_nodes,
+        n_jobs=40, tg_count=25, stop_frac=0.25, rollout_frac=0.2,
+        n_drains=2, n_expiries=2, n_hipri=2, n_fault_windows=0,
+        n_preempt_waves=1, leader_kill=True,
+    )
+    log(f"{name}: {len(trace)} trace events over {duration_s:.0f}s, "
+        f"{n_nodes} nodes, 3 server processes, seed {seed}")
+    replay = CrashReplay(
+        seed=seed, trace=trace, n_servers=3, n_nodes=n_nodes,
+        settle_timeout_s=settle_timeout_s,
+    )
+    t0 = time.monotonic()
+    result = replay.run()
+    wall = time.monotonic() - t0
+
+    # recovery bounds: election timeout is 0.5-1.0s per attempt, so 5s of
+    # MTTR covers several split-vote rounds before failing; first commit
+    # adds RPC retry/forwarding discovery on top. Latency/throughput gates
+    # are owned by chaos-churn-5K (in-proc, 250 nodes) — here the only
+    # floor is "the cluster still places work through the failover".
+    gate = SLOGate(SLOThresholds(
+        eval_ms_p99_max=None,
+        slowest_inflight_ms_max=None,
+        throughput_min_allocs_per_s=5.0,
+        failover_new_leader_ms_max=5_000.0,
+        failover_first_commit_ms_max=10_000.0,
+        require_rejoin=True,
+    ))
+    slo = gate.evaluate(result)
+    record = {
+        "config": name,
+        "seed": seed,
+        "wall_s": round(wall, 2),
+        "slo": slo,
+        "result": result,
+        "trace": trace_to_jsonable(trace),
+    }
+    write_artifact(name, record)
+    failover = result.get("failover") or {}
+    status = "PASS" if slo["passed"] else "FAIL"
+    log(f"{name}: {status} — {result['total_allocs']} allocs "
+        f"({result['throughput_allocs_per_s']}/s), new leader in "
+        f"{failover.get('time_to_new_leader_ms')}ms, first commit in "
+        f"{failover.get('time_to_first_commit_ms')}ms, rejoined="
+        f"{failover.get('rejoined')} via {failover.get('snapshot_installs')}"
+        f" snapshot install(s)")
+    for check in slo["checks"]:
+        log(f"  slo[{check['name']}]: observed={check['observed']} "
+            f"bound={check['bound']} passed={check['passed']}")
+    return {
+        "config": name,
+        "slo_passed": slo["passed"],
+        "total_allocs": result["total_allocs"],
+        "throughput_allocs_per_s": result["throughput_allocs_per_s"],
+        "invariants": result["invariants"],
+        "leader_kills": result["leader_kills"],
+        "time_to_new_leader_ms": failover.get("time_to_new_leader_ms"),
+        "time_to_first_commit_ms": failover.get("time_to_first_commit_ms"),
+        "restart_catchup_ms": failover.get("restart_catchup_ms"),
+        "snapshot_installs": failover.get("snapshot_installs"),
+        "rejoined": failover.get("rejoined"),
+        "wall_s": round(wall, 2),
+    }
+
+
 def _diagnostic(fn, *args, **kwargs):
     """Run one diagnostic bench in isolation: a failure is reported but
     never skips later diagnostics or breaks the headline JSON line. The
@@ -1151,6 +1242,9 @@ def main():
     # regression (gate FAIL or crash) still yields its own artifact and a
     # complete headline record
     chaos_churn = _diagnostic(bench_chaos_churn)
+    # crash-recovery config: real server processes, SIGKILL failover,
+    # snapshot-install rejoin — gated on MTTR instead of tail latency
+    chaos_crash = _diagnostic(bench_chaos_crash)
 
     # HEADLINE: end-to-end system C1M replay (jobs -> broker -> workers ->
     # eval-batched engine -> plan queue -> raft/FSM), one chip.
@@ -1218,6 +1312,7 @@ def main():
             "plan_queue_drain_10k_nodes": drain,
             "system_configs": sys_results,
             "chaos_churn": chaos_churn,
+            "chaos_crash": chaos_crash,
         },
     }
     write_artifact("headline", record)

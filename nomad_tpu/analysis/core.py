@@ -147,6 +147,7 @@ def default_checkers() -> list:
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
     from .pipeline_stage_discipline import PipelineStageDisciplineChecker
+    from .subprocess_discipline import SubprocessDisciplineChecker
     from .trace_span_discipline import TraceSpanDisciplineChecker
 
     return [
@@ -157,6 +158,7 @@ def default_checkers() -> list:
         TraceSpanDisciplineChecker(),
         PipelineStageDisciplineChecker(),
         FaultInjectionDisciplineChecker(),
+        SubprocessDisciplineChecker(),
     ]
 
 

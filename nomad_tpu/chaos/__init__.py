@@ -19,7 +19,10 @@ from .injector import MODES, POINTS, ChaosFault, ChaosInjector, active, fire
 # lazily (PEP 562) to keep that edge acyclic and the hook import cheap.
 _LAZY = {
     "ChurnReplay": ("replay", "ChurnReplay"),
+    "CrashReplay": ("crash", "CrashReplay"),
+    "ServerProcess": ("crash", "ServerProcess"),
     "invariant_sweep": ("replay", "invariant_sweep"),
+    "invariant_sweep_allocs": ("replay", "invariant_sweep_allocs"),
     "SLOGate": ("slo", "SLOGate"),
     "SLOThresholds": ("slo", "SLOThresholds"),
     "ChaosEvent": ("trace", "ChaosEvent"),
@@ -53,7 +56,10 @@ __all__ = [
     "trace_kind_counts",
     "trace_to_jsonable",
     "ChurnReplay",
+    "CrashReplay",
+    "ServerProcess",
     "invariant_sweep",
+    "invariant_sweep_allocs",
     "SLOGate",
     "SLOThresholds",
 ]

@@ -4,18 +4,26 @@
 servers, mock nodes with real heartbeat TTL timers and a background
 heartbeat pump), then plays a :mod:`nomad_tpu.chaos.trace` schedule
 against the current leader in real time: registrations, stops,
-destructive rollouts, high-priority arrivals, drains, heartbeat mutes
-(TTL expiry), fault windows armed on the :mod:`~nomad_tpu.chaos.injector`
-registry, and a mid-run leader kill (``raft.transfer_leadership`` — the
-in-proc equivalent of SIGKILLing the leader: abrupt, mid-write, with
-the broker flushed and the new leader restoring evals and heartbeats).
+rollouts (destructive or canaried), high-priority arrivals,
+preemption-pressure waves, drains, heartbeat mutes (TTL expiry), fault
+windows armed on the :mod:`~nomad_tpu.chaos.injector` registry, and a
+mid-run leader kill (``raft.transfer_leadership`` — the in-proc
+equivalent of SIGKILLing the leader: abrupt, mid-write, with the broker
+flushed and the new leader restoring evals and heartbeats).
 
 Every event application has bounded retries with backoff — injected
-faults (``ChaosFault``) and leadership races (``NotLeaderError``) are
-expected weather, not errors. After the last event the driver quiesces:
-disarms everything (in a ``finally``), restores muted/drained nodes,
-and waits for the cluster to converge before running the post-run
-state-store invariant sweep that the SLO gate consumes.
+faults (``ChaosFault``), leadership races (``NotLeaderError``), and RPC
+weather are expected, not errors. After the last event the driver
+quiesces: disarms everything (in a ``finally``), restores muted/drained
+nodes, and waits for the cluster to converge before running the
+post-run state-store invariant sweep that the SLO gate consumes.
+
+Cluster plumbing is factored into overridable hooks (``_start_cluster``,
+``_leader``, ``_leader_state``, ``_broker_stats``, ``_kill_leader``,
+``_post_trace``, ``_replica_run_counts``, ``_shutdown``) so
+:class:`nomad_tpu.chaos.crash.CrashReplay` can drive the same trace
+against a REAL multi-process wire-raft cluster where the leader kill is
+a SIGKILL -9.
 """
 from __future__ import annotations
 
@@ -26,6 +34,7 @@ from collections import Counter
 from typing import Dict, List, Optional, Set, Tuple
 
 from .. import mock
+from ..rpc.transport import RPCError
 from ..server.raft import InProcRaft, NotLeaderError
 from ..server.server import Server, ServerConfig
 from ..trace import lifecycle
@@ -33,17 +42,20 @@ from .injector import ChaosFault, ChaosInjector
 from .trace import ChaosEvent, generate_trace, trace_kind_counts
 
 # bounded per-event retry: flapping faults degrade an event to "late",
-# never to a hot loop or a wedged replay
+# never to a hot loop or a wedged replay. ConnectionError is an OSError
+# subclass, so RPC weather against a real cluster is covered too.
 _EVENT_RETRIES = 6
 _EVENT_BACKOFF_S = 0.05
+_RETRYABLE = (ChaosFault, NotLeaderError, RuntimeError, KeyError,
+              RPCError, OSError)
 
 
-def invariant_sweep(
-    state,
+def invariant_sweep_allocs(
+    allocs: List,
     expected: Dict[Tuple[str, str], int],
     stopped: Set[Tuple[str, str]],
 ) -> Dict[str, object]:
-    """Post-run state-store sweep: zero lost / duplicated allocations.
+    """Post-run sweep over an alloc list: zero lost / duplicated allocs.
 
     - *duplicated*: an alloc id present twice, or two desired-run allocs
       holding the same (job, name) slot — the OCC/redispatch machinery
@@ -51,11 +63,13 @@ def invariant_sweep(
     - *lost*: a live job whose desired-run alloc count is below its
       task-group count — churn ate a placement and nothing rescheduled it.
     - *orphaned*: desired-run allocs belonging to a stopped job.
+
+    Takes a plain alloc list (not a state store) so the same sweep runs
+    against remote replicas fetched over RPC by the crash harness.
     """
     from ..structs.structs import ALLOC_DESIRED_RUN
 
     violations: List[str] = []
-    allocs = state.allocs()
 
     id_counts = Counter(a.id for a in allocs)
     dup_ids = {aid: n for aid, n in id_counts.items() if n > 1}
@@ -96,6 +110,15 @@ def invariant_sweep(
         "converged": not violations,
         "violations": violations[:20],
     }
+
+
+def invariant_sweep(
+    state,
+    expected: Dict[Tuple[str, str], int],
+    stopped: Set[Tuple[str, str]],
+) -> Dict[str, object]:
+    """State-store form of :func:`invariant_sweep_allocs`."""
+    return invariant_sweep_allocs(state.allocs(), expected, stopped)
 
 
 class ChurnReplay:
@@ -142,11 +165,17 @@ class ChurnReplay:
         self._mute_lock = threading.Lock()
         self._pump_stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
+        self._nurse_thread: Optional[threading.Thread] = None
+        # the nurse needs in-proc state access; the crash subclass (which
+        # forbids canaried rollouts anyway) turns it off
+        self._nurse_enabled = True
 
         # convergence bookkeeping fed to the invariant sweep
         self._expected: Dict[Tuple[str, str], int] = {}
         self._stopped: Set[Tuple[str, str]] = set()
         self._drained: Set[str] = set()
+        self._preempt_fillers: Dict[int, Tuple[str, str]] = {}
+        self._preemption_enabled = False
 
         self.events_applied = 0
         self.events_degraded = 0   # exhausted retries; logged, not fatal
@@ -155,7 +184,16 @@ class ChurnReplay:
         self.errors: List[str] = []
         self.fault_fires: Dict[str, int] = {}
 
-    # -- cluster plumbing ------------------------------------------------
+    # -- cluster plumbing (the hooks CrashReplay overrides) ---------------
+
+    def _start_cluster(self) -> None:
+        raft = InProcRaft()
+        for i in range(self.n_servers):
+            self.servers.append(
+                Server(self.config, raft=raft, name=f"chaos-s{i + 1}")
+            )
+        for s in self.servers:
+            s.start()
 
     def _leader(self, timeout: float = 5.0) -> Server:
         deadline = time.monotonic() + timeout
@@ -166,6 +204,47 @@ class ChurnReplay:
             if time.monotonic() > deadline:
                 raise RuntimeError("no leader within timeout")
             time.sleep(0.01)
+
+    def _leader_state(self):
+        """Read surface for the leader's FSM (a StateStore, or the crash
+        harness's RPC-backed facade)."""
+        return self._leader().fsm.state
+
+    def _broker_stats(self) -> Dict[str, int]:
+        return self._leader().eval_broker.stats()
+
+    def _kill_leader(self) -> None:
+        leader = self._leader()
+        raft = leader.raft
+        peers = [s.peer for s in self.servers if s is not leader]
+        if peers:
+            raft.transfer_leadership(peers[0])
+            self.leader_kills += 1
+
+    def _post_trace(self) -> None:
+        """Hook between the last trace event and settle (the crash
+        harness restarts the killed server here)."""
+
+    def _replica_run_counts(self) -> Dict[str, Optional[int]]:
+        return {
+            s.name: s.fsm.state.count_allocs_desired_run()
+            for s in self.servers
+        }
+
+    def _extra_result(self) -> Dict[str, object]:
+        """Harness-specific additions merged into the run() result."""
+        return {}
+
+    def _shutdown(self) -> None:
+        self._pump_stop.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=2.0)
+        if self._nurse_thread is not None:
+            self._nurse_thread.join(timeout=2.0)
+        for s in self.servers:
+            s.stop()
+
+    # -- background pumps --------------------------------------------------
 
     def _pump_heartbeats(self) -> None:
         """Background client stand-in: heartbeat every live node well
@@ -185,19 +264,80 @@ class ChurnReplay:
                     continue
                 try:
                     leader.heartbeat(node_id)
-                except (ChaosFault, NotLeaderError, KeyError):
+                except _RETRYABLE:
                     continue
                 except Exception as e:  # noqa: BLE001 — pump must survive
                     self.errors.append(f"heartbeat pump: {e!r}")
 
+    def _nurse_deployments(self) -> None:
+        """Client-health stand-in: no real clients run here, so the
+        allochealth hook (client/allochealth in the reference) is pumped
+        by the driver — active deployments get their unreported allocs
+        marked healthy, and canaried deployments are promoted once every
+        placed canary reports healthy, letting canaried rollouts run to
+        completion instead of stalling the sweep."""
+        while not self._pump_stop.wait(0.2):
+            try:
+                self._pump_deployments_once()
+            except _RETRYABLE:
+                continue
+            except Exception as e:  # noqa: BLE001 — nurse must survive
+                self.errors.append(f"deployment nurse: {e!r}")
+
+    def _pump_deployments_once(self) -> None:
+        from ..structs.structs import (
+            ALLOC_CLIENT_RUNNING,
+            ALLOC_DESIRED_RUN,
+            AllocDeploymentStatus,
+        )
+
+        leader = self._leader(timeout=1.0)
+        state = leader.fsm.state
+        for d in state.deployments():
+            if not d.active():
+                continue
+            updates = []
+            for a in state.allocs_by_job(d.namespace, d.job_id, True):
+                if (
+                    a.deployment_id != d.id
+                    or a.desired_status != ALLOC_DESIRED_RUN
+                    or (a.deployment_status is not None
+                        and a.deployment_status.healthy is not None)
+                ):
+                    continue
+                u = a.copy_skip_job()
+                u.client_status = ALLOC_CLIENT_RUNNING
+                u.deployment_status = AllocDeploymentStatus(
+                    healthy=True, timestamp_ns=time.time_ns(),
+                    canary=(a.deployment_status.canary
+                            if a.deployment_status else False),
+                )
+                updates.append(u)
+            if updates:
+                leader.update_allocs_from_client(updates)
+            d2 = state.deployment_by_id(d.id)
+            if d2 is None or not d2.active() or not d2.requires_promotion():
+                continue
+            canaries = [
+                cid for tg in d2.task_groups.values()
+                for cid in (tg.placed_canaries or [])
+            ]
+
+            def healthy(cid: str) -> bool:
+                a = state.alloc_by_id(cid)
+                return bool(
+                    a is not None and a.deployment_status is not None
+                    and a.deployment_status.healthy
+                )
+
+            if canaries and all(healthy(c) for c in canaries):
+                try:
+                    leader.deployment_watcher.promote(d2.id)
+                except (KeyError, ValueError):
+                    pass  # promoted or failed concurrently
+
     def _boot(self) -> None:
-        raft = InProcRaft()
-        for i in range(self.n_servers):
-            self.servers.append(
-                Server(self.config, raft=raft, name=f"chaos-s{i + 1}")
-            )
-        for s in self.servers:
-            s.start()
+        self._start_cluster()
         leader = self._leader()
         for _ in range(self.n_nodes):
             n = mock.node()
@@ -211,6 +351,12 @@ class ChurnReplay:
             daemon=True,
         )
         self._pump_thread.start()
+        if self._nurse_enabled:
+            self._nurse_thread = threading.Thread(
+                target=self._nurse_deployments, name="chaos-deploy-nurse",
+                daemon=True,
+            )
+            self._nurse_thread.start()
 
     def _warmup(self, leader: Server) -> None:
         """Pre-trace compile warmup: place (then purge) one throwaway job
@@ -238,7 +384,7 @@ class ChurnReplay:
             leader.drain_evals(timeout=30.0)
         # warmup rows stay in the store (GC is off): exclude them from
         # the run's placement-throughput numerator
-        self._boot_allocs = len(leader.fsm.state.allocs())
+        self._boot_allocs = len(self._leader_state().allocs())
 
     # -- event application -----------------------------------------------
 
@@ -255,6 +401,26 @@ class ChurnReplay:
         res.memory_mb = memory_mb
         res.networks = []   # churn jobs don't contend on ports
         return job
+
+    def _set_service_preemption(self) -> None:
+        from ..structs.structs import PreemptionConfig, SchedulerConfiguration
+
+        leader = self._leader()
+        _, cfg = leader.fsm.state.scheduler_config()
+        cfg = copy.deepcopy(cfg) if cfg is not None else SchedulerConfiguration()
+        if cfg.preemption_config is None:
+            cfg.preemption_config = PreemptionConfig()
+        cfg.preemption_config.service_scheduler_enabled = True
+        leader.raft_apply("scheduler-config", cfg)
+
+    def _enable_service_preemption(self) -> None:
+        # service-scheduler preemption is off by default (matching the
+        # reference); a pressure wave flips it once, through raft, so
+        # every replica agrees
+        if self._preemption_enabled:
+            return
+        self._set_service_preemption()
+        self._preemption_enabled = True
 
     def _apply_event(self, ev: ChaosEvent) -> None:
         a = ev.args
@@ -279,16 +445,47 @@ class ChurnReplay:
             self._stopped.add(key)
         elif ev.kind == "rollout":
             leader = self._leader()
-            for (ns, jid), _count in list(self._expected.items()):
+            for (ns, jid), count in list(self._expected.items()):
                 if jid != a["job_id"]:
                     continue
-                stored = leader.fsm.state.job_by_id(ns, jid)
+                stored = self._leader_state().job_by_id(ns, jid)
                 if stored is None:
                     return
                 job = copy.deepcopy(stored)
                 job.task_groups[0].tasks[0].resources.cpu = a["cpu"]
+                canary = int(a.get("canary", 0))
+                if canary:
+                    # canaried deployment update: stage `canary` new-
+                    # version allocs; the deployment nurse reports their
+                    # health and promotes, unleashing the rolling
+                    # replacement (reference update block + deploymentwatcher)
+                    from ..structs.structs import UpdateStrategy
+
+                    update = UpdateStrategy(
+                        max_parallel=max(1, count), canary=canary)
+                    job.update = update
+                    job.task_groups[0].update = update
                 leader.register_job(job)
                 return
+        elif ev.kind == "preempt_pressure":
+            self._enable_service_preemption()
+            wave = int(a.get("wave", 0))
+            fill = self._make_job(
+                f"preempt-fill-{wave}", a["filler_count"], a["filler_cpu"],
+                a.get("memory_mb", 64), priority=10)
+            self._leader().register_job(fill)
+            # fillers are pressure, not fleet: under saturation they are
+            # LEGITIMATELY part-placed then evicted by the hipri burst,
+            # so they never enter _expected; release moves them to the
+            # stopped set, where leftovers DO count (as orphans)
+            self._preempt_fillers[wave] = (fill.namespace, fill.id)
+        elif ev.kind == "preempt_release":
+            wave = int(a.get("wave", 0))
+            key = self._preempt_fillers.pop(wave, None)
+            if key is None:
+                return   # pressure event degraded earlier
+            self._leader().deregister_job(key[0], key[1], purge=False)
+            self._stopped.add(key)
         elif ev.kind == "drain_node":
             node_id = self.node_ids[a["node_idx"] % len(self.node_ids)]
             self._leader().update_node_drain(node_id, True)
@@ -318,12 +515,7 @@ class ChurnReplay:
             )
             self.injector.disarm(point)
         elif ev.kind == "leader_kill":
-            leader = self._leader()
-            raft = leader.raft
-            peers = [s.peer for s in self.servers if s is not leader]
-            if peers:
-                raft.transfer_leadership(peers[0])
-                self.leader_kills += 1
+            self._kill_leader()
         else:
             raise ValueError(f"unknown trace event kind {ev.kind!r}")
 
@@ -334,7 +526,7 @@ class ChurnReplay:
                 self._apply_event(ev)
                 self.events_applied += 1
                 return
-            except (ChaosFault, NotLeaderError, RuntimeError, KeyError) as e:
+            except _RETRYABLE as e:
                 if attempt == _EVENT_RETRIES - 1:
                     self.events_degraded += 1
                     self.errors.append(f"{ev.kind}@{ev.t:.2f}: {e!r}")
@@ -371,19 +563,25 @@ class ChurnReplay:
         nudge_at = time.monotonic() + self.settle_timeout_s / 2.0
         nudged = False
         while time.monotonic() < deadline:
-            leader = self._leader()
-            stats = leader.eval_broker.stats()
-            broker_idle = (
-                stats["total_ready"] == 0
-                and stats["total_unacked"] == 0
-                and stats["total_waiting"] == 0
-            )
-            if broker_idle and self._live_jobs_converged(leader.fsm.state):
-                return True
+            try:
+                stats = self._broker_stats()
+                broker_idle = (
+                    stats["total_ready"] == 0
+                    and stats["total_unacked"] == 0
+                    and stats["total_waiting"] == 0
+                )
+                if broker_idle and self._live_jobs_converged(
+                        self._leader_state()):
+                    return True
+            except _RETRYABLE as e:
+                self.errors.append(f"settle probe: {e!r}")
+                time.sleep(0.2)
+                continue
             # drain/migrate health gating has no real clients here: one
             # re-evaluation nudge per straggler halfway through the window
             if not nudged and time.monotonic() >= nudge_at:
                 nudged = True
+                leader = self._leader()
                 for (ns, jid) in list(self._expected):
                     try:
                         leader.evaluate_job(ns, jid)
@@ -392,9 +590,57 @@ class ChurnReplay:
             time.sleep(0.05)
         return False
 
+    def _measure(self, settled: bool, t0: float, t_run: float) -> Dict[str, object]:
+        """Read the cluster while it is still up (before _shutdown)."""
+        from ..structs.structs import ALLOC_DESIRED_RUN
+
+        duration = time.monotonic() - t0
+        # throughput over the churn window itself (boot + compile warmup
+        # excluded — they are setup, not the workload under measurement)
+        run_duration = time.monotonic() - t_run
+        allocs = self._leader_state().allocs()
+        inv = invariant_sweep_allocs(allocs, self._expected, self._stopped)
+        if not settled:
+            inv["converged"] = False
+            inv["violations"] = (["settle timeout"] + inv["violations"])[:20]
+
+        # replica consistency: every FSM saw the same applied log (a
+        # permanently-dead replica reports None and is excluded)
+        counts = self._replica_run_counts()
+        live = [c for c in counts.values() if c is not None]
+        if len(set(live)) > 1:
+            inv["converged"] = False
+            inv["violations"].append(f"replica divergence: {counts}")
+
+        # allocs() retains stopped/superseded rows until GC (disabled for
+        # the run), so its length approximates placements ever created;
+        # boot-time warmup rows are excluded
+        total_allocs = max(0, len(allocs) - self._boot_allocs)
+        result = {
+            "seed": self.seed,
+            "duration_s": round(duration, 3),
+            "trace_events": len(self.trace),
+            "trace_kinds": trace_kind_counts(self.trace),
+            "events_applied": self.events_applied,
+            "events_degraded": self.events_degraded,
+            "leader_kills": self.leader_kills,
+            "fault_fires": dict(sorted(self.fault_fires.items())),
+            "total_allocs": total_allocs,
+            "desired_run_allocs": sum(
+                1 for a in allocs if a.desired_status == ALLOC_DESIRED_RUN
+            ),
+            "replica_run_counts": counts,
+            "throughput_allocs_per_s": round(total_allocs / run_duration, 2)
+            if run_duration > 0 else None,
+            "trace_summary": lifecycle.summary(),
+            "invariants": inv,
+            "errors": self.errors[:20],
+        }
+        result.update(self._extra_result())
+        return result
+
     def run(self) -> Dict[str, object]:
         t0 = time.monotonic()
-        t_run = t0
         try:
             self._boot()
             t_run = time.monotonic()
@@ -411,53 +657,12 @@ class ChurnReplay:
                 self.fault_fires[point] = (
                     self.fault_fires.get(point, 0) + st["fires"]
                 )
+            self._post_trace()
             settled = self._settle()
+            # measurement happens while the cluster is live: the crash
+            # harness's replicas are separate processes that stop
+            # answering RPC once _shutdown reaps them
+            return self._measure(settled, t0, t_run)
         finally:
             self.injector.disarm_all()
-            self._pump_stop.set()
-            if self._pump_thread is not None:
-                self._pump_thread.join(timeout=2.0)
-            for s in self.servers:
-                s.stop()
-
-        duration = time.monotonic() - t0
-        # throughput over the churn window itself (boot + compile warmup
-        # excluded — they are setup, not the workload under measurement)
-        run_duration = time.monotonic() - t_run
-        leader_state = self._leader().fsm.state
-        inv = invariant_sweep(leader_state, self._expected, self._stopped)
-        if not settled:
-            inv["converged"] = False
-            inv["violations"] = (["settle timeout"] + inv["violations"])[:20]
-
-        # replica consistency: every FSM saw the same applied log
-        counts = {
-            s.name: s.fsm.state.count_allocs_desired_run()
-            for s in self.servers
-        }
-        if len(set(counts.values())) > 1:
-            inv["converged"] = False
-            inv["violations"].append(f"replica divergence: {counts}")
-
-        # allocs() retains stopped/superseded rows until GC (disabled for
-        # the run), so its length approximates placements ever created;
-        # boot-time warmup rows are excluded
-        total_allocs = max(0, len(leader_state.allocs()) - self._boot_allocs)
-        return {
-            "seed": self.seed,
-            "duration_s": round(duration, 3),
-            "trace_events": len(self.trace),
-            "trace_kinds": trace_kind_counts(self.trace),
-            "events_applied": self.events_applied,
-            "events_degraded": self.events_degraded,
-            "leader_kills": self.leader_kills,
-            "fault_fires": dict(sorted(self.fault_fires.items())),
-            "total_allocs": total_allocs,
-            "desired_run_allocs": leader_state.count_allocs_desired_run(),
-            "replica_run_counts": counts,
-            "throughput_allocs_per_s": round(total_allocs / run_duration, 2)
-            if run_duration > 0 else None,
-            "trace_summary": lifecycle.summary(),
-            "invariants": inv,
-            "errors": self.errors[:20],
-        }
+            self._shutdown()

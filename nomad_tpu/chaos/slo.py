@@ -26,6 +26,11 @@ class SLOThresholds:
     require_zero_lost: bool = True
     require_zero_duplicated: bool = True
     require_converged: bool = True
+    # failover MTTR bounds (crash-recovery runs; the result's "failover"
+    # block comes from nomad_tpu.trace.failover via CrashReplay)
+    failover_new_leader_ms_max: Optional[float] = None
+    failover_first_commit_ms_max: Optional[float] = None
+    require_rejoin: bool = False
 
     def to_dict(self) -> Dict[str, object]:
         return {
@@ -35,6 +40,9 @@ class SLOThresholds:
             "require_zero_lost": self.require_zero_lost,
             "require_zero_duplicated": self.require_zero_duplicated,
             "require_converged": self.require_converged,
+            "failover_new_leader_ms_max": self.failover_new_leader_ms_max,
+            "failover_first_commit_ms_max": self.failover_first_commit_ms_max,
+            "require_rejoin": self.require_rejoin,
         }
 
 
@@ -98,6 +106,21 @@ class SLOGate:
         if th.require_converged:
             conv = inv.get("converged")
             check("converged", conv, True, bool(conv))
+
+        fo = result.get("failover") or {}
+        if th.failover_new_leader_ms_max is not None:
+            v = fo.get("time_to_new_leader_ms")
+            check("failover_time_to_new_leader_ms", v,
+                  th.failover_new_leader_ms_max,
+                  v is not None and v <= th.failover_new_leader_ms_max)
+        if th.failover_first_commit_ms_max is not None:
+            v = fo.get("time_to_first_commit_ms")
+            check("failover_time_to_first_commit_ms", v,
+                  th.failover_first_commit_ms_max,
+                  v is not None and v <= th.failover_first_commit_ms_max)
+        if th.require_rejoin:
+            rejoined = fo.get("rejoined")
+            check("killed_server_rejoined", rejoined, True, bool(rejoined))
 
         passed = all(c["passed"] is not False for c in checks)
         return {

@@ -23,9 +23,13 @@ Event kinds and their args:
 ====================  =====================================================
 ``register_job``      job_id, count, cpu, memory_mb, priority
 ``stop_job``          job_id (deregister, purge=False)
-``rollout``           job_id, cpu (destructive update: resource bump
-                      replaces every alloc)
-``hipri_job``         job_id, count, cpu, memory_mb (priority-80 arrival)
+``rollout``           job_id, cpu [, canary] (resource-bump update; with
+                      ``canary`` set it is a CANARIED deployment update —
+                      that many canary allocs stage first and the rollout
+                      only proceeds on promotion; without it the update is
+                      destructive and replaces every alloc at once)
+``hipri_job``         job_id, count, cpu, memory_mb [, priority]
+                      (priority-80 arrival by default)
 ``drain_node``        node_idx
 ``undrain_node``      node_idx
 ``mute_node``         node_idx (stop heartbeating it: TTL expires, node
@@ -33,8 +37,17 @@ Event kinds and their args:
 ``unmute_node``       node_idx (resume heartbeats: node returns READY)
 ``arm_fault``         point, mode, prob, delay_s, max_fires
 ``disarm_fault``      point
-``leader_kill``       (none) — abrupt leadership transfer away from the
-                      current leader, mid-run
+``preempt_pressure``  wave, filler_count, filler_cpu, memory_mb —
+                      low-priority saturation: enable service-scheduler
+                      preemption and register a priority-10 filler job
+                      sized to soak node capacity (the generator follows
+                      it with a priority-90 ``hipri_job`` burst that must
+                      place by evicting fillers)
+``preempt_release``   wave — deregister that wave's filler job (paired
+                      before the recovery tail so the sweep converges)
+``leader_kill``       (none) — abrupt leader loss mid-run. In-proc replay
+                      realizes it as a leadership transfer; the crash
+                      harness as a real SIGKILL -9 of the leader process
 ====================  =====================================================
 """
 from __future__ import annotations
@@ -92,13 +105,22 @@ def generate_trace(
     recovery_frac: float = 0.8,
     cpu: int = 200,
     memory_mb: int = 128,
+    canary_frac: float = 0.0,
+    n_preempt_waves: int = 0,
 ) -> List[ChaosEvent]:
     """Build a seeded churn schedule over ``duration_s`` trace-seconds.
 
     Phases: an initial registration wave over the first 20% of the
     window, overlapping churn (stops+replacements, rollouts, drains,
-    TTL expiries, high-priority arrivals, fault windows, the leader
-    kill) through ``recovery_frac``, then a clean recovery tail.
+    TTL expiries, high-priority arrivals, fault windows, preemption
+    waves, the leader kill) through ``recovery_frac``, then a clean
+    recovery tail.
+
+    ``canary_frac`` of the rollouts become canaried deployment updates;
+    ``n_preempt_waves`` adds paired preempt_pressure/preempt_release
+    waves (each with a hipri burst between them). Both default off, and
+    when off the generator's rng consumption is unchanged — existing
+    seeds keep producing byte-identical traces.
     """
     rng = Random(seed)
     events: List[ChaosEvent] = []
@@ -133,13 +155,20 @@ def generate_trace(
              "memory_mb": memory_mb, "priority": 50},
         ))
 
-    # -- destructive rollouts ------------------------------------------
+    # -- rollouts (destructive, plus an optional canaried head) --------
     rollable = [j for j in job_ids if j not in stopped]
-    for jid in rng.sample(rollable, min(len(rollable), int(n_jobs * rollout_frac))):
-        events.append(ChaosEvent(
-            jitter(churn_lo, churn_hi), "rollout",
-            {"job_id": jid, "cpu": cpu + 50},
-        ))
+    rolled = rng.sample(rollable, min(len(rollable), int(n_jobs * rollout_frac)))
+    n_canary = int(round(len(rolled) * canary_frac)) if canary_frac > 0 else 0
+    for ri, jid in enumerate(rolled):
+        args = {"job_id": jid, "cpu": cpu + 50}
+        if ri < n_canary:
+            # canaried rollouts need time for stage -> health -> promote
+            # -> roll before the recovery tail, so bound them earlier
+            args["canary"] = max(1, tg_count // 4)
+            t = jitter(churn_lo, churn_hi * 0.7)
+        else:
+            t = jitter(churn_lo, churn_hi)
+        events.append(ChaosEvent(t, "rollout", args))
 
     # -- high-priority arrivals ----------------------------------------
     for i in range(n_hipri):
@@ -184,6 +213,29 @@ def generate_trace(
         events.append(ChaosEvent(
             min(t + jitter(1.0, 3.0), recover_by),
             "disarm_fault", {"point": point},
+        ))
+
+    # -- preemption-pressure waves (paired release) --------------------
+    # each wave: low-priority fillers soak capacity, a priority-90 burst
+    # arrives into the saturated cluster (placing it requires the service
+    # scheduler to evict fillers), then the fillers are released before
+    # the recovery tail so the sweep converges
+    for i in range(n_preempt_waves):
+        t = jitter(churn_lo, churn_hi * 0.7)
+        events.append(ChaosEvent(t, "preempt_pressure", {
+            "wave": i,
+            "filler_count": max(4, tg_count),
+            "filler_cpu": cpu * 3,
+            "memory_mb": memory_mb,
+        }))
+        events.append(ChaosEvent(
+            min(t + jitter(0.8, 1.5), recover_by), "hipri_job",
+            {"job_id": f"preempt-hi-{i}", "count": max(2, tg_count // 2),
+             "cpu": cpu * 2, "memory_mb": memory_mb, "priority": 90},
+        ))
+        events.append(ChaosEvent(
+            min(t + jitter(2.5, 4.0), recover_by),
+            "preempt_release", {"wave": i},
         ))
 
     # -- the leader kill -----------------------------------------------

@@ -45,6 +45,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..server.eval_broker import NotOutstandingError, TokenMismatchError
+from ..server.raft import NotLeaderError
 from ..structs.structs import Plan, PlanResult
 from ..utils import metrics
 from .queues import BoundedStageQueue
@@ -223,6 +224,16 @@ class AsyncApplier:
             return  # watchdog or shutdown got here first
         try:
             result: PlanResult = fut.result()
+        except NotLeaderError:
+            # leadership lost mid-apply: this node can no longer commit
+            # anything, so redispatching would only re-fail — or worse,
+            # double-commit after the new leader reruns the eval. Nack
+            # straight back (best-effort: the revoke-time broker flush may
+            # already have closed the unack) and let the new leader's
+            # eval restore redeliver the wave.
+            metrics.incr_counter("nomad.pipeline.not_leader")
+            self._finish(rec, ack=False, why="not_leader")
+            return
         except Exception:  # noqa: BLE001 — per-payload FSM error
             metrics.incr_counter("nomad.pipeline.apply_error")
             self._finish(rec, ack=False, why="apply_error")

@@ -17,7 +17,13 @@ from .transport import RPCClient, RPCServer
 
 def bind_server(server, rpc: RPCServer) -> None:
     """Register every server endpoint on the transport."""
-    state = server.fsm.state
+
+    def state():
+        # resolved per-call, never captured: fsm.restore() (snapshot
+        # install on a rejoining replica) REPLACES server.fsm.state, and
+        # endpoints bound to the old store would answer from pre-restore
+        # state forever (empty, on a crash-restarted follower)
+        return server.fsm.state
 
     # -- Status --------------------------------------------------------
     rpc.register("Status.ping", lambda: "pong")
@@ -31,11 +37,12 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Node.UpdateDrain", server.update_node_drain)
     rpc.register("Node.UpdateEligibility", server.update_node_eligibility)
     rpc.register("Node.UpdateAlloc", server.update_allocs_from_client)
-    rpc.register("Node.List", lambda: [n.without_secret() for n in state.nodes()])
+    rpc.register("Node.List",
+                 lambda: [n.without_secret() for n in state().nodes()])
     rpc.register(
         "Node.GetNode",
         lambda node_id: (lambda n: n.without_secret() if n else None)(
-            state.node_by_id(node_id)
+            state().node_by_id(node_id)
         ),
     )
 
@@ -49,7 +56,7 @@ def bind_server(server, rpc: RPCServer) -> None:
                 out.append(a)
             return out
 
-        allocs, index = state.blocking_query(run, min_index, timeout=timeout)
+        allocs, index = state().blocking_query(run, min_index, timeout=timeout)
         return [allocs, index]
 
     rpc.register("Node.GetClientAllocs", get_client_allocs)
@@ -58,16 +65,17 @@ def bind_server(server, rpc: RPCServer) -> None:
     # -- Job -----------------------------------------------------------
     rpc.register("Job.Register", server.register_job)
     rpc.register("Job.Deregister", server.deregister_job)
-    rpc.register("Job.GetJob", state.job_by_id)
-    rpc.register("Job.List", lambda: state.jobs())
+    rpc.register("Job.GetJob", lambda ns, job_id: state().job_by_id(ns, job_id))
+    rpc.register("Job.List", lambda: state().jobs())
     rpc.register(
         "Job.Allocations",
-        lambda ns, job_id: state.allocs_by_job(ns, job_id, True),
+        lambda ns, job_id: state().allocs_by_job(ns, job_id, True),
     )
-    rpc.register("Job.Evaluations", state.evals_by_job)
+    rpc.register("Job.Evaluations",
+                 lambda ns, job_id: state().evals_by_job(ns, job_id))
     rpc.register("Job.GetJobVersions",
-                 lambda ns, job_id: state.job_versions.get((ns, job_id), []))
-    rpc.register("Job.Summary", state.job_summary)
+                 lambda ns, job_id: state().job_versions.get((ns, job_id), []))
+    rpc.register("Job.Summary", lambda ns, job_id: state().job_summary(ns, job_id))
     # write endpoints the HTTP agent reaches through leader_forward when
     # serving on a follower (reference job_endpoint.go Evaluate/Dispatch/
     # Revert/Stable, alloc_endpoint.go Stop, node_endpoint.go Evaluate,
@@ -81,9 +89,10 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("System.GC", server.force_gc)
 
     # -- Eval ----------------------------------------------------------
-    rpc.register("Eval.GetEval", state.eval_by_id)
-    rpc.register("Eval.List", lambda: state.evals())
-    rpc.register("Eval.Allocations", state.allocs_by_eval)
+    rpc.register("Eval.GetEval", lambda eval_id: state().eval_by_id(eval_id))
+    rpc.register("Eval.List", lambda: state().evals())
+    rpc.register("Eval.Allocations",
+                 lambda eval_id: state().allocs_by_eval(eval_id))
 
     # -- worker protocol (follower workers dequeue from the leader's
     #    broker and submit plans to its queue: worker.go:161 Eval.Dequeue,
@@ -125,13 +134,14 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Plan.Submit", plan_submit)
 
     # -- Alloc ---------------------------------------------------------
-    rpc.register("Alloc.GetAlloc", state.alloc_by_id)
-    rpc.register("Alloc.List", lambda: state.allocs())
+    rpc.register("Alloc.GetAlloc", lambda alloc_id: state().alloc_by_id(alloc_id))
+    rpc.register("Alloc.List", lambda: state().allocs())
 
     # -- Deployment ----------------------------------------------------
     dw = server.deployment_watcher
-    rpc.register("Deployment.List", lambda: state.deployments())
-    rpc.register("Deployment.GetDeployment", state.deployment_by_id)
+    rpc.register("Deployment.List", lambda: state().deployments())
+    rpc.register("Deployment.GetDeployment",
+                 lambda deployment_id: state().deployment_by_id(deployment_id))
     rpc.register("Deployment.Promote", dw.promote)
     rpc.register("Deployment.Pause", dw.pause)
     rpc.register("Deployment.Fail", dw.fail)
@@ -145,7 +155,7 @@ def bind_server(server, rpc: RPCServer) -> None:
 
     # -- Operator ------------------------------------------------------
     def scheduler_get_config():
-        index, config = state.scheduler_config()
+        index, config = state().scheduler_config()
         return [index, config]
 
     rpc.register("Operator.SchedulerGetConfiguration", scheduler_get_config)
@@ -153,6 +163,16 @@ def bind_server(server, rpc: RPCServer) -> None:
         "Operator.SchedulerSetConfiguration",
         lambda config: server.raft_apply("scheduler-config", config)[0],
     )
+    # raft introspection + snapshot trigger (operator_endpoint.go
+    # RaftGetConfiguration / the `nomad operator snapshot save` surface).
+    # Callers probing a SPECIFIC replica (the chaos crash harness polling
+    # each survivor for leadership/catch-up) must pass no_forward=True,
+    # or leader forwarding answers for the wrong node.
+    rpc.register("Operator.RaftStats",
+                 lambda: server.raft.stats(server.peer))
+    rpc.register("Operator.SnapshotSave",
+                 lambda: server.raft.snapshot(server.peer))
+    rpc.register("Eval.BrokerStats", server.eval_broker.stats)
 
 
 class RemoteServerProxy:

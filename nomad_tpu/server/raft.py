@@ -37,6 +37,9 @@ class InProcRaft:
 
     def __init__(self, data_dir: Optional[str] = None, sync_writes: bool = False) -> None:
         self._lock = threading.RLock()
+        # serializes whole snapshot() operations with each other, never
+        # with apply(): the durable write happens outside _lock
+        self._snap_lock = threading.Lock()
         self.log: List[Tuple[int, str, object]] = []
         self.last_index = 0
         self.fsms: List[NomadFSM] = []
@@ -45,6 +48,8 @@ class InProcRaft:
         self.sync_writes = sync_writes
         self.store = None
         self._snapshot_path = None
+        self._snapshot_state: Optional[bytes] = None
+        self._snapshot_index = 0
         if data_dir is not None:
             from ..native.log import NativeLog
 
@@ -87,12 +92,24 @@ class InProcRaft:
 
     def snapshot(self, peer: int) -> int:
         """Persist the peer's FSM state; compact the durable log behind it
-        (fsm.go:1059 Snapshot / SnapshotAfter)."""
-        with self._lock:
-            if self.store is None or self._snapshot_path is None:
-                return 0
-            state = self.fsms[peer].snapshot()
-            index = self.last_index
+        (fsm.go:1059 Snapshot / SnapshotAfter).
+
+        The (state, index) pair is captured atomically under ``_lock`` —
+        a snapshot must never claim an index whose mutations it does not
+        contain — but serialization and the fsync'd write happen OUTSIDE
+        the lock, so concurrent ``apply`` traffic never stalls behind a
+        large FSM dump. Installation re-checks under ``_lock`` that no
+        newer snapshot landed meanwhile."""
+        with self._snap_lock:
+            with self._lock:
+                if self.store is None or self._snapshot_path is None:
+                    return 0
+                state = self.fsms[peer].snapshot()
+                index = self.last_index
+                if index <= self._snapshot_index:
+                    return self._snapshot_index
+            # safe off-lock: StateStore.snapshot() is a point-in-time copy
+            # whose rows are never mutated in place by later applies
             state_blob = pickle.dumps(state)
             blob = pickle.dumps((index, state_blob))
             tmp = self._snapshot_path + ".tmp"
@@ -100,15 +117,37 @@ class InProcRaft:
                 f.write(blob)
                 f.flush()
                 os.fsync(f.fileno())
-            os.replace(tmp, self._snapshot_path)
-            self.store.truncate_before(index + 1)
-            self.store.sync()
-            # compact the in-memory log too, and refresh the cached snapshot
-            # state future join() calls restore from
-            self._snapshot_state = state_blob
-            self.log = [e for e in self.log if e[0] > index]
-            self._snapshot_index = index
-            return index
+            with self._lock:
+                if self.store is None or index <= self._snapshot_index:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+                    return self._snapshot_index
+                os.replace(tmp, self._snapshot_path)
+                self.store.truncate_before(index + 1)
+                self.store.sync()
+                # compact the in-memory log too, and refresh the cached
+                # snapshot state future join() calls restore from
+                self._snapshot_state = state_blob
+                self.log = [e for e in self.log if e[0] > index]
+                self._snapshot_index = index
+                return index
+
+    def stats(self, peer: int = 0) -> dict:
+        """WireRaft-shaped introspection (Operator.RaftStats)."""
+        with self._lock:
+            return {
+                "state": "leader" if self.leader_idx == peer else "follower",
+                "term": 0,
+                "leader_id": self.leader_idx,
+                "last_index": self.last_index,
+                "commit_index": self.last_index,
+                "applied_index": self.last_index,
+                "num_peers": max(0, len(self.fsms) - 1),
+                "snapshot_index": self._snapshot_index,
+                "snapshots_installed": 0,
+            }
 
     def close(self) -> None:
         if self.store is not None:

@@ -108,8 +108,10 @@ class WireRaft:
         self._clients: Dict[str, RPCClient] = {}
 
         self._lock = threading.RLock()
+        self._snap_lock = threading.Lock()
         self._commit_cv = threading.Condition(self._lock)
         self._repl_cv = threading.Condition(self._lock)
+        self._snapshots_installed = 0
 
         # persistent state
         self.current_term = 0
@@ -206,37 +208,60 @@ class WireRaft:
             return index, self._apply_results.pop(index, None)
 
     def snapshot(self, peer: int = 0) -> int:
-        """Snapshot the FSM and compact the log (fsm.go:1059)."""
-        with self._lock:
-            if self.fsm is None:
-                return 0
-            index = self.last_applied
-            if index == 0:
-                return 0
-            term = self._term_at(index)
-            state_blob = _encode_fsm_state(self.fsm.snapshot())
-            self._snapshot_state = state_blob
-            self._snapshot_term = term
-            # membership rides the snapshot (hashicorp/raft stores the
-            # configuration in snapshot meta): a follower caught up via
-            # InstallSnapshot must learn peers whose PEER_ADD entries
-            # were compacted away
-            self._snapshot_config = self._config_snapshot_locked()
-            self.log = [e for e in self.log if e[0] > index]
-            self._snapshot_index = index
+        """Snapshot the FSM and compact the log (fsm.go:1059).
+
+        Capture (state, applied index, term, membership) is atomic under
+        ``_lock``; the codec encode and the fsync'd file write run OUTSIDE
+        it, so a large FSM dump never stalls appends, commit advancement
+        or the replicator heartbeats (a leader serializing a big snapshot
+        under the lock reads as a dead leader to its peers). Installation
+        re-checks under ``_lock`` that no newer snapshot — e.g. a
+        concurrent InstallSnapshot — landed meanwhile."""
+        with self._snap_lock:
+            with self._lock:
+                if self.fsm is None:
+                    return 0
+                index = self.last_applied
+                if index == 0:
+                    return 0
+                if index <= self._snapshot_index:
+                    return self._snapshot_index
+                term = self._term_at(index)
+                state = self.fsm.snapshot()
+                # membership rides the snapshot (hashicorp/raft stores the
+                # configuration in snapshot meta): a follower caught up via
+                # InstallSnapshot must learn peers whose PEER_ADD entries
+                # were compacted away
+                config = self._config_snapshot_locked()
+            # safe off-lock: fsm.snapshot() is a point-in-time store copy
+            # whose rows later applies never mutate in place
+            state_blob = _encode_fsm_state(state)
+            tmp = None
             if self._snapshot_path is not None:
                 tmp = self._snapshot_path + ".tmp"
                 with open(tmp, "wb") as f:
-                    f.write(codec_encode(
-                        (index, term, state_blob, self._snapshot_config)
-                    ))
+                    f.write(codec_encode((index, term, state_blob, config)))
                     f.flush()
                     os.fsync(f.fileno())
-                os.replace(tmp, self._snapshot_path)
-            if self.store is not None:
-                self.store.truncate_before(index + 1)
-                self.store.sync()
-            return index
+            with self._lock:
+                if index <= self._snapshot_index:
+                    if tmp is not None:
+                        try:
+                            os.remove(tmp)
+                        except OSError:
+                            pass
+                    return self._snapshot_index
+                self._snapshot_state = state_blob
+                self._snapshot_term = term
+                self._snapshot_config = config
+                self.log = [e for e in self.log if e[0] > index]
+                self._snapshot_index = index
+                if tmp is not None:
+                    os.replace(tmp, self._snapshot_path)
+                if self.store is not None:
+                    self.store.truncate_before(index + 1)
+                    self.store.sync()
+                return index
 
     def close(self) -> None:
         self._shutdown.set()
@@ -906,11 +931,12 @@ class WireRaft:
                 self.fsm.restore(_decode_fsm_state(state_blob))
             self.last_applied = last_index
             self.commit_index = max(self.commit_index, last_index)
+            self._snapshots_installed += 1
             return self.current_term
 
     # -- introspection ---------------------------------------------------
 
-    def stats(self) -> dict:
+    def stats(self, peer: int = 0) -> dict:
         with self._lock:
             return {
                 "state": self.state,
@@ -920,4 +946,6 @@ class WireRaft:
                 "commit_index": self.commit_index,
                 "applied_index": self.last_applied,
                 "num_peers": len(self.peers),
+                "snapshot_index": self._snapshot_index,
+                "snapshots_installed": self._snapshots_installed,
             }

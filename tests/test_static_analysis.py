@@ -821,3 +821,112 @@ def test_fault_injection_flags_module_scope_arm():
     fs = run_source(src, "tests/test_chaos.py")
     assert [f.rule for f in fs] == ["fault-injection-discipline"]
     assert "module scope" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# subprocess-discipline
+
+
+def test_subprocess_flags_run_without_timeout():
+    src = dedent("""
+        import subprocess
+
+        def launch():
+            subprocess.run(["server", "--once"], check=True)
+    """)
+    fs = run_source(src, "tests/test_crash.py")
+    assert [f.rule for f in fs] == ["subprocess-discipline"]
+    assert "timeout" in fs[0].message
+
+
+def test_subprocess_accepts_bounded_run():
+    src = dedent("""
+        import subprocess
+
+        def launch():
+            subprocess.run(["server", "--once"], check=True, timeout=30)
+    """)
+    assert run_source(src, "tests/test_crash.py") == []
+
+
+def test_subprocess_flags_unbounded_proc_wait():
+    src = dedent("""
+        def reap(proc):
+            proc.kill()
+            proc.wait()
+    """)
+    fs = run_source(src, "nomad_tpu/chaos/crash.py")
+    assert [f.rule for f in fs] == ["subprocess-discipline"]
+    assert ".wait()" in fs[0].message
+
+
+def test_subprocess_accepts_bounded_wait_and_lock_wait():
+    # a condition-variable wait() is not a process reap: no finding
+    src = dedent("""
+        def reap(proc, cond):
+            proc.kill()
+            proc.wait(timeout=10)
+            with cond:
+                cond.wait()
+    """)
+    assert run_source(src, "nomad_tpu/chaos/crash.py") == []
+
+
+def test_subprocess_flags_unowned_popen():
+    # local Popen, no finally reap, not a self-attribute: leaks on the
+    # first exception between spawn and reap
+    src = dedent("""
+        import subprocess
+
+        def boot():
+            proc = subprocess.Popen(["server"])
+            wait_ready(proc)
+            return proc
+    """)
+    fs = run_source(src, "tests/test_crash.py")
+    assert [f.rule for f in fs] == ["subprocess-discipline"]
+    assert "Popen" in fs[0].message
+
+
+def test_subprocess_accepts_finally_reaped_popen():
+    src = dedent("""
+        import subprocess
+
+        def boot():
+            proc = subprocess.Popen(["server"])
+            try:
+                wait_ready(proc)
+            finally:
+                proc.kill()
+                proc.wait(timeout=10)
+    """)
+    assert run_source(src, "tests/test_crash.py") == []
+
+
+def test_subprocess_accepts_class_owned_popen():
+    # the ServerProcess pattern: Popen held as a self-attribute of a
+    # class that defines a reap method
+    src = dedent("""
+        import subprocess
+
+        class Proc:
+            def spawn(self):
+                self.proc = subprocess.Popen(["server"])
+
+            def terminate(self):
+                self.proc.terminate()
+                self.proc.wait(timeout=10)
+    """)
+    assert run_source(src, "nomad_tpu/chaos/crash.py") == []
+
+
+def test_subprocess_scoped_to_harness_code():
+    # production client drivers manage their own lifecycles: out of scope
+    src = dedent("""
+        import subprocess
+
+        def start_task():
+            p = subprocess.Popen(["workload"])
+            return p
+    """)
+    assert run_source(src, "nomad_tpu/client/drivers/exec_driver.py") == []

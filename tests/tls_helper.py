@@ -12,7 +12,8 @@ def make_cluster_certs(directory: str, names=("server", "client")) -> dict:
     os.makedirs(directory, exist_ok=True)
 
     def run(*args):
-        subprocess.run(args, check=True, capture_output=True, cwd=directory)
+        subprocess.run(args, check=True, capture_output=True, cwd=directory,
+                       timeout=60)
 
     ca_key = os.path.join(directory, "ca.key")
     ca_crt = os.path.join(directory, "ca.crt")
