@@ -17,6 +17,7 @@ chunked throughput mode, and the remaining BASELINE system configs.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import sys
@@ -55,6 +56,41 @@ def write_artifact(name, payload):
         os.replace(tmp, path)
     except Exception as e:  # noqa: BLE001
         log(f"artifact write failed for {name}: {e}")
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: every system config runs with the recorder armed and
+# spilling {server}.flight.jsonl under the artifact dir (written+flushed
+# every tick by the recorder itself, so a SIGKILL loses at most one frame).
+# The derived ranked bottleneck report lands as {config}.bottleneck.json
+# from the config's normal path, its finally, AND an atexit hook — a
+# timed-out headline is still self-diagnosing from disk.
+# ---------------------------------------------------------------------------
+
+_PENDING_FLIGHT = {}
+
+
+def _flush_flight(name, server):
+    """Write the ranked critical-path bottleneck report (+ recorder
+    overhead) for one system config. Idempotent and never raises."""
+    try:
+        from nomad_tpu.trace import attribution
+
+        report = attribution.bottleneck_report()
+        report["flight"] = dict(armed=server.flight.armed,
+                                **server.flight.overhead())
+        write_artifact(f"{name}.bottleneck", report)
+        return report
+    except Exception as e:  # noqa: BLE001
+        log(f"flight flush failed for {name}: {e}")
+        return None
+
+
+@atexit.register
+def _flush_pending_flight():
+    for name, fn in list(_PENDING_FLIGHT.items()):
+        fn()
+    _PENDING_FLIGHT.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -418,8 +454,13 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
         deterministic=deterministic,
         device_min_placements=device_min_placements,
         heartbeat_min_ttl=3600, heartbeat_max_ttl=7200,
-    ))
+        flight_spill_dir=_ARTIFACT_DIR,
+    ), name=name)
     server.start()
+    # crash/timeout insurance: the bottleneck report flushes from the
+    # normal path below, this config's finally, or process atexit —
+    # whichever comes first
+    _PENDING_FLIGHT[name] = lambda: _flush_flight(name, server)
     try:
         if node_factory is not None:
             node_factory(server, n_nodes, rng)
@@ -482,8 +523,12 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                 for k in server.device_batcher.stats:
                     server.device_batcher.stats[k] = 0
 
+        from nomad_tpu.trace import attribution
+        from nomad_tpu.trace import lifecycle as _lifecycle
         from nomad_tpu.utils import phases
 
+        # attribution covers the MEASURED window: drop boot/warmup spans
+        _lifecycle.reset()
         phases.enable()
         p_t0 = phases.now()
         t0 = time.perf_counter()
@@ -555,6 +600,9 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                     "elapsed_s": round(el, 2),
                     "placements_per_s": round(got_now / el, 1) if el else 0.0,
                     "phases": phases.wall_shares(p_t0, phases.now()),
+                    # in-flight critical-path ledger: a run that dies
+                    # mid-window still shows WHERE the wall was going
+                    "bottleneck": attribution.bottleneck_report(top_n=5),
                 })
             # 5ms poll: the completion check is O(table); at 50ms the poll
             # granularity itself dominates sub-second configs
@@ -618,10 +666,22 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             stats = TpuPlacementEngine._shared.parity_sample_stats()
             if stats["evals_sampled"]:
                 out["parity_sample"] = stats
+        report = _flush_flight(name, server)
+        _PENDING_FLIGHT.pop(name, None)
+        if report is not None:
+            # one-line bottleneck verdict rides the config record (the
+            # full ranked ledger is the {name}.bottleneck artifact)
+            out["bottleneck"] = report.get("top")
+            out["attribution_coverage"] = report.get("coverage")
         log(f"system[{name}]: {json.dumps(out)}")
         write_artifact(name, out)
         return out
     finally:
+        # exception/timeout path: flush whatever the recorder has before
+        # the server (and its flight thread) goes down
+        fn = _PENDING_FLIGHT.pop(name, None)
+        if fn is not None:
+            fn()
         server.stop()
 
 
@@ -1055,6 +1115,9 @@ def bench_chaos_churn(name="chaos-churn-5K", seed=0, duration_s=30.0,
             heartbeat_max_ttl=2.5,
             eval_gc_interval=3600.0,
             watchdog_stall_s=10.0,
+            # leader's flight recorder spills chaos-s*.flight.jsonl
+            # under the artifact dir alongside the SLO record
+            flight_spill_dir=_ARTIFACT_DIR,
         ),
         settle_timeout_s=settle_timeout_s,
         # pre-compile the trace's padded eval shapes (tg counts 50 and
@@ -1074,6 +1137,9 @@ def bench_chaos_churn(name="chaos-churn-5K", seed=0, duration_s=30.0,
         eval_ms_p99_max=5_000.0,
         slowest_inflight_ms_max=30_000.0,
         throughput_min_allocs_per_s=25.0,
+        # the run's critical-path ledger must account for >=90% of the
+        # churn makespan or its bottleneck claim is untrustworthy
+        attribution_coverage_min=0.9,
     ))
     slo = gate.evaluate(result)
     record = {
@@ -1086,12 +1152,13 @@ def bench_chaos_churn(name="chaos-churn-5K", seed=0, duration_s=30.0,
     }
     write_artifact(name, record)
     status = "PASS" if slo["passed"] else "FAIL"
+    bottleneck = (result.get("bottleneck_report") or {}).get("top")
     log(f"{name}: {status} — {result['total_allocs']} allocs "
         f"({result['throughput_allocs_per_s']}/s), p99 "
         f"{result['trace_summary'].get('eval_ms_p99')}ms, "
         f"{result['events_degraded']} degraded events, "
         f"{result['leader_kills']} leader kill(s), faults "
-        f"{result['fault_fires']}")
+        f"{result['fault_fires']}, bottleneck: {bottleneck}")
     for check in slo["checks"]:
         log(f"  slo[{check['name']}]: observed={check['observed']} "
             f"bound={check['bound']} passed={check['passed']}")
@@ -1108,6 +1175,9 @@ def bench_chaos_churn(name="chaos-churn-5K", seed=0, duration_s=30.0,
         "fault_fires": result["fault_fires"],
         "leader_kills": result["leader_kills"],
         "events_degraded": result["events_degraded"],
+        "bottleneck": bottleneck,
+        "attribution_coverage": (
+            result.get("bottleneck_report") or {}).get("coverage"),
         "wall_s": round(wall, 2),
     }
 
@@ -1299,6 +1369,9 @@ def main():
         "unit": "placements/s",
         "vs_baseline": round(vs_baseline, 4),
         "headline_status": headline.get("status", "timeout"),
+        # one-line critical-path verdict from the flight recorder: a DNF
+        # ("timeout") names its own bottleneck stage right here
+        "bottleneck": headline.get("bottleneck"),
         "extra": {
             "headline_config": headline,
             "v5e8_extrapolation_s": (

@@ -65,6 +65,11 @@ class AgentConfig:
     telemetry_datadog_address: str = ""
     telemetry_datadog_tags: Dict[str, str] = field(default_factory=dict)
     telemetry_prefix: str = ""
+    # flight recorder (telemetry stanza): leader-owned ~250ms sampler
+    # behind GET /v1/flight; <= 0 interval disables the thread entirely
+    flight_interval_s: float = 0.25
+    flight_retain: int = 1024
+    flight_spill_dir: str = ""
     # multi-process consensus: real raft over the RPC transport instead of
     # the in-proc shared log. Requires gossip; with bootstrap_expect > 1
     # the raft holds elections only once that many servers are known
@@ -237,6 +242,9 @@ class Agent:
                     authoritative_region=self.config.authoritative_region,
                     replication_token=self.config.replication_token,
                     replication_interval=self.config.acl_replication_interval,
+                    flight_interval_s=self.config.flight_interval_s,
+                    flight_retain=self.config.flight_retain,
+                    flight_spill_dir=self.config.flight_spill_dir,
                 ),
                 raft=raft,
                 name=self.config.name,

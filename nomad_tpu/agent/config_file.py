@@ -18,7 +18,8 @@ Nomad config files map over:
     client { enabled node_class servers meta {} host_volume "n" { path } }
     acl { enabled replication_token }
     telemetry { statsd_address statsite_address datadog_address
-                datadog_tags prefix }
+                datadog_tags prefix flight_interval_s flight_retain
+                flight_spill_dir }
     tls { http ca_file cert_file key_file verify_server_hostname }
 
 The file model intentionally covers the knobs this agent implements; an
@@ -113,6 +114,7 @@ _ACL_KEYS = {"enabled", "replication_token", "token_ttl", "policy_ttl"}
 _TELEMETRY_KEYS = {
     "statsd_address", "statsite_address", "datadog_address", "datadog_tags",
     "prefix", "prometheus_metrics", "collection_interval",
+    "flight_interval_s", "flight_retain", "flight_spill_dir",
 }
 _TLS_KEYS = {
     "http", "rpc", "ca_file", "cert_file", "key_file",
@@ -246,6 +248,12 @@ def apply_file_config(cfg: AgentConfig, data: Dict[str, Any]) -> AgentConfig:
         }
     if "prefix" in tel:
         cfg.telemetry_prefix = str(tel["prefix"])
+    if "flight_interval_s" in tel:
+        cfg.flight_interval_s = float(tel["flight_interval_s"])
+    if "flight_retain" in tel:
+        cfg.flight_retain = int(tel["flight_retain"])
+    if "flight_spill_dir" in tel:
+        cfg.flight_spill_dir = str(tel["flight_spill_dir"])
 
     tls = data.get("tls") or {}
     _check_keys(tls, _TLS_KEYS, "tls")

@@ -122,6 +122,7 @@ class Routes:
         r("/v1/search", self.search)
         r("/v1/metrics", self.metrics)
         r("/v1/trace", self.trace)
+        r("/v1/flight", self.flight)
 
     # -- jobs ------------------------------------------------------------
 
@@ -825,6 +826,26 @@ class Routes:
             out["workers"] = srv.watchdog.worker_spans()
             if srv.device_batcher is not None:
                 out["dispatch_profile"] = srv.device_batcher.dispatch_profile()
+        return out
+
+    def flight(self, req: Request):
+        """Flight-recorder snapshot (nomad-flightrec): the last N frames
+        of the leader's continuous sampler plus the live critical-path
+        bottleneck report. ?recent=N bounds the frame tail (default 64);
+        a non-server (client-only) agent serves the attribution report
+        with no frames."""
+        from ..trace import attribution
+
+        try:
+            recent = int(req.param("recent") or 64)
+        except ValueError:
+            raise HTTPError(400, "recent must be an integer")
+        srv = self.agent.server
+        if srv is not None:
+            out = srv.flight.snapshot(recent=max(0, recent))
+        else:
+            out = {"armed": False, "frames": []}
+        out["bottleneck_report"] = attribution.bottleneck_report()
         return out
 
     def search(self, req: Request):

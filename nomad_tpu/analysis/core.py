@@ -146,6 +146,7 @@ def default_checkers() -> list:
     from .fsm_determinism import FsmDeterminismChecker
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
+    from .metrics_discipline import MetricsDisciplineChecker
     from .pipeline_stage_discipline import PipelineStageDisciplineChecker
     from .subprocess_discipline import SubprocessDisciplineChecker
     from .trace_span_discipline import TraceSpanDisciplineChecker
@@ -159,6 +160,7 @@ def default_checkers() -> list:
         PipelineStageDisciplineChecker(),
         FaultInjectionDisciplineChecker(),
         SubprocessDisciplineChecker(),
+        MetricsDisciplineChecker(),
     ]
 
 

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..rpc.transport import RPCClient, RPCError
 from ..trace import failover
+from ..trace.flight import FlightRecorder
 from .replay import _RETRYABLE, ChurnReplay
 from .trace import ChaosEvent
 
@@ -297,6 +298,12 @@ class CrashReplay(ChurnReplay):
         self._owns_base = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="nomad-crash-")
         self.failover_info: Dict[str, object] = {}
+        # parent-side flight recorder: the replicas are separate
+        # processes, so the harness samples them over RPC (RaftStats +
+        # BrokerStats per replica, no_forward) — the frame ring is the
+        # failover's black box: which term each replica saw, when the
+        # broker drained, when the killed node went dark
+        self.harness_flight: Optional[FlightRecorder] = None
 
     # -- cluster plumbing overrides ---------------------------------------
 
@@ -315,6 +322,29 @@ class CrashReplay(ChurnReplay):
         for sp in self.procs.values():
             sp.wait_ready()
         failover.reset()
+        self.harness_flight = FlightRecorder(interval_s=1.0, retain=512)
+        for nid, sp in self.procs.items():
+            self.harness_flight.add_probe(
+                f"replica:{nid}", self._mk_replica_probe(sp))
+        self.harness_flight.arm()
+
+    def _mk_replica_probe(self, sp: ServerProcess):
+        def probe() -> Dict[str, object]:
+            if not sp.alive():
+                return {"alive": False}
+            # a mid-failover replica answers slowly or not at all; the
+            # 1s RPC bound keeps the tick loop live and the recorder
+            # stores the raised error as the frame's value
+            raft = sp.call("Operator.RaftStats", no_forward=True, timeout=1.0)
+            broker = sp.call("Eval.BrokerStats", no_forward=True, timeout=1.0)
+            return {"alive": True, "raft": raft, "broker": broker}
+        return probe
+
+    def _flight_stats(self) -> Dict[str, object]:
+        fl = self.harness_flight
+        if fl is None:
+            return {}
+        return {"harness": dict(armed=fl.armed, **fl.overhead())}
 
     def _find_leader_proc(self, timeout: float = 5.0,
                           min_term: int = 0) -> ServerProcess:
@@ -501,10 +531,15 @@ class CrashReplay(ChurnReplay):
         return counts
 
     def _extra_result(self) -> Dict[str, object]:
-        return {
+        out: Dict[str, object] = {
             "failover": dict(self.failover_info),
             "killed_servers": list(self._killed),
         }
+        if self.harness_flight is not None:
+            # last few frames: per-replica raft/broker state leading into
+            # measurement (the kill + re-election are visible here)
+            out["flight_tail"] = self.harness_flight.frames(recent=4)
+        return out
 
     def _set_service_preemption(self) -> None:
         from ..structs.structs import PreemptionConfig, SchedulerConfiguration
@@ -519,6 +554,8 @@ class CrashReplay(ChurnReplay):
         lp.call("Operator.SchedulerSetConfiguration", cfg)
 
     def _shutdown(self) -> None:
+        if self.harness_flight is not None:
+            self.harness_flight.disarm()
         super()._shutdown()   # stops the heartbeat pump (servers list is empty)
         for sp in self.procs.values():
             try:

@@ -37,7 +37,7 @@ from .. import mock
 from ..rpc.transport import RPCError
 from ..server.raft import InProcRaft, NotLeaderError
 from ..server.server import Server, ServerConfig
-from ..trace import lifecycle
+from ..trace import attribution, lifecycle
 from .injector import ChaosFault, ChaosInjector
 from .trace import ChaosEvent, generate_trace, trace_kind_counts
 
@@ -230,6 +230,18 @@ class ChurnReplay:
             s.name: s.fsm.state.count_allocs_desired_run()
             for s in self.servers
         }
+
+    def _flight_stats(self) -> Dict[str, object]:
+        """Per-server flight-recorder health (armed only on the leader;
+        the crash harness's out-of-proc replicas have no in-proc
+        recorder and report nothing here)."""
+        out: Dict[str, object] = {}
+        for s in self.servers:
+            fl = getattr(s, "flight", None)
+            if fl is not None:
+                out[getattr(s, "name", "?")] = dict(
+                    armed=fl.armed, **fl.overhead())
+        return out
 
     def _extra_result(self) -> Dict[str, object]:
         """Harness-specific additions merged into the run() result."""
@@ -633,6 +645,12 @@ class ChurnReplay:
             "throughput_allocs_per_s": round(total_allocs / run_duration, 2)
             if run_duration > 0 else None,
             "trace_summary": lifecycle.summary(),
+            # wave-level critical-path ledger over the churn window: the
+            # ranked decomposition names the stage the wall went to, and
+            # its coverage self-check is SLO-gateable
+            # (attribution_coverage_min)
+            "bottleneck_report": attribution.bottleneck_report(),
+            "flight": self._flight_stats(),
             "invariants": inv,
             "errors": self.errors[:20],
         }

@@ -31,6 +31,11 @@ class SLOThresholds:
     failover_new_leader_ms_max: Optional[float] = None
     failover_first_commit_ms_max: Optional[float] = None
     require_rejoin: bool = False
+    # minimum critical-path attribution coverage (the result's
+    # "bottleneck_report" block from nomad_tpu.trace.attribution): below
+    # this the instrumentation lost track of where the wall went and the
+    # run's bottleneck claim is untrustworthy
+    attribution_coverage_min: Optional[float] = None
 
     def to_dict(self) -> Dict[str, object]:
         return {
@@ -43,6 +48,7 @@ class SLOThresholds:
             "failover_new_leader_ms_max": self.failover_new_leader_ms_max,
             "failover_first_commit_ms_max": self.failover_first_commit_ms_max,
             "require_rejoin": self.require_rejoin,
+            "attribution_coverage_min": self.attribution_coverage_min,
         }
 
 
@@ -121,6 +127,12 @@ class SLOGate:
         if th.require_rejoin:
             rejoined = fo.get("rejoined")
             check("killed_server_rejoined", rejoined, True, bool(rejoined))
+
+        if th.attribution_coverage_min is not None:
+            rep = result.get("bottleneck_report") or {}
+            cov = rep.get("coverage")
+            check("attribution_coverage", cov, th.attribution_coverage_min,
+                  cov is not None and cov >= th.attribution_coverage_min)
 
         passed = all(c["passed"] is not False for c in checks)
         return {

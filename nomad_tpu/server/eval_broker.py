@@ -99,6 +99,10 @@ class EvalBroker:
         self.time_wait: Dict[str, threading.Timer] = {}
         # delayed evals (wait_until) handled by a timer per eval too
         self._delayed: Dict[str, threading.Timer] = {}
+        # workers currently parked in dequeue() waiting for a ready eval
+        # (flight-recorder probe: high waiters + nonzero ready = dequeue
+        # contention; high waiters + zero ready = starvation upstream)
+        self._dequeue_waiters = 0
 
     # ------------------------------------------------------------------
 
@@ -199,12 +203,20 @@ class EvalBroker:
                 if ev_token is not None:
                     return ev_token
                 if deadline is None:
-                    self._cond.wait(timeout=1.0)
+                    self._dequeue_waiters += 1
+                    try:
+                        self._cond.wait(timeout=1.0)
+                    finally:
+                        self._dequeue_waiters -= 1
                 else:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         return None, ""
-                    self._cond.wait(timeout=remaining)
+                    self._dequeue_waiters += 1
+                    try:
+                        self._cond.wait(timeout=remaining)
+                    finally:
+                        self._dequeue_waiters -= 1
                 if not self.enabled:
                     return None, ""
 
@@ -363,5 +375,6 @@ class EvalBroker:
                 "total_unacked": len(self.unack),
                 "total_blocked": sum(len(h) for h in self.blocked.values()),
                 "total_waiting": len(self.time_wait) + len(self._delayed),
+                "dequeue_waiters": self._dequeue_waiters,
                 "by_scheduler": by_sched,
             }

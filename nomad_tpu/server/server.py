@@ -109,6 +109,13 @@ class ServerConfig:
     # watchdog_interval <= 0 disables the tick entirely.
     watchdog_interval: float = 10.0
     watchdog_stall_s: float = 30.0
+    # flight recorder (nomad-flightrec): leader-owned background sampler
+    # snapshotting gauges + direct probes every flight_interval_s into a
+    # bounded ring of flight_retain frames, optionally spilling JSONL
+    # under flight_spill_dir. <= 0 disables (strict no-op: no thread).
+    flight_interval_s: float = 0.25
+    flight_retain: int = 1024
+    flight_spill_dir: str = ""
     scheduler_algorithm: str = "tpu_binpack"
     # chunked throughput tier (scheduler_algorithm = "tpu_binpack_chunked"):
     # top-K chunk size per scan step, and the fraction of chunk-placed
@@ -276,11 +283,34 @@ class Server:
         # liveness watchdog: ticked from the leader timer loop (below);
         # the instance survives leadership churn, its progress baseline
         # re-seeds on the first tick of each generation
-        from ..trace import LivenessWatchdog
+        from ..trace import FlightRecorder, LivenessWatchdog, \
+            install_server_probes
 
         self.watchdog = LivenessWatchdog(
             self, stall_after=self.config.watchdog_stall_s
         )
+
+        # flight recorder: armed with leadership (below), so followers
+        # pay nothing; probes are wired once here — they all read through
+        # self.* and survive leadership churn
+        spill = None
+        if self.config.flight_spill_dir:
+            import os as _os
+
+            _os.makedirs(self.config.flight_spill_dir, exist_ok=True)
+            spill = _os.path.join(
+                self.config.flight_spill_dir, f"{name}.flight.jsonl"
+            )
+        self.flight = FlightRecorder(
+            interval_s=self.config.flight_interval_s,
+            retain=self.config.flight_retain,
+            spill_path=spill,
+        )
+        install_server_probes(self.flight, self)
+        # the recorder tick drives the gauge publish so /v1/metrics stays
+        # fresh even when the 10s stats sweep hasn't run yet (bench and
+        # chaos harnesses poll gauges without an agent)
+        self.flight.add_publisher(self.publish_stats_gauges)
 
         # Join before observing: the join-time election fires observers, and
         # start() handles the initial-leadership case explicitly.
@@ -312,8 +342,18 @@ class Server:
         from ..utils import phases
 
         chaos_fire("raft_apply", entry_type=entry_type)
-        with phases.track("raft_fsm"):
-            return self.raft.apply(self.peer, entry_type, payload)
+        from ..trace import lifecycle as _lc
+
+        t0 = _lc.pipeline_now()
+        try:
+            with phases.track("raft_fsm"):
+                return self.raft.apply(self.peer, entry_type, payload)
+        finally:
+            # same span on the lifecycle (monotonic) clock, keyed by entry
+            # type: attribution joins it against the wave windows (phases
+            # uses perf_counter and bench-window unions — wrong clock and
+            # wrong granularity for per-wave critical paths)
+            _lc.pipeline_record("raft_fsm", entry_type, t0, _lc.pipeline_now())
 
     def start(self) -> None:
         for i in range(self.config.num_schedulers):
@@ -378,11 +418,13 @@ class Server:
         self._schedule_leader_task(gen, self.config.unblock_failed_interval,
                                    self._reap_failed_evals)
         self._schedule_leader_task(gen, self.config.eval_gc_interval, self._create_gc_evals)
-        self._schedule_leader_task(gen, 10.0, self._emit_stats)
+        self._schedule_leader_task(gen, 10.0, self.publish_stats_gauges)
         if self.config.watchdog_interval > 0:
             self._schedule_leader_task(
                 gen, self.config.watchdog_interval, self.watchdog.tick
             )
+        # flight recorder flies with leadership: followers run no sampler
+        self.flight.arm()
         if self.vault is not None:
             self._schedule_leader_task(gen, 60.0, self._sweep_vault_accessors)
         if (self.config.authoritative_region
@@ -394,29 +436,34 @@ class Server:
                 gen, self.config.replication_interval, self._replicate_acl
             )
 
-    def _emit_stats(self) -> None:
+    def publish_stats_gauges(self) -> None:
         """Publish broker/blocked/plan-queue gauges (reference
         eval_broker.go:825 EmitStats, blocked_evals.go EmitStats,
-        leader.go:603 job summary metrics)."""
-        from ..utils import metrics
+        leader.go:603 job summary metrics). Driven from BOTH the 10s
+        leader stats sweep and the flight recorder's tick, so gauges on
+        /v1/metrics stay fresh on harnesses with no agent sweep."""
+        from ..utils import metric_names, metrics
 
         bs = self.eval_broker.stats()
         metrics.set_gauge("nomad.broker.total_ready", bs.get("total_ready", 0))
         metrics.set_gauge("nomad.broker.total_unacked", bs.get("total_unacked", 0))
         metrics.set_gauge("nomad.broker.total_blocked", bs.get("total_blocked", 0))
         metrics.set_gauge(
+            "nomad.broker.dequeue_waiters", bs.get("dequeue_waiters", 0)
+        )
+        metrics.set_gauge(
             "nomad.blocked_evals.total_blocked",
             self.blocked_evals.stats().get("total_blocked", 0),
         )
         if self.device_batcher is not None:
-            for key, value in self.device_batcher.stats.items():
-                metrics.set_gauge(f"nomad.device_batcher.{key}", value)
+            metric_names.publish_family(
+                "nomad.device_batcher", self.device_batcher.stats
+            )
         metrics.set_gauge(
             "nomad.plan.queue_depth", self.plan_queue.stats().get("depth", 0)
         )
         if self.pipeline is not None:
-            for key, value in self.pipeline.stats().items():
-                metrics.set_gauge(f"nomad.pipeline.{key}", value)
+            metric_names.publish_family("nomad.pipeline", self.pipeline.stats())
         metrics.set_gauge(
             "nomad.heartbeat.active", self.heartbeaters.num_active()
         )
@@ -445,6 +492,7 @@ class Server:
         self.periodic_dispatcher.set_enabled(False)
         if self.pipeline is not None:
             self.pipeline.set_enabled(False)
+        self.flight.disarm()
         self._leader_generation += 1  # invalidates in-flight leader timers
         with self._lock:
             for t in self._leader_timers:

@@ -203,8 +203,16 @@ class Worker:
             # wait for the raft index WITHOUT the host-work permit (it can
             # block seconds); the snapshot COPY is a pure-GIL table clone —
             # park excess threads for that part only
+            wait_t0 = _lifecycle.pipeline_now()
             with phases.track("wait_index"):
                 self.server.fsm.state.wait_min_index(wait_index)
+            # per-eval SnapshotMinIndex wait span on the lifecycle clock:
+            # the attribution engine joins these against the wave windows
+            # ("wait_min_index: 41% of makespan" names this exact block)
+            _lifecycle.pipeline_record(
+                "wait_min_index", evaluation.id, wait_t0,
+                _lifecycle.pipeline_now(),
+            )
             with HOST_WORK_SEM:
                 with phases.track("snapshot"):
                     # read-only shared view: a burst of evals at one state

@@ -61,6 +61,9 @@ class StateStore:
         # last snapshot served by shared_snapshot_min_index (read-only
         # consumers; replaced whenever the live version moves past it)
         self._shared_snap: Optional["StateStore"] = None
+        # callers currently blocked in a *min_index wait (flight-recorder
+        # probe: the SnapshotMinIndex stall surface)
+        self._min_index_waiters = 0
 
         self.nodes_table: Dict[str, Node] = {}
         self.jobs_table: Dict[Tuple[str, str], Job] = {}
@@ -124,6 +127,7 @@ class StateStore:
         d.pop("_lock", None)
         d.pop("_cond", None)
         d.pop("_shared_snap", None)
+        d.pop("_min_index_waiters", None)
         d.pop("_dense_by_id", None)
         d.pop("_dense_by_job", None)
         d.pop("_dense_by_node", None)
@@ -146,6 +150,7 @@ class StateStore:
         if "usage_epoch" not in self.__dict__:
             self.usage_epoch = 0
         self._shared_snap = None
+        self._min_index_waiters = 0
         # Pickles from pre-mirror builds lack the usage mirror: rebuild it
         # from the alloc table so writes and snapshots keep working.
         # pre-dense snapshots lack the dense tables entirely; fresh ones
@@ -203,6 +208,7 @@ class StateStore:
             snap.capacity_epoch = self.capacity_epoch
             snap.usage_epoch = self.usage_epoch
             snap._shared_snap = None
+            snap._min_index_waiters = 0
             snap.nodes_table = dict(self.nodes_table)
             snap.jobs_table = dict(self.jobs_table)
             snap.job_versions = {k: list(v) for k, v in self.job_versions.items()}
@@ -241,22 +247,34 @@ class StateStore:
             snap._jobs_by_parent = {k: set(v) for k, v in self._jobs_by_parent.items()}
             return snap
 
-    def wait_min_index(self, index: int, timeout: float = 5.0) -> None:
-        """Block until the store has applied ``index`` (no snapshot)."""
-        with self._cond:
-            if not self._cond.wait_for(lambda: self.latest_index >= index, timeout=timeout):
+    def _wait_for_index_locked(self, index: int, timeout: float) -> None:
+        """Shared wait body (callers hold self._cond); tracks the waiter
+        count the flight recorder probes."""
+        self._min_index_waiters += 1
+        try:
+            if not self._cond.wait_for(
+                lambda: self.latest_index >= index, timeout=timeout
+            ):
                 raise TimeoutError(
                     f"timed out waiting for index {index} (at {self.latest_index})"
                 )
+        finally:
+            self._min_index_waiters -= 1
+
+    def min_index_waiters(self) -> int:
+        """Callers currently blocked waiting for an applied index."""
+        return getattr(self, "_min_index_waiters", 0)
+
+    def wait_min_index(self, index: int, timeout: float = 5.0) -> None:
+        """Block until the store has applied ``index`` (no snapshot)."""
+        with self._cond:
+            self._wait_for_index_locked(index, timeout)
 
     def snapshot_min_index(self, index: int, timeout: float = 5.0) -> "StateStore":
         """Wait until the store has applied ``index`` then snapshot
         (reference state_store.go:114)."""
         with self._cond:
-            if not self._cond.wait_for(lambda: self.latest_index >= index, timeout=timeout):
-                raise TimeoutError(
-                    f"timed out waiting for index {index} (at {self.latest_index})"
-                )
+            self._wait_for_index_locked(index, timeout)
             return self.snapshot()
 
     def shared_snapshot_min_index(
@@ -275,10 +293,7 @@ class StateStore:
         which folds optimistic results into its snapshot, must keep
         using ``snapshot_min_index``."""
         with self._cond:
-            if not self._cond.wait_for(lambda: self.latest_index >= index, timeout=timeout):
-                raise TimeoutError(
-                    f"timed out waiting for index {index} (at {self.latest_index})"
-                )
+            self._wait_for_index_locked(index, timeout)
             cached = self._shared_snap
             # serve the cached view only while it matches the LIVE
             # version: a fresher-than-requested-but-stale-vs-live view

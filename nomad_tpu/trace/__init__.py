@@ -1,22 +1,37 @@
 """nomad-trace: always-on, low-overhead eval-lifecycle observability.
 
-Three pieces (ISSUE 4 tentpole):
+Pieces (ISSUE 4 tentpole + ISSUE 12 flight recorder):
 
-  lifecycle  per-delivery eval trace records stamped at broker enqueue ->
-             dequeue -> scheduler invoke (host/device path, OCC attempt) ->
-             plan submit -> apply -> ack/nack, with tail-latency gauges
-  watchdog   leader-side liveness monitor: dumps broker stats, per-worker
-             current spans and thread stacks when placement throughput
-             flatlines while evals are in flight
-  (phases)   wall-clock phase attribution lives in utils/phases.py; this
-             package consumes it for the coverage self-check
+  lifecycle    per-delivery eval trace records stamped at broker enqueue
+               -> dequeue -> scheduler invoke (host/device path, OCC
+               attempt) -> plan submit -> apply -> ack/nack, with
+               tail-latency gauges
+  watchdog     leader-side liveness monitor: dumps broker stats,
+               per-worker current spans, thread stacks and the last
+               flight frames when placement throughput flatlines while
+               evals are in flight
+  flight       continuous flight recorder: a leader-owned sampler that
+               snapshots gauges + direct probes into a bounded ring
+               (optional JSONL spill) every ~250ms
+  attribution  critical-path engine: joins lifecycle + pipeline spans
+               into a ranked per-component bottleneck_report() with a
+               coverage self-check
+  (phases)     wall-clock phase attribution lives in utils/phases.py;
+               this package consumes it for the coverage self-check
 
 The reference scatters the same signals across per-call timers
 (nomad/worker.go:245 invoke_scheduler, nomad/plan_apply.go:185/369/400);
 here they are joined per evaluation so a stalled eval is a queryable
 record, not a needle across counters.
 """
-from . import lifecycle
+from . import attribution, lifecycle
+from .flight import FlightRecorder, install_server_probes
 from .watchdog import LivenessWatchdog
 
-__all__ = ["lifecycle", "LivenessWatchdog"]
+__all__ = [
+    "attribution",
+    "lifecycle",
+    "FlightRecorder",
+    "install_server_probes",
+    "LivenessWatchdog",
+]

@@ -1,0 +1,271 @@
+"""Critical-path attribution: turn spans into a ranked bottleneck ledger.
+
+The lifecycle layer records WHAT happened (per-eval stamps, per-wave
+pipeline stage spans, aux spans for ``wait_min_index`` and ``raft_fsm``)
+but not WHY a run was slow. This module joins those spans into an
+exclusive wall-clock decomposition of the makespan and emits
+``bottleneck_report()``: "wait_min_index: 41% of makespan; broker
+dequeue idle: 22%; ...".
+
+The decomposition is a greedy exclusive claim in a fixed precedence
+order (work stages before waits, waits before idle): each instant of
+the makespan is attributed to the HIGHEST-precedence component active
+at that instant. That answers "what was the system doing" the way a
+profiler's self-time does — an eval sitting in the broker queue while
+the device is mid-dispatch is pipelining, not a bottleneck; the same
+queue time with nothing else running is. Components claim only once, so
+the entries sum to at most the makespan and
+
+    coverage = attributed_time / makespan
+
+is a self-check on the span set itself: coverage < 0.9 means the
+instrumentation lost track of what the system was doing and the report
+says so instead of ranking garbage.
+
+All interval math is on the lifecycle clock (``time.monotonic``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import lifecycle
+
+#: claim order: real work first, then ordered waits, then idle. Renaming
+#: or reordering changes report semantics — tests pin this.
+PRECEDENCE: Tuple[str, ...] = (
+    "encode",          # pipeline stage: dense-plan encode
+    "dispatch",        # pipeline stage: device dispatch
+    "evaluate",        # pipeline stage: scheduler evaluate
+    "commit",          # pipeline stage: applier commit
+    "raft_fsm",        # aux span: raft apply + FSM
+    "invoke",          # scheduler think-time not covered by stage spans
+    "wait_min_index",  # aux span: worker blocked on SnapshotMinIndex
+    "commit_wait",     # plan submitted, waiting for the applier
+    "finalize",        # applied, waiting for ack bookkeeping
+    "invoke_wait",     # dequeued, waiting for a scheduler slot
+    "queue_wait",      # enqueued, waiting for a broker dequeue
+    "broker_idle",     # no eval in flight at all (dequeue idle)
+)
+
+COVERAGE_FLOOR = 0.9
+
+Interval = Tuple[float, float]
+
+
+# -- interval algebra -------------------------------------------------------
+
+
+def _merged(spans: Iterable[Interval],
+            lo: Optional[float] = None,
+            hi: Optional[float] = None) -> List[Interval]:
+    """Sorted, coalesced, optionally clipped intervals."""
+    out: List[Interval] = []
+    for a, b in sorted(spans):
+        if lo is not None:
+            a = max(a, lo)
+        if hi is not None:
+            b = min(b, hi)
+        if b <= a:
+            continue
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _length(merged: Sequence[Interval]) -> float:
+    return sum(b - a for a, b in merged)
+
+
+def _subtract(merged: Sequence[Interval],
+              claimed: Sequence[Interval]) -> List[Interval]:
+    """``merged`` minus ``claimed`` (both sorted+coalesced)."""
+    out: List[Interval] = []
+    j = 0
+    for a, b in merged:
+        cur = a
+        while j < len(claimed) and claimed[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(claimed) and claimed[k][0] < b:
+            ca, cb = claimed[k]
+            if ca > cur:
+                out.append((cur, ca))
+            cur = max(cur, cb)
+            if cur >= b:
+                break
+            k += 1
+        if cur < b:
+            out.append((cur, b))
+    return out
+
+
+def _complement(merged: Sequence[Interval], lo: float, hi: float) -> List[Interval]:
+    return _subtract([(lo, hi)], _merged(merged))
+
+
+# -- component extraction ---------------------------------------------------
+
+
+def _record_component_spans(records: Sequence[Dict[str, object]],
+                            now: float) -> Dict[str, List[Interval]]:
+    """Per-component raw intervals from lifecycle records. Open-ended
+    segments (eval still in flight) extend to ``now``."""
+    comps: Dict[str, List[Interval]] = {
+        "queue_wait": [], "invoke_wait": [], "invoke": [],
+        "commit_wait": [], "finalize": [],
+    }
+    for r in records:
+        enq = r.get("enqueue_t")
+        if enq is None:
+            continue
+        end = r.get("end_t") or now
+        deq = r.get("dequeue_t")
+        inv0 = r.get("invoke_start_t")
+        inv1 = r.get("invoke_end_t")
+        sub = r.get("submit_t")
+        app = r.get("apply_t")
+        comps["queue_wait"].append((enq, deq if deq is not None else end))
+        if deq is not None:
+            comps["invoke_wait"].append(
+                (deq, inv0 if inv0 is not None else end))
+        if inv0 is not None:
+            comps["invoke"].append((inv0, inv1 if inv1 is not None else end))
+        if sub is not None:
+            comps["commit_wait"].append((sub, app if app is not None else end))
+        if app is not None:
+            comps["finalize"].append((app, end))
+    return comps
+
+
+def _wave_windows(records: Sequence[Dict[str, object]],
+                  now: float) -> List[Interval]:
+    return _merged(
+        (r["enqueue_t"], r.get("end_t") or now)
+        for r in records if r.get("enqueue_t") is not None
+    )
+
+
+# -- the decomposition ------------------------------------------------------
+
+
+def critical_path(records: Optional[Sequence[Dict[str, object]]] = None,
+                  spans: Optional[Sequence[Tuple[str, str, float, float]]] = None,
+                  now: Optional[float] = None) -> Dict[str, object]:
+    """Exclusive per-component wall-clock decomposition of the makespan.
+
+    ``records``/``spans`` default to the live lifecycle tables; tests
+    pass synthetic sets. Returns makespan bounds, per-component claimed
+    seconds (precedence order) and the coverage self-check.
+    """
+    if records is None:
+        records = lifecycle.raw_records()
+    if spans is None:
+        spans = lifecycle.pipeline_spans()
+    if now is None:
+        now = lifecycle.pipeline_now()
+
+    bounds: List[float] = []
+    for r in records:
+        if r.get("enqueue_t") is not None:
+            bounds.append(r["enqueue_t"])
+            bounds.append(r.get("end_t") or now)
+    for (_s, _w, a, b) in spans:
+        bounds.append(a)
+        bounds.append(b)
+    if not bounds:
+        return {"makespan_s": 0.0, "t0": None, "t1": None, "waves": 0, "components": {},
+                "occ_retries": 0, "coverage": 0.0, "unattributed_s": 0.0}
+    t0, t1 = min(bounds), max(bounds)
+    makespan = t1 - t0
+    if makespan <= 0:
+        return {"makespan_s": 0.0, "t0": t0, "t1": t1, "waves": 0, "components": {},
+                "occ_retries": 0, "coverage": 0.0, "unattributed_s": 0.0}
+
+    comp_spans = _record_component_spans(records, now)
+    occ_retries = sum(1 for r in records if r.get("outcome") == "nack")
+    for stage, _wave, a, b in spans:
+        comp_spans.setdefault(stage, []).append((a, b))
+    comp_spans["broker_idle"] = _complement(_wave_windows(records, now), t0, t1)
+
+    order = list(PRECEDENCE) + sorted(set(comp_spans) - set(PRECEDENCE))
+    claimed: List[Interval] = []
+    components: Dict[str, float] = {}
+    for name in order:
+        raw = comp_spans.get(name)
+        if not raw:
+            continue
+        merged = _merged(raw, t0, t1)
+        exclusive = _subtract(merged, claimed)
+        seconds = _length(exclusive)
+        if seconds > 0:
+            components[name] = seconds
+        claimed = _merged(claimed + exclusive)
+    attributed = _length(claimed)
+    return {
+        "makespan_s": round(makespan, 6),
+        "t0": t0,
+        "t1": t1,
+        "waves": len(_wave_windows(records, now)),
+        "components": {k: round(v, 6) for k, v in components.items()},
+        "occ_retries": occ_retries,
+        "coverage": round(attributed / makespan, 4),
+        "unattributed_s": round(makespan - attributed, 6),
+    }
+
+
+def bottleneck_report(records: Optional[Sequence[Dict[str, object]]] = None,
+                      spans: Optional[Sequence[Tuple[str, str, float, float]]] = None,
+                      now: Optional[float] = None,
+                      top_n: int = 0) -> Dict[str, object]:
+    """The ranked wall-clock ledger. ``entries`` are sorted by claimed
+    seconds (ties broken by name — deterministic for equal span sets);
+    ``top`` is the one-line headline ("wait_min_index: 41% of makespan").
+    ``coverage_ok`` is the >=0.9 self-check: when it fails the top line
+    says the instrumentation lost coverage instead of naming a stage.
+    """
+    cp = critical_path(records, spans, now)
+    makespan = cp["makespan_s"]
+    entries = [
+        {
+            "component": name,
+            "seconds": seconds,
+            "share": round(seconds / makespan, 4) if makespan else 0.0,
+        }
+        for name, seconds in cp["components"].items()
+    ]
+    entries.sort(key=lambda e: (-e["seconds"], e["component"]))
+    if top_n > 0:
+        entries = entries[:top_n]
+    coverage_ok = cp["coverage"] >= COVERAGE_FLOOR
+    if not entries:
+        top = "no spans recorded"
+    elif not coverage_ok:
+        top = (f"coverage {cp['coverage']:.0%} below "
+               f"{COVERAGE_FLOOR:.0%} floor: span set incomplete")
+    else:
+        lead = entries[0]
+        top = f"{lead['component']}: {lead['share']:.0%} of makespan"
+    return {
+        "makespan_s": makespan,
+        "waves": cp["waves"],
+        "occ_retries": cp["occ_retries"],
+        "coverage": cp["coverage"],
+        "coverage_ok": coverage_ok,
+        "unattributed_s": cp["unattributed_s"],
+        "entries": entries,
+        "top": top,
+    }
+
+
+def format_report(report: Dict[str, object], top_n: int = 5) -> str:
+    """Human one-liner for logs/records: ``wait_min_index: 41%; broker
+    dequeue idle: 22%; ... (coverage 96%)``."""
+    parts = [
+        f"{e['component']}: {e['share']:.0%}"
+        for e in report.get("entries", [])[:top_n]
+    ]
+    if not parts:
+        return report.get("top", "no spans recorded")
+    return "; ".join(parts) + f" (coverage {report.get('coverage', 0):.0%})"

@@ -23,7 +23,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
-from ..utils import metrics
+from ..utils import metric_names
 
 _MAX_EPISODES = 64
 
@@ -32,10 +32,7 @@ _episodes: List[Dict[str, object]] = []
 
 
 def _publish(fields: Dict[str, object]) -> None:
-    for key, value in fields.items():
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
-            continue
-        metrics.set_gauge(f"nomad.chaos.failover.{key}", float(value))
+    metric_names.publish_family("nomad.chaos.failover", fields)
 
 
 def record(**fields) -> Dict[str, object]:

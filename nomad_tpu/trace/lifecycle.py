@@ -26,7 +26,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
-from ..utils import metrics
+from ..utils import metric_names, metrics
 
 _DONE_CAP = 2048
 
@@ -93,6 +93,24 @@ class EvalTrace:
             "total_ms": round(self.total_ms(now), 3),
         }
 
+    def raw(self) -> Dict[str, object]:
+        """Raw monotonic stamps (attribution joins these with pipeline
+        spans on the same clock; to_dict() only exposes durations)."""
+        return {
+            "eval_id": self.eval_id,
+            "type": self.type,
+            "attempt": self.attempt,
+            "path": self.path,
+            "outcome": self.outcome,
+            "enqueue_t": self.enqueue_t,
+            "dequeue_t": self.dequeue_t,
+            "invoke_start_t": self.invoke_start_t,
+            "invoke_end_t": self.invoke_end_t,
+            "submit_t": self.submit_t,
+            "apply_t": self.apply_t,
+            "end_t": self.end_t,
+        }
+
 
 _lock = threading.Lock()
 _inflight: Dict[str, EvalTrace] = {}
@@ -126,6 +144,11 @@ def reset() -> None:
         _done.clear()
         for k in _counts:
             _counts[k] = 0
+        # aux stages (wait_min_index, raft_fsm, ...) registered via
+        # setdefault must not survive a reset either
+        for table in (_pipe_open, _pipe_done, _pipe_counts):
+            for s in [k for k in table if k not in PIPELINE_STAGES]:
+                del table[s]
         for s in PIPELINE_STAGES:
             _pipe_open[s] = 0
             _pipe_done[s].clear()
@@ -333,6 +356,30 @@ def summary() -> Dict[str, object]:
     }
 
 
+def raw_records() -> List[Dict[str, object]]:
+    """Raw-stamp dicts for every completed + in-flight record, oldest
+    completion first (the attribution engine's input)."""
+    with _lock:
+        out = [r.raw() for r in _done]
+        out.extend(r.raw() for r in _inflight.values())
+    return out
+
+
+def quick_stats() -> Dict[str, object]:
+    """Cheap per-tick snapshot for the flight recorder: counts and open
+    stage depths only — no percentile sorts (summary() and
+    pipeline_summary() sort thousands of spans, too hot for a 250ms
+    cadence)."""
+    with _lock:
+        return {
+            "inflight": len(_inflight),
+            "completed": len(_done),
+            "outcomes": dict(_counts),
+            "pipeline_depth": dict(_pipe_open),
+            "pipeline_count": dict(_pipe_counts),
+        }
+
+
 def slowest_inflight(n: int = 5) -> List[Dict[str, object]]:
     """The n oldest in-flight records (watchdog dump material)."""
     now = _clock()
@@ -366,8 +413,9 @@ def publish_gauges() -> None:
     metrics.set_gauge("nomad.trace.slowest_inflight_ms",
                       s["slowest_inflight_ms"])
     metrics.set_gauge("nomad.trace.inflight", s["inflight"])
+    flat: Dict[str, object] = {}
     for stage, row in pipeline_summary().items():
-        base = f"nomad.trace.pipeline.{stage}"
-        metrics.set_gauge(f"{base}.depth", row["depth"])
-        metrics.set_gauge(f"{base}.count", row["count"])
-        metrics.set_gauge(f"{base}.latency_ms_p95", row["latency_ms_p95"])
+        flat[f"{stage}.depth"] = row["depth"]
+        flat[f"{stage}.count"] = row["count"]
+        flat[f"{stage}.latency_ms_p95"] = row["latency_ms_p95"]
+    metric_names.publish_family("nomad.trace.pipeline", flat)

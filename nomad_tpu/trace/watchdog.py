@@ -118,17 +118,23 @@ class LivenessWatchdog:
               broker: Dict[str, object]) -> None:
         from ..agent.monitor import thread_dump
 
+        # the flight recorder's tail shows what the system was doing
+        # LEADING INTO the stall, which the instantaneous probes can't
+        flight = getattr(self.server, "flight", None)
+        flight_tail = flight.frames(recent=8) if flight is not None else []
         self.logger.warning(
             "liveness watchdog: placement flat at %s desired-run allocs "
             "for %.1fs with evals in flight\n"
             "broker stats: %s\n"
             "worker spans: %s\n"
             "slowest in-flight evals: %s\n"
+            "last flight frames: %s\n"
             "thread stacks:\n%s",
             placed, stalled,
             json.dumps(broker, sort_keys=True, default=str),
             json.dumps(self.worker_spans(), sort_keys=True, default=str),
             json.dumps(lifecycle.slowest_inflight(5), sort_keys=True,
                        default=str),
+            json.dumps(flight_tail, sort_keys=True, default=str),
             thread_dump(),
         )

@@ -92,6 +92,23 @@ class InmemSink:
 
     # -- query api --------------------------------------------------------
 
+    def gauges(self) -> Dict[str, float]:
+        """Merged gauge dict across retained intervals — the cheap
+        accessor the flight recorder samples every tick (summary()
+        sorts everything; this is one dict merge under the lock)."""
+        with self._lock:
+            merged: Dict[str, float] = {}
+            for itv in self._intervals:
+                merged.update(itv.gauges)
+            return merged
+
+    def counter_sums(self) -> Dict[str, float]:
+        """Current-interval counter sums, unsorted (flight-frame cheap
+        accessor; deltas between frames give per-tick rates)."""
+        with self._lock:
+            cur = self._intervals[-1]
+            return {k: round(a.sum, 6) for k, a in cur.counters.items()}
+
     def summary(self) -> dict:
         """Aggregated view of the most recent *complete-ish* interval,
         matching the reference's /v1/metrics InmemSink DisplayMetrics."""

@@ -478,7 +478,7 @@ class TestAdaptiveGatherLatency:
             assert "gather_wait_ms_max" in server.device_batcher.stats
             from nomad_tpu.utils import metrics as m
 
-            server._emit_stats()
+            server.publish_stats_gauges()
             data = m.global_sink().summary()
             gauges = {g["Name"] for g in data.get("Gauges", [])}
             assert any(

@@ -1,0 +1,341 @@
+"""nomad-flightrec: flight recorder ring/spill/overhead mechanics,
+critical-path attribution on synthetic span sets, server/agent wiring
+(armed with leadership, /v1/flight route), and the strict disarmed
+no-op contract."""
+import json
+import threading
+import time
+
+from nomad_tpu.trace import attribution, lifecycle
+from nomad_tpu.trace.flight import FlightRecorder
+
+
+def spin_until(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out: {msg}")
+
+
+# ---------------------------------------------------------------------------
+# recorder mechanics
+# ---------------------------------------------------------------------------
+
+FRAME_KEYS = {"seq", "t", "wall", "probes", "gauges", "counters", "tick_ms"}
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_seq(self):
+        rec = FlightRecorder(interval_s=0.25, retain=8)
+        for _ in range(20):
+            rec.tick()
+        frames = rec.frames()
+        assert len(frames) == 8  # retain honored, oldest evicted
+        assert [f["seq"] for f in frames] == list(range(12, 20))
+        assert rec.frames(recent=3) == frames[-3:]
+        assert rec.frames(recent=0) == []
+
+    def test_frame_schema_stable(self):
+        """The frame key set is the JSONL spill schema — downstream
+        consumers (watchdog dump, bench artifacts) parse it."""
+        rec = FlightRecorder(interval_s=0.25, retain=4)
+        rec.add_probe("const", lambda: {"x": 1})
+        frame = rec.tick()
+        assert set(frame) == FRAME_KEYS
+        assert frame["probes"]["const"] == {"x": 1}
+        assert isinstance(frame["gauges"], dict)
+        assert isinstance(frame["counters"], dict)
+
+    def test_probe_error_is_contained(self):
+        rec = FlightRecorder(interval_s=0.25, retain=4)
+        rec.add_probe("bad", lambda: 1 / 0)
+        rec.add_probe("good", lambda: {"ok": True})
+        frame = rec.tick()
+        assert "error" in frame["probes"]["bad"]
+        assert frame["probes"]["good"] == {"ok": True}
+
+    def test_disarmed_is_strict_noop(self):
+        """interval_s <= 0 disables: arm() starts nothing, no thread, no
+        frames, zero overhead."""
+        rec = FlightRecorder(interval_s=0.0)
+        before = threading.active_count()
+        rec.arm()
+        assert not rec.armed
+        assert threading.active_count() == before
+        assert rec.frames() == []
+        assert rec.overhead()["ticks"] == 0
+        rec.disarm()  # idempotent
+
+    def test_arm_disarm_thread_lifecycle(self):
+        rec = FlightRecorder(interval_s=0.01, retain=64)
+        rec.arm()
+        assert rec.armed
+        spin_until(lambda: len(rec.frames()) >= 3, msg="frames sampled")
+        rec.arm()  # second arm is a no-op, not a second thread
+        rec.disarm()
+        assert not rec.armed
+        n = len(rec.frames())
+        time.sleep(0.05)
+        assert len(rec.frames()) == n  # sampling actually stopped
+        ov = rec.overhead()
+        assert ov["ticks"] >= 3 and ov["tick_ms_max"] >= ov["tick_ms_avg"]
+        assert 0.0 <= ov["duty_cycle"] < 1.0
+
+    def test_spill_jsonl(self, tmp_path):
+        path = str(tmp_path / "flight.jsonl")
+        rec = FlightRecorder(interval_s=0.01, retain=16, spill_path=path)
+        rec.add_probe("p", lambda: {"v": 7})
+        rec.arm()
+        spin_until(lambda: len(rec.frames()) >= 4, msg="spilled frames")
+        rec.disarm()
+        with open(path) as fh:
+            lines = [json.loads(line) for line in fh if line.strip()]
+        assert len(lines) >= 4
+        assert all(set(f) == FRAME_KEYS for f in lines)
+        assert lines[0]["probes"]["p"] == {"v": 7}
+        # seq strictly increasing: the spill is an append-only log
+        seqs = [f["seq"] for f in lines]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    def test_write_spill_tail_flush(self, tmp_path):
+        rec = FlightRecorder(interval_s=0.25, retain=32)
+        for _ in range(10):
+            rec.tick()
+        path = str(tmp_path / "tail.jsonl")
+        assert rec.write_spill(path, recent=4) == 4
+        with open(path) as fh:
+            assert len(fh.readlines()) == 4
+
+    def test_snapshot_payload_shape(self):
+        rec = FlightRecorder(interval_s=0.25, retain=16)
+        rec.tick()
+        snap = rec.snapshot(recent=8)
+        assert snap["armed"] is False
+        assert snap["interval_s"] == 0.25
+        assert snap["retain"] == 16
+        assert len(snap["frames"]) == 1
+        assert snap["overhead"]["ticks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# critical-path attribution on synthetic span sets
+# ---------------------------------------------------------------------------
+
+
+def _rec(eval_id="e1", **stamps):
+    base = {
+        "eval_id": eval_id, "type": "service", "attempt": 1,
+        "path": "device", "outcome": stamps.pop("outcome", "ack"),
+        "enqueue_t": None, "dequeue_t": None, "invoke_start_t": None,
+        "invoke_end_t": None, "submit_t": None, "apply_t": None,
+        "end_t": None,
+    }
+    base.update(stamps)
+    return base
+
+
+# one fully-instrumented wave over [0, 10]: queue 1s, encode 1s,
+# dispatch 3s (the plant: top-ranked), residual invoke 1s, then
+# wait_min_index/commit machinery and a short second eval
+SYNTH_RECORDS = [
+    _rec("e1", enqueue_t=0.0, dequeue_t=1.0, invoke_start_t=1.0,
+         invoke_end_t=6.0, submit_t=6.0, apply_t=8.0, end_t=8.5),
+    _rec("e2", enqueue_t=8.5, dequeue_t=9.0, invoke_start_t=9.0,
+         invoke_end_t=10.0, submit_t=10.0, apply_t=10.0, end_t=10.0),
+    _rec("e3", enqueue_t=9.0, dequeue_t=9.2, invoke_start_t=9.2,
+         invoke_end_t=9.4, end_t=9.4, outcome="nack"),
+]
+SYNTH_SPANS = [
+    ("encode", "w1", 1.0, 2.0),
+    ("dispatch", "w1", 2.0, 5.0),
+    ("wait_min_index", "e1", 6.5, 7.5),
+]
+
+
+class TestAttribution:
+    def test_synthetic_coverage_and_ranking(self):
+        cp = attribution.critical_path(SYNTH_RECORDS, SYNTH_SPANS, now=10.0)
+        assert cp["makespan_s"] == 10.0
+        assert cp["waves"] == 1  # e1/e2/e3 windows abut into one wave
+        assert cp["occ_retries"] == 1
+        comps = cp["components"]
+        # the planted decomposition, exclusive (no double counting)
+        assert comps["dispatch"] == 3.0
+        assert comps["encode"] == 1.0
+        assert comps["invoke"] == 2.0  # [5,6] residual + [9,10]; e3 overlap claimed once
+        assert comps["wait_min_index"] == 1.0
+        assert comps["queue_wait"] == 1.5  # [0,1] + [8.5,9]
+        assert comps["commit_wait"] == 1.0  # [6,8] minus wait_min_index
+        assert comps["finalize"] == 0.5  # [8,8.5]
+        assert "broker_idle" not in comps  # evals in flight wall-to-wall
+        # exclusivity: components sum to attributed time, never above
+        assert abs(sum(comps.values()) - 10.0) < 1e-9
+        assert cp["coverage"] == 1.0
+        assert cp["unattributed_s"] == 0.0
+
+    def test_report_ranks_and_names_top(self):
+        rep = attribution.bottleneck_report(
+            SYNTH_RECORDS, SYNTH_SPANS, now=10.0)
+        assert rep["coverage_ok"] is True
+        assert rep["coverage"] >= attribution.COVERAGE_FLOOR
+        assert rep["top"] == "dispatch: 30% of makespan"
+        assert rep["entries"][0] == {
+            "component": "dispatch", "seconds": 3.0, "share": 0.3}
+        shares = [e["seconds"] for e in rep["entries"]]
+        assert shares == sorted(shares, reverse=True)
+        assert rep["occ_retries"] == 1
+
+    def test_report_is_deterministic(self):
+        a = attribution.bottleneck_report(SYNTH_RECORDS, SYNTH_SPANS, now=10.0)
+        b = attribution.bottleneck_report(
+            list(reversed(SYNTH_RECORDS)), list(reversed(SYNTH_SPANS)),
+            now=10.0)
+        assert a == b  # input order never changes the ledger
+
+    def test_tie_break_is_by_name(self):
+        recs = [_rec("t", enqueue_t=0.0, dequeue_t=1.0, invoke_start_t=1.0,
+                     invoke_end_t=2.0, end_t=2.0)]
+        rep = attribution.bottleneck_report(recs, [], now=2.0)
+        assert [e["component"] for e in rep["entries"]] == \
+            ["invoke", "queue_wait"]  # equal 1s claims: alphabetical
+
+    def test_coverage_failure_refuses_to_rank(self):
+        """A span set with a 9s instrumentation hole must say so instead
+        of naming a bogus bottleneck."""
+        recs = [_rec("gap", enqueue_t=0.0, dequeue_t=0.1,
+                     invoke_start_t=0.2, invoke_end_t=0.5,
+                     submit_t=9.5, apply_t=9.8, end_t=10.0)]
+        rep = attribution.bottleneck_report(recs, [], now=10.0)
+        assert rep["coverage"] < attribution.COVERAGE_FLOOR
+        assert rep["coverage_ok"] is False
+        assert "coverage" in rep["top"] and "incomplete" in rep["top"]
+
+    def test_broker_idle_claims_gaps_between_waves(self):
+        recs = [
+            _rec("a", enqueue_t=0.0, dequeue_t=0.5, invoke_start_t=0.5,
+                 invoke_end_t=1.0, end_t=1.0),
+            _rec("b", enqueue_t=9.0, dequeue_t=9.5, invoke_start_t=9.5,
+                 invoke_end_t=10.0, end_t=10.0),
+        ]
+        cp = attribution.critical_path(recs, [], now=10.0)
+        assert cp["components"]["broker_idle"] == 8.0  # [1, 9]
+        assert cp["coverage"] == 1.0
+
+    def test_empty_inputs(self):
+        rep = attribution.bottleneck_report([], [], now=0.0)
+        assert rep["top"] == "no spans recorded"
+        assert rep["entries"] == [] and rep["makespan_s"] == 0.0
+
+    def test_inflight_spans_extend_to_now(self):
+        recs = [_rec("open", enqueue_t=0.0, dequeue_t=1.0,
+                     invoke_start_t=1.0)]  # still invoking
+        cp = attribution.critical_path(recs, [], now=4.0)
+        assert cp["components"]["invoke"] == 3.0
+        assert cp["coverage"] == 1.0
+
+    def test_format_report_one_liner(self):
+        rep = attribution.bottleneck_report(
+            SYNTH_RECORDS, SYNTH_SPANS, now=10.0)
+        line = attribution.format_report(rep, top_n=2)
+        assert line.startswith("dispatch: 30%; ")
+        assert line.endswith("(coverage 100%)")
+
+    def test_live_lifecycle_integration(self):
+        """Default-argument path reads the live lifecycle tables."""
+        from nomad_tpu.structs.structs import EVAL_STATUS_PENDING, Evaluation
+
+        lifecycle.reset()
+        ev = Evaluation(job_id="live", type="service",
+                        status=EVAL_STATUS_PENDING, priority=50)
+        lifecycle.on_enqueue(ev)
+        lifecycle.on_dequeue(ev.id, 1)
+        lifecycle.on_invoke_start(ev.id)
+        time.sleep(0.02)
+        lifecycle.on_invoke_end(ev.id)
+        lifecycle.on_ack(ev.id)
+        t0 = lifecycle.pipeline_now()
+        lifecycle.pipeline_record("dispatch", "w-live", t0 - 0.005, t0)
+        rep = attribution.bottleneck_report()
+        assert rep["makespan_s"] > 0
+        assert rep["coverage_ok"], rep
+        assert any(e["component"] == "invoke" for e in rep["entries"])
+        lifecycle.reset()
+
+
+# ---------------------------------------------------------------------------
+# server + agent wiring
+# ---------------------------------------------------------------------------
+
+
+def test_server_arms_flight_with_leadership(tmp_path):
+    from nomad_tpu.server.server import Server, ServerConfig
+
+    server = Server(ServerConfig(
+        num_schedulers=0, device_batch=0,
+        heartbeat_min_ttl=3600, heartbeat_max_ttl=7200,
+        flight_interval_s=0.02, flight_retain=128,
+        flight_spill_dir=str(tmp_path),
+    ), name="flight-srv")
+    server.start()
+    try:
+        spin_until(lambda: server.flight.armed, msg="flight armed on leader")
+        spin_until(lambda: len(server.flight.frames()) >= 2,
+                   msg="flight frames")
+        frame = server.flight.frames(recent=1)[0]
+        # the standard probe set is wired
+        assert {"broker", "plan_queue", "trace", "state", "encode_cache"} \
+            <= set(frame["probes"])
+        assert "dequeue_waiters" in frame["probes"]["broker"]
+        assert "min_index_waiters" in frame["probes"]["state"]
+        # publisher satellite: the flight tick keeps gauges fresh with no
+        # agent and no 10s leader sweep having run yet
+        spin_until(
+            lambda: "nomad.broker.total_ready" in (
+                server.flight.frames(recent=1) or [{}])[-1].get("gauges", {}),
+            msg="gauges published from flight tick")
+    finally:
+        server.stop()
+    assert not server.flight.armed  # disarmed with leadership revocation
+    spill = tmp_path / "flight-srv.flight.jsonl"
+    assert spill.exists() and spill.read_text().strip()
+
+
+def test_v1_flight_endpoint_end_to_end():
+    import urllib.error
+    import urllib.request
+
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    lifecycle.reset()
+    agent = Agent(AgentConfig(dev_mode=True, num_schedulers=2,
+                              name="flight1", flight_interval_s=0.02))
+    agent.start()
+    try:
+        from nomad_tpu import mock
+
+        agent.server.register_job(mock.job())
+        spin_until(lambda: lifecycle.summary()["completed"] >= 1,
+                   msg="an eval completing")
+        spin_until(lambda: len(agent.server.flight.frames()) >= 2,
+                   msg="flight frames")
+        with urllib.request.urlopen(
+                agent.http_addr + "/v1/flight?recent=4", timeout=30) as resp:
+            out = json.loads(resp.read().decode())
+        assert out["armed"] is True
+        assert 0 < len(out["frames"]) <= 4
+        assert set(out["frames"][-1]) == FRAME_KEYS
+        assert "broker" in out["frames"][-1]["probes"]
+        rep = out["bottleneck_report"]
+        assert "top" in rep and "coverage" in rep and "entries" in rep
+        # bad recent= is a 400, not a 500
+        try:
+            urllib.request.urlopen(
+                agent.http_addr + "/v1/flight?recent=bogus", timeout=30)
+            raise AssertionError("recent=bogus must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        agent.shutdown()
+        lifecycle.reset()

@@ -52,6 +52,72 @@ def test_interval_rotation_retains_gauges():
     assert [c["Name"] for c in out["Counters"]] == ["c2"]  # counters don't
 
 
+def test_gauges_and_counter_sums_accessors():
+    """Cheap flight-frame accessors: gauges merge across retained
+    intervals (newest wins), counter sums scope to the current one."""
+    s = InmemSink(interval=0.05, retain=3)
+    s.set_gauge("nomad.test.a", 1)
+    s.incr_counter("nomad.test.c", 2)
+    time.sleep(0.06)
+    s.set_gauge("nomad.test.b", 5)  # forces rotation
+    s.incr_counter("nomad.test.d", 3)
+    g = s.gauges()
+    assert g["nomad.test.a"] == 1 and g["nomad.test.b"] == 5
+    assert s.counter_sums() == {"nomad.test.d": 3}
+    s.set_gauge("nomad.test.a", 9)
+    assert s.gauges()["nomad.test.a"] == 9  # newest interval wins the merge
+
+
+def test_interval_rotation_under_concurrent_writers():
+    """Writers hammering the sink across rotations must never corrupt an
+    aggregate or grow the ring past ``retain`` — flight-recorder
+    publishers and worker hot paths all share one global sink."""
+    import threading
+
+    s = InmemSink(interval=0.03, retain=3)
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        n = 0
+        while not stop.is_set():
+            s.incr_counter("nomad.stress.ticks")
+            s.set_gauge("nomad.stress.g%d" % i, n)
+            n += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                s.gauges()
+                s.counter_sums()
+                s.summary()
+                s.prometheus()
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    threads.append(threading.Thread(target=reader))
+    for t in threads:
+        t.start()
+    time.sleep(0.25)  # ~8 rotations at 30ms
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+        assert not t.is_alive()
+    assert not errors
+    assert len(s._intervals) <= 3  # retention bound held under load
+    # unit increments mean every retained aggregate must have
+    # Count == Sum and Min == Max == 1 — anything else is corruption
+    for itv in s._intervals:
+        agg = itv.counters.get("nomad.stress.ticks")
+        if agg is not None:
+            assert agg.count == agg.sum
+            assert agg.min == 1.0 and agg.max == 1.0
+    g = s.gauges()
+    for i in range(4):
+        assert "nomad.stress.g%d" % i in g  # last write per thread survives
+
+
 def test_prometheus_format():
     s = InmemSink(interval=100)
     s.set_gauge("nomad.broker.total_ready", 3)

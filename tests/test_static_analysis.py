@@ -930,3 +930,144 @@ def test_subprocess_scoped_to_harness_code():
             return p
     """)
     assert run_source(src, "nomad_tpu/client/drivers/exec_driver.py") == []
+
+
+# ---------------------------------------------------------------------------
+# fixture units — metrics-discipline
+# ---------------------------------------------------------------------------
+
+REGISTRY_DECL = dedent("""
+    FAMILIES = {
+        "nomad.broker": "eval broker",
+        "nomad.trace": "lifecycle spans",
+    }
+""")
+
+
+def test_metrics_flags_fstring_name_in_loop():
+    # the exact failover.py bug shape: per-key metric names minted inside
+    # a loop (reverting the publish_family fix re-creates this finding)
+    src = dedent("""
+        from ..utils import metrics
+
+        def publish(fields):
+            for k, v in fields.items():
+                metrics.set_gauge(f"nomad.chaos.failover.{k}", float(v))
+    """)
+    fs = run_source(src, "nomad_tpu/trace/failover.py")
+    assert [f.rule for f in fs] == ["metrics-discipline"]
+    assert "inside a loop" in fs[0].message
+    assert "publish_family" in fs[0].message
+
+
+def test_metrics_flags_non_nomad_literal():
+    src = dedent("""
+        from nomad_tpu.utils import metrics
+
+        def tick():
+            metrics.incr_counter("broker_enqueues")
+    """)
+    fs = run_source(src, "nomad_tpu/server/eval_broker.py")
+    assert [f.rule for f in fs] == ["metrics-discipline"]
+    assert "not a dotted" in fs[0].message
+
+
+def test_metrics_flags_fully_dynamic_name():
+    src = dedent("""
+        from nomad_tpu.utils import metrics
+
+        def tick(eval_id):
+            metrics.add_sample("nomad.sched." + eval_id, 1.0)
+    """)
+    fs = run_source(src, "nomad_tpu/server/worker.py")
+    assert [f.rule for f in fs] == ["metrics-discipline"]
+    assert "dynamic" in fs[0].message
+
+
+def test_metrics_flags_headless_fstring():
+    # an f-string whose literal head isn't 'nomad.<family>.' hides the
+    # family from grep even outside loops
+    src = dedent("""
+        from nomad_tpu.utils import metrics
+
+        def tick(prefix):
+            metrics.set_gauge(f"{prefix}.depth", 1.0)
+    """)
+    fs = run_source(src, "nomad_tpu/server/worker.py")
+    assert [f.rule for f in fs] == ["metrics-discipline"]
+    assert "literal head" in fs[0].message
+
+
+def test_metrics_flags_unregistered_family_with_registry():
+    # family enforcement arms only when the registry module is in the
+    # collect set (full-tree runs; fixtures opt in via extra_modules)
+    src = dedent("""
+        from nomad_tpu.utils import metrics
+
+        def tick():
+            metrics.incr_counter("nomad.mystery.count")
+    """)
+    fs = run_source(
+        src, "nomad_tpu/server/worker.py",
+        extra_modules=[(REGISTRY_DECL, "nomad_tpu/utils/metric_names.py")])
+    assert [f.rule for f in fs] == ["metrics-discipline"]
+    assert "nomad.mystery" in fs[0].message and "FAMILIES" in fs[0].message
+
+
+def test_metrics_accepts_literal_constant_and_bounded_fstring():
+    src = dedent("""
+        from nomad_tpu.utils import metrics
+
+        STALL_GAUGE = "nomad.watchdog.stalled_s"
+
+        def tick(eval_type):
+            metrics.incr_counter("nomad.broker.enqueues")
+            metrics.set_gauge(STALL_GAUGE, 2.0)
+            # bounded enum suffix outside a loop: family stays greppable
+            metrics.add_sample(f"nomad.trace.eval_ms.{eval_type}", 5.0)
+    """)
+    assert run_source(
+        src, "nomad_tpu/server/worker.py",
+        extra_modules=[(REGISTRY_DECL, "nomad_tpu/utils/metric_names.py")]) \
+        == []
+
+
+def test_metrics_accepts_publish_family_door_in_loop():
+    # the blessed dynamic-name door: a literal registered prefix, dict
+    # fan-out handled inside metric_names (which is itself exempt)
+    src = dedent("""
+        from ..utils import metric_names
+
+        def publish(snapshots):
+            for snap in snapshots:
+                metric_names.publish_family("nomad.broker", snap)
+    """)
+    assert run_source(
+        src, "nomad_tpu/server/eval_broker.py",
+        extra_modules=[(REGISTRY_DECL, "nomad_tpu/utils/metric_names.py")]) \
+        == []
+
+
+def test_metrics_flags_dynamic_publish_family_prefix():
+    src = dedent("""
+        from ..utils import metric_names
+
+        def publish(prefix, fields):
+            metric_names.publish_family(prefix, fields)
+    """)
+    fs = run_source(src, "nomad_tpu/server/server.py")
+    assert [f.rule for f in fs] == ["metrics-discipline"]
+    assert "prefix" in fs[0].message
+
+
+def test_metrics_exempts_sink_plumbing():
+    # the sink's own fan-out and the registry door are the two modules
+    # allowed to touch dynamic names
+    src = dedent("""
+        from . import metrics
+
+        def publish_family(prefix, mapping):
+            for key, value in mapping.items():
+                metrics.set_gauge(f"{prefix}.{key}", float(value))
+    """)
+    assert run_source(src, "nomad_tpu/utils/metric_names.py") == []
