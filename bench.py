@@ -1272,6 +1272,145 @@ def bench_chaos_crash(name="chaos-crash-5K", seed=0, duration_s=25.0,
     }
 
 
+# ---------------------------------------------------------------------------
+# capacity-pressure-5K: saturation waves park evals in BlockedEvals, then
+# node-registration bursts storm them back out through the coalesced
+# unblock path while the leader's autoscaler covers the remainder — gated
+# on unblock-to-place latency, storm flatline, and drain-to-zero
+# ---------------------------------------------------------------------------
+
+def bench_capacity_pressure(name="capacity-pressure-5K", seed=0,
+                            duration_s=30.0, n_nodes=100,
+                            settle_timeout_s=180.0):
+    """Replay a trace whose job load starts near the fleet's capacity
+    ceiling (~85% cpu-committed), then submit two saturation waves sized
+    well past it: those placements fail and their evals park in
+    BlockedEvals. Each wave's paired capacity_release registers a burst
+    of fresh nodes — every registration fires the capacity-change
+    trigger, so the parked evals re-enqueue as an unblock storm through
+    the coalesced batch path — and the leader's autoscaler watches
+    blocked depth and registers whatever the releases didn't cover. The
+    gate reads the saturated-regime surfaces chaos-churn-5K never
+    exercises: unblock-to-place p99, placement flatline while blocked,
+    batch-size mean (the storm must demonstrably coalesce), and blocked
+    depth drained to <=1% of peak by measurement time. Fault windows are
+    off — pressure here is capacity, not injected failure; the mid-run
+    leader kill stays (parked evals must survive a leadership transfer
+    via eval restore on the new leader)."""
+    from nomad_tpu.chaos import ChurnReplay, SLOGate, SLOThresholds
+    from nomad_tpu.chaos.trace import generate_trace, trace_to_jsonable
+    from nomad_tpu.server import ServerConfig
+
+    # sizing: ~1400 background allocs at 250cpu fill ~93% of the fleet's
+    # usable slots (15 per node after the reserved share), so each
+    # 15-job saturation wave (600 allocs, ~40 nodes' worth) parks well
+    # past free capacity; the two 30-node releases cover most of it and
+    # the autoscaler's steps close the remainder
+    trace = generate_trace(
+        seed=seed, duration_s=duration_s, n_nodes=n_nodes,
+        n_jobs=35, tg_count=40, stop_frac=0.2, rollout_frac=0.15,
+        n_drains=2, n_expiries=2, n_hipri=1, n_fault_windows=0,
+        leader_kill=True, cpu=250, memory_mb=128,
+        n_saturate_waves=2, saturate_jobs=15, release_nodes=30,
+    )
+    log(f"{name}: {len(trace)} trace events over {duration_s:.0f}s, "
+        f"{n_nodes} nodes, 2 saturation waves, seed {seed}")
+    replay = ChurnReplay(
+        seed=seed, trace=trace, n_servers=3, n_nodes=n_nodes,
+        config=ServerConfig(
+            num_schedulers=2,
+            heartbeat_min_ttl=1.5,
+            heartbeat_max_ttl=2.5,
+            eval_gc_interval=3600.0,
+            watchdog_stall_s=10.0,
+            flight_spill_dir=_ARTIFACT_DIR,
+            # storm path: coalesce per-trigger unblocks for 50ms, cap
+            # each batched enqueue (the spike bound under test)
+            unblock_coalesce_window_s=0.05,
+            unblock_max_batch=256,
+            # leader-side autoscaler: tick at 2Hz, add up to 8 nodes per
+            # 1s cooldown while evals stay parked (each saturate job
+            # spans ~2.6 nodes, so evals_per_node=1 under-provisions per
+            # step and the releases + repeated steps share the work)
+            autoscaler_interval_s=0.5,
+            autoscaler_cooldown_s=1.0,
+            autoscaler_max_step=8,
+            autoscaler_evals_per_node=1,
+        ),
+        settle_timeout_s=settle_timeout_s,
+        autoscale=True,
+        warmup_counts=(40, 20),
+    )
+    t0 = time.monotonic()
+    result = replay.run()
+    wall = time.monotonic() - t0
+
+    # eval-latency gates are owned by chaos-churn-5K and deliberately OFF
+    # here: a parked eval's lifecycle spans its whole blocked wait, so
+    # eval_ms p99 in a saturated run measures time-to-capacity, which
+    # unblock_to_place_ms_p99 bounds directly. The saturated regime's
+    # gates: evals must actually have parked (else the config measured
+    # nothing), placement must follow capacity within 10s at p99, the
+    # storm must never starve the pipeline for >5s while work is parked,
+    # and the blocked ledger must be drained by the time the gate reads it
+    gate = SLOGate(SLOThresholds(
+        eval_ms_p99_max=None,
+        slowest_inflight_ms_max=None,
+        throughput_min_allocs_per_s=20.0,
+        attribution_coverage_min=0.9,
+        blocked_peak_min=4,
+        unblock_to_place_p99_ms_max=10_000.0,
+        storm_flatline_s_max=5.0,
+        blocked_drain_frac_max=0.01,
+        unblock_batch_mean_min=1.5,
+    ))
+    slo = gate.evaluate(result)
+    record = {
+        "config": name,
+        "seed": seed,
+        "wall_s": round(wall, 2),
+        "slo": slo,
+        "result": result,
+        "trace": trace_to_jsonable(trace),
+    }
+    write_artifact(name, record)
+    cap = result.get("capacity") or {}
+    status = "PASS" if slo["passed"] else "FAIL"
+    bottleneck = (result.get("bottleneck_report") or {}).get("top")
+    log(f"{name}: {status} — {result['total_allocs']} allocs "
+        f"({result['throughput_allocs_per_s']}/s), blocked peak "
+        f"{cap.get('peak_blocked')}, unblock->place p99 "
+        f"{cap.get('unblock_to_place_ms_p99')}ms, batch mean "
+        f"{cap.get('unblock_batch_size_mean')}, flatline "
+        f"{cap.get('max_flatline_s_while_blocked')}s, drain frac "
+        f"{cap.get('blocked_drain_frac')}, autoscaled "
+        f"{cap.get('autoscaled_nodes')} node(s), bottleneck: {bottleneck}")
+    for check in slo["checks"]:
+        log(f"  slo[{check['name']}]: observed={check['observed']} "
+            f"bound={check['bound']} passed={check['passed']}")
+    return {
+        "config": name,
+        "slo_passed": slo["passed"],
+        "total_allocs": result["total_allocs"],
+        "throughput_allocs_per_s": result["throughput_allocs_per_s"],
+        "eval_ms_p99": result["trace_summary"].get("eval_ms_p99"),
+        "blocked_peak": cap.get("peak_blocked"),
+        "unblock_to_place_ms_p99": cap.get("unblock_to_place_ms_p99"),
+        "unblock_batch_size_mean": cap.get("unblock_batch_size_mean"),
+        "unblock_batches": cap.get("unblock_batches"),
+        "blocked_drain_frac": cap.get("blocked_drain_frac"),
+        "max_flatline_s_while_blocked": cap.get(
+            "max_flatline_s_while_blocked"),
+        "autoscaled_nodes": cap.get("autoscaled_nodes"),
+        "invariants": result["invariants"],
+        "leader_kills": result["leader_kills"],
+        "bottleneck": bottleneck,
+        "attribution_coverage": (
+            result.get("bottleneck_report") or {}).get("coverage"),
+        "wall_s": round(wall, 2),
+    }
+
+
 def _diagnostic(fn, *args, **kwargs):
     """Run one diagnostic bench in isolation: a failure is reported but
     never skips later diagnostics or breaks the headline JSON line. The
@@ -1315,6 +1454,9 @@ def main():
     # crash-recovery config: real server processes, SIGKILL failover,
     # snapshot-install rejoin — gated on MTTR instead of tail latency
     chaos_crash = _diagnostic(bench_chaos_crash)
+    # saturated-regime config: blocked-eval storms + autoscaler drain —
+    # gated on unblock-to-place latency and drain-to-zero
+    capacity_pressure = _diagnostic(bench_capacity_pressure)
 
     # HEADLINE: end-to-end system C1M replay (jobs -> broker -> workers ->
     # eval-batched engine -> plan queue -> raft/FSM), one chip.
@@ -1386,6 +1528,7 @@ def main():
             "system_configs": sys_results,
             "chaos_churn": chaos_churn,
             "chaos_crash": chaos_crash,
+            "capacity_pressure": capacity_pressure,
         },
     }
     write_artifact("headline", record)

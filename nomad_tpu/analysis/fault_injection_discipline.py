@@ -44,6 +44,7 @@ _KNOWN_POINTS = (
     "broker_ack",
     "raft_apply",
     "heartbeat",
+    "unblock_enqueue",
 )
 
 _ARM_RECEIVER_HINTS = ("chaos", "inj")

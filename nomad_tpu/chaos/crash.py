@@ -290,6 +290,9 @@ class CrashReplay(ChurnReplay):
                 "use ChurnReplay for canary scenarios"
             )
         self._nurse_enabled = False
+        # the capacity monitor reads in-proc leader state; the replicas
+        # here are separate processes
+        self._capacity_monitor_enabled = False
         self.procs: Dict[str, ServerProcess] = {}
         self._leader_proc: Optional[ServerProcess] = None
         self._killed: List[str] = []

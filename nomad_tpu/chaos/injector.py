@@ -7,7 +7,7 @@ chaos conditioning in production code). When nothing is armed, ``fire``
 is a single global read and a return: the production cost of having the
 hooks compiled in is one dict-free branch per call site.
 
-The five points mirror the failure surfaces the churn harness shakes:
+The points mirror the failure surfaces the churn harness shakes:
 
 ==================  ========================================================
 ``device_dispatch``  ``tpu/batcher.DeviceBatcher.run`` — a raised fault
@@ -26,6 +26,10 @@ The five points mirror the failure surfaces the churn harness shakes:
 ``heartbeat``        ``server/heartbeat.HeartbeatTimers.reset_heartbeat_timer``
                      — a dropped heartbeat; enough of them in a row and
                      the TTL expires, marking the node down.
+``unblock_enqueue``  ``server/blocked_evals.BlockedEvals._flush_pending_locked``
+                     — a fault on the coalesced unblock-storm re-enqueue:
+                     the staged batch parks and retries on a bounded
+                     backoff timer instead of reaching the broker.
 ==================  ========================================================
 
 Determinism: each armed point draws from its own ``random.Random`` seeded
@@ -51,6 +55,7 @@ POINTS = (
     "broker_ack",
     "raft_apply",
     "heartbeat",
+    "unblock_enqueue",
 )
 
 MODES = ("fail", "delay")

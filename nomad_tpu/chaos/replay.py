@@ -38,6 +38,7 @@ from ..rpc.transport import RPCError
 from ..server.raft import InProcRaft, NotLeaderError
 from ..server.server import Server, ServerConfig
 from ..trace import attribution, lifecycle
+from ..trace import capacity as capacity_trace
 from .injector import ChaosFault, ChaosInjector
 from .trace import ChaosEvent, generate_trace, trace_kind_counts
 
@@ -141,6 +142,7 @@ class ChurnReplay:
         settle_timeout_s: float = 30.0,
         trace_kwargs: Optional[dict] = None,
         warmup_counts: Tuple[int, ...] = (),
+        autoscale: bool = False,
     ) -> None:
         self.seed = int(seed)
         kw = dict(trace_kwargs or {})
@@ -170,6 +172,17 @@ class ChurnReplay:
         # forbids canaried rollouts anyway) turns it off
         self._nurse_enabled = True
 
+        # capacity-pressure bookkeeping: a sampler thread tracks blocked
+        # depth peaks and placement flatlines (in-proc state access; the
+        # crash subclass turns it off), and `autoscale=True` wires every
+        # server's leader autoscaler to register fresh mock nodes
+        self.autoscale = bool(autoscale)
+        self._capacity_monitor_enabled = True
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._peak_blocked = 0
+        self._max_flatline_s = 0.0
+        self._autoscaled_nodes = 0
+
         # convergence bookkeeping fed to the invariant sweep
         self._expected: Dict[Tuple[str, str], int] = {}
         self._stopped: Set[Tuple[str, str]] = set()
@@ -192,8 +205,28 @@ class ChurnReplay:
             self.servers.append(
                 Server(self.config, raft=raft, name=f"chaos-s{i + 1}")
             )
+        if self.autoscale:
+            # every server gets the node provider — whichever holds
+            # leadership runs the (leadership-armed) loop
+            for s in self.servers:
+                s.autoscaler.scale_up_fn = self._autoscale_up
         for s in self.servers:
             s.start()
+
+    def _autoscale_up(self, n: int) -> int:
+        """Autoscaler node provider: register ``n`` fresh mock nodes on
+        the current leader (each registration fires the capacity-change
+        trigger, storming parked evals back out) and enroll them in the
+        heartbeat pump so they stay READY."""
+        leader = self._leader(timeout=2.0)
+        added = 0
+        for _ in range(int(n)):
+            node = mock.node()
+            leader.register_node(node)
+            self.node_ids.append(node.id)
+            added += 1
+        self._autoscaled_nodes += added
+        return added
 
     def _leader(self, timeout: float = 5.0) -> Server:
         deadline = time.monotonic() + timeout
@@ -253,6 +286,8 @@ class ChurnReplay:
             self._pump_thread.join(timeout=2.0)
         if self._nurse_thread is not None:
             self._nurse_thread.join(timeout=2.0)
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=2.0)
         for s in self.servers:
             s.stop()
 
@@ -271,7 +306,8 @@ class ChurnReplay:
                 continue
             with self._mute_lock:
                 muted = set(self._muted)
-            for node_id in self.node_ids:
+            # snapshot: capacity_release / autoscaler threads append
+            for node_id in list(self.node_ids):
                 if node_id in muted:
                     continue
                 try:
@@ -358,6 +394,7 @@ class ChurnReplay:
         self._warmup(leader)
         # gauges measure the churn run, not boot/warmup
         lifecycle.reset()
+        capacity_trace.reset()
         self._pump_thread = threading.Thread(
             target=self._pump_heartbeats, name="chaos-heartbeat-pump",
             daemon=True,
@@ -369,6 +406,37 @@ class ChurnReplay:
                 daemon=True,
             )
             self._nurse_thread.start()
+        if self._capacity_monitor_enabled:
+            self._monitor_thread = threading.Thread(
+                target=self._watch_capacity, name="chaos-capacity-monitor",
+                daemon=True,
+            )
+            self._monitor_thread.start()
+
+    def _watch_capacity(self) -> None:
+        """Capacity-pressure sampler: blocked-depth high-water mark, and
+        the longest stretch where blocked evals remained but NOTHING
+        placed — the convoy signature the storm SLO bounds (placement
+        rate must never flatline while work is parked and capacity is
+        arriving)."""
+        last_allocs = -1
+        last_progress_t = time.monotonic()
+        while not self._pump_stop.wait(0.1):
+            try:
+                leader = self._leader(timeout=1.0)
+                blocked = leader.blocked_evals.stats().get("total_blocked", 0)
+                capacity_trace.note_blocked_depth(blocked)
+                if blocked > self._peak_blocked:
+                    self._peak_blocked = blocked
+                n = leader.fsm.state.count_allocs_desired_run()
+                now = time.monotonic()
+                if n != last_allocs or blocked == 0:
+                    last_allocs = n
+                    last_progress_t = now
+                elif now - last_progress_t > self._max_flatline_s:
+                    self._max_flatline_s = now - last_progress_t
+            except Exception:  # noqa: BLE001 — monitor must survive churn
+                continue
 
     def _warmup(self, leader: Server) -> None:
         """Pre-trace compile warmup: place (then purge) one throwaway job
@@ -498,6 +566,27 @@ class ChurnReplay:
                 return   # pressure event degraded earlier
             self._leader().deregister_job(key[0], key[1], purge=False)
             self._stopped.add(key)
+        elif ev.kind == "saturate":
+            # a burst of real fleet jobs past free capacity: placements
+            # fail, evals park in BlockedEvals. They enter _expected —
+            # the sweep requires them placed once capacity arrives
+            wave = int(a.get("wave", 0))
+            leader = self._leader()
+            for i in range(int(a["job_count"])):
+                job = self._make_job(
+                    f"sat-{wave}-{i}", a["count"], a["cpu"],
+                    a["memory_mb"], priority=40)
+                leader.register_job(job)
+                self._expected[(job.namespace, job.id)] = a["count"]
+                self._stopped.discard((job.namespace, job.id))
+        elif ev.kind == "capacity_release":
+            # node-registration burst: each lands in the FSM and fires
+            # the capacity-change trigger — the unblock storm
+            leader = self._leader()
+            for _ in range(int(a.get("node_count", 0))):
+                node = mock.node()
+                leader.register_node(node)
+                self.node_ids.append(node.id)
         elif ev.kind == "drain_node":
             node_id = self.node_ids[a["node_idx"] % len(self.node_ids)]
             self._leader().update_node_drain(node_id, True)
@@ -651,11 +740,47 @@ class ChurnReplay:
             # (attribution_coverage_min)
             "bottleneck_report": attribution.bottleneck_report(),
             "flight": self._flight_stats(),
+            "capacity": self._capacity_result(),
             "invariants": inv,
             "errors": self.errors[:20],
         }
         result.update(self._extra_result())
         return result
+
+    def _capacity_result(self) -> Dict[str, object]:
+        """Storm ledger: unblock-to-place percentiles and batch stats
+        from the capacity trace module, joined with the monitor's peak /
+        flatline bookkeeping and the end-of-run drain check."""
+        cap = capacity_trace.summary()
+        peak = max(self._peak_blocked, int(cap.get("peak_blocked") or 0))
+        final_blocked = None
+        blocked_stats = None
+        auto: Dict[str, object] = {}
+        for s in self.servers:
+            tracker = getattr(s, "blocked_evals", None)
+            if tracker is None:
+                continue
+            if getattr(s, "is_leader", False):
+                blocked_stats = tracker.stats()
+            scaler = getattr(s, "autoscaler", None)
+            if scaler is not None and (scaler.stats().get("ticks")
+                                       or self.autoscale):
+                auto[getattr(s, "name", "?")] = scaler.stats()
+        if blocked_stats is not None:
+            final_blocked = blocked_stats.get("total_blocked", 0)
+            cap["blocked_stats"] = blocked_stats
+        cap.update({
+            "peak_blocked": peak,
+            "final_blocked": final_blocked,
+            "blocked_drain_frac": (
+                round(final_blocked / peak, 4)
+                if peak and final_blocked is not None else None
+            ),
+            "max_flatline_s_while_blocked": round(self._max_flatline_s, 2),
+            "autoscaled_nodes": self._autoscaled_nodes,
+            "autoscaler": auto,
+        })
+        return cap
 
     def run(self) -> Dict[str, object]:
         t0 = time.monotonic()

@@ -36,6 +36,17 @@ class SLOThresholds:
     # this the instrumentation lost track of where the wall went and the
     # run's bottleneck claim is untrustworthy
     attribution_coverage_min: Optional[float] = None
+    # capacity-pressure bounds (the result's "capacity" block from
+    # nomad_tpu.trace.capacity via ChurnReplay): the saturated-regime
+    # gates — evals must actually have parked (peak_min), placement must
+    # follow capacity fast (p99), the storm must not convoy the pipeline
+    # (flatline), the blocked depth must drain by trace end, and the
+    # unblock path must demonstrably batch (mean batch size)
+    blocked_peak_min: Optional[int] = None
+    unblock_to_place_p99_ms_max: Optional[float] = None
+    storm_flatline_s_max: Optional[float] = None
+    blocked_drain_frac_max: Optional[float] = None
+    unblock_batch_mean_min: Optional[float] = None
 
     def to_dict(self) -> Dict[str, object]:
         return {
@@ -49,6 +60,11 @@ class SLOThresholds:
             "failover_first_commit_ms_max": self.failover_first_commit_ms_max,
             "require_rejoin": self.require_rejoin,
             "attribution_coverage_min": self.attribution_coverage_min,
+            "blocked_peak_min": self.blocked_peak_min,
+            "unblock_to_place_p99_ms_max": self.unblock_to_place_p99_ms_max,
+            "storm_flatline_s_max": self.storm_flatline_s_max,
+            "blocked_drain_frac_max": self.blocked_drain_frac_max,
+            "unblock_batch_mean_min": self.unblock_batch_mean_min,
         }
 
 
@@ -133,6 +149,31 @@ class SLOGate:
             cov = rep.get("coverage")
             check("attribution_coverage", cov, th.attribution_coverage_min,
                   cov is not None and cov >= th.attribution_coverage_min)
+
+        cap = result.get("capacity") or {}
+        if th.blocked_peak_min is not None:
+            v = cap.get("peak_blocked")
+            check("blocked_peak", v, th.blocked_peak_min,
+                  v is not None and v >= th.blocked_peak_min)
+        if th.unblock_to_place_p99_ms_max is not None:
+            v = cap.get("unblock_to_place_ms_p99")
+            check("unblock_to_place_ms_p99", v, th.unblock_to_place_p99_ms_max,
+                  v is not None and v <= th.unblock_to_place_p99_ms_max)
+        if th.storm_flatline_s_max is not None:
+            v = cap.get("max_flatline_s_while_blocked")
+            check("storm_flatline_s", v, th.storm_flatline_s_max,
+                  v is not None and v <= th.storm_flatline_s_max)
+        if th.blocked_drain_frac_max is not None:
+            # final blocked depth as a fraction of peak; None peak means
+            # the run never saturated, which blocked_peak_min calls out —
+            # an unsaturated run trivially drained
+            v = cap.get("blocked_drain_frac")
+            check("blocked_drain_frac", v, th.blocked_drain_frac_max,
+                  v is None or v <= th.blocked_drain_frac_max)
+        if th.unblock_batch_mean_min is not None:
+            v = cap.get("unblock_batch_size_mean")
+            check("unblock_batch_size_mean", v, th.unblock_batch_mean_min,
+                  v is not None and v >= th.unblock_batch_mean_min)
 
         passed = all(c["passed"] is not False for c in checks)
         return {

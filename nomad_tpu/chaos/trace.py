@@ -45,6 +45,17 @@ Event kinds and their args:
                       place by evicting fillers)
 ``preempt_release``   wave — deregister that wave's filler job (paired
                       before the recovery tail so the sweep converges)
+``saturate``          wave, job_count, count, cpu, memory_mb — submit
+                      job_count real jobs in one burst, sized well past
+                      free capacity: placements fail and their evals
+                      park in BlockedEvals (the saturated regime). The
+                      jobs are fleet, not pressure — the sweep requires
+                      them placed once capacity arrives
+``capacity_release``  wave, node_count — register node_count fresh READY
+                      nodes in one burst; every registration fires the
+                      capacity-change trigger, so the parked evals
+                      re-enqueue as an unblock storm through the
+                      coalesced batch path
 ``leader_kill``       (none) — abrupt leader loss mid-run. In-proc replay
                       realizes it as a leadership transfer; the crash
                       harness as a real SIGKILL -9 of the leader process
@@ -107,6 +118,9 @@ def generate_trace(
     memory_mb: int = 128,
     canary_frac: float = 0.0,
     n_preempt_waves: int = 0,
+    n_saturate_waves: int = 0,
+    saturate_jobs: int = 8,
+    release_nodes: int = 0,
 ) -> List[ChaosEvent]:
     """Build a seeded churn schedule over ``duration_s`` trace-seconds.
 
@@ -118,9 +132,12 @@ def generate_trace(
 
     ``canary_frac`` of the rollouts become canaried deployment updates;
     ``n_preempt_waves`` adds paired preempt_pressure/preempt_release
-    waves (each with a hipri burst between them). Both default off, and
-    when off the generator's rng consumption is unchanged — existing
-    seeds keep producing byte-identical traces.
+    waves (each with a hipri burst between them); ``n_saturate_waves``
+    adds paired saturate/capacity_release waves (``saturate_jobs`` jobs
+    past capacity, then ``release_nodes`` fresh nodes — the unblock
+    storm). All default off, and when off the generator's rng
+    consumption is unchanged — existing seeds keep producing
+    byte-identical traces.
     """
     rng = Random(seed)
     events: List[ChaosEvent] = []
@@ -236,6 +253,25 @@ def generate_trace(
         events.append(ChaosEvent(
             min(t + jitter(2.5, 4.0), recover_by),
             "preempt_release", {"wave": i},
+        ))
+
+    # -- saturation waves (paired capacity release) --------------------
+    # each wave: a burst of real jobs well past free capacity parks its
+    # evals in BlockedEvals; the paired node-registration burst lands
+    # before the recovery tail and storms them back out through the
+    # coalesced unblock path (an armed autoscaler covers any remainder)
+    for i in range(n_saturate_waves):
+        t = jitter(churn_lo, churn_hi * 0.55)
+        events.append(ChaosEvent(t, "saturate", {
+            "wave": i,
+            "job_count": saturate_jobs,
+            "count": tg_count,
+            "cpu": cpu,
+            "memory_mb": memory_mb,
+        }))
+        events.append(ChaosEvent(
+            min(t + jitter(1.5, 3.0), recover_by * 0.9),
+            "capacity_release", {"wave": i, "node_count": release_nodes},
         ))
 
     # -- the leader kill -----------------------------------------------

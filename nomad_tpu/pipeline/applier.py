@@ -84,7 +84,8 @@ class AsyncApplier:
                  redispatch_max: int = 2,
                  ack_timeout_s: float = 30.0,
                  redispatch_backoff_s: float = 0.05,
-                 redispatch_backoff_max_s: float = 1.0) -> None:
+                 redispatch_backoff_max_s: float = 1.0,
+                 backpressure_wait_s: float = 0.02) -> None:
         self.server = server
         self.inflight_max = max(1, int(inflight_max))
         self.redispatch_max = max(0, int(redispatch_max))
@@ -92,6 +93,7 @@ class AsyncApplier:
         self.redispatch_backoff_s = max(0.0, float(redispatch_backoff_s))
         self.redispatch_backoff_max_s = max(
             self.redispatch_backoff_s, float(redispatch_backoff_max_s))
+        self.backpressure_wait_s = max(0.0, float(backpressure_wait_s))
 
         self.registry = WaveEncodeRegistry()
         self.redispatcher = Redispatcher(server, self.registry)
@@ -149,7 +151,8 @@ class AsyncApplier:
         """Take ownership of a dense plan's commit + ack, or return False
         so the worker falls back to the classic synchronous submit.
         Called on the worker (dispatch-stage) thread; everything here is
-        non-blocking."""
+        bounded — the longest wait is one ``backpressure_wait_s`` slot
+        wait when the pipeline is full."""
         if not self._enabled or not getattr(plan, "async_ok", False):
             return False
         # async-eligible shape: device-built dense placements only. Any
@@ -165,8 +168,17 @@ class AsyncApplier:
         ):
             return False
         if not self._slots.acquire(blocking=False):
-            metrics.incr_counter("nomad.pipeline.slots_exhausted")
-            return False
+            # explicit backpressure: the pipeline is full (an unblock
+            # storm re-enqueued more waves than inflight_max). Defer with
+            # one bounded wait for a slot instead of immediately falling
+            # back — a transient spike degrades to a slightly-delayed
+            # async submit; only sustained saturation convoys onto the
+            # classic synchronous path below.
+            metrics.incr_counter("nomad.pipeline.backpressure")
+            if (self.backpressure_wait_s <= 0 or not self._slots.acquire(
+                    timeout=self.backpressure_wait_s)):
+                metrics.incr_counter("nomad.pipeline.slots_exhausted")
+                return False
         try:
             # the broker must not redeliver while the wave sits in the
             # plan queue; the watchdog sweep below is the new bound

@@ -4,6 +4,20 @@ Semantics follow reference ``nomad/blocked_evals.go`` — evals that failed
 placement wait keyed by computed node class (captured vs escaped), and are
 re-enqueued when new capacity (node updates, alloc stops) appears. The
 system-scheduler variant tracks per-node blocks (blocked_evals_system.go).
+
+Unblock storms: one capacity burst (a wave of node registrations, a big
+plan's stopped allocs) arrives as MANY triggers — per-class, per-node and
+per-quota capacity changes, each of which would re-enqueue its interested
+evals immediately. With ``coalesce_window_s > 0`` the triggers instead
+stage their evals into a pending batch; a flush timer drains the batch as
+ONE ``enqueue_all`` per window, deduped across triggers (an eval collected
+by both a class and a node trigger re-enqueues once, carrying the highest
+capacity index it witnessed). Each flush is capped at ``max_batch`` evals —
+the remainder defers to the next window — so a 10K-eval storm reaches the
+broker as bounded batches instead of one giant lock-hold + wakeup spike.
+The flush path carries the ``unblock_enqueue`` chaos fire point: an
+injected fault parks the batch and retries on a bounded-backoff timer
+(degrade, never drop).
 """
 from __future__ import annotations
 
@@ -11,14 +25,24 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..chaos.injector import fire as chaos_fire
 from ..structs.structs import EVAL_STATUS_PENDING, EVAL_TRIGGER_MAX_PLANS, Evaluation
+from ..trace import capacity
+from ..utils import metrics
 
 UNBLOCK_FAILED_INTERVAL = 60.0  # periodic retry of max-plan-failed evals
 
+# retry backoff for a flush whose enqueue faulted (chaos or transient):
+# bounded, so a flapping enqueue path degrades to spaced batches
+FLUSH_RETRY_BACKOFF_S = 0.05
+
 
 class BlockedEvals:
-    def __init__(self, eval_broker) -> None:
+    def __init__(self, eval_broker, coalesce_window_s: float = 0.0,
+                 max_batch: int = 512) -> None:
         self.eval_broker = eval_broker
+        self.coalesce_window_s = max(0.0, float(coalesce_window_s))
+        self.max_batch = max(1, int(max_batch))
         self._lock = threading.RLock()
         self.enabled = False
 
@@ -44,6 +68,17 @@ class BlockedEvals:
         self.node_unblock_indexes: Dict[str, int] = {}
         self.quota_unblock_indexes: Dict[str, int] = {}
         self.stats_blocked = 0
+
+        # coalesced unblock staging: eval id -> (eval, token, index).
+        # Triggers land evals here; the flush timer (or a synchronous
+        # flush when coalesce_window_s == 0) drains it in bounded batches.
+        self._pending: Dict[str, Tuple[Evaluation, str, int]] = {}
+        self._flush_timer: Optional[threading.Timer] = None
+        # cumulative storm counters (EmitStats parity + artifact fields)
+        self.stats_unblocks = 0          # evals re-enqueued through flushes
+        self.stats_unblock_batches = 0   # enqueue_all batches issued
+        self.stats_dups_coalesced = 0    # cross-trigger dedup hits
+        self.stats_unblock_deferred = 0  # flushes deferred (cap or fault)
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -223,16 +258,84 @@ class BlockedEvals:
             self._enqueue(unblock, index)
 
     def _enqueue(self, evals: List[Evaluation], index: int) -> None:
-        batch = {}
+        """Stage unblocked evals for a coalesced broker re-enqueue.
+
+        Called under the lock by every trigger (class/node/quota/failed).
+        An eval two triggers both collected inside one window dedups here
+        and keeps the highest capacity index it witnessed (its refreshed
+        snapshot_index must cover every capacity change that unblocked
+        it, or the next block would spuriously look missed)."""
         for ev in evals:
             self.job_blocks.pop((ev.namespace, ev.job_id), None)
             token = self.tokens.pop(ev.id, "")
-            new_eval = ev.copy()
-            new_eval.status = EVAL_STATUS_PENDING
-            new_eval.snapshot_index = index
-            batch[new_eval.id] = (new_eval, token)
-        if batch:
+            ev_index = index
+            prev = self._pending.get(ev.id)
+            if prev is not None:
+                self.stats_dups_coalesced += 1
+                token = token or prev[1]
+                ev_index = max(ev_index, prev[2])
+            self._pending[ev.id] = (ev, token, ev_index)
+        if not self._pending:
+            return
+        if self.coalesce_window_s <= 0:
+            self._flush_pending_locked()
+        else:
+            self._schedule_flush_locked(self.coalesce_window_s)
+
+    def _schedule_flush_locked(self, delay: float) -> None:
+        if self._flush_timer is not None:
+            return
+        t = threading.Timer(delay, self._flush_timer_fire)
+        t.daemon = True
+        self._flush_timer = t
+        t.start()
+
+    def _flush_timer_fire(self) -> None:
+        with self._lock:
+            self._flush_timer = None
+            if not self.enabled:
+                self._pending.clear()
+                return
+            self._flush_pending_locked()
+
+    def _flush_pending_locked(self) -> None:
+        """Drain the staged batch into the broker, ``max_batch`` evals per
+        ``enqueue_all``. In windowed mode the remainder past the cap defers
+        to the next window tick (the spike bound); synchronous mode loops
+        so callers that expect unblock-then-ready semantics keep them. An
+        injected ``unblock_enqueue`` fault re-parks the batch and retries
+        on a bounded-backoff timer."""
+        while self._pending:
+            chunk_ids = list(self._pending)[: self.max_batch]
+            batch = {}
+            for eid in chunk_ids:
+                ev, token, index = self._pending[eid]
+                new_eval = ev.copy()
+                new_eval.status = EVAL_STATUS_PENDING
+                new_eval.snapshot_index = index
+                batch[eid] = (new_eval, token)
+            try:
+                # ChaosFault subclasses RuntimeError; production stays on
+                # the fire-only import surface and catches the base
+                chaos_fire("unblock_enqueue", batch=len(batch))
+            except RuntimeError:
+                self.stats_unblock_deferred += 1
+                metrics.incr_counter("nomad.blocked_evals.unblock_deferred")
+                self._schedule_flush_locked(
+                    max(self.coalesce_window_s, FLUSH_RETRY_BACKOFF_S))
+                return
+            for eid in chunk_ids:
+                del self._pending[eid]
             self.eval_broker.enqueue_all(batch)
+            self.stats_unblock_batches += 1
+            self.stats_unblocks += len(batch)
+            capacity.record_batch(len(batch))
+            capacity.mark_unblocked(batch)
+            if self._pending and self.coalesce_window_s > 0:
+                self.stats_unblock_deferred += 1
+                metrics.incr_counter("nomad.blocked_evals.unblock_deferred")
+                self._schedule_flush_locked(self.coalesce_window_s)
+                return
 
     # ------------------------------------------------------------------
 
@@ -248,11 +351,29 @@ class BlockedEvals:
             self.node_unblock_indexes.clear()
             self.quota_unblock_indexes.clear()
             self.tokens.clear()
+            # staged-but-unflushed unblocks die with leadership: the new
+            # leader's eval restore re-enqueues anything non-terminal
+            self._pending.clear()
+            timer = self._flush_timer
+            self._flush_timer = None
+        if timer is not None:
+            timer.cancel()
 
     def stats(self) -> Dict[str, int]:
+        """EmitStats parity (blocked_evals.go:774): depth gauges plus the
+        storm counters the capacity-pressure SLO gate reads."""
         with self._lock:
             return {
                 "total_blocked": len(self.captured) + len(self.escaped),
                 "total_escaped": len(self.escaped),
                 "total_failed": len(self.failed),
+                "total_captured": len(self.captured),
+                "total_system_blocked": sum(
+                    len(ids) for ids in self.system_blocks.values()
+                ),
+                "pending_unblocks": len(self._pending),
+                "unblocks_total": self.stats_unblocks,
+                "unblock_batches": self.stats_unblock_batches,
+                "unblock_dups_coalesced": self.stats_dups_coalesced,
+                "unblock_deferred": self.stats_unblock_deferred,
             }

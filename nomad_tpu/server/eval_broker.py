@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..chaos.injector import fire as chaos_fire
 from ..structs.structs import Evaluation, generate_uuid
+from ..trace import capacity as _capacity
 from ..trace import lifecycle as _trace
 
 FAILED_QUEUE = "_failed"
@@ -274,6 +275,9 @@ class EvalBroker:
             del self.evals[eval_id]
             # close BEFORE the requeue below may reopen the same id
             _trace.on_ack(eval_id)
+            # close the unblock->place storm sample (no-op for evals
+            # that never sat in BlockedEvals)
+            _capacity.observe_placed(eval_id)
 
             namespaced = (unack.eval.namespace, unack.eval.job_id)
             if self.job_evals.get(namespaced) == eval_id:

@@ -103,6 +103,25 @@ class ServerConfig:
     heartbeat_max_ttl: float = 30.0
     eval_gc_interval: float = 300.0
     unblock_failed_interval: float = 60.0
+    # -- capacity pressure (nomad_tpu/server/blocked_evals + autoscaler) --
+    # unblock coalescing: capacity triggers landing within this window
+    # merge into one batched, cross-trigger-deduped broker re-enqueue;
+    # 0 flushes synchronously per trigger (the pre-storm behavior)
+    unblock_coalesce_window_s: float = 0.05
+    # per-flush cap on the re-enqueue batch — a 10K-eval unblock storm
+    # reaches the broker as bounded batches, the remainder deferring one
+    # window at a time
+    unblock_max_batch: int = 512
+    # leader autoscaler loop: reads blocked_evals.stats() every interval
+    # and drives node registration/drain through harness-supplied
+    # callbacks (Autoscaler.scale_up_fn / scale_down_fn; without them the
+    # loop observes but never acts). interval <= 0 disables the tick.
+    autoscaler_interval_s: float = 0.0
+    autoscaler_cooldown_s: float = 3.0
+    autoscaler_max_step: int = 8
+    autoscaler_blocked_threshold: int = 1
+    autoscaler_evals_per_node: int = 2
+    autoscaler_drain_idle_ticks: int = 3
     # liveness watchdog (nomad-trace): when placement throughput is flat
     # for watchdog_stall_s while evals are in flight, dump broker stats,
     # per-worker current spans and thread stacks to the monitor stream.
@@ -161,6 +180,11 @@ class ServerConfig:
     # to spaced retries instead of hot-looping device dispatches
     pipeline_redispatch_backoff_s: float = 0.05
     pipeline_redispatch_backoff_max_s: float = 1.0
+    # bounded wait for a pipeline slot when inflight_max is saturated
+    # (an unblock storm's re-enqueue spike): a transient spike defers
+    # briefly and stays async, sustained saturation falls back to the
+    # classic synchronous path (counted as nomad.pipeline.backpressure)
+    pipeline_backpressure_wait_s: float = 0.02
     # federation (reference leader.go:997/:1138): non-authoritative
     # regions' leaders mirror ACL policies and GLOBAL tokens from the
     # authoritative region. Empty authoritative_region (or equal to our
@@ -185,7 +209,23 @@ class Server:
         self.fsm = NomadFSM()
         self.raft = raft or InProcRaft()
         self.eval_broker = EvalBroker()
-        self.blocked_evals = BlockedEvals(self.eval_broker)
+        self.blocked_evals = BlockedEvals(
+            self.eval_broker,
+            coalesce_window_s=self.config.unblock_coalesce_window_s,
+            max_batch=self.config.unblock_max_batch,
+        )
+        # leader autoscaler: armed with leadership below; inert until a
+        # harness attaches scale_up_fn / scale_down_fn node providers
+        from .autoscaler import Autoscaler
+
+        self.autoscaler = Autoscaler(
+            self.blocked_evals.stats,
+            blocked_threshold=self.config.autoscaler_blocked_threshold,
+            evals_per_node=self.config.autoscaler_evals_per_node,
+            max_step=self.config.autoscaler_max_step,
+            cooldown_s=self.config.autoscaler_cooldown_s,
+            drain_idle_ticks=self.config.autoscaler_drain_idle_ticks,
+        )
         self.plan_queue = PlanQueue()
         self.heartbeaters = HeartbeatTimers(
             self, self.config.heartbeat_min_ttl, self.config.heartbeat_max_ttl
@@ -270,6 +310,7 @@ class Server:
                 ack_timeout_s=self.config.pipeline_ack_timeout_s,
                 redispatch_backoff_s=self.config.pipeline_redispatch_backoff_s,
                 redispatch_backoff_max_s=self.config.pipeline_redispatch_backoff_max_s,
+                backpressure_wait_s=self.config.pipeline_backpressure_wait_s,
             )
 
         # Cross-region RPC hook (set by the agent): callable
@@ -423,6 +464,12 @@ class Server:
             self._schedule_leader_task(
                 gen, self.config.watchdog_interval, self.watchdog.tick
             )
+        # autoscaler flies with leadership, like the watchdog/flight tasks
+        if self.config.autoscaler_interval_s > 0:
+            self.autoscaler.set_enabled(True)
+            self._schedule_leader_task(
+                gen, self.config.autoscaler_interval_s, self.autoscaler.tick
+            )
         # flight recorder flies with leadership: followers run no sampler
         self.flight.arm()
         if self.vault is not None:
@@ -451,10 +498,15 @@ class Server:
         metrics.set_gauge(
             "nomad.broker.dequeue_waiters", bs.get("dequeue_waiters", 0)
         )
-        metrics.set_gauge(
-            "nomad.blocked_evals.total_blocked",
-            self.blocked_evals.stats().get("total_blocked", 0),
-        )
+        blocked_stats = self.blocked_evals.stats()
+        metric_names.publish_family("nomad.blocked_evals", blocked_stats)
+        # storm ledger (unblock_to_place percentiles, batch sizes, peak
+        # depth) rides the same sweep
+        from ..trace import capacity as _capacity
+
+        _capacity.note_blocked_depth(blocked_stats.get("total_blocked", 0))
+        _capacity.publish_gauges()
+        metric_names.publish_family("nomad.autoscaler", self.autoscaler.stats())
         if self.device_batcher is not None:
             metric_names.publish_family(
                 "nomad.device_batcher", self.device_batcher.stats
@@ -492,6 +544,7 @@ class Server:
         self.periodic_dispatcher.set_enabled(False)
         if self.pipeline is not None:
             self.pipeline.set_enabled(False)
+        self.autoscaler.set_enabled(False)
         self.flight.disarm()
         self._leader_generation += 1  # invalidates in-flight leader timers
         with self._lock:

@@ -270,6 +270,11 @@ def install_server_probes(rec: FlightRecorder, server) -> None:
         lambda: {"depth": server.plan_queue.stats().get("depth", 0)},
     )
     rec.add_probe("trace", lifecycle.quick_stats)
+    # blocked-eval depth + storm counters (unblock batches, coalesced
+    # dups, deferrals) so bottleneck_report-adjacent frames can attribute
+    # blocked-wait time during capacity pressure
+    rec.add_probe("blocked_evals", server.blocked_evals.stats)
+    rec.add_probe("autoscaler", server.autoscaler.stats)
     if server.pipeline is not None:
         rec.add_probe("pipeline", server.pipeline.stats)
     if server.device_batcher is not None:

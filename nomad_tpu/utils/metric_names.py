@@ -29,7 +29,13 @@ from . import metrics
 FAMILIES: Dict[str, str] = {
     "nomad.broker": "eval broker depths: total_ready/unacked/blocked, "
                     "dequeue_waiters (gauges, leader stats sweep)",
-    "nomad.blocked_evals": "blocked-eval tracker depth (gauge)",
+    "nomad.blocked_evals": "blocked-eval tracker: EmitStats depth gauges "
+                           "(publish_family), unblock_batch_size/"
+                           "unblock_to_place_ms samples, "
+                           "unblock_deferred counter",
+    "nomad.autoscaler": "leader autoscaler loop: blocked_depth/"
+                        "nodes_added gauges, scale_up/scale_down "
+                        "counters",
     "nomad.plan": "plan pipeline: queue_depth gauge; evaluate/apply/"
                   "wait_for_index samples; dense_nodes_rejected counter",
     "nomad.worker": "scheduler workers: dequeue_eval/async_handoff "
@@ -44,7 +50,8 @@ FAMILIES: Dict[str, str] = {
                             "compute/transfer samples",
     "nomad.pipeline": "async eval-lifecycle pipeline: stats gauges "
                       "(publish_family) + acked/nacked/nack.<why>/"
-                      "redispatch*/slots_exhausted/... counters",
+                      "redispatch*/slots_exhausted/backpressure/... "
+                      "counters",
     "nomad.tpu_engine": "placement kernel engine: handled/fallback/"
                         "chunk/parity/encode_cache counters + "
                         "encode/apply/device_wait samples",

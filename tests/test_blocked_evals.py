@@ -2,11 +2,14 @@
 nomad/blocked_evals_test.go: class-keyed unblocking (captured vs escaped),
 per-job dedup (latest wins), missed-unblock protection via snapshot
 indexes, system (node-keyed) blocks, the failed (max-plans) queue, and
-untracking.
+untracking — plus the coalesced unblock-storm path (windowed batching,
+the max_batch spike bound, cross-trigger dedup, the unblock_enqueue
+fault's defer-and-retry, and flush-on-leadership-loss).
 """
 import time
 
 from nomad_tpu import mock
+from nomad_tpu.chaos.injector import ChaosInjector
 from nomad_tpu.server.blocked_evals import BlockedEvals
 from nomad_tpu.server.eval_broker import EvalBroker
 from nomad_tpu.structs.structs import EVAL_TRIGGER_MAX_PLANS
@@ -26,12 +29,25 @@ def make_blocked(job_id=None, classes=None, escaped=False, snapshot=0,
     return ev
 
 
-def harness():
+def harness(coalesce_window_s=0.0, max_batch=512):
     broker = EvalBroker()
     broker.set_enabled(True)
-    b = BlockedEvals(broker)
+    b = BlockedEvals(broker, coalesce_window_s=coalesce_window_s,
+                     max_batch=max_batch)
     b.set_enabled(True)
     return broker, b
+
+
+def wait_ready(broker, n, timeout=2.0):
+    """Spin until the broker holds ``n`` ready evals (coalesced flushes
+    land on a timer thread)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if broker.stats()["total_ready"] >= n:
+            return
+        time.sleep(0.005)
+    raise AssertionError(
+        f"broker never reached {n} ready: {broker.stats()}")
 
 
 def drain(broker, timeout=1.0):
@@ -163,3 +179,129 @@ class TestSystemAndFailed:
         b.unblock_failed()
         got = drain(broker)
         assert [e.id for e in got] == [ev.id]
+
+
+class TestCoalescedStorm:
+    def test_window_batches_triggers_into_one_enqueue(self):
+        """With a coalesce window, an unblock trigger stages instead of
+        enqueueing; the timer flush lands the whole set as ONE batch."""
+        broker, b = harness(coalesce_window_s=0.03)
+        evs = [make_blocked(job_id=f"j{i}", classes={"web": True})
+               for i in range(4)]
+        for ev in evs:
+            b.block(ev)
+        b.block(make_blocked(job_id="esc", escaped=True))
+        b.unblock("web", index=10)
+        st = b.stats()
+        assert st["pending_unblocks"] == 5, "staged, not yet enqueued"
+        assert st["unblock_batches"] == 0
+        wait_ready(broker, 5)
+        got = drain(broker)
+        assert len(got) == 5
+        st = b.stats()
+        assert st["unblock_batches"] == 1
+        assert st["unblocks_total"] == 5
+        assert st["pending_unblocks"] == 0
+
+    def test_reblock_between_triggers_dedups_keeping_max_index(self):
+        """The storm race: an eval unblocked by one trigger re-blocks and
+        a second trigger collects it again inside the same window — it
+        must re-enqueue ONCE, carrying the highest capacity index it
+        witnessed (else its refreshed snapshot misses the later change
+        and the next block spuriously parks)."""
+        broker, b = harness(coalesce_window_s=0.05)
+        ev = make_blocked(job_id="racer", classes={"web": True})
+        b.block(ev)
+        b.unblock("web", index=5)
+        assert b.stats()["pending_unblocks"] == 1
+        # re-block at a snapshot covering index 5 (a fresh scheduling
+        # attempt that saw the new capacity and still failed) — a stale
+        # snapshot would take the missed-unblock fast path instead
+        ev.snapshot_index = 5
+        b.block(ev)                    # re-blocks while staged
+        b.unblock("web", index=7)      # second trigger, same window
+        wait_ready(broker, 1)
+        got = drain(broker)
+        assert [e.id for e in got] == [ev.id]
+        assert got[0].snapshot_index == 7, "must keep the max index"
+        st = b.stats()
+        assert st["unblock_dups_coalesced"] == 1
+        assert st["unblocks_total"] == 1
+
+    def test_flushed_snapshot_covers_unblock_index(self):
+        """The re-enqueued copy's snapshot_index equals the unblock
+        index, so re-blocking at that snapshot parks instead of spinning
+        through the missed-unblock fast path forever."""
+        broker, b = harness()
+        ev = make_blocked(job_id="rt", classes={"web": True}, snapshot=3)
+        b.block(ev)
+        b.unblock("web", index=10)
+        got = drain(broker)
+        assert got[0].snapshot_index == 10
+        reblocked = make_blocked(job_id="rt", classes={"web": True},
+                                 snapshot=got[0].snapshot_index)
+        b.block(reblocked)
+        assert b.stats()["total_blocked"] == 1, \
+            "snapshot at the unblock index must park, not re-enqueue"
+
+    def test_max_batch_bounds_each_windowed_flush(self):
+        """A storm bigger than max_batch drains as bounded batches, the
+        remainder deferring one window at a time."""
+        broker, b = harness(coalesce_window_s=0.02, max_batch=4)
+        for i in range(10):
+            b.block(make_blocked(job_id=f"s{i}", classes={"web": True}))
+        b.unblock("web", index=10)
+        wait_ready(broker, 10)
+        assert len(drain(broker)) == 10
+        st = b.stats()
+        assert st["unblock_batches"] == 3          # 4 + 4 + 2
+        assert st["unblocks_total"] == 10
+        assert st["unblock_deferred"] == 2
+
+    def test_sync_mode_drains_all_batches_at_once(self):
+        """coalesce_window_s == 0 keeps unblock-then-ready semantics:
+        the flush loops every capped batch synchronously."""
+        broker, b = harness(max_batch=4)
+        for i in range(10):
+            b.block(make_blocked(job_id=f"y{i}", classes={"web": True}))
+        b.unblock("web", index=10)
+        assert broker.stats()["total_ready"] == 10, "no window, no wait"
+        st = b.stats()
+        assert st["unblock_batches"] == 3
+        assert st["unblock_deferred"] == 0
+
+    def test_unblock_enqueue_fault_defers_then_retries(self):
+        """An injected unblock_enqueue fault re-parks the batch and a
+        backoff timer retries it — degrade, never drop."""
+        broker, b = harness()
+        inj = ChaosInjector(seed=0)
+        inj.arm("unblock_enqueue", mode="fail", prob=1.0, max_fires=1)
+        try:
+            for i in range(3):
+                b.block(make_blocked(job_id=f"f{i}", classes={"web": True}))
+            b.unblock("web", index=10)
+            # the one-shot fault consumed the synchronous flush: the
+            # batch is parked, nothing reached the broker yet
+            assert b.stats()["pending_unblocks"] == 3
+            assert b.stats()["unblock_deferred"] == 1
+            wait_ready(broker, 3)      # backoff retry lands it
+            assert len(drain(broker)) == 3
+            assert b.stats()["pending_unblocks"] == 0
+        finally:
+            inj.disarm_all()
+
+    def test_flush_on_leadership_loss_drops_staged_unblocks(self):
+        """Losing leadership mid-window clears tracked AND staged evals
+        without enqueueing: the new leader's eval restore owns them."""
+        broker, b = harness(coalesce_window_s=0.05)
+        for i in range(3):
+            b.block(make_blocked(job_id=f"l{i}", classes={"web": True}))
+        b.unblock("web", index=10)
+        assert b.stats()["pending_unblocks"] == 3
+        b.set_enabled(False)           # leadership loss -> flush()
+        st = b.stats()
+        assert st["pending_unblocks"] == 0
+        assert st["total_blocked"] == 0
+        time.sleep(0.12)               # past the (cancelled) window
+        assert drain(broker, timeout=0.1) == []
+        assert b.stats()["unblocks_total"] == 0

@@ -52,6 +52,52 @@ def test_trace_deterministic_by_seed():
     assert kinds.count("leader_kill") == 1
 
 
+def test_saturation_kinds_off_is_rng_neutral():
+    """New trace kinds default OFF and, when off, consume no rng — every
+    existing seed keeps producing a byte-identical trace (replay
+    artifacts recorded before the saturation kinds existed stay
+    reproducible)."""
+    for seed in (0, 7, 42):
+        base = generate_trace(seed=seed, duration_s=20.0, n_nodes=50,
+                              n_jobs=12)
+        explicit_off = generate_trace(seed=seed, duration_s=20.0,
+                                      n_nodes=50, n_jobs=12,
+                                      n_saturate_waves=0, saturate_jobs=99,
+                                      release_nodes=99)
+        assert base == explicit_off, \
+            "zero saturation waves must not perturb the rng stream"
+        assert not any(ev.kind in ("saturate", "capacity_release")
+                       for ev in base)
+
+
+def test_saturation_waves_paired_and_bounded():
+    # leader_kill off on both sides: its jitter draws AFTER the
+    # saturation block, so the shared-prefix comparison below would
+    # otherwise see a shifted kill time
+    trace = generate_trace(seed=3, duration_s=20.0, n_nodes=50, n_jobs=12,
+                           leader_kill=False,
+                           n_saturate_waves=2, saturate_jobs=5,
+                           release_nodes=9)
+    sats = [ev for ev in trace if ev.kind == "saturate"]
+    rels = [ev for ev in trace if ev.kind == "capacity_release"]
+    assert len(sats) == len(rels) == 2
+    by_wave = {ev.args["wave"]: ev for ev in sats}
+    for rel in rels:
+        sat = by_wave[rel.args["wave"]]
+        assert sat.t < rel.t, "release must follow its wave's saturation"
+        assert rel.t <= 20.0 * 0.8 * 0.9, \
+            "release lands before the recovery tail"
+        assert rel.args["node_count"] == 9
+        assert sat.args["job_count"] == 5
+    # the prefix shared with a saturation-free trace is unchanged: the
+    # new kinds only APPEND rng draws
+    base = generate_trace(seed=3, duration_s=20.0, n_nodes=50, n_jobs=12,
+                          leader_kill=False)
+    residue = [ev for ev in trace
+               if ev.kind not in ("saturate", "capacity_release")]
+    assert residue == base
+
+
 # ---------------------------------------------------------------------------
 # injector: strict no-op unless armed
 # ---------------------------------------------------------------------------

@@ -823,6 +823,34 @@ def test_fault_injection_flags_module_scope_arm():
     assert "module scope" in fs[0].message
 
 
+def test_fault_injection_unblock_enqueue_point_is_known():
+    # the storm-flush fire point registered with ISSUE 13: a production
+    # fire on it is clean, a near-miss typo is flagged
+    src = dedent("""
+        from ..chaos.injector import fire as chaos_fire
+
+        class BlockedEvals:
+            def _flush_pending_locked(self):
+                chaos_fire("unblock_enqueue", batch=len(self._pending))
+                self.eval_broker.enqueue_all(dict(self._pending))
+    """)
+    assert run_source(src, "nomad_tpu/server/blocked_evals.py") == []
+    typo = src.replace("unblock_enqueue", "unblock_enqueu")
+    fs = run_source(typo, "nomad_tpu/server/blocked_evals.py")
+    assert [f.rule for f in fs] == ["fault-injection-discipline"]
+    assert "unknown injection point" in fs[0].message
+
+
+def test_fault_injection_known_points_match_injector_registry():
+    """The lint's _KNOWN_POINTS copy is maintained by hand (the rule
+    must not import production code); this pins it to the injector's
+    POINTS so a new fire point can't silently lint as unknown."""
+    from nomad_tpu.analysis.fault_injection_discipline import _KNOWN_POINTS
+    from nomad_tpu.chaos.injector import POINTS
+
+    assert set(_KNOWN_POINTS) == set(POINTS)
+
+
 # ---------------------------------------------------------------------------
 # subprocess-discipline
 
