@@ -1,8 +1,18 @@
 """CLI: ``python -m nomad_tpu.analysis [paths...]``.
 
-Exit 0 when every finding is baselined or suppressed; 1 otherwise; 2 on
-bad usage. ``--write-baseline`` records the current findings as the new
-baseline (the ratchet: fix a finding, re-write, commit the smaller file).
+Exit 0 when every finding is baselined or suppressed AND no baseline
+entry is stale; 1 on new findings or stale baseline entries (the
+ratchet is enforced both ways — a fixed finding must be pruned, not
+left as a silent credit new regressions could spend); 2 on bad usage.
+
+``--write-baseline`` records the current findings as the new baseline.
+``--prune`` rewrites the baseline in place with only the stale entries
+removed (the surgical version: it never ADDS entries, so it cannot
+launder a new finding into the baseline). ``--rule`` restricts the run
+to a comma-separated set of rules — baseline matching is restricted to
+the same rules so unrelated entries are not reported stale. ``--json``
+emits a machine-readable object with rendered findings and per-rule
+counts.
 """
 from __future__ import annotations
 
@@ -26,7 +36,7 @@ def main(argv=None) -> int:
         prog="python -m nomad_tpu.analysis",
         description="nomad-lint: AST invariant checks "
                     "(jit-purity, dtype-discipline, lock-discipline, "
-                    "fsm-determinism)",
+                    "lock-order, condition-discipline, fsm-determinism, ...)",
     )
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/directories to lint (default: nomad_tpu)")
@@ -37,8 +47,16 @@ def main(argv=None) -> int:
                         help="report every finding, baselined or not")
     parser.add_argument("--write-baseline", action="store_true",
                         help="record current findings as the new baseline")
+    parser.add_argument("--prune", action="store_true",
+                        help="rewrite the baseline with stale (fixed) "
+                             "entries removed; never adds entries")
+    parser.add_argument("--rule", action="append", default=None,
+                        help="only run/report these rules (repeatable or "
+                             "comma-separated); baseline matching is "
+                             "restricted to the same rules")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="emit findings as JSON")
+                        help="emit a JSON object: rendered findings, "
+                             "per-rule counts, stale baseline entries")
     args = parser.parse_args(argv)
 
     paths = args.paths or ["nomad_tpu"]
@@ -47,7 +65,17 @@ def main(argv=None) -> int:
             print(f"error: no such path: {p}", file=sys.stderr)
             return 2
 
+    rules = None
+    if args.rule:
+        rules = {r.strip() for part in args.rule for r in part.split(",")
+                 if r.strip()}
+        if not rules:
+            print("error: --rule given but empty", file=sys.stderr)
+            return 2
+
     findings = run_paths(paths, rel_to=os.getcwd())
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
@@ -58,21 +86,63 @@ def main(argv=None) -> int:
     stale = []
     if not args.no_baseline and os.path.exists(baseline_path):
         baseline = load_baseline(baseline_path)
+        if rules is not None:
+            baseline = [e for e in baseline if e.get("rule") in rules]
         findings, stale = apply_baseline(findings, baseline)
 
+    if args.prune:
+        if args.no_baseline or not os.path.exists(baseline_path):
+            print("error: --prune needs an existing baseline", file=sys.stderr)
+            return 2
+        full = load_baseline(args.baseline or DEFAULT_BASELINE)
+        budget = {}
+        for ent in stale:
+            key = (ent.get("rule", ""), ent.get("file", ""),
+                   ent.get("message", ""))
+            budget[key] = budget.get(key, 0) + 1
+        kept = []
+        for ent in full:
+            key = (ent.get("rule", ""), ent.get("file", ""),
+                   ent.get("message", ""))
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+                continue
+            kept.append(ent)
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            json.dump(kept, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"pruned {len(stale)} stale entr"
+              f"{'y' if len(stale) == 1 else 'ies'}; "
+              f"{len(kept)} kept in {baseline_path}")
+        stale = []
+
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+
     if args.as_json:
-        print(json.dumps(
-            [f.__dict__ for f in findings], indent=2, sort_keys=True
-        ))
+        print(json.dumps({
+            "findings": [
+                {"rule": f.rule, "file": f.file, "line": f.line,
+                 "message": f.message, "rendered": f.render()}
+                for f in findings
+            ],
+            "counts": counts,
+            "stale_baseline": stale,
+        }, indent=2, sort_keys=True))
     else:
         for f in findings:
             print(f.render())
         if stale:
             print(f"note: {len(stale)} stale baseline entr"
                   f"{'y' if len(stale) == 1 else 'ies'} (fixed findings) — "
-                  "re-run with --write-baseline to prune", file=sys.stderr)
+                  "re-run with --prune to drop them", file=sys.stderr)
     if findings:
         print(f"{len(findings)} new finding(s)", file=sys.stderr)
+        return 1
+    if stale:
+        print("stale baseline entries fail the run: the ratchet only "
+              "tightens", file=sys.stderr)
         return 1
     return 0
 

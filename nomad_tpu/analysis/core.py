@@ -141,11 +141,13 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 
 def default_checkers() -> list:
+    from .condition_discipline import ConditionDisciplineChecker
     from .dtype_discipline import DtypeDisciplineChecker
     from .fault_injection_discipline import FaultInjectionDisciplineChecker
     from .fsm_determinism import FsmDeterminismChecker
     from .jit_purity import JitPurityChecker
     from .lock_discipline import LockDisciplineChecker
+    from .lock_order import LockOrderChecker
     from .metrics_discipline import MetricsDisciplineChecker
     from .pipeline_stage_discipline import PipelineStageDisciplineChecker
     from .subprocess_discipline import SubprocessDisciplineChecker
@@ -161,6 +163,8 @@ def default_checkers() -> list:
         FaultInjectionDisciplineChecker(),
         SubprocessDisciplineChecker(),
         MetricsDisciplineChecker(),
+        LockOrderChecker(),
+        ConditionDisciplineChecker(),
     ]
 
 

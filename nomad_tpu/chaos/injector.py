@@ -48,6 +48,7 @@ import threading
 import time
 from random import Random
 from typing import Dict, Optional
+from ..utils.lock_witness import witness_lock
 
 POINTS = (
     "device_dispatch",
@@ -87,7 +88,7 @@ class ChaosInjector:
 
     def __init__(self, seed: int = 0) -> None:
         self.seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = witness_lock("injector.ChaosInjector._lock")
         self._specs: Dict[str, _PointSpec] = {}
 
     # -- arming ----------------------------------------------------------

@@ -143,6 +143,7 @@ class ChurnReplay:
         trace_kwargs: Optional[dict] = None,
         warmup_counts: Tuple[int, ...] = (),
         autoscale: bool = False,
+        lock_witness: bool = False,
     ) -> None:
         self.seed = int(seed)
         kw = dict(trace_kwargs or {})
@@ -162,6 +163,9 @@ class ChurnReplay:
         self.servers: List[Server] = []
         self.node_ids: List[str] = []
         self.injector = ChaosInjector(seed=self.seed)
+        # nomad-lockdep: arm the runtime lock witness for the whole run
+        # and cross-check witnessed order edges against the static graph
+        self.lock_witness = bool(lock_witness)
 
         self._muted: Set[str] = set()
         self._mute_lock = threading.Lock()
@@ -784,6 +788,12 @@ class ChurnReplay:
 
     def run(self) -> Dict[str, object]:
         t0 = time.monotonic()
+        witness = None
+        if self.lock_witness:
+            from ..utils import lock_witness as _lw
+            # armed BEFORE _boot so every factory-created lock in the
+            # servers under churn is instrumented
+            witness = _lw.arm()
         try:
             self._boot()
             t_run = time.monotonic()
@@ -805,7 +815,20 @@ class ChurnReplay:
             # measurement happens while the cluster is live: the crash
             # harness's replicas are separate processes that stop
             # answering RPC once _shutdown reaps them
-            return self._measure(settled, t0, t_run)
+            result = self._measure(settled, t0, t_run)
+            if witness is not None:
+                from ..analysis.lock_order import build_static_graph
+                result["lock_witness"] = {
+                    **witness.stats(),
+                    "missing_from_static": [
+                        list(e) for e in witness.cross_check(
+                            build_static_graph())
+                    ],
+                }
+            return result
         finally:
+            if witness is not None:
+                from ..utils import lock_witness as _lw
+                _lw.disarm()
             self.injector.disarm_all()
             self._shutdown()

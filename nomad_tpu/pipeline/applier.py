@@ -50,6 +50,7 @@ from ..structs.structs import Plan, PlanResult
 from ..utils import metrics
 from .queues import BoundedStageQueue
 from .redispatch import Redispatcher, WaveEncodeRegistry
+from ..utils.lock_witness import witness_lock
 
 logger = logging.getLogger("nomad_tpu.pipeline.applier")
 
@@ -104,7 +105,7 @@ class AsyncApplier:
         # effectively non-blocking, the bound is the discipline
         self._completions = BoundedStageQueue(
             self.inflight_max + 1, name="wave-completions")
-        self._lock = threading.Lock()
+        self._lock = witness_lock("applier.AsyncApplier._lock")
         self._waves: Dict[str, _Wave] = {}
         # waves parked between redispatches (backoff); drained by _sweep
         self._deferred: List[_Wave] = []

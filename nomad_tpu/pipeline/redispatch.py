@@ -42,6 +42,7 @@ import numpy as np
 from ..structs.structs import Plan, PlanResult
 from ..trace import lifecycle as _lifecycle
 from ..utils import metrics
+from ..utils.lock_witness import witness_lock
 
 logger = logging.getLogger("nomad_tpu.pipeline.redispatch")
 
@@ -69,7 +70,7 @@ class WaveEncodeRegistry:
     applier forgets entries on ack/nack."""
 
     def __init__(self, cap: int = _REGISTRY_CAP) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness_lock("redispatch.WaveEncodeRegistry._lock")
         self._entries: "OrderedDict[str, tuple]" = OrderedDict()
         self.cap = cap
 

@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..trace import capacity
 from ..utils import metrics
+from ..utils.lock_witness import witness_lock
 
 _MAX_HISTORY = 256
 
@@ -61,7 +62,7 @@ class Autoscaler:
         self.cooldown_s = max(0.0, float(cooldown_s))
         self.drain_idle_ticks = max(1, int(drain_idle_ticks))
 
-        self._lock = threading.Lock()
+        self._lock = witness_lock("autoscaler.Autoscaler._lock")
         self._enabled = False
         self._last_action_t = float("-inf")
         self._idle_ticks = 0

@@ -29,6 +29,7 @@ from ..chaos.injector import fire as chaos_fire
 from ..structs.structs import EVAL_STATUS_PENDING, EVAL_TRIGGER_MAX_PLANS, Evaluation
 from ..trace import capacity
 from ..utils import metrics
+from ..utils.lock_witness import witness_rlock
 
 UNBLOCK_FAILED_INTERVAL = 60.0  # periodic retry of max-plan-failed evals
 
@@ -43,7 +44,7 @@ class BlockedEvals:
         self.eval_broker = eval_broker
         self.coalesce_window_s = max(0.0, float(coalesce_window_s))
         self.max_batch = max(1, int(max_batch))
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("blocked_evals.BlockedEvals._lock")
         self.enabled = False
 
         # eval id -> eval

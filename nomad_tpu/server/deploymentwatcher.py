@@ -45,6 +45,7 @@ from ..structs.structs import (
     Evaluation,
     Job,
 )
+from ..utils.lock_witness import witness_lock
 
 # status descriptions (reference structs.go DeploymentStatusDescription*)
 DESC_RUNNING = "Deployment is running"
@@ -72,7 +73,7 @@ class DeploymentsWatcher:
         self._enabled = False
         self._thread: Optional[threading.Thread] = None
         self._generation = 0
-        self._lock = threading.Lock()
+        self._lock = witness_lock("deploymentwatcher.DeploymentsWatcher._lock")
         # deployment id → last observed healthy-alloc total, for detecting
         # mid-rollout health transitions that must kick the scheduler
         self._last_healthy: dict = {}

@@ -42,6 +42,7 @@ from ..structs.structs import (
     Evaluation,
     Node,
 )
+from ..utils.lock_witness import witness_lock
 
 
 class NodeDrainer:
@@ -54,7 +55,7 @@ class NodeDrainer:
         self._enabled = False
         self._thread: Optional[threading.Thread] = None
         self._generation = 0
-        self._lock = threading.Lock()
+        self._lock = witness_lock("drainer.NodeDrainer._lock")
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:

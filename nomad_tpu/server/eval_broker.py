@@ -17,6 +17,7 @@ from ..chaos.injector import fire as chaos_fire
 from ..structs.structs import Evaluation, generate_uuid
 from ..trace import capacity as _capacity
 from ..trace import lifecycle as _trace
+from ..utils.lock_witness import witness_rlock
 
 FAILED_QUEUE = "_failed"
 
@@ -80,7 +81,7 @@ class EvalBroker:
         self.initial_nack_delay = initial_nack_delay
         self.subsequent_nack_delay = subsequent_nack_delay
 
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("eval_broker.EvalBroker._lock")
         self._cond = threading.Condition(self._lock)
         self.enabled = False
 

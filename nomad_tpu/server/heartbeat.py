@@ -19,6 +19,7 @@ from ..structs.structs import (
     Evaluation,
 )
 from .fsm import EVAL_UPDATE, NODE_STATUS_UPDATE
+from ..utils.lock_witness import witness_lock
 
 
 class HeartbeatTimers:
@@ -27,7 +28,7 @@ class HeartbeatTimers:
         self.min_ttl = min_ttl
         self.max_ttl = max_ttl
         self.logger = logging.getLogger("nomad_tpu.heartbeat")
-        self._lock = threading.Lock()
+        self._lock = witness_lock("heartbeat.HeartbeatTimers._lock")
         self._timers: Dict[str, threading.Timer] = {}
         self.enabled = False
 

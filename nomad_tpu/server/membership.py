@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..gossip.memberlist import Member, Memberlist, MemberlistConfig
+from ..utils.lock_witness import witness_rlock
 
 
 @dataclass
@@ -77,7 +78,7 @@ class ServerMembership:
     ) -> None:
         self.region = region
         self.logger = logging.getLogger(f"nomad_tpu.membership.{name}")
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("membership.ServerMembership._lock")
         # region → {member name → ServerMeta}; includes ourselves
         self.peers: Dict[str, Dict[str, ServerMeta]] = {}
         self._tags = {

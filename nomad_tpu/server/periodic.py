@@ -24,6 +24,7 @@ from datetime import datetime, timedelta, timezone
 from typing import Dict, List, Optional, Tuple
 
 from ..structs.structs import Job
+from ..utils.lock_witness import witness_lock
 
 # ---------------------------------------------------------------------------
 # cron engine
@@ -136,7 +137,7 @@ class PeriodicDispatch:
     def __init__(self, server) -> None:
         self.server = server
         self.logger = logging.getLogger("nomad_tpu.periodic")
-        self._lock = threading.Lock()
+        self._lock = witness_lock("periodic.PeriodicDispatch._lock")
         self._cond = threading.Condition(self._lock)
         self.enabled = False
         self._generation = 0

@@ -46,6 +46,7 @@ from ..structs.structs import (
     PlanResult,
 )
 from .fsm import APPLY_PLAN_RESULTS, APPLY_PLAN_RESULTS_BATCH  # noqa: F401 — single-plan op kept for wire compat
+from ..utils.lock_witness import witness_lock
 
 
 class PendingPlan:
@@ -58,7 +59,7 @@ class PlanQueue:
     """Leader-only priority queue of submitted plans (reference plan_queue.go)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness_lock("plan_apply.PlanQueue._lock")
         self._cond = threading.Condition(self._lock)
         self._heap: List[Tuple[int, int, PendingPlan]] = []
         self._counter = itertools.count()
@@ -84,11 +85,18 @@ class PlanQueue:
             return pending
 
     def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
-            if not self._heap:
-                self._cond.wait(timeout=timeout)
-            if not self._heap:
-                return None
+            while not self._heap:
+                if not self.enabled:
+                    return None
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cond.wait(timeout=remaining)
             return heapq.heappop(self._heap)[2]
 
     def stats(self) -> Dict[str, int]:

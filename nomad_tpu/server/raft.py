@@ -19,6 +19,7 @@ import threading
 from typing import Callable, List, Optional, Tuple
 
 from .fsm import NomadFSM
+from ..utils.lock_witness import witness_lock, witness_rlock
 
 
 class NotLeaderError(Exception):
@@ -36,10 +37,10 @@ class InProcRaft:
     """
 
     def __init__(self, data_dir: Optional[str] = None, sync_writes: bool = False) -> None:
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("raft.InProcRaft._lock")
         # serializes whole snapshot() operations with each other, never
         # with apply(): the durable write happens outside _lock
-        self._snap_lock = threading.Lock()
+        self._snap_lock = witness_lock("raft.InProcRaft._snap_lock")
         self.log: List[Tuple[int, str, object]] = []
         self.last_index = 0
         self.fsms: List[NomadFSM] = []

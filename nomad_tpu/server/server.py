@@ -52,6 +52,7 @@ from .heartbeat import HeartbeatTimers
 from .plan_apply import Planner, PlanQueue
 from .raft import InProcRaft
 from .worker import Worker
+from ..utils.lock_witness import witness_rlock
 
 
 def leader_forward(rpc_method: str):
@@ -235,7 +236,7 @@ class Server:
         self._leadership = False
         self._leader_generation = 0
         self._leader_timers: List[threading.Timer] = []
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("server.Server._lock")
 
         # follower->leader write forwarding (leader_forward decorator):
         # one cached RPC client that follows the moving leader address.

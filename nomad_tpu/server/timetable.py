@@ -9,13 +9,14 @@ from __future__ import annotations
 import threading
 import time
 from typing import List, Tuple
+from ..utils.lock_witness import witness_lock
 
 DEFAULT_MAX_ENTRIES = 512
 
 
 class TimeTable:
     def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES) -> None:
-        self._lock = threading.Lock()
+        self._lock = witness_lock("timetable.TimeTable._lock")
         self._entries: List[Tuple[int, int]] = []  # (index, time_ns) ascending
         self.max_entries = max_entries
 

@@ -31,6 +31,7 @@ from ..rpc.codec import encode as codec_encode
 from ..rpc.transport import RPCClient, RPCError, RPCServer
 from .fsm import NomadFSM
 from .raft import NotLeaderError
+from ..utils.lock_witness import witness_lock, witness_rlock
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -107,8 +108,8 @@ class WireRaft:
         self._staged: Dict[str, int] = {}  # peer -> catch-up target index
         self._clients: Dict[str, RPCClient] = {}
 
-        self._lock = threading.RLock()
-        self._snap_lock = threading.Lock()
+        self._lock = witness_rlock("wire_raft.WireRaft._lock")
+        self._snap_lock = witness_lock("wire_raft.WireRaft._snap_lock")
         self._commit_cv = threading.Condition(self._lock)
         self._repl_cv = threading.Condition(self._lock)
         self._snapshots_installed = 0

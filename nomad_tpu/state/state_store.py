@@ -32,11 +32,12 @@ from ..structs.structs import (
     PlanResult,
     SchedulerConfiguration,
 )
+from ..utils.lock_witness import witness_rlock
 
 
 class StateStore:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("state_store.StateStore._lock")
         self._cond = threading.Condition(self._lock)
         self.latest_index = 0
 
@@ -136,7 +137,7 @@ class StateStore:
 
     def __setstate__(self, d):
         self.__dict__.update(d)
-        self._lock = threading.RLock()
+        self._lock = witness_rlock("state_store.StateStore._lock")
         self._cond = threading.Condition(self._lock)
         # fresh identity: a restored store may diverge from its origin, so
         # it must never share the origin's encode-cache key space
@@ -200,7 +201,7 @@ class StateStore:
         immutable once inserted — all writers insert copies)."""
         with self._lock:
             snap = StateStore.__new__(StateStore)
-            snap._lock = threading.RLock()
+            snap._lock = witness_rlock("state_store.StateStore._lock")
             snap._cond = threading.Condition(snap._lock)
             snap.latest_index = self.latest_index
             snap.store_id = self.store_id

@@ -29,6 +29,7 @@ import numpy as np
 from ..chaos.injector import fire as chaos_fire
 from .engine import EncodedEval, _build_batched_scan, _round_up
 from .intscore import E27_ONE as _E27_NEUTRAL
+from ..utils.lock_witness import witness_lock
 
 logger = logging.getLogger("nomad_tpu.tpu.batcher")
 
@@ -290,11 +291,11 @@ class DeviceBatcher:
             maxsize=max(0, self.queue_max)
         )
         self._scan = None
-        self._scan_lock = threading.Lock()  # prewarm + dispatcher race
+        self._scan_lock = witness_lock("batcher.DeviceBatcher._scan_lock")  # prewarm + dispatcher race
         # padded-shape key -> set of batch buckets already compiled/warming
         self._warmed: Dict[tuple, set] = {}
         self._warm_threads: List[threading.Thread] = []
-        self._lock = threading.Lock()
+        self._lock = witness_lock("batcher.DeviceBatcher._lock")
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # observability — the server publishes these as

@@ -54,6 +54,7 @@ from .encode import (
     build_tg_spec,
     job_device_dims,
 )
+from ..utils.lock_witness import witness_lock
 
 logger = logging.getLogger("nomad_tpu.tpu.engine")
 
@@ -1157,7 +1158,7 @@ class TpuPlacementEngine:
         self._chunk_scans: Dict[int, object] = {}
         import threading as _threading
 
-        self._parity_lock = _threading.Lock()
+        self._parity_lock = witness_lock("engine.TpuPlacementEngine._parity_lock")
         self._parity_samples = {
             "evals_sampled": 0,
             "placements_checked": 0,

@@ -26,8 +26,9 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
-from ..utils import metrics
+from ..utils import lock_witness, metrics
 from . import lifecycle
+from ..utils.lock_witness import witness_lock
 
 _clock = time.monotonic
 
@@ -45,7 +46,7 @@ class FlightRecorder:
         self._frames: "deque[Dict[str, object]]" = deque(maxlen=max(1, self.retain))
         self._probes: Dict[str, Callable[[], object]] = {}
         self._publishers: List[Callable[[], None]] = []
-        self._lock = threading.Lock()
+        self._lock = witness_lock("flight.FlightRecorder._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._spill_fh = None
@@ -287,3 +288,7 @@ def install_server_probes(rec: FlightRecorder, server) -> None:
         },
     )
     rec.add_probe("encode_cache", _encode_cache_probe())
+    # nomad-lockdep: {"armed": 0} when disarmed; lock/edge/violation
+    # counters when a witness is live (probes run OUTSIDE rec._lock, so
+    # this adds no flight->witness order edge)
+    rec.add_probe("lock_witness", lock_witness.stats)

@@ -27,6 +27,7 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 from ..utils import metric_names, metrics
+from ..utils.lock_witness import witness_lock
 
 _DONE_CAP = 2048
 
@@ -112,7 +113,7 @@ class EvalTrace:
         }
 
 
-_lock = threading.Lock()
+_lock = witness_lock("lifecycle._lock")
 _inflight: Dict[str, EvalTrace] = {}
 _done: "deque[EvalTrace]" = deque(maxlen=_DONE_CAP)
 _counts: Dict[str, int] = {"ack": 0, "nack": 0, "failed": 0, "flush": 0}

@@ -117,11 +117,15 @@ class LivenessWatchdog:
     def _dump(self, stalled: float, placed: Optional[int],
               broker: Dict[str, object]) -> None:
         from ..agent.monitor import thread_dump
+        from ..utils import lock_witness
 
         # the flight recorder's tail shows what the system was doing
         # LEADING INTO the stall, which the instantaneous probes can't
         flight = getattr(self.server, "flight", None)
         flight_tail = flight.frames(recent=8) if flight is not None else []
+        # when the lock witness is armed, which thread holds which locks
+        # is often the entire stall story (empty table when disarmed)
+        held = lock_witness.held_snapshot()
         self.logger.warning(
             "liveness watchdog: placement flat at %s desired-run allocs "
             "for %.1fs with evals in flight\n"
@@ -129,6 +133,7 @@ class LivenessWatchdog:
             "worker spans: %s\n"
             "slowest in-flight evals: %s\n"
             "last flight frames: %s\n"
+            "witnessed held locks per thread: %s\n"
             "thread stacks:\n%s",
             placed, stalled,
             json.dumps(broker, sort_keys=True, default=str),
@@ -136,5 +141,7 @@ class LivenessWatchdog:
             json.dumps(lifecycle.slowest_inflight(5), sort_keys=True,
                        default=str),
             json.dumps(flight_tail, sort_keys=True, default=str),
+            json.dumps(held, sort_keys=True, default=str)
+            if held else "(witness disarmed)",
             thread_dump(),
         )

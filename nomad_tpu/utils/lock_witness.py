@@ -1,0 +1,341 @@
+"""nomad-lockdep's dynamic side: an opt-in lock witness.
+
+Production lock sites create their locks through the factories here
+(``witness_lock``/``witness_rlock``), naming each lock with the SAME key
+the static analyzer (``nomad_tpu/analysis/lock_order.py``) derives for
+it — ``module.Class._lockname`` for instance locks, ``module._lockname``
+for module-level ones. When the witness is DISARMED (the default) the
+factories return plain ``threading.Lock``/``RLock`` objects: production
+pays nothing, not even an isinstance check per acquisition. When ARMED
+(``NOMAD_LOCK_WITNESS=1`` in the environment at import time, or
+``arm()`` before the locks are constructed — mirroring the chaos
+injector's arm/disarm pattern) the factories return instrumented
+wrappers that record, per thread, the set of held locks and, globally,
+every acquisition-order edge ``A -> B`` ("B was acquired while A was
+held"). Edges are keyed by lock NAME, not instance — kernel lockdep's
+lock-class semantics — so a thousand short-lived ``StateStore``
+snapshots share one node and same-name nesting is treated as reentrant
+rather than inverted.
+
+On every NEW edge the witness checks the global graph for a path
+``B -> ... -> A``; finding one means two threads can take the same pair
+of locks in opposite orders — a potential deadlock — and the witness
+fails FAST with :class:`LockOrderViolation` carrying both acquisition
+stacks (this thread's, plus the stack recorded when the reverse path's
+first edge was witnessed). At teardown, :func:`cross_check` compares
+every witnessed edge against the static analyzer's whole-program graph:
+the dynamic run validates that the static pass is a sound
+over-approximation, and the static pass covers orders no test happened
+to exercise.
+
+Conditions: ``threading.Condition(self._lock)`` works unchanged on a
+witness lock — the wrapper implements ``_is_owned``/``_release_save``/
+``_acquire_restore`` with held-set bookkeeping, so a ``wait()`` properly
+drops the lock from the thread's held set while parked.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+class LockOrderViolation(RuntimeError):
+    """A lock acquisition would close a cycle in the witnessed order
+    graph — i.e. some other thread has taken (part of) the same lock set
+    in the opposite order."""
+
+
+def _stack_summary(skip: int = 2, limit: int = 14) -> str:
+    frames = traceback.format_stack()[:-skip]
+    return "".join(frames[-limit:])
+
+
+class LockWitness:
+    """Global witness state: the order graph, per-thread held stacks and
+    the first-witness stack for every edge."""
+
+    def __init__(self) -> None:
+        # internal mutex — a plain lock, invisible to the witness itself
+        self._mu = threading.Lock()
+        # name -> set of successor names ("successor acquired while name held")
+        self._graph: Dict[str, Set[str]] = {}
+        # (a, b) -> (thread name, stack at first witness)
+        self._edge_stacks: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        # thread ident -> ordered list of held lock names (dups collapsed)
+        self._held: Dict[int, List[str]] = {}
+        self._thread_names: Dict[int, str] = {}
+        self._names: Set[str] = set()
+        self.acquisitions = 0
+        self.violations = 0
+
+    # -- bookkeeping (called from _WitnessLock) --------------------------
+
+    def _register(self, name: str) -> None:
+        with self._mu:
+            self._names.add(name)
+
+    def note_acquired(self, name: str, record_edges: bool) -> None:
+        """Called AFTER the inner lock is acquired. Records edges from
+        every currently-held (differently-named) lock to ``name`` and
+        fails fast if any new edge closes a cycle."""
+        ident = threading.get_ident()
+        with self._mu:
+            self.acquisitions += 1
+            held = self._held.setdefault(ident, [])
+            self._thread_names[ident] = threading.current_thread().name
+            if name in held:
+                held.append(name)  # reentrant by name: no edges
+                return
+            if record_edges:
+                for prior in dict.fromkeys(held):
+                    if prior == name:
+                        continue
+                    succ = self._graph.setdefault(prior, set())
+                    if name in succ:
+                        continue
+                    cyc = self._find_path(name, prior)
+                    if cyc is not None:
+                        self.violations += 1
+                        # raise WITHOUT registering the hold: the caller
+                        # releases the inner lock before propagating
+                        raise self._violation(prior, name, cyc)
+                    succ.add(name)
+                    self._edge_stacks[(prior, name)] = (
+                        threading.current_thread().name,
+                        _stack_summary(skip=3),
+                    )
+            held.append(name)
+
+    def note_released(self, name: str) -> None:
+        ident = threading.get_ident()
+        with self._mu:
+            held = self._held.get(ident)
+            if not held:
+                return
+            # release the most recent entry with this name (LIFO-ish; out
+            # of order releases still keep the multiset right)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == name:
+                    del held[i]
+                    break
+            if not held:
+                self._held.pop(ident, None)
+
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS for a path src -> ... -> dst in the edge graph."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in sorted(self._graph.get(node, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _violation(self, prior: str, name: str,
+                   cycle_path: List[str]) -> LockOrderViolation:
+        chain = " -> ".join(cycle_path + [cycle_path[0]]) if cycle_path else ""
+        first = self._edge_stacks.get(
+            (cycle_path[0], cycle_path[1]) if len(cycle_path) > 1 else (name, prior)
+        )
+        other = (f"reverse edge first witnessed on thread "
+                 f"{first[0]!r}:\n{first[1]}" if first else
+                 "reverse edge stack unavailable")
+        return LockOrderViolation(
+            f"lock order inversion: acquiring {name!r} while holding "
+            f"{prior!r}, but the witnessed graph already orders "
+            f"{chain or (name + ' -> ' + prior)}.\n"
+            f"this thread {threading.current_thread().name!r}:\n"
+            f"{_stack_summary(skip=4)}\n{other}"
+        )
+
+    # -- read side -------------------------------------------------------
+
+    def edges(self) -> List[Tuple[str, str]]:
+        with self._mu:
+            return sorted(
+                (a, b) for a, succ in self._graph.items() for b in succ
+            )
+
+    def edge_stacks(self) -> Dict[Tuple[str, str], Tuple[str, str]]:
+        with self._mu:
+            return dict(self._edge_stacks)
+
+    def held_snapshot(self) -> Dict[str, List[str]]:
+        """Thread name -> held lock names, for the watchdog's stall dump."""
+        with self._mu:
+            return {
+                self._thread_names.get(ident, str(ident)): list(names)
+                for ident, names in sorted(self._held.items())
+                if names
+            }
+
+    def stats(self) -> Dict[str, object]:
+        with self._mu:
+            return {
+                "armed": 1,
+                "locks": len(self._names),
+                "edges": sum(len(s) for s in self._graph.values()),
+                "acquisitions": self.acquisitions,
+                "violations": self.violations,
+            }
+
+    def cross_check(self, static_edges: Sequence[Tuple[str, str]]
+                    ) -> List[Tuple[str, str]]:
+        """Witnessed edges MISSING from the static analyzer's graph —
+        each one is a real runtime order the static pass failed to see
+        (an unsoundness in its call resolution). Empty list == sound."""
+        allowed = set(static_edges)
+        return [e for e in self.edges() if e not in allowed]
+
+
+class _WitnessLock:
+    """Instrumented wrapper around a Lock/RLock. Duck-types the full
+    lock protocol including the private Condition hooks."""
+
+    def __init__(self, name: str, inner, witness: LockWitness) -> None:
+        self._name = name
+        self._inner = inner
+        self._w = witness
+        witness._register(name)
+
+    # -- core protocol ---------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                # trylocks (blocking=False) can't participate in a deadlock
+                # cycle by themselves — record the hold, not the order edge
+                self._w.note_acquired(self._name, record_edges=blocking)
+            except LockOrderViolation:
+                self._inner.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._w.note_released(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        return bool(inner_locked()) if inner_locked is not None else False
+
+    # -- Condition integration -------------------------------------------
+    #
+    # Condition.wait() swaps the lock out via _release_save and back via
+    # _acquire_restore; the held-set must follow so a parked waiter does
+    # not look like a lock holder to the witness.
+
+    def _is_owned(self) -> bool:
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        # plain Lock: Python's fallback probe would self-deadlock through
+        # the wrapper; approximate with "locked at all"
+        return self._inner.locked()
+
+    def _release_save(self):
+        save = getattr(self._inner, "_release_save", None)
+        state = save() if save is not None else self._inner.release()
+        self._w.note_released(self._name)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        restore = getattr(self._inner, "_acquire_restore", None)
+        if restore is not None:
+            restore(state)
+        else:
+            self._inner.acquire()
+        # re-entry after a wait IS an acquisition: if the thread still
+        # holds other locks, the order edge is real
+        self._w.note_acquired(self._name, record_edges=True)
+
+    def __repr__(self) -> str:
+        return f"<witness {self._name} {self._inner!r}>"
+
+
+# -- the production-facing factories ----------------------------------------
+#
+# _ACTIVE is None almost always; lock creation sites pay one global read at
+# CONSTRUCTION time only. Exactly one witness can be active.
+
+_ACTIVE: Optional[LockWitness] = None
+_active_mu = threading.Lock()
+
+
+def arm(witness: Optional[LockWitness] = None) -> LockWitness:
+    """Install a witness. Locks created BEFORE arming stay plain — arm
+    before constructing the servers under test."""
+    global _ACTIVE
+    with _active_mu:
+        if _ACTIVE is not None and witness is not None and _ACTIVE is not witness:
+            raise RuntimeError("another LockWitness is already armed; disarm first")
+        if _ACTIVE is None:
+            _ACTIVE = witness or LockWitness()
+        return _ACTIVE
+
+
+def disarm() -> None:
+    global _ACTIVE
+    with _active_mu:
+        _ACTIVE = None
+
+
+def active() -> Optional[LockWitness]:
+    return _ACTIVE
+
+
+def witness_lock(name: str):
+    """A ``threading.Lock`` — instrumented iff a witness is armed."""
+    w = _ACTIVE
+    if w is None:
+        return threading.Lock()
+    return _WitnessLock(name, threading.Lock(), w)
+
+
+def witness_rlock(name: str):
+    """A ``threading.RLock`` — instrumented iff a witness is armed."""
+    w = _ACTIVE
+    if w is None:
+        return threading.RLock()
+    return _WitnessLock(name, threading.RLock(), w)
+
+
+def witness_condition(name: str, lock=None):
+    """A ``threading.Condition``. Pass the (already witness-created)
+    lock it guards; with no lock, an instrumented RLock is minted under
+    ``name`` so the condition's internal lock is witnessed too."""
+    if lock is None:
+        lock = witness_rlock(name)
+    return threading.Condition(lock)
+
+
+def stats() -> Dict[str, object]:
+    """Flight-recorder probe: cheap, never raises."""
+    w = _ACTIVE
+    if w is None:
+        return {"armed": 0}
+    return w.stats()
+
+
+def held_snapshot() -> Dict[str, List[str]]:
+    """Watchdog hook: thread -> held locks when armed, else empty."""
+    w = _ACTIVE
+    return w.held_snapshot() if w is not None else {}
+
+
+if os.environ.get("NOMAD_LOCK_WITNESS") == "1":  # pragma: no cover - env gate
+    arm()

@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 import time
 from typing import Dict, List, Optional
+from .lock_witness import witness_lock
 
 
 class _Aggregate:
@@ -59,7 +60,7 @@ class InmemSink:
     def __init__(self, interval: float = 10.0, retain: int = 6) -> None:
         self.interval = interval
         self.retain = retain
-        self._lock = threading.Lock()
+        self._lock = witness_lock("metrics.InmemSink._lock")
         self._intervals: List[_Interval] = [_Interval(time.time())]
 
     def _current(self) -> _Interval:
@@ -218,7 +219,7 @@ _global = InmemSink()
 #: external push sinks fanned out alongside the inmem sink (go-metrics
 #: FanoutSink: inmem + statsd/statsite/datadog per telemetry config)
 _sinks: List[object] = []
-_sinks_lock = threading.Lock()
+_sinks_lock = witness_lock("metrics._sinks_lock")
 
 
 def register_sink(sink) -> None:

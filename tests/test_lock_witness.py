@@ -1,0 +1,228 @@
+"""nomad-lockdep's dynamic side (nomad_tpu/utils/lock_witness.py).
+
+The contract under test:
+
+  * disarmed (the default) the factories return PLAIN threading locks —
+    zero instrumentation, zero edges;
+  * armed, a planted A->B / B->A inversion raises
+    :class:`LockOrderViolation` at acquisition time, before the second
+    thread can deadlock, and the failed acquisition does not leak the
+    inner lock;
+  * same-name nesting is reentrant (lock-class semantics), trylocks
+    record holds but no order edges, and a Condition wait() drops the
+    lock from the thread's held set while parked;
+  * cross_check() reports exactly the witnessed edges missing from a
+    static edge set.
+"""
+import threading
+
+import pytest
+
+from nomad_tpu.utils import lock_witness
+from nomad_tpu.utils.lock_witness import (
+    LockOrderViolation,
+    LockWitness,
+    witness_condition,
+    witness_lock,
+    witness_rlock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    lock_witness.disarm()
+    yield
+    lock_witness.disarm()
+
+
+# ---------------------------------------------------------------------------
+# pass-through
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_factories_return_plain_locks():
+    assert lock_witness.active() is None
+    lk = witness_lock("x.X._lock")
+    rlk = witness_rlock("x.X._rlock")
+    assert type(lk) is type(threading.Lock())
+    assert type(rlk) is type(threading.RLock())
+    assert lock_witness.stats() == {"armed": 0}
+    assert lock_witness.held_snapshot() == {}
+
+
+def test_disarmed_usage_adds_zero_edges_after_arming():
+    """Locks created before arm() stay plain: using them under a
+    later-armed witness contributes nothing."""
+    pre = witness_lock("pre.Pre._lock")
+    w = lock_witness.arm()
+    post = witness_lock("post.Post._lock")
+    with pre:
+        with post:
+            pass
+    # only the instrumented lock registered an acquisition; the plain
+    # one is invisible, so no edge could involve it
+    assert w.edges() == []
+    assert w.stats()["acquisitions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# planted inversion
+# ---------------------------------------------------------------------------
+
+
+def test_planted_inversion_raises_with_both_stacks():
+    lock_witness.arm()
+    a = witness_lock("t.T._a")
+    b = witness_lock("t.T._b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderViolation) as ei:
+            a.acquire()
+    msg = str(ei.value)
+    assert "t.T._a" in msg and "t.T._b" in msg
+    assert "this thread" in msg
+    assert "first witnessed on thread" in msg
+    # the failed acquisition must not leak the inner lock
+    assert not a.locked()
+    with a:  # still usable on the correct order
+        pass
+
+
+def test_planted_inversion_raises_across_threads():
+    w = lock_witness.arm()
+    a = witness_lock("x.X._a")
+    b = witness_lock("x.X._b")
+
+    def fwd():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=fwd)
+    t.start()
+    t.join()
+    with b:
+        with pytest.raises(LockOrderViolation):
+            with a:
+                pass
+    assert w.stats()["violations"] == 1
+
+
+def test_consistent_order_never_raises():
+    w = lock_witness.arm()
+    a = witness_lock("y.Y._a")
+    b = witness_lock("y.Y._b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert w.edges() == [("y.Y._a", "y.Y._b")]
+    assert w.stats()["violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lock-class semantics and trylocks
+# ---------------------------------------------------------------------------
+
+
+def test_same_name_nesting_is_reentrant_no_edges():
+    w = lock_witness.arm()
+    outer = witness_rlock("snap.Snap._lock")
+    inner = witness_rlock("snap.Snap._lock")  # a thousand snapshots, one node
+    with outer:
+        with inner:
+            pass
+    assert w.edges() == []
+
+
+def test_trylock_records_hold_but_no_order_edge():
+    w = lock_witness.arm()
+    a = witness_lock("z.Z._a")
+    b = witness_lock("z.Z._b")
+    with a:
+        assert b.acquire(blocking=False)
+        assert "z.Z._b" in [n for ns in w.held_snapshot().values() for n in ns]
+        b.release()
+    assert w.edges() == []
+
+
+# ---------------------------------------------------------------------------
+# conditions
+# ---------------------------------------------------------------------------
+
+
+def test_condition_wait_drops_hold_while_parked():
+    w = lock_witness.arm()
+    lk = witness_lock("c.C._lock")
+    cond = threading.Condition(lk)
+    parked = threading.Event()
+    released = []
+
+    def waiter():
+        with cond:
+            parked.set()
+            cond.wait(timeout=10)
+            released.append(True)
+
+    t = threading.Thread(target=waiter, name="parked-waiter")
+    t.start()
+    parked.wait(5)
+    # the waiter is parked inside wait(): it must NOT look like a holder
+    for _ in range(200):
+        held = {n for ns in w.held_snapshot().values() for n in ns}
+        if "c.C._lock" not in held:
+            break
+        threading.Event().wait(0.01)
+    else:
+        raise AssertionError("parked waiter still shown as lock holder")
+    with cond:
+        cond.notify()
+    t.join(5)
+    assert released == [True]
+    assert w.stats()["violations"] == 0
+
+
+def test_witness_condition_factory_mints_a_witnessed_lock():
+    w = lock_witness.arm()
+    cond = witness_condition("m.M._cond")
+    with cond:
+        pass
+    st = w.stats()
+    assert st["locks"] == 1
+    assert st["acquisitions"] == 1
+    assert st["violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cross-check against the static graph
+# ---------------------------------------------------------------------------
+
+
+def test_cross_check_reports_only_missing_edges():
+    w = LockWitness()
+    lock_witness.arm(w)
+    a = witness_lock("s.S._a")
+    b = witness_lock("s.S._b")
+    c = witness_lock("s.S._c")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    static = {("s.S._a", "s.S._b")}  # b->c never derived statically
+    assert w.cross_check(static) == [("s.S._b", "s.S._c")]
+    static_full = {("s.S._a", "s.S._b"), ("s.S._b", "s.S._c")}
+    assert w.cross_check(static_full) == []
+
+
+def test_arm_twice_is_idempotent_but_two_witnesses_conflict():
+    w1 = lock_witness.arm()
+    assert lock_witness.arm() is w1
+    with pytest.raises(RuntimeError):
+        lock_witness.arm(LockWitness())
+    lock_witness.disarm()
+    w2 = lock_witness.arm(LockWitness())
+    assert w2 is not w1

@@ -1099,3 +1099,383 @@ def test_metrics_exempts_sink_plumbing():
                 metrics.set_gauge(f"{prefix}.{key}", float(value))
     """)
     assert run_source(src, "nomad_tpu/utils/metric_names.py") == []
+
+
+# ---------------------------------------------------------------------------
+# fixture units — lock-order
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_flags_lexical_inversion():
+    # the planted A->B / B->A shape: two methods of one class take the
+    # same pair of locks in opposite orders
+    src = dedent("""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lk1 = threading.Lock()
+                self._lk2 = threading.Lock()
+
+            def fwd(self):
+                with self._lk1:
+                    with self._lk2:
+                        pass
+
+            def rev(self):
+                with self._lk2:
+                    with self._lk1:
+                        pass
+    """)
+    fs = run_source(src, "server/locky.py")
+    assert [f.rule for f in fs] == ["lock-order"]
+    assert "potential deadlock" in fs[0].message
+    assert "locky.A._lk1" in fs[0].message
+    assert "locky.A._lk2" in fs[0].message
+
+
+def test_lock_order_accepts_consistent_order():
+    src = dedent("""
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lk1 = threading.Lock()
+                self._lk2 = threading.Lock()
+
+            def fwd(self):
+                with self._lk1:
+                    with self._lk2:
+                        pass
+
+            def also_fwd(self):
+                with self._lk1:
+                    with self._lk2:
+                        pass
+    """)
+    assert run_source(src, "server/locky.py") == []
+
+
+def test_lock_order_walks_through_calls():
+    # neither inversion is lexical: each second lock is taken in a
+    # callee while the first is held in the caller — only the
+    # interprocedural walk sees the cycle
+    src = dedent("""
+        import threading
+
+        class B:
+            def __init__(self):
+                self._x = threading.Lock()
+                self._y = threading.Lock()
+
+            def top(self):
+                with self._x:
+                    self._grab_y()
+
+            def _grab_y(self):
+                with self._y:
+                    pass
+
+            def other(self):
+                with self._y:
+                    self._grab_x()
+
+            def _grab_x(self):
+                with self._x:
+                    pass
+    """)
+    fs = run_source(src, "server/calls.py")
+    assert [f.rule for f in fs] == ["lock-order"]
+    assert "calls.B._x" in fs[0].message
+    assert " via " in fs[0].message  # the call chain is named in the edge
+
+
+def test_lock_order_through_call_consistent_is_clean():
+    src = dedent("""
+        import threading
+
+        class B:
+            def __init__(self):
+                self._x = threading.Lock()
+                self._y = threading.Lock()
+
+            def top(self):
+                with self._x:
+                    self._grab_y()
+
+            def _grab_y(self):
+                with self._y:
+                    pass
+    """)
+    assert run_source(src, "server/calls.py") == []
+
+
+def test_lock_order_uses_witness_factory_literal_keys():
+    # witness-created locks carry their static key as a literal: the
+    # finding names the LITERAL keys, proving the static side and the
+    # runtime witness share one namespace by construction
+    src = dedent("""
+        from nomad_tpu.utils.lock_witness import witness_lock
+
+        class Broker:
+            def __init__(self):
+                self._lock = witness_lock("eval_broker.Broker._lock")
+                self._q = witness_lock("eval_broker.Broker._q")
+
+            def fwd(self):
+                with self._lock:
+                    with self._q:
+                        pass
+
+            def rev(self):
+                with self._q:
+                    with self._lock:
+                        pass
+    """)
+    fs = run_source(src, "server/eval_broker.py")
+    assert [f.rule for f in fs] == ["lock-order"]
+    assert "eval_broker.Broker._lock" in fs[0].message
+    assert "eval_broker.Broker._q" in fs[0].message
+
+
+def test_lock_order_same_name_nesting_is_reentrant():
+    # lock-class semantics: a snapshot's lock shares the live store's
+    # key, so same-key nesting must not self-edge into a "cycle"
+    src = dedent("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def snapshot(self):
+                with self._lock:
+                    other = Store()
+                    with other._lock:
+                        pass
+    """)
+    assert run_source(src, "state/state_store.py") == []
+
+
+# ---------------------------------------------------------------------------
+# fixture units — condition-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_condition_flags_bare_wait():
+    src = dedent("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._items = []
+
+            def take(self):
+                with self._cv:
+                    self._cv.wait()
+                    return self._items.pop()
+    """)
+    fs = run_source(src, "server/condy.py")
+    assert [f.rule for f in fs] == ["condition-discipline"]
+    assert "while-predicate loop" in fs[0].message
+
+
+def test_condition_accepts_while_loop_and_wait_for():
+    src = dedent("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._items = []
+
+            def take(self):
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait(timeout=1.0)
+                    return self._items.pop()
+
+            def take2(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self._items, timeout=1.0)
+                    return self._items.pop()
+    """)
+    assert run_source(src, "server/condy.py") == []
+
+
+def test_condition_flags_unheld_notify():
+    src = dedent("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._items = []
+
+            def put(self, x):
+                self._items.append(x)
+                self._cv.notify()
+    """)
+    fs = run_source(src, "server/condy.py")
+    assert [f.rule for f in fs] == ["condition-discipline"]
+    assert "not provably issued with the lock held" in fs[0].message
+
+
+def test_condition_accepts_provably_held_notify():
+    # three proofs: lexical with, the *_locked naming convention, and
+    # every-call-site-holds-it
+    src = dedent("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._items = []
+
+            def put(self, x):
+                with self._cv:
+                    self._items.append(x)
+                    self._cv.notify()
+
+            def _wake_locked(self):
+                self._cv.notify_all()
+
+            def _wake(self):
+                self._cv.notify()
+
+            def put2(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._wake()
+    """)
+    assert run_source(src, "server/condy.py") == []
+
+
+def test_condition_ignores_non_condition_waits():
+    # Event.wait / subprocess wait are not inventoried Conditions
+    src = dedent("""
+        import threading
+
+        def reap(ev, proc):
+            ev.wait(timeout=5)
+            proc.wait(timeout=5)
+    """)
+    assert run_source(src, "server/condy.py") == []
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: --json / --rule / stale-baseline exit / --prune
+# ---------------------------------------------------------------------------
+
+CYCLE_SRC = dedent("""
+    import threading
+
+    class A:
+        def __init__(self):
+            self._lk1 = threading.Lock()
+            self._lk2 = threading.Lock()
+
+        def fwd(self):
+            with self._lk1:
+                with self._lk2:
+                    pass
+
+        def rev(self):
+            with self._lk2:
+                with self._lk1:
+                    pass
+""")
+
+
+def _cli(argv):
+    from nomad_tpu.analysis.__main__ import main
+    return main(argv)
+
+
+def test_cli_json_output_shape(tmp_path, capsys):
+    mod = tmp_path / "locky.py"
+    mod.write_text(CYCLE_SRC)
+    rc = _cli(["--json", "--no-baseline", str(mod)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    data = json.loads(out)
+    assert set(data) == {"findings", "counts", "stale_baseline"}
+    assert data["counts"] == {"lock-order": 1}
+    (f,) = data["findings"]
+    assert set(f) == {"rule", "file", "line", "message", "rendered"}
+    assert f["rule"] == "lock-order"
+    assert "potential deadlock" in f["message"]
+    assert f["rendered"].startswith(f["file"])
+    assert data["stale_baseline"] == []
+
+
+def test_cli_rule_filter(tmp_path, capsys):
+    mod = tmp_path / "locky.py"
+    mod.write_text(CYCLE_SRC)
+    # filtered to an unrelated rule, the cycle is out of scope
+    rc = _cli(["--rule", "condition-discipline", "--no-baseline", str(mod)])
+    capsys.readouterr()
+    assert rc == 0
+    # comma-separated form includes it again
+    rc = _cli(["--rule", "condition-discipline,lock-order", "--no-baseline",
+               str(mod)])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_cli_stale_baseline_fails_and_prune_heals(tmp_path, capsys):
+    mod = tmp_path / "clean.py"
+    mod.write_text("x = 1\n")
+    base = tmp_path / "baseline.json"
+    stale_entry = {"rule": "lock-order", "file": "gone.py",
+                   "message": "potential deadlock: long since fixed"}
+    base.write_text(json.dumps([stale_entry]))
+
+    # stale entries are a FAILURE, not a warning: the ratchet only tightens
+    rc = _cli(["--baseline", str(base), str(mod)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "stale baseline" in err
+
+    # --prune removes exactly the stale entries and the run goes green
+    rc = _cli(["--baseline", str(base), "--prune", str(mod)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pruned 1 stale entry" in out
+    assert json.loads(base.read_text()) == []
+
+    rc = _cli(["--baseline", str(base), str(mod)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_prune_never_adds_entries(tmp_path, capsys):
+    # a tree with a NEW finding and a stale baseline: prune drops the
+    # stale entry but must not launder the new finding in
+    mod = tmp_path / "locky.py"
+    mod.write_text(CYCLE_SRC)
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps([
+        {"rule": "lock-order", "file": "gone.py", "message": "fixed ages ago"},
+    ]))
+    rc = _cli(["--baseline", str(base), "--prune", str(mod)])
+    capsys.readouterr()
+    assert rc == 1  # the new finding still fails the run
+    assert json.loads(base.read_text()) == []
+
+
+def test_cli_write_baseline_then_green(tmp_path, capsys):
+    mod = tmp_path / "locky.py"
+    mod.write_text(CYCLE_SRC)
+    base = tmp_path / "baseline.json"
+    rc = _cli(["--baseline", str(base), "--write-baseline", str(mod)])
+    capsys.readouterr()
+    assert rc == 0
+    rc = _cli(["--baseline", str(base), str(mod)])
+    capsys.readouterr()
+    assert rc == 0

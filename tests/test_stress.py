@@ -799,3 +799,69 @@ class TestBlockingQueryFanout:
         finally:
             state._bump = orig_bump
             server.stop()
+
+
+class TestLockWitnessStress:
+    """nomad-lockdep's dynamic side under full scheduler pressure: arm
+    the witness, flood a real server, and require (a) no order
+    inversion among the instrumented locks and (b) every witnessed
+    acquisition-order edge to be present in the static analyzer's
+    whole-program graph — the run is the soundness proof for the static
+    pass, and the static pass covers orders the flood didn't hit."""
+
+    def test_witness_armed_flood_is_inversion_free_and_sound(self):
+        from nomad_tpu.analysis.lock_order import build_static_graph
+        from nomad_tpu.server.fsm import NODE_REGISTER
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.trace import lifecycle
+        from nomad_tpu.utils import lock_witness, metrics
+
+        lifecycle.reset()
+        metrics.global_sink().reset()
+        witness = lock_witness.arm()
+        try:
+            # constructed AFTER arming, so every factory-created lock in
+            # the server tree is instrumented
+            server = Server(ServerConfig(
+                num_schedulers=4, device_batch=0,
+                heartbeat_min_ttl=3600, heartbeat_max_ttl=7200,
+            ))
+            server.start()
+            try:
+                for i in range(12):
+                    n = mock.node()
+                    n.name = f"witness-{i}"
+                    n.compute_class()
+                    server.raft_apply(NODE_REGISTER, n)
+                jobs = []
+                for i in range(8):
+                    j = mock.job()
+                    j.id = f"witness-{i}"
+                    j.task_groups[0].count = 8
+                    j.task_groups[0].tasks[0].resources.cpu = 20
+                    j.task_groups[0].tasks[0].resources.memory_mb = 32
+                    jobs.append(j)
+                expected = sum(tg.count for j in jobs for tg in j.task_groups)
+                for j in jobs:
+                    server.register_job(j)
+                spin_until(
+                    lambda: server.fsm.state.count_allocs_desired_run()
+                    >= expected,
+                    timeout=120, msg=f"{expected} witnessed placements",
+                )
+            finally:
+                server.stop()
+
+            stats = witness.stats()
+            assert stats["violations"] == 0
+            # the flood must actually exercise nested acquisition — a
+            # zero-edge run would vacuously "prove" soundness
+            assert stats["acquisitions"] > 1000, stats
+            assert stats["edges"] > 0, stats
+            missing = witness.cross_check(build_static_graph())
+            assert not missing, (
+                "runtime lock orders the static lock-order graph never "
+                f"derived (static-analysis unsoundness): {missing}"
+            )
+        finally:
+            lock_witness.disarm()
