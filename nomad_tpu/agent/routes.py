@@ -122,6 +122,7 @@ class Routes:
         r("/v1/search", self.search)
         r("/v1/metrics", self.metrics)
         r("/v1/trace", self.trace)
+        r("/v1/trace/distributed", self.trace_distributed)
         r("/v1/flight", self.flight)
 
     # -- jobs ------------------------------------------------------------
@@ -826,6 +827,27 @@ class Routes:
             out["workers"] = srv.watchdog.worker_spans()
             if srv.device_batcher is not None:
                 out["dispatch_profile"] = srv.device_batcher.dispatch_profile()
+        return out
+
+    def trace_distributed(self, req: Request):
+        """Stitched cross-process trace view (nomad-xtrace): this
+        process's span ring merged into per-trace span trees, with the
+        stitched bottleneck ledger and the per-method RPC table. A
+        single-agent view covers one process; chaos harnesses stitch all
+        replicas via Trace.Export. ?recent=N bounds the trace tail
+        (default 16)."""
+        from ..rpc import transport
+        from ..trace import attribution, context, stitch
+
+        try:
+            recent = int(req.param("recent") or 16)
+        except ValueError:
+            raise HTTPError(400, "recent must be an integer")
+        exported = context.export()
+        out = stitch.stitch([exported["spans"]], recent=max(0, recent))
+        out["stitched_report"] = attribution.stitched_report(out.pop("spans"))
+        out["rpc"] = transport.rpc_stats()
+        out["dropped"] = exported["dropped"]
         return out
 
     def flight(self, req: Request):

@@ -150,6 +150,7 @@ def default_checkers() -> list:
     from .lock_order import LockOrderChecker
     from .metrics_discipline import MetricsDisciplineChecker
     from .pipeline_stage_discipline import PipelineStageDisciplineChecker
+    from .rpc_telemetry_discipline import RpcTelemetryDisciplineChecker
     from .subprocess_discipline import SubprocessDisciplineChecker
     from .trace_span_discipline import TraceSpanDisciplineChecker
 
@@ -165,6 +166,7 @@ def default_checkers() -> list:
         MetricsDisciplineChecker(),
         LockOrderChecker(),
         ConditionDisciplineChecker(),
+        RpcTelemetryDisciplineChecker(),
     ]
 
 

@@ -12,8 +12,10 @@ string grows every retained interval without bound and makes
      fine — the family stays greppable);
   2. f-string names must NOT appear lexically inside a for/while loop —
      that is the "minted in a hot loop" cardinality smell. Loops publish
-     dynamic key sets through the one blessed door,
-     ``utils.metric_names.publish_family(prefix, mapping)``;
+     dynamic key sets through the blessed doors in
+     ``utils.metric_names``: ``publish_family(prefix, mapping)`` for
+     gauges, ``family_sample``/``family_counter`` for bounded dynamic
+     keys under a registered family (the RPC layer's per-method names);
   3. the name's family (``nomad.<second segment>``) is documented in
      ``utils/metric_names.py`` FAMILIES (enforced when that registry is
      in the scanned module set, i.e. on full-tree runs; fixtures opt in
@@ -56,9 +58,17 @@ def _is_metrics_call(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
     return None
 
 
-def _is_publish_family(call: ast.Call, aliases: Dict[str, str]) -> bool:
+#: the blessed dynamic-name doors in utils/metric_names.py; each takes a
+#: literal registered family prefix as its first argument
+_BLESSED_DOORS = {"publish_family", "family_sample", "family_counter"}
+
+
+def _blessed_door(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
     name = resolve_call_name(call.func, aliases)
-    return name is not None and name.split(".")[-1] == "publish_family"
+    if name is None:
+        return None
+    tail = name.split(".")[-1]
+    return tail if tail in _BLESSED_DOORS else None
 
 
 def _fstring_head(node: ast.JoinedStr) -> Optional[str]:
@@ -136,8 +146,9 @@ class MetricsDisciplineChecker:
     def _check_call(self, module: ParsedModule, call: ast.Call,
                     aliases: Dict[str, str], in_loop: bool,
                     findings: List[Finding]) -> None:
-        if _is_publish_family(call, aliases):
-            self._check_prefix(module, call, findings)
+        door = _blessed_door(call, aliases)
+        if door is not None:
+            self._check_prefix(module, call, door, findings)
             return
         fn = _is_metrics_call(call, aliases)
         if fn is None or not call.args:
@@ -188,7 +199,7 @@ class MetricsDisciplineChecker:
         ))
 
     def _check_prefix(self, module: ParsedModule, call: ast.Call,
-                      findings: List[Finding]) -> None:
+                      door: str, findings: List[Finding]) -> None:
         if not call.args:
             return
         prefix = call.args[0]
@@ -197,8 +208,8 @@ class MetricsDisciplineChecker:
                 and prefix.value.startswith("nomad.")):
             findings.append(Finding(
                 RULE, module.rel, call.lineno,
-                "publish_family() prefix must be a 'nomad.*' string "
-                "literal",
+                f"{door}() prefix must be a 'nomad.*' string "
+                f"literal",
             ))
             return
         self._check_family(module, call, prefix.value, findings)

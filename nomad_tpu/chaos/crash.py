@@ -37,10 +37,13 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..rpc import transport as rpc_transport
 from ..rpc.transport import RPCClient, RPCError
+from ..trace import context as xtrace
 from ..trace import failover
 from ..trace.flight import FlightRecorder
 from .replay import _RETRYABLE, ChurnReplay
@@ -307,6 +310,16 @@ class CrashReplay(ChurnReplay):
         # failover's black box: which term each replica saw, when the
         # broker drained, when the killed node went dark
         self.harness_flight: Optional[FlightRecorder] = None
+        # nomad-xtrace collector: incremental Trace.Export drains on the
+        # flight-probe cadence, per-replica seq cursors so a re-poll
+        # never double-counts and ring eviction only loses what the
+        # collector was too slow to read
+        self._trace_cursors: Dict[str, int] = {}
+        self._collected_spans: Dict[str, List[Dict[str, object]]] = {}
+        self._replica_rpc: Dict[str, Dict[str, object]] = {}
+        self._trace_dropped: Dict[str, int] = {}
+        self._collect_lock = threading.Lock()
+        self._pump_rr = 0
 
     # -- cluster plumbing overrides ---------------------------------------
 
@@ -329,6 +342,10 @@ class CrashReplay(ChurnReplay):
         for nid, sp in self.procs.items():
             self.harness_flight.add_probe(
                 f"replica:{nid}", self._mk_replica_probe(sp))
+        # span collection rides the same cadence: each tick drains every
+        # live replica's ring incrementally, so a later SIGKILL loses at
+        # most one tick's worth of spans
+        self.harness_flight.add_probe("xtrace", self._drain_traces)
         self.harness_flight.arm()
 
     def _mk_replica_probe(self, sp: ServerProcess):
@@ -348,6 +365,80 @@ class CrashReplay(ChurnReplay):
         if fl is None:
             return {}
         return {"harness": dict(armed=fl.armed, **fl.overhead())}
+
+    # -- nomad-xtrace collection ------------------------------------------
+
+    def _drain_traces(self) -> Dict[str, object]:
+        """One incremental collection pass: drain every live replica's
+        span ring past this collector's cursor, plus its per-method RPC
+        table. Doubles as a flight probe (the returned brief lands in
+        the frame ring)."""
+        with self._collect_lock:
+            for nid, sp in self.procs.items():
+                if not sp.alive():
+                    continue
+                try:
+                    out = sp.call(
+                        "Trace.Export", self._trace_cursors.get(nid, 0),
+                        no_forward=True, timeout=2.0,
+                    )
+                except (RPCError, OSError):
+                    continue
+                spans = out.get("spans") or []
+                if spans:
+                    self._collected_spans.setdefault(nid, []).extend(spans)
+                self._trace_cursors[nid] = int(
+                    out.get("next_seq", self._trace_cursors.get(nid, 0)))
+                self._trace_dropped[nid] = int(out.get("dropped", 0))
+                self._replica_rpc[nid] = out.get("rpc") or {}
+            return {
+                "collected": sum(
+                    len(v) for v in self._collected_spans.values()),
+                "dropped": dict(self._trace_dropped),
+            }
+
+    def _span_sets(self) -> List[List[Dict[str, object]]]:
+        """Final drain, then every replica's accumulated spans plus the
+        driver's own ring (the RemoteLeader client spans live there)."""
+        self._drain_traces()
+        with self._collect_lock:
+            sets = [list(xtrace.export()["spans"])]
+            sets.extend(list(v) for v in self._collected_spans.values())
+        return sets
+
+    def _rpc_result(self) -> Dict[str, object]:
+        """Cluster-wide per-method table: every replica's wire-form
+        table merged (histogram buckets add; percentiles recomputed from
+        the merged histogram), plus the per-replica views."""
+        with self._collect_lock:
+            per_replica = {
+                nid: table for nid, table in sorted(self._replica_rpc.items())
+            }
+        return {
+            "cluster": rpc_transport.merge_rpc_tables(per_replica.values()),
+            "replicas": {
+                nid: {
+                    m: {k: v for k, v in row.items() if k != "latency_hist"}
+                    for m, row in table.items()
+                }
+                for nid, table in per_replica.items()
+            },
+        }
+
+    def _pump_leader(self) -> RemoteLeader:
+        """Route heartbeats through a rotating live FOLLOWER: the write
+        forwards follower → leader at layer 7 (reference rpc.go
+        forward()), so the run's steady background traffic exercises —
+        and the stitched ledger measures — the real ``forward_hop``
+        path, without putting the eval critical path behind an extra
+        hop."""
+        lp = self._leader_proc
+        followers = [sp for sp in self.procs.values()
+                     if sp.alive() and sp is not lp]
+        if followers:
+            self._pump_rr += 1
+            return RemoteLeader(followers[self._pump_rr % len(followers)])
+        return self._leader(timeout=1.0)
 
     def _find_leader_proc(self, timeout: float = 5.0,
                           min_term: int = 0) -> ServerProcess:

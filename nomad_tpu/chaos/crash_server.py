@@ -56,6 +56,16 @@ def main(argv=None) -> int:
     from ..rpc.transport import RPCServer
     from ..server.server import Server, ServerConfig
     from ..server.wire_raft import WireRaft, WireRaftConfig
+    from ..trace import context as xtrace
+
+    # nomad-xtrace: stamp this replica's node id on every span it
+    # records, and spill spans to the data dir (append + flush per span)
+    # so a SIGKILL loses nothing already written — the collector's
+    # Trace.Export drain is the fast path, the spill is the black box
+    import os
+
+    xtrace.set_process(args.node_id)
+    xtrace.configure_spill(os.path.join(args.data_dir, "spans.jsonl"))
 
     peers = parse_peers(args.peers)
     rpc = RPCServer(host="127.0.0.1", port=args.rpc_port)

@@ -34,11 +34,13 @@ from collections import Counter
 from typing import Dict, List, Optional, Set, Tuple
 
 from .. import mock
+from ..rpc import transport as rpc_transport
 from ..rpc.transport import RPCError
 from ..server.raft import InProcRaft, NotLeaderError
 from ..server.server import Server, ServerConfig
-from ..trace import attribution, lifecycle
+from ..trace import attribution, lifecycle, stitch
 from ..trace import capacity as capacity_trace
+from ..trace import context as xtrace
 from .injector import ChaosFault, ChaosInjector
 from .trace import ChaosEvent, generate_trace, trace_kind_counts
 
@@ -242,6 +244,13 @@ class ChurnReplay:
                 raise RuntimeError("no leader within timeout")
             time.sleep(0.01)
 
+    def _pump_leader(self):
+        """Server the heartbeat pump drives. The crash harness routes
+        this through a rotating live FOLLOWER so heartbeats traverse
+        layer-7 leader forwarding — the traffic that populates
+        ``forward_hop`` in the stitched ledger."""
+        return self._leader(timeout=1.0)
+
     def _leader_state(self):
         """Read surface for the leader's FSM (a StateStore, or the crash
         harness's RPC-backed facade)."""
@@ -280,6 +289,42 @@ class ChurnReplay:
                     armed=fl.armed, **fl.overhead())
         return out
 
+    def _span_sets(self) -> List[List[Dict[str, object]]]:
+        """Per-process span sets for stitching. The in-proc harness has
+        exactly one process (its own ring); the crash harness returns
+        every replica's Trace.Export drain plus the driver's ring."""
+        return [list(xtrace.export()["spans"])]
+
+    def _rpc_result(self) -> Dict[str, object]:
+        """Per-method RPC table. The in-proc harness reports the driver
+        process's table (empty when ServerProxy short-circuits the
+        wire); the crash harness merges every replica's."""
+        return {"cluster": rpc_transport.rpc_stats(), "replicas": {}}
+
+    def _stitched_result(self) -> Dict[str, object]:
+        """Stitched cross-process trace sample + bottleneck ledger: the
+        nomad-xtrace view of the run. Full trees are too big for a
+        result dict, so this carries the ranked component report, clock
+        offsets, and ONE formatted sample tree (the span-richest
+        trace)."""
+        st = stitch.stitch(self._span_sets())
+        spans = st.pop("spans")
+        report = attribution.stitched_report(spans)
+        sample = ""
+        if st["traces"]:
+            richest = max(st["traces"],
+                          key=lambda t: (t["spans"], t["trace_id"]))
+            sample = stitch.format_tree(richest)
+        return {
+            "processes": st["processes"],
+            "clock_offsets_ms": st["clock_offsets_ms"],
+            "span_count": st["span_count"],
+            "trace_count": st["trace_count"],
+            "orphan_spans": sum(t["orphans"] for t in st["traces"]),
+            "report": report,
+            "sample_trace": sample,
+        }
+
     def _extra_result(self) -> Dict[str, object]:
         """Harness-specific additions merged into the run() result."""
         return {}
@@ -305,7 +350,7 @@ class ChurnReplay:
         interval = max(0.05, self.config.heartbeat_min_ttl / 3.0)
         while not self._pump_stop.wait(interval):
             try:
-                leader = self._leader(timeout=1.0)
+                leader = self._pump_leader()
             except RuntimeError:
                 continue
             with self._mute_lock:
@@ -399,6 +444,9 @@ class ChurnReplay:
         # gauges measure the churn run, not boot/warmup
         lifecycle.reset()
         capacity_trace.reset()
+        xtrace.reset()
+        xtrace.set_process("chaos-driver")
+        rpc_transport.reset_rpc_stats()
         self._pump_thread = threading.Thread(
             target=self._pump_heartbeats, name="chaos-heartbeat-pump",
             daemon=True,
@@ -743,6 +791,9 @@ class ChurnReplay:
             # its coverage self-check is SLO-gateable
             # (attribution_coverage_min)
             "bottleneck_report": attribution.bottleneck_report(),
+            # nomad-xtrace: per-method RPC table + stitched trace sample
+            "rpc": self._rpc_result(),
+            "stitched": self._stitched_result(),
             "flight": self._flight_stats(),
             "capacity": self._capacity_result(),
             "invariants": inv,

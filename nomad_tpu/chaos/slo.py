@@ -36,6 +36,10 @@ class SLOThresholds:
     # this the instrumentation lost track of where the wall went and the
     # run's bottleneck claim is untrustworthy
     attribution_coverage_min: Optional[float] = None
+    # same floor for the STITCHED cross-process ledger (the result's
+    # "stitched" block from nomad_tpu.trace.stitch + attribution via
+    # the crash harness's Trace.Export collector)
+    stitched_attribution_coverage_min: Optional[float] = None
     # capacity-pressure bounds (the result's "capacity" block from
     # nomad_tpu.trace.capacity via ChurnReplay): the saturated-regime
     # gates — evals must actually have parked (peak_min), placement must
@@ -60,6 +64,8 @@ class SLOThresholds:
             "failover_first_commit_ms_max": self.failover_first_commit_ms_max,
             "require_rejoin": self.require_rejoin,
             "attribution_coverage_min": self.attribution_coverage_min,
+            "stitched_attribution_coverage_min":
+                self.stitched_attribution_coverage_min,
             "blocked_peak_min": self.blocked_peak_min,
             "unblock_to_place_p99_ms_max": self.unblock_to_place_p99_ms_max,
             "storm_flatline_s_max": self.storm_flatline_s_max,
@@ -149,6 +155,13 @@ class SLOGate:
             cov = rep.get("coverage")
             check("attribution_coverage", cov, th.attribution_coverage_min,
                   cov is not None and cov >= th.attribution_coverage_min)
+        if th.stitched_attribution_coverage_min is not None:
+            rep = (result.get("stitched") or {}).get("report") or {}
+            cov = rep.get("coverage")
+            check("stitched_attribution_coverage", cov,
+                  th.stitched_attribution_coverage_min,
+                  cov is not None
+                  and cov >= th.stitched_attribution_coverage_min)
 
         cap = result.get("capacity") or {}
         if th.blocked_peak_min is not None:

@@ -5,6 +5,13 @@ Fills the role of the reference's msgpack codec over net/rpc
 tagged with their registered type name and are rebuilt through a class
 registry — never arbitrary deserialization (no pickle on the wire), so a
 malicious peer can only produce known struct types.
+
+Request envelopes are ``{"seq", "method", "body"}`` plus optional
+routing flags (``no_forward``, ``region``) and the distributed-tracing
+context under :data:`TRACE_KEY` — a ``{"trace_id", "span_id"}`` dict
+(trace/context.py) that the server side re-activates so its handler
+span becomes a child of the caller's span. Unknown envelope fields are
+ignored by older peers, so the trace field is wire-compatible both ways.
 """
 from __future__ import annotations
 
@@ -15,6 +22,9 @@ from typing import Any, Dict, Type
 import msgpack
 
 _TYPE_KEY = "__t"
+
+#: request-envelope field carrying the TraceContext wire dict
+TRACE_KEY = "trace"
 _REGISTRY: Dict[str, Type] = {}
 _REGISTRY_READY = False
 _REGISTRY_LOCK = threading.Lock()

@@ -12,6 +12,8 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..structs.structs import Allocation, Job, Node
+from ..trace import context as xtrace
+from . import transport
 from .transport import RPCClient, RPCServer
 
 
@@ -173,6 +175,19 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Operator.SnapshotSave",
                  lambda: server.raft.snapshot(server.peer))
     rpc.register("Eval.BrokerStats", server.eval_broker.stats)
+
+    # -- Trace (nomad-xtrace collector surface) ------------------------
+    # Drains THIS replica's span ring + per-method RPC table. Collectors
+    # keep a per-replica ``after_seq`` cursor (the returned ``next_seq``)
+    # so repeated drains are incremental and idempotent — and like
+    # RaftStats they must pass no_forward=True, or leader forwarding
+    # exports the wrong node's ring.
+    def trace_export(after_seq: int = 0):
+        out = xtrace.export(after_seq=after_seq)
+        out["rpc"] = transport.rpc_stats(wire=True)
+        return out
+
+    rpc.register("Trace.Export", trace_export)
 
 
 class RemoteServerProxy:

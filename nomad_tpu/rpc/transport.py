@@ -4,9 +4,23 @@ Fills the role of reference ``nomad/rpc.go`` + ``helper/pool/``: msgpack
 net/rpc over TCP with connection reuse and leader forwarding
 (rpc.go:409 ``forward`` / :493 forwardLeader). Framing is
 [u32 length][msgpack envelope]; the envelope is
-{"seq", "method", "body"} out and {"seq", "error", "body"} back. One
-server thread per connection (yamux multiplexing is unnecessary when each
-connection already pipelines request/response pairs).
+{"seq", "method", "body"[, "trace"]} out and {"seq", "error", "body"}
+back — ``trace`` is the distributed-tracing context (codec.TRACE_KEY,
+trace/context.py). One server thread per connection (yamux multiplexing
+is unnecessary when each connection already pipelines request/response
+pairs).
+
+Telemetry (the reference exports yamux/raft RPC metrics via go-metrics;
+here the transport itself is the choke point): every dispatched method
+records latency into a log-bucketed histogram plus error /
+``NotLeaderError`` counters and request/response frame bytes, under the
+``nomad.rpc.<method>.*`` family and in a module-level per-method table
+(:func:`rpc_stats`) that the ``Trace.Export`` RPC and the flight
+recorder's ``rpc`` probe read. Client calls open a ``client`` span and
+inject the ambient TraceContext; the server opens a child ``server``
+span around dispatch, so a forwarded write shows up as
+client → server(follower) → client(forward) → server(leader) in the
+stitched trace.
 """
 from __future__ import annotations
 
@@ -17,9 +31,14 @@ import socketserver
 import ssl
 import struct
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
-from .codec import decode, encode
+from ..trace import context as xtrace
+from ..utils import metric_names, metrics
+from ..utils.lock_witness import witness_lock
+from ..utils.metrics import LogHistogram
+from .codec import TRACE_KEY, decode, encode
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 << 20
@@ -27,6 +46,132 @@ MAX_FRAME = 256 << 20
 
 class RPCError(Exception):
     pass
+
+
+class FrameError(ConnectionError):
+    """A frame-level failure (short read, dropped send) tagged with the
+    method, peer address and bytes transferred — a ``ConnectionError``
+    subclass so every retry/failover path that handles peer death keeps
+    working, but a chaos-run log line now says WHICH call to WHOM died
+    mid-frame instead of a bare "peer closed"."""
+
+
+# -- per-method server telemetry -------------------------------------------
+
+
+class _MethodStats:
+    __slots__ = ("calls", "errors", "not_leader", "req_bytes",
+                 "resp_bytes", "hist")
+
+    def __init__(self) -> None:
+        self.calls = 0
+        self.errors = 0
+        self.not_leader = 0
+        self.req_bytes = 0
+        self.resp_bytes = 0
+        self.hist = LogHistogram()
+
+    def row(self, wire: bool = False) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "calls": self.calls,
+            "errors": self.errors,
+            "not_leader": self.not_leader,
+            "req_bytes": self.req_bytes,
+            "resp_bytes": self.resp_bytes,
+            "latency_ms_p50": self.hist.percentile(0.50),
+            "latency_ms_p95": self.hist.percentile(0.95),
+            "latency_ms_p99": self.hist.percentile(0.99),
+        }
+        if wire:
+            # mergeable across replicas: elementwise bucket addition
+            out["latency_hist"] = self.hist.to_wire()
+        return out
+
+
+_rpc_lock = witness_lock("rpc.transport._rpc_lock")
+_rpc_stats: Dict[str, _MethodStats] = {}
+_rpc_inflight = 0
+
+
+def _record_dispatch(method: str, elapsed_s: float,
+                     error: Optional[str]) -> None:
+    ms = elapsed_s * 1000.0
+    not_leader = bool(error) and error.startswith("NotLeaderError")
+    with _rpc_lock:
+        st = _rpc_stats.setdefault(method, _MethodStats())
+        st.calls += 1
+        st.hist.add(ms)
+        if error:
+            st.errors += 1
+        if not_leader:
+            st.not_leader += 1
+    # the method set is bounded by the bind_server registry (unknown
+    # methods never reach here), so these dynamic names stay bounded
+    metric_names.family_sample("nomad.rpc", f"{method}.latency_ms", ms)
+    if error:
+        metric_names.family_counter("nomad.rpc", f"{method}.errors")
+    if not_leader:
+        metric_names.family_counter("nomad.rpc", f"{method}.not_leader")
+
+
+def _record_frame_bytes(method: str, req_bytes: int, resp_bytes: int) -> None:
+    with _rpc_lock:
+        st = _rpc_stats.setdefault(method, _MethodStats())
+        st.req_bytes += req_bytes
+        st.resp_bytes += resp_bytes
+    metric_names.family_sample("nomad.rpc", f"{method}.req_bytes", req_bytes)
+    metric_names.family_sample("nomad.rpc", f"{method}.resp_bytes", resp_bytes)
+
+
+def rpc_stats(wire: bool = False) -> Dict[str, Dict[str, object]]:
+    """Per-method table for this process: counts, byte totals, latency
+    percentiles (``wire=True`` adds the raw histogram buckets so a
+    collector can merge tables across replicas)."""
+    with _rpc_lock:
+        items = list(_rpc_stats.items())
+    return {m: st.row(wire) for m, st in sorted(items)}
+
+
+def rpc_stats_brief() -> Dict[str, object]:
+    """Cheap flight-recorder probe: totals only, no percentile walks."""
+    with _rpc_lock:
+        return {
+            "methods": len(_rpc_stats),
+            "inflight": _rpc_inflight,
+            "calls": sum(st.calls for st in _rpc_stats.values()),
+            "errors": sum(st.errors for st in _rpc_stats.values()),
+            "not_leader": sum(st.not_leader for st in _rpc_stats.values()),
+        }
+
+
+def reset_rpc_stats() -> None:
+    global _rpc_inflight
+    with _rpc_lock:
+        _rpc_stats.clear()
+        _rpc_inflight = 0
+
+
+def merge_rpc_tables(
+    tables: Iterable[Dict[str, Dict[str, object]]],
+) -> Dict[str, Dict[str, object]]:
+    """Merge wire-form per-method tables (``rpc_stats(wire=True)``) from
+    N replicas into one cluster table: counters add, histogram buckets
+    add elementwise, and the percentiles are recomputed from the MERGED
+    histogram — not averaged, so a single slow replica still moves the
+    cluster p99."""
+    merged: Dict[str, _MethodStats] = {}
+    for table in tables:
+        for method, row in (table or {}).items():
+            st = merged.setdefault(method, _MethodStats())
+            st.calls += int(row.get("calls", 0))
+            st.errors += int(row.get("errors", 0))
+            st.not_leader += int(row.get("not_leader", 0))
+            st.req_bytes += int(row.get("req_bytes", 0))
+            st.resp_bytes += int(row.get("resp_bytes", 0))
+            counts = row.get("latency_hist")
+            if counts:
+                st.hist.merge(LogHistogram(counts))
+    return {m: st.row() for m, st in sorted(merged.items())}
 
 
 class TLSConfig:
@@ -100,25 +245,41 @@ class TLSConfig:
             return self._http_client_ctx
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes:
+def _read_exact(sock: socket.socket, n: int, peer: str = "",
+                what: str = "") -> bytes:
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            raise ConnectionError("peer closed")
+            raise FrameError(
+                f"peer {peer or '?'} closed after {len(buf)}/{n} bytes"
+                f"{f' reading {what}' if what else ''}"
+            )
         buf += chunk
     return buf
 
 
-def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+def _send_frame(sock: socket.socket, payload: bytes, peer: str = "",
+                method: str = "") -> None:
+    try:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+    except ConnectionError as e:
+        raise FrameError(
+            f"send of {len(payload)}B frame"
+            f"{f' for {method}' if method else ''} to peer {peer or '?'} "
+            f"failed: {e}"
+        ) from e
 
 
-def _recv_frame(sock: socket.socket) -> bytes:
-    (length,) = _LEN.unpack(_read_exact(sock, 4))
+def _recv_frame(sock: socket.socket, peer: str = "", method: str = "") -> bytes:
+    what = f"{method} response" if method else "frame"
+    (length,) = _LEN.unpack(_read_exact(sock, 4, peer, f"{what} header"))
     if length > MAX_FRAME:
-        raise RPCError(f"frame too large: {length}")
-    return _read_exact(sock, length)
+        raise RPCError(
+            f"frame too large: {length} "
+            f"({what} from peer {peer or '?'})"
+        )
+    return _read_exact(sock, length, peer, f"{length}B {what}")
 
 
 class RPCServer:
@@ -172,12 +333,17 @@ class RPCServer:
                             pass
                         return
                     outer._active_conns.add(sock)
+                peer = "%s:%s" % self.client_address[:2]
                 try:
                     while True:
-                        frame = _recv_frame(sock)
+                        frame = _recv_frame(sock, peer)
                         req = decode(frame)
                         resp = outer._dispatch(req)
-                        _send_frame(sock, encode(resp))
+                        out = encode(resp)
+                        method = req.get("method", "")
+                        if method in outer.handlers:
+                            _record_frame_bytes(method, len(frame), len(out))
+                        _send_frame(sock, out, peer, method)
                 except (ConnectionError, OSError, ssl.SSLError):
                     pass
                 finally:
@@ -233,28 +399,56 @@ class RPCServer:
         body = req.get("body", ())
         fn = self.handlers.get(method)
         if fn is None:
+            # unregistered methods are NOT recorded: the per-method stats
+            # table must stay bounded by the bind_server registry, not by
+            # whatever strings a hostile peer mints
             return {"seq": seq, "error": f"unknown method {method!r}", "body": None}
+        global _rpc_inflight
+        with _rpc_lock:
+            _rpc_inflight += 1
+            inflight = _rpc_inflight
+        metrics.set_gauge("nomad.rpc.inflight", inflight)
+        t0 = time.monotonic()
+        # re-enter the caller's trace: the server span is a child of the
+        # client span that crossed the wire, so a forwarded write nests
+        # client -> server(follower) -> client(forward) -> server(leader)
+        token = xtrace.activate(req.get(TRACE_KEY))
+        resp: dict
         try:
-            # region forwarding (rpc.go:502 forwardRegion): a request naming
-            # another region hops to any server there, which then applies
-            # its own leader forwarding
-            req_region = req.get("region")
-            if req_region and req_region != self.region:
-                result = self._forward_region(req_region, method, body)
-            # leader forwarding (rpc.go:409): followers proxy writes
-            elif (
-                not self.is_leader()
-                and self.leader_addr is not None
-                and self.leader_addr != self.addr
-                and method not in self.LOCAL_ONLY
-                and not req.get("no_forward")
-            ):
-                result = self._forward(method, body)
-            else:
-                result = fn(*body)
-            return {"seq": seq, "error": None, "body": result}
-        except Exception as e:  # noqa: BLE001
-            return {"seq": seq, "error": f"{type(e).__name__}: {e}", "body": None}
+            with xtrace.span(f"rpc.server.{method}", kind="server",
+                             attrs={"method": method}) as sattrs:
+                try:
+                    # region forwarding (rpc.go:502 forwardRegion): a
+                    # request naming another region hops to any server
+                    # there, which then applies its own leader forwarding
+                    req_region = req.get("region")
+                    if req_region and req_region != self.region:
+                        result = self._forward_region(req_region, method, body)
+                    # leader forwarding (rpc.go:409): followers proxy writes
+                    elif (
+                        not self.is_leader()
+                        and self.leader_addr is not None
+                        and self.leader_addr != self.addr
+                        and method not in self.LOCAL_ONLY
+                        and not req.get("no_forward")
+                    ):
+                        sattrs["forwarded"] = True
+                        result = self._forward(method, body)
+                    else:
+                        result = fn(*body)
+                    resp = {"seq": seq, "error": None, "body": result}
+                except Exception as e:  # noqa: BLE001
+                    sattrs["error"] = type(e).__name__
+                    resp = {"seq": seq, "error": f"{type(e).__name__}: {e}",
+                            "body": None}
+        finally:
+            xtrace.deactivate(token)
+            with _rpc_lock:
+                _rpc_inflight -= 1
+                inflight = _rpc_inflight
+            metrics.set_gauge("nomad.rpc.inflight", inflight)
+        _record_dispatch(method, time.monotonic() - t0, resp["error"])
+        return resp
 
     def _forward(self, method: str, body) -> Any:
         if self._forward_pool is None or self._forward_pool.addr != self.leader_addr:
@@ -351,43 +545,58 @@ class RPCClient:
         ``no_retry`` disables the reconnect-resend (required for
         non-idempotent calls like Plan.Submit, where a resend would
         enqueue the work twice)."""
-        with self._lock:
-            self._seq += 1
-            req = {"seq": self._seq, "method": method, "body": tuple(args)}
-            if no_forward:
-                req["no_forward"] = True
-            if region:
-                req["region"] = region
-            try:
-                sock = self._connect()
-                if timeout is not None:
-                    sock.settimeout(timeout)
+        peer = f"{self.addr[0]}:{self.addr[1]}"
+        # the outbound span is opened BEFORE the envelope is built so
+        # inject() carries this span's id: the server's handler span
+        # becomes its child and the stitcher can pair the two to
+        # estimate the clock offset between the processes
+        with xtrace.span(f"rpc.client.{method}", kind="client",
+                         attrs={"method": method, "peer": peer}) as attrs:
+            with self._lock:
+                self._seq += 1
+                req = {"seq": self._seq, "method": method, "body": tuple(args)}
+                if no_forward:
+                    req["no_forward"] = True
+                if region:
+                    req["region"] = region
+                tctx = xtrace.inject()
+                if tctx is not None:
+                    req[TRACE_KEY] = tctx
+                payload = encode(req)
+                attrs["req_bytes"] = len(payload)
                 try:
-                    _send_frame(sock, encode(req))
-                    resp = decode(_recv_frame(sock))
-                finally:
+                    sock = self._connect()
                     if timeout is not None:
-                        sock.settimeout(self.timeout)
-            except (ConnectionError, OSError):
-                self._close_locked()
-                if no_retry:
-                    raise
-                # one reconnect attempt (pool behavior on dead conns)
-                sock = self._connect()
-                if timeout is not None:
-                    sock.settimeout(timeout)
-                try:
-                    _send_frame(sock, encode(req))
-                    resp = decode(_recv_frame(sock))
-                finally:
-                    if timeout is not None:
-                        try:
+                        sock.settimeout(timeout)
+                    try:
+                        _send_frame(sock, payload, peer, method)
+                        frame = _recv_frame(sock, peer, method)
+                    finally:
+                        if timeout is not None:
                             sock.settimeout(self.timeout)
-                        except OSError:
-                            pass
-        if resp.get("error"):
-            raise RPCError(resp["error"])
-        return resp.get("body")
+                except (ConnectionError, OSError):
+                    self._close_locked()
+                    if no_retry:
+                        raise
+                    # one reconnect attempt (pool behavior on dead conns)
+                    attrs["reconnected"] = True
+                    sock = self._connect()
+                    if timeout is not None:
+                        sock.settimeout(timeout)
+                    try:
+                        _send_frame(sock, payload, peer, method)
+                        frame = _recv_frame(sock, peer, method)
+                    finally:
+                        if timeout is not None:
+                            try:
+                                sock.settimeout(self.timeout)
+                            except OSError:
+                                pass
+                attrs["resp_bytes"] = len(frame)
+                resp = decode(frame)
+            if resp.get("error"):
+                raise RPCError(resp["error"])
+            return resp.get("body")
 
     def _close_locked(self) -> None:
         if self._sock is not None:

@@ -14,6 +14,7 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 from ..scheduler.scheduler import new_scheduler
+from ..trace import context as _xcontext
 from ..trace import lifecycle as _lifecycle
 from ..utils import metrics, phases
 from ..structs.structs import Evaluation, Plan, PlanResult
@@ -144,6 +145,13 @@ class Worker:
             _lifecycle.on_worker(evaluation.id, self.id)
             self._eval_token = token
             self._handed_off = False
+            # re-enter the eval's distributed trace (carried in
+            # Evaluation.trace_ctx across raft AND the Eval.Dequeue wire
+            # hop): outbound RPCs below — Plan.Submit, Eval.Ack — become
+            # children of the span that created the eval
+            trace_token = _xcontext.activate(
+                getattr(evaluation, "trace_ctx", None)
+            )
             try:
                 # worker_busy is the coverage denominator: everything the
                 # worker does between dequeue and ack should be explained
@@ -162,6 +170,8 @@ class Worker:
                     self._nack(evaluation.id, token)
                 except Exception:  # noqa: BLE001
                     pass
+            finally:
+                _xcontext.deactivate(trace_token)
 
     def _ack(self, eval_id: str, token: str) -> None:
         if self._active_remote is not None:
@@ -197,6 +207,19 @@ class Worker:
 
         from ..utils.hostwork import HOST_WORK_SEM
 
+        # worker-side spans are emitted HERE, in the worker's process:
+        # in follower mode the leader's lifecycle record never sees these
+        # stamps, and the stitched trace is the only place the invoke
+        # appears at all. role tags feed the follower_lag component.
+        trace_id, trace_parent = _lifecycle.eval_trace_ids(
+            evaluation.id, getattr(evaluation, "trace_ctx", None)
+        )
+        span_attrs = {
+            "eval_id": evaluation.id, "worker": self.id,
+            "role": "follower" if self._active_remote is not None
+            else "leader",
+        }
+
         wait_index = max(evaluation.modify_index, evaluation.snapshot_index)
         start = metrics.now()
         with self._span("wait_for_index", evaluation.id):
@@ -209,9 +232,16 @@ class Worker:
             # per-eval SnapshotMinIndex wait span on the lifecycle clock:
             # the attribution engine joins these against the wave windows
             # ("wait_min_index: 41% of makespan" names this exact block)
+            wait_t1 = _lifecycle.pipeline_now()
             _lifecycle.pipeline_record(
-                "wait_min_index", evaluation.id, wait_t0,
-                _lifecycle.pipeline_now(),
+                "wait_min_index", evaluation.id, wait_t0, wait_t1,
+            )
+            _xcontext.record_span(
+                "eval.wait_min_index",
+                _xcontext.wall_from_monotonic(wait_t0),
+                _xcontext.wall_from_monotonic(wait_t1),
+                trace_id=trace_id, parent_id=trace_parent,
+                attrs=span_attrs,
             )
             with HOST_WORK_SEM:
                 with phases.track("snapshot"):
@@ -237,11 +267,19 @@ class Worker:
             )
         start = metrics.now()
         _lifecycle.on_invoke_start(evaluation.id)
+        invoke_t0 = _lifecycle.pipeline_now()
         try:
             with self._span("invoke_scheduler", evaluation.id):
                 sched.process(evaluation)
         finally:
             _lifecycle.on_invoke_end(evaluation.id)
+            _xcontext.record_span(
+                "eval.invoke",
+                _xcontext.wall_from_monotonic(invoke_t0),
+                _xcontext.wall_from_monotonic(_lifecycle.pipeline_now()),
+                trace_id=trace_id, parent_id=trace_parent,
+                attrs=span_attrs,
+            )
         metrics.measure_since(
             f"nomad.worker.invoke_scheduler.{evaluation.type}", start
         )

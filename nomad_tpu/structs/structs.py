@@ -1242,6 +1242,23 @@ class Evaluation:
     modify_index: int = 0
     create_time_ns: int = 0
     modify_time_ns: int = 0
+    # distributed-trace context ({"trace_id", "span_id"}) carried with
+    # the eval through raft and RPC so one trace_id follows submit ->
+    # broker -> (possibly remote) worker -> plan apply -> ack
+    trace_ctx: Optional[Dict[str, str]] = None
+
+    def __post_init__(self) -> None:
+        if self.trace_ctx is None:
+            # stamp the ambient trace at CREATION: an eval minted inside
+            # an RPC handler span (Job.Register) or by a scheduler
+            # processing a traced eval (follow-up/blocked evals) inherits
+            # that trace. Deterministic across replicas — the stamp rides
+            # the raft log; FSM-side decode passes trace_ctx explicitly.
+            # Deferred import: structs is the data layer, loaded long
+            # before the trace package.
+            from ..trace import context as _trace_context
+
+            self.trace_ctx = _trace_context.inject()
 
     def terminal_status(self) -> bool:
         return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, EVAL_STATUS_CANCELLED)

@@ -1,6 +1,7 @@
 """nomad-trace: always-on, low-overhead eval-lifecycle observability.
 
-Pieces (ISSUE 4 tentpole + ISSUE 12 flight recorder):
+Pieces (ISSUE 4 tentpole + ISSUE 12 flight recorder + ISSUE 15
+cross-process tracing):
 
   lifecycle    per-delivery eval trace records stamped at broker enqueue
                -> dequeue -> scheduler invoke (host/device path, OCC
@@ -15,7 +16,14 @@ Pieces (ISSUE 4 tentpole + ISSUE 12 flight recorder):
                (optional JSONL spill) every ~250ms
   attribution  critical-path engine: joins lifecycle + pipeline spans
                into a ranked per-component bottleneck_report() with a
-               coverage self-check
+               coverage self-check; stitched_report() extends it across
+               processes (rpc_wait / forward_hop / follower_lag)
+  context      cross-process TraceContext (trace_id/span_id/parent_id)
+               carried in the RPC envelope + Evaluation payloads, with
+               a per-process bounded span ring drained by Trace.Export
+  stitch       collector merging N processes' span rings into per-eval
+               span trees, estimating per-process clock offset from
+               client/server span pairs
   (phases)     wall-clock phase attribution lives in utils/phases.py;
                this package consumes it for the coverage self-check
 
@@ -24,13 +32,17 @@ The reference scatters the same signals across per-call timers
 here they are joined per evaluation so a stalled eval is a queryable
 record, not a needle across counters.
 """
-from . import attribution, lifecycle
+from . import attribution, context, lifecycle, stitch
+from .context import TraceContext
 from .flight import FlightRecorder, install_server_probes
 from .watchdog import LivenessWatchdog
 
 __all__ = [
     "attribution",
+    "context",
     "lifecycle",
+    "stitch",
+    "TraceContext",
     "FlightRecorder",
     "install_server_probes",
     "LivenessWatchdog",

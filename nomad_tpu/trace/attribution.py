@@ -26,6 +26,7 @@ All interval math is on the lifecycle clock (``time.monotonic``).
 """
 from __future__ import annotations
 
+from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import lifecycle
@@ -150,6 +151,29 @@ def _wave_windows(records: Sequence[Dict[str, object]],
 # -- the decomposition ------------------------------------------------------
 
 
+def _greedy_claim(comp_spans: Dict[str, List[Interval]],
+                  precedence: Sequence[str],
+                  t0: float, t1: float) -> Tuple[Dict[str, float], float]:
+    """The exclusive claim loop shared by the single-process and the
+    stitched decomposition: walk components in precedence order, each
+    claims only the instants no higher-precedence component already
+    holds. Returns (component -> seconds, total attributed seconds)."""
+    order = list(precedence) + sorted(set(comp_spans) - set(precedence))
+    claimed: List[Interval] = []
+    components: Dict[str, float] = {}
+    for name in order:
+        raw = comp_spans.get(name)
+        if not raw:
+            continue
+        merged = _merged(raw, t0, t1)
+        exclusive = _subtract(merged, claimed)
+        seconds = _length(exclusive)
+        if seconds > 0:
+            components[name] = seconds
+        claimed = _merged(claimed + exclusive)
+    return components, _length(claimed)
+
+
 def critical_path(records: Optional[Sequence[Dict[str, object]]] = None,
                   spans: Optional[Sequence[Tuple[str, str, float, float]]] = None,
                   now: Optional[float] = None) -> Dict[str, object]:
@@ -189,20 +213,7 @@ def critical_path(records: Optional[Sequence[Dict[str, object]]] = None,
         comp_spans.setdefault(stage, []).append((a, b))
     comp_spans["broker_idle"] = _complement(_wave_windows(records, now), t0, t1)
 
-    order = list(PRECEDENCE) + sorted(set(comp_spans) - set(PRECEDENCE))
-    claimed: List[Interval] = []
-    components: Dict[str, float] = {}
-    for name in order:
-        raw = comp_spans.get(name)
-        if not raw:
-            continue
-        merged = _merged(raw, t0, t1)
-        exclusive = _subtract(merged, claimed)
-        seconds = _length(exclusive)
-        if seconds > 0:
-            components[name] = seconds
-        claimed = _merged(claimed + exclusive)
-    attributed = _length(claimed)
+    components, attributed = _greedy_claim(comp_spans, PRECEDENCE, t0, t1)
     return {
         "makespan_s": round(makespan, 6),
         "t0": t0,
@@ -269,3 +280,183 @@ def format_report(report: Dict[str, object], top_n: int = 5) -> str:
     if not parts:
         return report.get("top", "no spans recorded")
     return "; ".join(parts) + f" (coverage {report.get('coverage', 0):.0%})"
+
+
+# -- stitched (cross-process) decomposition ---------------------------------
+#
+# Same greedy exclusive claim, but over the wall-clock spans a stitched
+# multi-process collection produced (trace/stitch.py output, already
+# clock-aligned). This is where wire time finally gets a name: an RPC's
+# client span minus its matched server child is time on the wire or in
+# the accept queue (``rpc_wait``); a client span whose PARENT is a
+# server span is a layer-7 forwarding hop (``forward_hop``); a
+# wait_min_index span recorded by a follower-driven worker is
+# replication lag (``follower_lag``).
+
+#: stitched claim order: eval work, then the wire, then handler time and
+#: queue waits, then idle between traces.
+STITCHED_PRECEDENCE: Tuple[str, ...] = (
+    "invoke",          # worker-side scheduler think-time
+    "forward_hop",     # client span under a server span: follower -> leader hop
+    "rpc_wait",        # client span minus its matched server child: wire + accept
+    "follower_lag",    # wait_min_index on a follower-driven worker
+    "wait_min_index",  # wait_min_index on the leader's own worker
+    "commit_wait",     # plan submitted, waiting for the applier
+    "finalize",        # applied, waiting for ack bookkeeping
+    "rpc_handler",     # server-side handler time not otherwise claimed
+    "queue_wait",      # enqueued, waiting for a broker dequeue
+    "driver",          # driver-side root spans (event.*)
+    "trace_idle",      # no trace in flight at all
+)
+
+#: lifecycle/worker span name -> stitched component. ``eval.wait_min_index``
+#: is resolved by role attr (follower_lag vs wait_min_index) below.
+_STITCHED_SPAN_COMPONENTS: Dict[str, str] = {
+    "eval.queue_wait": "queue_wait",
+    "eval.invoke": "invoke",
+    "eval.commit_wait": "commit_wait",
+    "eval.finalize": "finalize",
+}
+
+
+def _stitched_component_spans(
+    spans: Sequence[Dict[str, object]],
+) -> Dict[str, List[Interval]]:
+    """Raw per-component intervals from a clock-aligned span set."""
+    comps: Dict[str, List[Interval]] = defaultdict(list)
+    by_id: Dict[object, Dict[str, object]] = {}
+    for s in spans:
+        sid = s.get("span_id")
+        if sid is not None:
+            by_id[sid] = s
+    # server spans matched to their client parent: subtracted from the
+    # client interval so rpc_wait is the wire/accept remainder only
+    server_child: Dict[object, List[Interval]] = defaultdict(list)
+    for s in spans:
+        if s.get("kind") == "server":
+            parent = by_id.get(s.get("parent_id"))
+            if parent is not None and parent.get("kind") == "client":
+                server_child[s.get("parent_id")].append((s["start"], s["end"]))
+    for s in spans:
+        iv: Interval = (s["start"], s["end"])
+        name = str(s.get("name", ""))
+        kind = s.get("kind")
+        if name == "eval.wait_min_index":
+            role = (s.get("attrs") or {}).get("role")
+            comps["follower_lag" if role == "follower" else
+                  "wait_min_index"].append(iv)
+        elif name in _STITCHED_SPAN_COMPONENTS:
+            comps[_STITCHED_SPAN_COMPONENTS[name]].append(iv)
+        elif kind == "client":
+            parent = by_id.get(s.get("parent_id"))
+            if parent is not None and parent.get("kind") == "server":
+                # this process is relaying someone else's request:
+                # the whole hop is forwarding overhead
+                comps["forward_hop"].append(iv)
+            else:
+                kids = _merged(server_child.get(s.get("span_id"), ()))
+                if kids:
+                    comps["rpc_wait"].extend(_subtract([iv], kids))
+                else:
+                    # server never exported (killed replica / evicted
+                    # ring): the whole call reads as wire time
+                    comps["rpc_wait"].append(iv)
+        elif kind == "server":
+            comps["rpc_handler"].append(iv)
+        else:
+            comps["driver"].append(iv)
+    return dict(comps)
+
+
+def stitched_critical_path(
+    spans: Sequence[Dict[str, object]],
+) -> Dict[str, object]:
+    """Exclusive decomposition of a stitched span set's makespan.
+    ``spans`` is the flat clock-aligned list ``stitch.stitch()`` returns
+    under ``"spans"``. Same shape as :func:`critical_path` plus the
+    process roster."""
+    valid = [
+        s for s in spans
+        if isinstance(s.get("start"), (int, float))
+        and isinstance(s.get("end"), (int, float))
+        and s["end"] >= s["start"]
+    ]
+    if not valid:
+        return {"makespan_s": 0.0, "t0": None, "t1": None, "traces": 0,
+                "processes": [], "components": {}, "coverage": 0.0,
+                "unattributed_s": 0.0}
+    t0 = min(s["start"] for s in valid)
+    t1 = max(s["end"] for s in valid)
+    makespan = t1 - t0
+    traces = {str(s.get("trace_id")) for s in valid}
+    processes = sorted({str(s.get("process")) for s in valid})
+    if makespan <= 0:
+        return {"makespan_s": 0.0, "t0": t0, "t1": t1, "traces": len(traces),
+                "processes": processes, "components": {}, "coverage": 0.0,
+                "unattributed_s": 0.0}
+    comp_spans = _stitched_component_spans(valid)
+    # idle = no trace window active at all (precedent: broker_idle)
+    windows = _merged(
+        (min(s["start"] for s in group), max(s["end"] for s in group))
+        for group in _by_trace(valid).values()
+    )
+    comp_spans["trace_idle"] = _complement(windows, t0, t1)
+    components, attributed = _greedy_claim(
+        comp_spans, STITCHED_PRECEDENCE, t0, t1)
+    return {
+        "makespan_s": round(makespan, 6),
+        "t0": t0,
+        "t1": t1,
+        "traces": len(traces),
+        "processes": processes,
+        "components": {k: round(v, 6) for k, v in components.items()},
+        "coverage": round(attributed / makespan, 4),
+        "unattributed_s": round(makespan - attributed, 6),
+    }
+
+
+def _by_trace(
+    spans: Sequence[Dict[str, object]],
+) -> Dict[str, List[Dict[str, object]]]:
+    groups: Dict[str, List[Dict[str, object]]] = defaultdict(list)
+    for s in spans:
+        groups[str(s.get("trace_id"))].append(s)
+    return groups
+
+
+def stitched_report(spans: Sequence[Dict[str, object]],
+                    top_n: int = 0) -> Dict[str, object]:
+    """Ranked cross-process ledger; the multi-process sibling of
+    :func:`bottleneck_report` with the same >=0.9 coverage self-check."""
+    cp = stitched_critical_path(spans)
+    makespan = cp["makespan_s"]
+    entries = [
+        {
+            "component": name,
+            "seconds": seconds,
+            "share": round(seconds / makespan, 4) if makespan else 0.0,
+        }
+        for name, seconds in cp["components"].items()
+    ]
+    entries.sort(key=lambda e: (-e["seconds"], e["component"]))
+    if top_n > 0:
+        entries = entries[:top_n]
+    coverage_ok = cp["coverage"] >= COVERAGE_FLOOR
+    if not entries:
+        top = "no spans recorded"
+    elif not coverage_ok:
+        top = (f"coverage {cp['coverage']:.0%} below "
+               f"{COVERAGE_FLOOR:.0%} floor: span set incomplete")
+    else:
+        lead = entries[0]
+        top = f"{lead['component']}: {lead['share']:.0%} of makespan"
+    return {
+        "makespan_s": makespan,
+        "traces": cp["traces"],
+        "processes": cp["processes"],
+        "coverage": cp["coverage"],
+        "coverage_ok": coverage_ok,
+        "unattributed_s": cp["unattributed_s"],
+        "entries": entries,
+        "top": top,
+    }

@@ -27,7 +27,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from ..utils import lock_witness, metrics
-from . import lifecycle
+from . import context, lifecycle
 from ..utils.lock_witness import witness_lock
 
 _clock = time.monotonic
@@ -292,3 +292,11 @@ def install_server_probes(rec: FlightRecorder, server) -> None:
     # counters when a witness is live (probes run OUTSIDE rec._lock, so
     # this adds no flight->witness order edge)
     rec.add_probe("lock_witness", lock_witness.stats)
+    # wire-RPC method table totals + distributed-trace ring counters.
+    # Imported here, not at module top: rpc/transport imports this
+    # package (trace.context) at import time, so a top-level import
+    # would be circular.
+    from ..rpc import transport as _transport
+
+    rec.add_probe("rpc", _transport.rpc_stats_brief)
+    rec.add_probe("xtrace", context.stats)

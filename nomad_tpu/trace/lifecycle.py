@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..utils import metric_names, metrics
 from ..utils.lock_witness import witness_lock
+from . import context as _xcontext
 
 _DONE_CAP = 2048
 
@@ -41,7 +42,7 @@ class EvalTrace:
         "eval_id", "job_id", "namespace", "type", "triggered_by", "priority",
         "attempt", "worker_id", "path",
         "enqueue_t", "dequeue_t", "invoke_start_t", "invoke_end_t",
-        "submit_t", "apply_t", "end_t", "outcome",
+        "submit_t", "apply_t", "end_t", "outcome", "trace_ctx",
     )
 
     def __init__(self, eval_id: str, job_id: str, namespace: str,
@@ -64,6 +65,9 @@ class EvalTrace:
         self.apply_t: Optional[float] = None
         self.end_t: Optional[float] = None
         self.outcome: Optional[str] = None  # "ack" | "nack" | "failed" | "flush"
+        # carried distributed-trace context ({"trace_id","span_id"}) so
+        # the record's phase spans land in the cross-process trace
+        self.trace_ctx: Optional[Dict[str, str]] = None
 
     def total_ms(self, now: Optional[float] = None) -> float:
         end = self.end_t if self.end_t is not None else (now or _clock())
@@ -168,6 +172,7 @@ def on_enqueue(evaluation) -> None:
         getattr(evaluation, "triggered_by", ""),
         getattr(evaluation, "priority", 0), _clock(),
     )
+    rec.trace_ctx = getattr(evaluation, "trace_ctx", None)
     with _lock:
         _inflight.setdefault(evaluation.id, rec)
 
@@ -225,6 +230,43 @@ def on_apply(eval_id: str) -> None:
             rec.apply_t = _clock()
 
 
+def eval_trace_ids(eval_id: str,
+                   trace_ctx: Optional[Dict[str, str]]) -> Tuple[str, Optional[str]]:
+    """(trace_id, parent_span_id) for an eval's spans: the carried
+    context when the eval was created inside a trace, else a trace id
+    derived from the eval id so an untraced eval's spans still group
+    into one tree (roots, not orphans)."""
+    ctx = trace_ctx or {}
+    trace_id = ctx.get("trace_id") or eval_id.replace("-", "")[:16]
+    return trace_id, ctx.get("span_id")
+
+
+def _emit_trace_spans(rec: EvalTrace) -> None:
+    """Emit the record's broker/applier-side phase spans into the
+    cross-process span ring (trace/context.py). Worker-side phases
+    (wait_min_index, invoke) are emitted by the worker in ITS process —
+    in follower mode those stamps never reach this record at all."""
+    trace_id, parent = eval_trace_ids(rec.eval_id, rec.trace_ctx)
+    skew = _xcontext.wall_from_monotonic(0.0)
+    attrs: Dict[str, object] = {
+        "eval_id": rec.eval_id, "outcome": rec.outcome,
+        "attempt": rec.attempt,
+    }
+
+    def emit(name: str, a: Optional[float], b: Optional[float]) -> None:
+        if a is None or b is None or b < a:
+            return
+        _xcontext.record_span(
+            name, a + skew, b + skew, trace_id=trace_id,
+            parent_id=parent, attrs=attrs,
+        )
+
+    emit("eval.queue_wait", rec.enqueue_t,
+         rec.dequeue_t if rec.dequeue_t is not None else rec.end_t)
+    emit("eval.commit_wait", rec.submit_t, rec.apply_t)
+    emit("eval.finalize", rec.apply_t, rec.end_t)
+
+
 def _close(eval_id: str, outcome: str) -> None:
     with _lock:
         rec = _inflight.pop(eval_id, None)
@@ -234,6 +276,8 @@ def _close(eval_id: str, outcome: str) -> None:
         rec.outcome = outcome
         _done.append(rec)
         _counts[outcome] = _counts.get(outcome, 0) + 1
+    # outside _lock: span recording takes the context ring's own lock
+    _emit_trace_spans(rec)
 
 
 def on_ack(eval_id: str) -> None:
@@ -250,12 +294,15 @@ def on_flush() -> None:
     """Broker flushed (leadership lost): close every in-flight record."""
     with _lock:
         now = _clock()
-        for rec in _inflight.values():
+        flushed = list(_inflight.values())
+        for rec in flushed:
             rec.end_t = now
             rec.outcome = "flush"
             _done.append(rec)
             _counts["flush"] += 1
         _inflight.clear()
+    for rec in flushed:
+        _emit_trace_spans(rec)
 
 
 # -- pipeline stage stamping -----------------------------------------------

@@ -66,6 +66,11 @@ FAMILIES: Dict[str, str] = {
     "nomad.state": "state store: latest_index gauge",
     "nomad.flight": "flight recorder self-telemetry: tick_ms sample, "
                     "frames/dropped counters, duty_cycle gauge",
+    "nomad.rpc": "wire RPC layer: per-method latency_ms histograms, "
+                 "req_bytes/resp_bytes samples, calls/errors/not_leader "
+                 "counters (family_sample/family_counter — the method "
+                 "enum is bounded by bind_server's registry), inflight "
+                 "gauge",
 }
 
 
@@ -89,3 +94,28 @@ def publish_family(prefix: str, mapping: Mapping[str, object]) -> None:
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue
         metrics.set_gauge(f"{prefix}.{key}", float(value))
+
+
+def _require_family(prefix: str) -> None:
+    if family_of(prefix) not in FAMILIES:
+        raise ValueError(
+            f"metric family {prefix!r} is not registered in "
+            f"nomad_tpu.utils.metric_names.FAMILIES"
+        )
+
+
+def family_sample(prefix: str, key: str, value: float) -> None:
+    """Blessed dynamic-name door for SAMPLES (publish_family only does
+    gauges): one histogram/summary series per ``<prefix>.<key>`` under a
+    registered family. The key set must be bounded by construction — the
+    RPC layer's per-method latency tables qualify (the method enum is
+    the bind_server registry), per-eval or per-node keys do not."""
+    _require_family(prefix)
+    metrics.add_sample(f"{prefix}.{key}", value)
+
+
+def family_counter(prefix: str, key: str, value: float = 1.0) -> None:
+    """Blessed dynamic-name door for COUNTERS under a registered family
+    (same bounded-key contract as :func:`family_sample`)."""
+    _require_family(prefix)
+    metrics.incr_counter(f"{prefix}.{key}", value)

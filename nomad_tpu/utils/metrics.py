@@ -11,26 +11,124 @@ reference's instrumentation (e.g. ``nomad.worker.invoke_scheduler.service``,
 
 from __future__ import annotations
 
+import math
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 from .lock_witness import witness_lock
 
 
+class LogHistogram:
+    """Log₂-bucketed, mergeable histogram.
+
+    Bucket ``i`` holds values in ``(2^(MIN_EXP+i-1), 2^(MIN_EXP+i)]``;
+    bucket 0 is the underflow bucket (everything ≤ 2^MIN_EXP, including
+    zeros) and the last bucket is the overflow. The layout is fixed at
+    the class level so two histograms — e.g. per-method RPC latency
+    tables exported by different replicas — merge by elementwise count
+    addition, and percentiles of the merged distribution stay exact to
+    one bucket width (a factor of 2).
+
+    Not synchronized: every embedding (``_Aggregate`` under the
+    ``InmemSink`` lock, the RPC method table under the transport's
+    witness lock) already serializes writers.
+    """
+
+    #: first finite upper bound is 2^MIN_EXP (≈1µs when values are ms);
+    #: values above 2^MAX_EXP (~12 days in ms) land in overflow
+    MIN_EXP = -10
+    MAX_EXP = 30
+    NBUCKETS = MAX_EXP - MIN_EXP + 2  # + underflow + overflow
+
+    __slots__ = ("counts",)
+
+    def __init__(self, counts: Optional[Sequence[int]] = None) -> None:
+        if counts is not None:
+            if len(counts) != self.NBUCKETS:
+                raise ValueError(
+                    f"expected {self.NBUCKETS} buckets, got {len(counts)}"
+                )
+            self.counts = [int(c) for c in counts]
+        else:
+            self.counts = [0] * self.NBUCKETS
+
+    def add(self, v: float) -> None:
+        if v <= 0 or math.isnan(v):
+            self.counts[0] += 1
+            return
+        # frexp: v = m * 2^e with 0.5 <= m < 1, so 2^(e-1) < v <= 2^e
+        e = math.frexp(v)[1]
+        idx = e - self.MIN_EXP
+        if idx < 0:
+            idx = 0
+        elif idx >= self.NBUCKETS:
+            idx = self.NBUCKETS - 1
+        self.counts[idx] += 1
+
+    @classmethod
+    def upper_bound(cls, idx: int) -> float:
+        """Inclusive upper bound of bucket ``idx`` (+inf for overflow)."""
+        if idx >= cls.NBUCKETS - 1:
+            return math.inf
+        return 2.0 ** (cls.MIN_EXP + idx)
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts)
+
+    def merge(self, other: "LogHistogram") -> "LogHistogram":
+        """In-place elementwise merge; returns self for chaining."""
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        return self
+
+    def percentile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-quantile (0 when
+        empty). Exact to a factor of 2 — enough to rank bottlenecks."""
+        total = self.count
+        if total <= 0:
+            return 0.0
+        rank = max(1, math.ceil(q * total))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                if i >= self.NBUCKETS - 1:
+                    return 2.0 ** (self.MAX_EXP + 1)
+                return self.upper_bound(i)
+        return 2.0 ** (self.MAX_EXP + 1)
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(le, count)`` pairs, Prometheus-style, ending
+        with ``(inf, total)``."""
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            out.append((self.upper_bound(i), cum))
+        return out
+
+    def to_wire(self) -> List[int]:
+        """Counts list for RPC export (rebuild with LogHistogram(counts))."""
+        return list(self.counts)
+
+
 class _Aggregate:
-    __slots__ = ("count", "sum", "min", "max")
+    __slots__ = ("count", "sum", "min", "max", "hist")
 
     def __init__(self) -> None:
         self.count = 0
         self.sum = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        self.hist = LogHistogram()
 
     def ingest(self, v: float) -> None:
         self.count += 1
         self.sum += v
         self.min = min(self.min, v)
         self.max = max(self.max, v)
+        self.hist.add(v)
 
     @property
     def mean(self) -> float:
@@ -45,6 +143,9 @@ class _Aggregate:
             "Max": round(self.max, 6) if self.count else 0,
             "Mean": round(self.mean, 6),
             "Rate": round(self.sum / rate_interval, 6) if rate_interval else 0,
+            "P50": self.hist.percentile(0.50),
+            "P95": self.hist.percentile(0.95),
+            "P99": self.hist.percentile(0.99),
         }
 
 
@@ -157,7 +258,17 @@ class InmemSink:
             for k in sorted(cur.samples):
                 agg = cur.samples[k]
                 n = esc(k)
-                out.append(f"# TYPE {n} summary")
+                out.append(f"# TYPE {n} histogram")
+                # sparse cumulative buckets: only the occupied region of
+                # the fixed log₂ layout (each line is cumulative, so a
+                # sparse `le` set is still valid exposition)
+                prev = 0
+                for le, cum in agg.hist.buckets():
+                    if math.isinf(le) or cum == 0 or cum == prev:
+                        continue
+                    out.append(f'{n}_bucket{{le="{le:g}"}} {cum}')
+                    prev = cum
+                out.append(f'{n}_bucket{{le="+Inf"}} {agg.count}')
                 out.append(f"{n}_sum {agg.sum}")
                 out.append(f"{n}_count {agg.count}")
         return "\n".join(out) + "\n"

@@ -1479,3 +1479,78 @@ def test_cli_write_baseline_then_green(tmp_path, capsys):
     rc = _cli(["--baseline", str(base), str(mod)])
     capsys.readouterr()
     assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# rpc-telemetry-discipline: RPC traffic must go through the instrumented
+# choke points (register / RPCClient.call), or it is invisible to the
+# per-method stats table and the cross-process trace
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_telemetry_flags_raw_handler_insert():
+    src = dedent("""
+        def wire(rpc):
+            rpc.handlers["Sneaky.call"] = lambda: 1
+    """)
+    fs = run_source(src, "server/extra.py")
+    assert any(f.rule == "rpc-telemetry-discipline"
+               and "register" in f.message for f in fs)
+
+
+def test_rpc_telemetry_flags_private_frame_import_and_call():
+    src = dedent("""
+        from nomad_tpu.rpc.transport import _send_frame
+
+        def leak(sock, payload):
+            _send_frame(sock, payload)
+    """)
+    fs = run_source(src, "server/extra.py")
+    assert any("side channel" in f.message for f in fs)
+
+    src2 = dedent("""
+        from nomad_tpu.rpc import transport
+
+        def leak(sock):
+            return transport._recv_frame(sock)
+    """)
+    fs2 = run_source(src2, "server/extra.py")
+    assert any(f.rule == "rpc-telemetry-discipline"
+               and "instrumented RPC path" in f.message for f in fs2)
+
+
+def test_rpc_telemetry_flags_handbuilt_envelope():
+    src = dedent("""
+        def craft(seq):
+            return {"seq": seq, "method": "Node.Register", "body": ()}
+    """)
+    fs = run_source(src, "server/extra.py")
+    assert any(f.rule == "rpc-telemetry-discipline"
+               and "TraceContext" in f.message for f in fs)
+
+
+def test_rpc_telemetry_accepts_register_and_local_helpers():
+    # the blessed shapes: register(), RPCClient.call, and a module's OWN
+    # _read_exact helper (the websocket framer) stay clean
+    src = dedent("""
+        def wire(rpc, client):
+            rpc.register("Status.ping", lambda: "pong")
+            return client.call("Status.ping")
+
+        def _read_exact(rfile, n):
+            return rfile.read(n)
+
+        def use(rfile):
+            return _read_exact(rfile, 4)
+    """)
+    assert run_source(src, "server/extra.py") == []
+
+
+def test_rpc_telemetry_exempts_transport_itself():
+    src = dedent("""
+        def handler_loop(self, method, fn):
+            self.handlers[method] = fn
+            return {"seq": 1, "method": method}
+    """)
+    assert run_source(src, "rpc/transport.py") == []
+    assert run_source(src, "plugins/transport.py") == []

@@ -1,0 +1,444 @@
+"""nomad-xtrace tests: cross-process trace context, RPC telemetry, the
+log-bucketed histogram, and the multi-process stitcher.
+
+Covers the full carrier chain — TraceContext on the RPC envelope,
+client/server span pairing, ``Evaluation.trace_ctx`` riding the codec,
+the ``Trace.Export`` cursor drain — plus the collector side: stitching
+determinism, NTP-style clock-offset recovery against a planted skew,
+and mandatory orphan degradation when a replica's spans never arrive.
+"""
+import random
+import threading
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.rpc import RPCClient, RPCServer, bind_server, decode, encode
+from nomad_tpu.rpc import transport
+from nomad_tpu.server import InProcRaft, Server, ServerConfig
+from nomad_tpu.structs.structs import Evaluation
+from nomad_tpu.trace import attribution, stitch
+from nomad_tpu.trace import context as xtrace
+from nomad_tpu.utils.metrics import InmemSink, LogHistogram
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_state():
+    xtrace.reset()
+    transport.reset_rpc_stats()
+    yield
+    xtrace.reset()
+    transport.reset_rpc_stats()
+
+
+# ---------------------------------------------------------------------------
+# LogHistogram
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_percentile_within_bucket_factor():
+    h = LogHistogram()
+    values = [0.3, 1.5, 7.0, 40.0, 900.0, 900.0, 900.0, 12_000.0]
+    for v in values:
+        h.add(v)
+    assert h.count == len(values)
+    # log2 buckets: the reported percentile is within a factor of 2
+    p50 = h.percentile(0.5)
+    assert 7.0 / 2 <= p50 <= 40.0 * 2
+    p99 = h.percentile(0.99)
+    assert 12_000.0 / 2 <= p99 <= 12_000.0 * 2
+
+
+def test_histogram_merge_equals_combined():
+    a, b, both = LogHistogram(), LogHistogram(), LogHistogram()
+    for i in range(1, 200):
+        v = i * 0.7
+        (a if i % 2 else b).add(v)
+        both.add(v)
+    a.merge(b)
+    assert a.count == both.count
+    assert a.to_wire() == both.to_wire()
+    for q in (0.5, 0.9, 0.99):
+        assert a.percentile(q) == both.percentile(q)
+
+
+def test_histogram_wire_roundtrip_and_extremes():
+    h = LogHistogram()
+    h.add(0.0)          # underflow bucket
+    h.add(1e-12)        # underflow bucket
+    h.add(2.0 ** 40)    # overflow bucket
+    rebuilt = LogHistogram(h.to_wire())
+    assert rebuilt.count == 3
+    assert rebuilt.to_wire() == h.to_wire()
+    # overflow percentile reports the overflow bound, not garbage
+    assert rebuilt.percentile(1.0) == 2.0 ** (LogHistogram.MAX_EXP + 1)
+
+
+def test_histogram_concurrent_adds_under_witness():
+    """The histogram is documented unsynchronized — embedders hold their
+    own lock. Drive the real embedder (_record_dispatch under _rpc_lock,
+    which then publishes through the metrics sink lock) from N threads
+    with the runtime lock witness armed: every add lands and no lock-
+    order violation is recorded."""
+    from nomad_tpu.utils import lock_witness as _lw
+
+    witness = _lw.arm()
+    try:
+        n_threads, per_thread = 8, 200
+
+        def pound(tid):
+            for i in range(per_thread):
+                transport._record_dispatch(
+                    "Witness.test", 0.001 * ((tid + i) % 7 + 1), None)
+
+        threads = [threading.Thread(target=pound, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = witness.stats()
+        assert st["violations"] == 0
+        row = transport.rpc_stats()["Witness.test"]
+        assert row["calls"] == n_threads * per_thread
+    finally:
+        _lw.disarm()
+
+
+def test_prometheus_exposition_has_le_buckets():
+    s = InmemSink(interval=100)
+    for v in (0.5, 3.0, 3.0, 50.0):
+        s.add_sample("nomad.rpc.Ping.latency_ms", v)
+    text = s.prometheus()
+    assert "# TYPE nomad_rpc_Ping_latency_ms histogram" in text
+    assert 'nomad_rpc_Ping_latency_ms_bucket{le="+Inf"} 4' in text
+    # cumulative counts are monotone over the le-labeled lines
+    cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("nomad_rpc_Ping_latency_ms_bucket")]
+    assert cums == sorted(cums) and cums[-1] == 4
+    assert "nomad_rpc_Ping_latency_ms_sum" in text
+    assert "nomad_rpc_Ping_latency_ms_count 4" in text
+
+
+# ---------------------------------------------------------------------------
+# trace context: propagation, span ring, export cursor
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_ambient_context():
+    with xtrace.span("outer") as _:
+        outer = xtrace.current()
+        with xtrace.span("inner"):
+            inner = xtrace.current()
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    spans = {s["name"]: s for s in xtrace.snapshot()}
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["inner"]["trace_id"] == spans["outer"]["trace_id"]
+    assert spans["outer"]["parent_id"] is None
+
+
+def test_activate_carries_wire_context():
+    token = xtrace.activate({"trace_id": "t" * 16, "span_id": "s" * 16})
+    try:
+        ctx = xtrace.inject()
+        assert ctx == {"trace_id": "t" * 16, "span_id": "s" * 16}
+        with xtrace.span("child"):
+            pass
+    finally:
+        xtrace.deactivate(token)
+    assert xtrace.inject() is None
+    (child,) = xtrace.snapshot()
+    assert child["trace_id"] == "t" * 16
+    assert child["parent_id"] == "s" * 16
+
+
+def test_export_cursor_is_incremental_and_idempotent():
+    for i in range(5):
+        xtrace.record_span(f"s{i}", 0.0, 1.0)
+    first = xtrace.export()
+    assert [s["name"] for s in first["spans"]] == [f"s{i}" for i in range(5)]
+    cursor = first["next_seq"]
+    assert xtrace.export(after_seq=cursor)["spans"] == []
+    xtrace.record_span("late", 1.0, 2.0)
+    second = xtrace.export(after_seq=cursor)
+    assert [s["name"] for s in second["spans"]] == ["late"]
+    # re-polling the same cursor never double-counts
+    again = xtrace.export(after_seq=cursor)
+    assert [s["name"] for s in again["spans"]] == ["late"]
+
+
+def test_error_spans_tag_exception_type():
+    with pytest.raises(ValueError):
+        with xtrace.span("boom"):
+            raise ValueError("nope")
+    (s,) = xtrace.snapshot()
+    assert s["attrs"]["error"] == "ValueError"
+
+
+# ---------------------------------------------------------------------------
+# RPC layer: envelope propagation, per-method stats, frame errors
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_call_links_client_and_server_spans():
+    rpc = RPCServer()
+    rpc.register("Math.add", lambda a, b: a + b)
+    rpc.start()
+    try:
+        c = RPCClient(*rpc.addr)
+        with xtrace.span("driver.op"):
+            assert c.call("Math.add", 2, 3) == 5
+        c.close()
+    finally:
+        rpc.stop()
+    spans = {s["name"]: s for s in xtrace.snapshot()}
+    root = spans["driver.op"]
+    client = spans["rpc.client.Math.add"]
+    server = spans["rpc.server.Math.add"]
+    assert client["trace_id"] == server["trace_id"] == root["trace_id"]
+    assert client["parent_id"] == root["span_id"]
+    assert server["parent_id"] == client["span_id"]
+    assert client["kind"] == "client" and server["kind"] == "server"
+    assert client["attrs"]["req_bytes"] > 0
+    # server span must nest inside the client span (same process, same
+    # clock — true nesting, no skew)
+    assert client["start"] <= server["start"] <= server["end"] <= client["end"]
+
+
+def test_rpc_stats_table_and_unknown_methods_unrecorded():
+    rpc = RPCServer()
+    rpc.register("Math.add", lambda a, b: a + b)
+    rpc.start()
+    try:
+        c = RPCClient(*rpc.addr)
+        for i in range(3):
+            c.call("Math.add", i, i)
+        with pytest.raises(Exception):
+            c.call("Totally.bogus")
+        c.close()
+    finally:
+        rpc.stop()
+    table = transport.rpc_stats(wire=True)
+    assert set(table) == {"Math.add"}   # bogus methods never enter
+    row = table["Math.add"]
+    assert row["calls"] == 3 and row["errors"] == 0
+    assert row["req_bytes"] > 0 and row["resp_bytes"] > 0
+    assert row["latency_ms_p99"] >= row["latency_ms_p50"] > 0
+    assert sum(row["latency_hist"]) == 3
+
+
+def test_merge_rpc_tables_recomputes_percentiles():
+    fast, slow = LogHistogram(), LogHistogram()
+    for _ in range(90):
+        fast.add(1.0)
+    for _ in range(10):
+        slow.add(4000.0)
+    merged = transport.merge_rpc_tables([
+        {"M.x": {"calls": 90, "errors": 0, "not_leader": 0,
+                 "req_bytes": 10, "resp_bytes": 10,
+                 "latency_hist": fast.to_wire()}},
+        {"M.x": {"calls": 10, "errors": 1, "not_leader": 1,
+                 "req_bytes": 5, "resp_bytes": 5,
+                 "latency_hist": slow.to_wire()}},
+    ])
+    row = merged["M.x"]
+    assert row["calls"] == 100 and row["errors"] == 1
+    assert row["req_bytes"] == 15
+    # one slow replica still moves the merged tail
+    assert row["latency_ms_p99"] >= 2000.0
+    assert row["latency_ms_p50"] <= 2.0
+
+
+def test_frame_errors_carry_method_and_peer_context():
+    import socket
+
+    a, b = socket.socketpair()
+    b.close()
+    with pytest.raises(transport.FrameError) as ei:
+        transport._read_exact(a, 8, peer="1.2.3.4:99", what="resp header")
+    a.close()
+    msg = str(ei.value)
+    assert "1.2.3.4:99" in msg and "resp header" in msg and "/8 bytes" in msg
+    # FrameError stays a ConnectionError: every existing retry/failover
+    # except-clause keeps catching it
+    assert isinstance(ei.value, ConnectionError)
+
+
+# ---------------------------------------------------------------------------
+# Evaluation.trace_ctx: the eval payload carrier
+# ---------------------------------------------------------------------------
+
+
+def test_eval_stamps_and_carries_trace_ctx():
+    with xtrace.span("submit"):
+        ev = mock.eval()
+        expected = xtrace.inject()
+    assert ev.trace_ctx == expected
+    # rides the codec (raft log / RPC body) unchanged
+    assert decode(encode(ev)).trace_ctx == expected
+    # copy preserves it
+    assert ev.copy().trace_ctx == expected
+
+
+def test_eval_outside_trace_has_none_ctx_and_derived_ids():
+    from nomad_tpu.trace import lifecycle
+
+    ev = mock.eval()
+    assert ev.trace_ctx is None
+    trace_id, parent = lifecycle.eval_trace_ids(ev.id, ev.trace_ctx)
+    assert trace_id == ev.id.replace("-", "")[:16]
+    assert parent is None
+    # deterministic: same eval id -> same derived trace id
+    assert (trace_id, parent) == lifecycle.eval_trace_ids(ev.id, None)
+
+
+# ---------------------------------------------------------------------------
+# Trace.Export endpoint over the wire
+# ---------------------------------------------------------------------------
+
+
+def test_trace_export_rpc_drains_ring_with_cursor():
+    s = Server(ServerConfig(num_schedulers=0), raft=InProcRaft(), name="s1")
+    rpc = RPCServer()
+    bind_server(s, rpc)
+    rpc.start()
+    try:
+        c = RPCClient(*rpc.addr)
+        node = mock.node()
+        c.call("Node.Register", node)
+        out = c.call("Trace.Export", 0, no_forward=True)
+        assert out["spans"], "ring should hold the Node.Register span"
+        names = {sp["name"] for sp in out["spans"]}
+        assert "rpc.server.Node.Register" in names
+        assert "Node.Register" in out["rpc"]
+        cursor = out["next_seq"]
+        out2 = c.call("Trace.Export", cursor, no_forward=True)
+        assert all(sp["seq"] > cursor for sp in out2["spans"])
+        c.close()
+    finally:
+        rpc.stop()
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# stitching: merge determinism, clock skew, orphan degradation
+# ---------------------------------------------------------------------------
+
+
+def _mk(name, proc, sid, parent, a, b, kind="internal", trace="t1",
+        attrs=None):
+    return {"trace_id": trace, "span_id": sid, "parent_id": parent,
+            "name": name, "kind": kind, "process": proc,
+            "start": a, "end": b, "attrs": attrs or {}}
+
+
+def _three_process_spans(skew=0.0):
+    """driver -> s0 (forward) -> s1, with s1's clock shifted by skew."""
+    return [
+        _mk("event.submit", "driver", "d1", None, 0.0, 1.0),
+        _mk("rpc.client.Job.Register", "driver", "c1", "d1",
+            0.1, 0.9, kind="client"),
+        _mk("rpc.server.Job.Register", "s0", "s1span", "c1",
+            0.15, 0.85, kind="server"),
+        _mk("rpc.client.Job.Register", "s0", "c2", "s1span",
+            0.2, 0.8, kind="client"),
+        _mk("rpc.server.Job.Register", "s1", "s2span", "c2",
+            0.3 + skew, 0.7 + skew, kind="server"),
+    ]
+
+
+def test_stitch_merge_is_deterministic_and_dedups():
+    spans = _three_process_spans()
+    shuffled = list(spans)
+    random.Random(7).shuffle(shuffled)
+    # overlapping drains: every span delivered twice
+    a = stitch.merge_spans([spans, shuffled])
+    b = stitch.merge_spans([shuffled, spans])
+    assert a == b
+    assert len(a) == len(spans)
+
+
+def test_stitch_recovers_planted_clock_offset():
+    skew = 5.0
+    out = stitch.stitch([_three_process_spans(skew=skew)])
+    # s1's clock read 5s ahead; the estimator recovers it (driver is the
+    # reference: most spans tie -> deterministic name tie-break picks it)
+    off = out["clock_offsets_ms"]
+    assert abs(off["s1"] - skew * 1000.0) < 1.0
+    assert off["s0"] == 0.0
+    (trace,) = out["traces"]
+    assert trace["orphans"] == 0
+    # after normalization the leaf nests inside its parent again
+    by_name = {(s["process"], s["name"]): s for s in out["spans"]}
+    leaf = by_name[("s1", "rpc.server.Job.Register")]
+    hop = by_name[("s0", "rpc.client.Job.Register")]
+    assert hop["start"] <= leaf["start"] <= leaf["end"] <= hop["end"]
+    # the whole stitched trace spans one second, not six
+    assert trace["duration_ms"] < 1500.0
+
+
+def test_stitch_orphans_degrade_to_partial_tree():
+    spans = _three_process_spans()
+    # the middle process was SIGKILLed: its spans never exported
+    survivors = [s for s in spans if s["process"] != "s0"]
+    out = stitch.stitch([survivors])
+    (trace,) = out["traces"]
+    assert trace["orphans"] == 1   # s1's server span lost its parent
+    assert trace["spans"] == len(survivors)
+    text = stitch.format_tree(trace)
+    assert "ORPHAN" in text
+    # parent-pointer cycle (corrupt input) also degrades, never raises
+    cyc = [_mk("a", "p", "x", "y", 0.0, 1.0), _mk("b", "p", "y", "x", 0.0, 1.0)]
+    (t2,) = stitch.build_trees(cyc)
+    assert t2["orphans"] == 2
+
+
+# ---------------------------------------------------------------------------
+# stitched attribution
+# ---------------------------------------------------------------------------
+
+
+def test_stitched_report_names_wire_components():
+    spans = _three_process_spans() + [
+        _mk("eval.queue_wait", "s1", "q1", None, 1.0, 2.0),
+        _mk("eval.wait_min_index", "s1", "w1", None, 2.0, 2.5,
+            attrs={"role": "follower"}),
+        _mk("eval.invoke", "s1", "i1", None, 2.5, 4.0),
+    ]
+    rep = attribution.stitched_report(spans)
+    comps = {e["component"]: e["seconds"] for e in rep["entries"]}
+    # the follower->leader relay claims forward_hop; the driver's call
+    # minus its matched server child claims rpc_wait
+    assert comps["forward_hop"] > 0
+    assert comps["rpc_wait"] > 0
+    assert comps["follower_lag"] == pytest.approx(0.5)
+    assert comps["invoke"] == pytest.approx(1.5)
+    assert rep["coverage"] >= attribution.COVERAGE_FLOOR
+    assert rep["coverage_ok"]
+    assert rep["processes"] == ["driver", "s0", "s1"]
+
+
+def test_stitched_report_unmatched_client_span_is_all_rpc_wait():
+    spans = [
+        _mk("rpc.client.Node.Heartbeat", "driver", "c1", None,
+            0.0, 1.0, kind="client"),
+    ]
+    rep = attribution.stitched_report(spans)
+    comps = {e["component"]: e["seconds"] for e in rep["entries"]}
+    # the server died before exporting: the whole call reads as wire time
+    assert comps["rpc_wait"] == pytest.approx(1.0)
+
+
+def test_stitched_report_empty_and_coverage_floor():
+    rep = attribution.stitched_report([])
+    assert rep["top"] == "no spans recorded"
+    assert not rep["entries"]
+    # a span set with a huge intra-trace hole fails the self-check
+    spans = [
+        _mk("eval.invoke", "p", "a", None, 0.0, 1.0),
+        _mk("eval.invoke", "p", "b", None, 99.0, 100.0),
+    ]
+    rep2 = attribution.stitched_report(spans)
+    assert not rep2["coverage_ok"]
+    assert "coverage" in rep2["top"]
