@@ -282,7 +282,9 @@ def bench_c1m_chunked():
         "effective_gbps": round(gbps, 2),
         "parity_sample": parity,
     })
-    return rate
+    # dict (not a bare rate) so main() can stamp the sampled-parity
+    # divergence next to the tier's rate in the round record
+    return {"placements_per_s": rate, "parity_sample": parity}
 
 
 def _chunked_divergence_sample(n_evals=3, n_nodes=512, p=200):
@@ -431,8 +433,8 @@ def bench_parity_scan_single(n_nodes=5000, n_placements=10_000):
 def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                  timeout=180.0, node_seed=0, warmup=None,
                  node_factory=None, expected=None, done=None,
-                 deterministic=False, window_ms=25.0, idle_ms=0.0,
-                 device_min_placements=24, tranches=0):
+                 deterministic=False, window_ms=None, idle_ms=None,
+                 device_min_placements=None, tranches=0):
     """Run ``jobs`` through a real in-proc server; returns metrics dict.
 
     ``workers`` is 2x the device batch so the next wave encodes while the
@@ -442,10 +444,23 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
     persistent XLA cache makes repeat runs cheap). ``node_factory`` and
     ``done``/``expected`` override the default cluster and completion
     check for shapes (system jobs, preemption) where per-TG counts don't
-    describe the goal."""
+    describe the goal.
+
+    Gather-cadence knobs (``window_ms``/``idle_ms``/
+    ``device_min_placements``) default to None = the PRODUCTION
+    ServerConfig defaults, so what a bench row measures by default is
+    what an operator actually gets; rows that pass explicit values are
+    measuring a deliberate experiment and record it in batcher_config."""
     from nomad_tpu import mock
     from nomad_tpu.server.fsm import NODE_REGISTER
     from nomad_tpu.server.server import Server, ServerConfig
+
+    if window_ms is None:
+        window_ms = ServerConfig.device_batch_window_ms
+    if idle_ms is None:
+        idle_ms = ServerConfig.device_batch_idle_ms
+    if device_min_placements is None:
+        device_min_placements = ServerConfig.device_min_placements
 
     rng = np.random.default_rng(node_seed)
     server = Server(ServerConfig(
@@ -560,10 +575,19 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
                     with phases.track("register"):
                         for job in group:
                             server.register_job(job)
-                    cum += sum(
+                    group_count = sum(
                         tg.count for job in group for tg in job.task_groups
                     )
-                    gate = cum - max(50, cum // 100)  # ~99% settle gate
+                    cum += group_count
+                    # overlap gate: release tranche k+1 once tranche k is
+                    # ~half placed, so its snapshot/encode work overlaps
+                    # tranche k's device+commit tail. The old ~99% settle
+                    # gate serialized tranches — the pipeline drained dry
+                    # during every commit tail and the workers sat in the
+                    # gather, which is where r05's ~500s untracked idle
+                    # came from. Collision cohorts stay tranche-sized:
+                    # overlapping halves touch disjoint job sets.
+                    gate = cum - max(50, group_count // 2)
                     g_deadline = time.perf_counter() + timeout
                     while (placed() < gate
                            and time.perf_counter() < g_deadline):
@@ -630,6 +654,23 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
             "device_dispatches": db.get("dispatches", 0),
             "device_evals": db.get("evals", 0),
             "max_eval_batch": db.get("max_batch_seen", 0),
+            "workers": workers,
+            # wave formation: did dispatches actually fill the eval
+            # batch? fill_ratio near 1.0 means the broker/gather kept
+            # max_eval_batch evals in flight per wave; near 1/batch
+            # means the device ran single-eval waves (r05's failure
+            # mode: 328 evals over 21 dispatches against a 64 cap).
+            "wave_fill": {
+                "device_batch": device_batch,
+                "gathers": db.get("gathers", 0),
+                "full_gathers": db.get("full_gathers", 0),
+                "mean_eval_batch": round(
+                    db.get("evals", 0) / db["dispatches"], 2
+                ) if db.get("dispatches") else 0.0,
+                "fill_ratio": round(
+                    db.get("evals", 0) / db["dispatches"] / device_batch, 3
+                ) if db.get("dispatches") and device_batch else 0.0,
+            },
             # wall-clock share (interval UNION across threads, not a
             # thread-sum) each pipeline phase held during the window
             "phases": phase_shares,
@@ -670,8 +711,11 @@ def bench_system(name, n_nodes, jobs, workers=32, device_batch=16,
         _PENDING_FLIGHT.pop(name, None)
         if report is not None:
             # one-line bottleneck verdict rides the config record (the
-            # full ranked ledger is the {name}.bottleneck artifact)
+            # full ranked ledger is the {name}.bottleneck artifact); the
+            # ranked component list also rides along so BENCH_r06 can
+            # embed it without re-reading artifacts
             out["bottleneck"] = report.get("top")
+            out["bottleneck_ranked"] = report.get("entries")
             out["attribution_coverage"] = report.get("coverage")
         log(f"system[{name}]: {json.dumps(out)}")
         write_artifact(name, out)
@@ -757,8 +801,13 @@ def bench_c1m_system():
     flow as dense arrays through plan apply and the FSM. The JSON's
     ``phases`` record the measured wall share of every pipeline phase —
     the v5e-8 extrapolation in main() is computed from THOSE, not from
-    an assumed per-chip proration."""
-    jobs, templates, mk_job = c1m_mixed_jobs()
+    an assumed per-chip proration.
+
+    NOMAD_BENCH_C1M_TOTAL scales the placement count down for CI/local
+    validation of the mechanics (wave fill, coverage, BENCH_r06 shape);
+    the default 1M is the measured headline."""
+    total = int(os.environ.get("NOMAD_BENCH_C1M_TOTAL", "1000000"))
+    jobs, templates, mk_job = c1m_mixed_jobs(total=total)
 
     def _warm():
         # one warm job per compiled SHAPE the measured run produces:
@@ -773,15 +822,20 @@ def bench_c1m_system():
     # collision cohorts keep the optimistic-concurrency rejection rate
     # near zero, every dispatch rides the warm (b=64, p=1024) compile
     # bucket, and the wall covers full convergence of all 1M
-    # placements. Rare partial retries under 600 placements take the
-    # host iterator stack rather than minting fresh compile buckets
-    # mid-run. The 360s internal budget is the acceptance bar: overruns
-    # surface as headline_status="timeout" in the artifact rather than
-    # eating the whole bench wall.
+    # placements. Gather cadence is the PRODUCTION default (demand-aware
+    # window, 2s backstop): r05 proved that a bespoke 15s window +
+    # 600ms idle gap left workers parked in the gather for ~500s of the
+    # 600s wall, so the headline now runs exactly what
+    # service-prod-defaults-5K measures — if the defaults can't carry
+    # the headline, the defaults are the bug. 128 workers (2x the
+    # 64-eval batch) keep a full next wave encoding while the current
+    # one is on device. The 360s internal budget is the acceptance bar:
+    # overruns surface as headline_status="timeout" in the artifact
+    # rather than eating the whole bench wall.
     return bench_system(
-        "c1m-mixed-1M", 5000, jobs, workers=64, device_batch=64,
-        timeout=360.0, deterministic=True, window_ms=15000.0, idle_ms=600.0,
-        warmup=_warm, device_min_placements=600, tranches=16,
+        "c1m-mixed-1M", 5000, jobs, workers=128, device_batch=64,
+        timeout=360.0, deterministic=True,
+        warmup=_warm, tranches=16,
     )
 
 
@@ -970,10 +1024,11 @@ def system_benches():
         results.append(r)
 
     # config 3b: the PRODUCTION batcher defaults at the 5K-node shape —
-    # device_min_placements=24, gather window 25ms, idle gap 3ms (the
-    # ServerConfig defaults). Recorded as its own row so regressions in
-    # the defaults an operator actually gets are visible directly,
-    # instead of hiding behind the bench-tuned gather windows above.
+    # no gather knobs passed, so this row runs exactly what ServerConfig
+    # ships (demand-aware gather, 2s backstop window, 3ms idle gap,
+    # device_min_placements=24). Since r06 the headline runs these same
+    # defaults, so this row is the small-shape control for the headline
+    # rather than a what-an-operator-gets footnote.
     def _prod_job(job_id):
         j = mock.job()
         j.id = job_id
@@ -988,8 +1043,7 @@ def system_benches():
         return _prod_job("warm-prod")
 
     r = _diagnostic(bench_system, "service-prod-defaults-5K", 5000, jobs,
-                    timeout=300.0, window_ms=25.0, idle_ms=3.0,
-                    device_min_placements=24, warmup=_prod_warm)
+                    timeout=300.0, warmup=_prod_warm)
     if r:
         results.append(r)
 
@@ -1474,7 +1528,8 @@ def main():
         write_artifact("kernel-rate",
                        {"placements_per_s": round(kernel_rate, 1)})
     drain = _diagnostic(bench_plan_queue_drain)
-    chunked_rate = _diagnostic(bench_c1m_chunked)
+    chunked = _diagnostic(bench_c1m_chunked) or {}
+    chunked_rate = chunked.get("placements_per_s", 0.0)
     _diagnostic(bench_parity_scan_single)
     _diagnostic(bench_kernel_roofline)
     sys_results = _diagnostic(system_benches) or []
@@ -1555,6 +1610,10 @@ def main():
             ),
             "kernel_placements_per_s": round(kernel_rate or 0.0, 1),
             "chunked_tier_placements_per_s": round(chunked_rate or 0.0, 1),
+            # sampled-parity divergence of the throughput tier, stamped
+            # next to its rate: the tier is only quotable WITH its
+            # measured drift from the host oracle
+            "chunked_tier_parity_sample": chunked.get("parity_sample"),
             "plan_queue_drain_10k_nodes": drain,
             "system_configs": sys_results,
             "chaos_churn": chaos_churn,
@@ -1563,6 +1622,45 @@ def main():
         },
     }
     write_artifact("headline", record)
+
+    # Round record at the repo root, written by bench.py itself (r05's
+    # lesson: the outer harness timed out and its wrapper recorded
+    # parsed=null — the run's own data survived only in a stderr tail).
+    # Everything the acceptance gate reads is top-level here.
+    r06 = {
+        "round": "r06",
+        "headline_config": headline.get("config"),
+        "headline_status": headline.get("status", "timeout"),
+        "placements_per_s": round(rate, 1),
+        "placements": placements,
+        # expected != 1M marks a NOMAD_BENCH_C1M_TOTAL-scaled dry run —
+        # never quote such a file as the round's measured number
+        "expected": headline.get("expected"),
+        "wall_s": round(wall, 2),
+        "vs_baseline": round(vs_baseline, 4),
+        "workers": headline.get("workers"),
+        "wave_fill": headline.get("wave_fill"),
+        "bottleneck": headline.get("bottleneck"),
+        "bottleneck_ranked": headline.get("bottleneck_ranked"),
+        "attribution_coverage": headline.get("attribution_coverage"),
+        "phases": phases,
+        "chunked_tier_placements_per_s": round(chunked_rate or 0.0, 1),
+        "chunked_tier_parity_sample": chunked.get("parity_sample"),
+        "headline_parity_sample": headline.get("parity_sample"),
+        "v5e8_extrapolation_s": (
+            round(t_v5e8, 2) if t_v5e8 is not None else None
+        ),
+    }
+    try:
+        root = os.path.dirname(os.path.abspath(__file__))
+        tmp = os.path.join(root, ".BENCH_r06.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(r06, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, os.path.join(root, "BENCH_r06.json"))
+    except OSError as e:
+        log(f"BENCH_r06.json write failed: {e}")
+
     print(json.dumps(record))
 
 

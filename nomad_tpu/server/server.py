@@ -148,8 +148,15 @@ class ServerConfig:
     # batched placement scan. 0/1 disables batching (per-eval dispatch).
     device_batch: int = 8
     # how long the batcher waits for co-arriving evals before dispatching
-    # (the total CAP when idle-gap gathering is on)
-    device_batch_window_ms: float = 25.0
+    # (the total CAP when idle-gap or demand-aware gathering is on).
+    # Sized as a pure BACKSTOP, not the gather pacing: with demand-aware
+    # gathering (DeviceBatcher.expect) a wave dispatches the moment its
+    # announced cohort has arrived — typically bounded by the concurrent
+    # encode time, tens of ms — and this cap only bites when an announced
+    # encode stalls. The old 25ms default silently amputated any cohort
+    # whose encodes took longer than 25ms to trickle in, which at C1M
+    # scale meant waves never filled (r05: mean 16 evals vs a 64 cap).
+    device_batch_window_ms: float = 2000.0
     # adaptive gather: keep the batch growing while requests keep arriving
     # within this gap of each other (a burst's encodes trickle in);
     # 0 disables (fixed window only). ON by default: a lone eval pays at

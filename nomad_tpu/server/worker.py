@@ -115,12 +115,21 @@ class Worker:
 
     def _run(self) -> None:
         schedulers = BUILTIN_SCHEDULERS + [CORE_SCHEDULER]
+        # Coalesced idle accounting: consecutive empty dequeues accumulate
+        # into ONE pending period, flushed as a single lifecycle IDLE_STAGE
+        # span when work finally arrives. One span per busy->idle->busy
+        # transition keeps the span ring at O(transitions) regardless of
+        # poll cadence, and gives attribution direct evidence for the
+        # "workers alive but starved" residual instead of an unattributed
+        # hole (r05's invisible 498s).
+        idle_t0: Optional[float] = None
         while not self._stop.is_set():
             try:
                 remote = self._leader_rpc()
             except Exception:  # noqa: BLE001
                 remote = None
             self._active_remote = remote
+            poll_t0 = _lifecycle.pipeline_now()
             try:
                 if remote is not None:
                     # core (GC) evals mutate raft directly and only run on
@@ -138,9 +147,17 @@ class Worker:
                 self._stop.wait(0.5)
                 continue
             if evaluation is None:
+                if idle_t0 is None:
+                    idle_t0 = poll_t0
                 if remote is not None:
                     self._stop.wait(0.1)
                 continue
+            if idle_t0 is not None:
+                _lifecycle.pipeline_record(
+                    _lifecycle.IDLE_STAGE, f"worker-{self.id}",
+                    idle_t0, _lifecycle.pipeline_now(),
+                )
+                idle_t0 = None
             metrics.incr_counter("nomad.worker.dequeue_eval")
             _lifecycle.on_worker(evaluation.id, self.id)
             self._eval_token = token

@@ -308,6 +308,13 @@ class DeviceBatcher:
             "evals": 0,
             "max_batch_seen": 0,
             "padded_evals": 0,
+            # wave-fill accounting: gathers = gather rounds closed,
+            # full_gathers = rounds that filled max_batch. The r05 DNF
+            # shipped 21 dispatches averaging ~16 evals against a 64 cap
+            # with nothing recording the fill ratio; bench stamps these
+            # on every config artifact now.
+            "gathers": 0,
+            "full_gathers": 0,
             # gather-window latency (enqueue -> dispatch start), the
             # quantity the adaptive idle gap bounds: an operator watching
             # /v1/metrics sees directly whether batching is adding
@@ -323,6 +330,15 @@ class DeviceBatcher:
             "transfer_ms_total": 0.0,
             "d2h_bytes_total": 0,
         }
+        # Demand-aware gather (guarded-by: _lock): workers announce an
+        # encode-in-flight destined for this batcher via expect(); the
+        # gather loop keeps its window open while announced encodes are
+        # still en route instead of breaking on a fixed idle gap. Armed
+        # lazily on the first expect() so raw batchers (unit tests,
+        # forced-kernel paths that never announce) keep the classic
+        # window/idle semantics.
+        self._expected = 0
+        self._demand_aware = False
         _LIVE.add(self)
 
     # -- lifecycle -------------------------------------------------------
@@ -375,15 +391,46 @@ class DeviceBatcher:
         with self._lock:
             return self.stats["dispatches"] > 0
 
-    def run(self, enc: EncodedEval):
+    def expect(self, n: int = 1) -> None:
+        """Announce ``n`` encodes in flight that will submit here. The
+        gather loop holds its window open (up to window_ms) while
+        announced work is still en route, so a cohort of concurrently
+        encoding evals forms ONE full wave instead of fragmenting on the
+        idle gap. Every expect() must be balanced by run(expected=True)
+        or cancel_expected() — the engine's dispatch path does this in a
+        try/finally; a leaked expectation costs at most one window_ms cap
+        per gather, never a hang."""
+        with self._lock:
+            self._demand_aware = True
+            self._expected += n
+
+    def cancel_expected(self) -> None:
+        """Withdraw one expect() (encode fell back to the host path,
+        rerouted to the chunked tier, or raised)."""
+        with self._lock:
+            self._expected = max(0, self._expected - 1)
+
+    def _expected_now(self) -> int:
+        with self._lock:
+            return self._expected if self._demand_aware else -1
+
+    def run(self, enc: EncodedEval, expected: bool = False):
         """Submit one encoded eval; blocks until its results are ready.
         Returns (chosen, scores, pulls, skipped, evict) numpy arrays of
         length enc.p (already sliced back from the padded batch).
+
+        ``expected=True`` consumes one prior expect() announcement
+        (arrival: the demand token converts into a queued request).
 
         Robust against a concurrent stop(): the wait loop re-ensures the
         dispatcher is alive, so a request that slipped into the queue
         after stop() drained it is picked up by the restarted thread
         rather than parking its worker forever."""
+        if expected:
+            # release the demand token before anything that can raise:
+            # a chaos-failed dispatch must not leave a phantom
+            # expectation holding future gathers open
+            self.cancel_expected()
         # chaos hook: a fault here is a failed/slow device round trip for
         # THIS eval — the engine's dispatch guard reroutes it to the host
         # iterator path (parity-identical placements, reference latency)
@@ -416,9 +463,18 @@ class DeviceBatcher:
                         break
                     # adaptive mode waits only as long as the arrival gap
                     wait = min(remaining, self.idle_s) if self.idle_s else remaining
+                    # demand-aware: while announced encodes are still en
+                    # route, keep polling up to the window cap instead of
+                    # closing the wave on an arrival gap — this is what
+                    # turns a trickling 64-eval cohort into ONE dispatch
+                    demand = self._expected_now()
+                    if demand > 0:
+                        wait = min(remaining, max(wait, 0.02))
                     try:
                         batch.append(self._queue.get(timeout=wait))
                     except queue.Empty:
+                        if demand > 0 and self._expected_now() > 0:
+                            continue  # encodes still en route
                         break  # stream paused (or window expired)
             else:
                 while len(batch) < self.max_batch:
@@ -426,6 +482,10 @@ class DeviceBatcher:
                         batch.append(self._queue.get_nowait())
                     except queue.Empty:
                         break
+            with self._lock:
+                self.stats["gathers"] += 1
+                if len(batch) >= self.max_batch:
+                    self.stats["full_gathers"] += 1
             # dtype-homogeneous sub-batches: co-batching must never change
             # an eval's arithmetic (f32 evals upcast could select
             # differently than they would alone). int32 = the exact
@@ -602,14 +662,18 @@ class DeviceBatcher:
         s_raw = max(e.s for e in encs)
         s_pad = _pow2ceil(s_raw) if s_raw else 0
         v_pad = _pow2ceil(max(max(e.v for e in encs), 2))
-        # COARSE placement-count buckets (16/64/256, pow2 beyond): retried
-        # partial evals arrive at arbitrary small p, and a fresh compile
-        # (even a persistent-cache load) per pow2 bucket costs seconds —
-        # far more than the padded steps, which skip cheaply
+        # COARSE placement-count buckets (16/64/256/1024, pow2 beyond):
+        # retried partial evals arrive at arbitrary small p, and a fresh
+        # compile (even a persistent-cache load) per pow2 bucket costs
+        # seconds — far more than the padded steps, which skip cheaply.
+        # 257..1024 collapses into ONE bucket: a mid-run OCC retry of a
+        # few hundred placements must ride the wave cohort's warm 1024
+        # bucket, not stall the dispatcher on a fresh 512 compile.
         p_raw = max(e.p for e in encs)
         p_pad = (
             16 if p_raw <= 16 else 64 if p_raw <= 64
-            else 256 if p_raw <= 256 else _pow2ceil(p_raw)
+            else 256 if p_raw <= 256 else 1024 if p_raw <= 1024
+            else _pow2ceil(p_raw)
         )
         d_pad = max(e.static[0].shape[1] for e in encs)
         # absent-feature axes stay ZERO when the whole batch lacks them

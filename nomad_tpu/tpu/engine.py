@@ -1459,51 +1459,73 @@ class TpuPlacementEngine:
         from ..utils import phases as _phases
 
         wave_id = sched.eval.id
-        t0 = _metrics.now()
-        with _HOST_WORK_SEM:
-            t1 = _metrics.now()
-            with _phases.track("encode"), _tlc.pipeline_stage("encode", wave_id):
-                enc = self.encode_eval(sched, destructive, place)
-            _metrics.measure_since("nomad.tpu_engine.encode_work", t1)
-        _metrics.measure_since("nomad.tpu_engine.encode", t0)
-        if enc is NotImplemented:
-            return NotImplemented
-        if enc is True:
-            return True
-        self._pipeline_remember(sched, enc)
-        t0 = _metrics.now()
         batcher = getattr(sched.planner, "device_batcher", None)
-        # tpu_binpack_chunked: chunk-eligible evals take the top-K
-        # throughput scan; everything else — preempting, destructive,
-        # int-mode, penalized — falls back to the bit-parity dispatch
-        # below exactly as under tpu_binpack
-        use_chunked = False
-        if getattr(sched, "chunked_tier", False):
-            chunk_reason = self._chunk_eligible(enc)
-            use_chunked = chunk_reason is None
-            if not use_chunked:
-                _metrics.incr_counter("nomad.tpu_engine.chunk_fallback")
-                logger.debug("chunked tier ineligible (%s): %s",
-                             wave_id[:8], chunk_reason)
+        # Demand announcement: tell the batcher an encode destined for it
+        # is in flight BEFORE the encode starts, so the gather window
+        # stays open for this eval's cohort instead of closing on an
+        # arrival gap (the r05 wave-fragmentation bug: 328 evals over 21
+        # dispatches against a 64 cap). Balanced in the finally / by
+        # run(expected=True) on every path out of this function.
+        expected_held = False
+        if batcher is not None:
+            batcher.expect()
+            expected_held = True
         try:
-            with _tlc.pipeline_stage("dispatch", wave_id):
-                if use_chunked:
-                    chosen, scores, pulls, skipped_steps, evict = self.run_chunked(
-                        enc, chunk_k=int(getattr(sched, "chunk_k", 128)))
-                elif batcher is not None:
-                    chosen, scores, pulls, skipped_steps, evict = batcher.run(enc)
-                else:
-                    chosen, scores, pulls, skipped_steps, evict = self.run_scan_single(enc)
-        except Exception:  # noqa: BLE001 — device dispatch failed
-            # A failed/poisoned device round trip must not fail the eval:
-            # the host iterator stack computes the identical placements
-            # (bit-parity contract), so degrade this eval to the host
-            # path and let the caller's fall-through handle it.
-            logger.warning("device dispatch failed for %s; host fallback",
-                           wave_id[:8], exc_info=True)
-            _metrics.incr_counter("nomad.tpu_engine.dispatch_fallback_host")
-            self._pipeline_forget(sched)
-            return NotImplemented
+            t0 = _metrics.now()
+            with _HOST_WORK_SEM:
+                t1 = _metrics.now()
+                with _phases.track("encode"), _tlc.pipeline_stage("encode", wave_id):
+                    enc = self.encode_eval(sched, destructive, place)
+                _metrics.measure_since("nomad.tpu_engine.encode_work", t1)
+            _metrics.measure_since("nomad.tpu_engine.encode", t0)
+            if enc is NotImplemented:
+                return NotImplemented
+            if enc is True:
+                return True
+            self._pipeline_remember(sched, enc)
+            t0 = _metrics.now()
+            # tpu_binpack_chunked: chunk-eligible evals take the top-K
+            # throughput scan; everything else — preempting, destructive,
+            # int-mode, penalized — falls back to the bit-parity dispatch
+            # below exactly as under tpu_binpack
+            use_chunked = False
+            if getattr(sched, "chunked_tier", False):
+                chunk_reason = self._chunk_eligible(enc)
+                use_chunked = chunk_reason is None
+                if not use_chunked:
+                    _metrics.incr_counter("nomad.tpu_engine.chunk_fallback")
+                    logger.debug("chunked tier ineligible (%s): %s",
+                                 wave_id[:8], chunk_reason)
+            if use_chunked and expected_held:
+                # withdraw BEFORE the long chunked scan: a phantom
+                # expectation would hold concurrent gathers open for it
+                batcher.cancel_expected()
+                expected_held = False
+            try:
+                with _phases.track("device_wait"), \
+                        _tlc.pipeline_stage("dispatch", wave_id):
+                    if use_chunked:
+                        chosen, scores, pulls, skipped_steps, evict = self.run_chunked(
+                            enc, chunk_k=int(getattr(sched, "chunk_k", 128)))
+                    elif batcher is not None:
+                        expected_held = False  # run() consumes the token
+                        chosen, scores, pulls, skipped_steps, evict = batcher.run(
+                            enc, expected=True)
+                    else:
+                        chosen, scores, pulls, skipped_steps, evict = self.run_scan_single(enc)
+            except Exception:  # noqa: BLE001 — device dispatch failed
+                # A failed/poisoned device round trip must not fail the eval:
+                # the host iterator stack computes the identical placements
+                # (bit-parity contract), so degrade this eval to the host
+                # path and let the caller's fall-through handle it.
+                logger.warning("device dispatch failed for %s; host fallback",
+                               wave_id[:8], exc_info=True)
+                _metrics.incr_counter("nomad.tpu_engine.dispatch_fallback_host")
+                self._pipeline_forget(sched)
+                return NotImplemented
+        finally:
+            if expected_held:
+                batcher.cancel_expected()
         _metrics.measure_since("nomad.tpu_engine.device_wait", t0)
         if use_chunked:
             _metrics.incr_counter("nomad.tpu_engine.chunk_dispatch")
@@ -2371,7 +2393,8 @@ class TpuPlacementEngine:
         # allocs on one node) interact through used/tg_counts and keep
         # the sequential scan.
         batcher = getattr(sched.planner, "device_batcher", None)
-        with _tlc.pipeline_stage("dispatch", sched.eval.id):
+        with _phases.track("device_wait"), \
+                _tlc.pipeline_stage("dispatch", sched.eval.id):
             if len(set(forced.tolist())) == p and pre_tables is None:
                 # (the forced fast path never encodes preemption — a preempt
                 # pass always takes the sequential scan below)

@@ -22,6 +22,16 @@ is a self-check on the span set itself: coverage < 0.9 means the
 instrumentation lost track of what the system was doing and the report
 says so instead of ranking garbage.
 
+Idle comes in two explicitly distinguished flavors. ``idle`` is
+INSTRUMENTED: scheduler workers record their coalesced empty-dequeue
+periods (lifecycle.IDLE_STAGE), so dead time between waves is claimed
+with direct evidence and counts toward coverage. ``broker_idle`` is the
+SYNTHESIZED complement of the wave windows — no eval in flight at all —
+and ranks below ``idle``. Time inside the makespan that neither work
+spans, instrumented idle, nor the complement explains stays
+unattributed and drags coverage below the floor: an instrumentation
+hole must still fail the self-check, never get laundered as idle.
+
 All interval math is on the lifecycle clock (``time.monotonic``).
 """
 from __future__ import annotations
@@ -45,7 +55,10 @@ PRECEDENCE: Tuple[str, ...] = (
     "finalize",        # applied, waiting for ack bookkeeping
     "invoke_wait",     # dequeued, waiting for a scheduler slot
     "queue_wait",      # enqueued, waiting for a broker dequeue
-    "broker_idle",     # no eval in flight at all (dequeue idle)
+    "idle",            # INSTRUMENTED worker idle: >=1 scheduler worker
+                       # recorded a coalesced empty-dequeue period and no
+                       # higher component was active (lifecycle.IDLE_STAGE)
+    "broker_idle",     # synthesized complement: no eval in flight at all
 )
 
 COVERAGE_FLOOR = 0.9

@@ -135,15 +135,29 @@ _counts: Dict[str, int] = {"ack": 0, "nack": 0, "failed": 0, "flush": 0}
 PIPELINE_STAGES = ("encode", "dispatch", "evaluate", "commit")
 _PIPE_CAP = 4096
 
+#: aux stage name for worker dequeue idle: a scheduler worker that polls
+#: the broker and finds nothing records its whole contiguous idle period
+#: as ONE span (coalesced at the worker, one span per busy->idle->busy
+#: transition, so 64 workers cannot flood the ring). These spans are what
+#: lets attribution decompose the busy-vs-window residual explicitly
+#: instead of leaving it unattributed (r05's invisible 498s).
+IDLE_STAGE = "idle"
+
 _pipe_open: Dict[str, int] = {s: 0 for s in PIPELINE_STAGES}
 _pipe_done: Dict[str, "deque"] = {
     s: deque(maxlen=_PIPE_CAP) for s in PIPELINE_STAGES
 }
 _pipe_counts: Dict[str, int] = {s: 0 for s in PIPELINE_STAGES}
+# measurement epoch: externally-timed spans (pipeline_record) are clamped
+# to start no earlier than the last reset(), so a worker's idle
+# accumulation that straddles a bench's warmup reset cannot drag the
+# attribution makespan back into the warmup window
+_pipe_epoch: float = 0.0
 
 
 def reset() -> None:
     """Drop all records (tests / broker re-enable)."""
+    global _pipe_epoch
     with _lock:
         _inflight.clear()
         _done.clear()
@@ -158,6 +172,7 @@ def reset() -> None:
             _pipe_open[s] = 0
             _pipe_done[s].clear()
             _pipe_counts[s] = 0
+        _pipe_epoch = _clock()
 
 
 # -- stamping API (call sites: broker, worker, scheduler, applier) ---------
@@ -335,9 +350,15 @@ def pipeline_stage(stage: str, wave_id: str):
 
 def pipeline_record(stage: str, wave_id: str, t0: float, t1: float) -> None:
     """Record an externally-timed stage span (times from pipeline_now());
-    used by the applier's waiter thread, which times per-payload commits
-    inside one batched raft entry."""
+    used by the applier's waiter thread (per-payload commit times inside
+    one batched raft entry) and by scheduler workers flushing coalesced
+    ``idle`` dequeue-wait periods. Spans are clamped to the last reset()
+    so accumulations straddling a bench's warmup reset cannot stretch the
+    measured window backwards."""
     with _lock:
+        t0 = max(t0, _pipe_epoch)
+        if t1 <= t0:
+            return
         _pipe_done.setdefault(stage, deque(maxlen=_PIPE_CAP)).append(
             (wave_id, t0, t1)
         )

@@ -12,9 +12,31 @@ cap the number of RUNNABLE threads in the whole process, and a worker
 holds it only for bounded, non-blocking sections (never across a device
 dispatch or a plan-queue wait — that would deadlock the batch gather,
 which needs every co-batched worker to reach the batcher).
+
+The permit count scales with the host: the guarded sections are
+numpy/memdb-read heavy and release the GIL for most of their wall time,
+so a wave of 64+ concurrent evals wants more than a handful of
+concurrent encoders — r05's fixed bound of 4 made the pre-device
+stages (snapshot -> reconcile -> encode) trickle into the batcher one
+at a time and left the device starved between waves. Bounded at 16:
+past that the pure-Python remainder convoys on the GIL again.
+``NOMAD_HOST_WORK_PERMITS`` overrides for experiments.
 """
 from __future__ import annotations
 
+import os
 import threading
 
-HOST_WORK_SEM = threading.BoundedSemaphore(4)
+
+def _permits() -> int:
+    env = os.environ.get("NOMAD_HOST_WORK_PERMITS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return min(16, max(4, os.cpu_count() or 4))
+
+
+HOST_WORK_PERMITS = _permits()
+HOST_WORK_SEM = threading.BoundedSemaphore(HOST_WORK_PERMITS)

@@ -31,6 +31,11 @@ window. Phases tracked across the system path:
                  around the rank pulls (generic/system scheduler)
   engine_gate    device-path gate checks + encode attempts + fallback
                  decision (tpu/integration.py; engine phases nest inside)
+  device_wait    worker parked in the device dispatch block — the
+                 batcher's gather window + queue + device round trip
+                 (or the chunked-tier scan) until its wave's results
+                 land. r05's ~500s busy-vs-window gap lived here,
+                 untracked; device/pad_stack nest inside its union.
   plan_submit    worker parked on the plan queue future (worker)
   wait_index     worker parked on raft replication before snapshotting
   raft_fsm       raft log append -> FSM -> state store commit (every
@@ -136,6 +141,11 @@ def wall_shares(t0: float, t1: float) -> Dict[str, float]:
                  ``device`` and meta-phases)
       busy       union over every fine phase (meta-phases excluded)
       window     t1 - t0
+      untracked  window - busy: wall seconds during which NO fine phase
+                 had a thread inside it. r05 shipped a headline where
+                 this residual was 498s of a 600s window and invisible —
+                 the gap is now an explicit row so a busy-vs-window
+                 mismatch can never again go unreported.
     """
     with _lock:
         snap = {k: list(v) for k, v in _intervals.items()}
@@ -146,6 +156,7 @@ def wall_shares(t0: float, t1: float) -> Dict[str, float]:
     out["any_host"] = round(_union_len(host, t0, t1), 3)
     out["busy"] = round(_union_len(every, t0, t1), 3)
     out["window"] = round(t1 - t0, 3)
+    out["untracked"] = round(max(0.0, out["window"] - out["busy"]), 3)
     return out
 
 

@@ -223,6 +223,37 @@ class TestAttribution:
         assert cp["components"]["broker_idle"] == 8.0  # [1, 9]
         assert cp["coverage"] == 1.0
 
+    def test_instrumented_idle_outranks_broker_idle_synthesis(self):
+        """Worker-recorded idle spans (lifecycle.IDLE_STAGE) claim ahead
+        of the synthesized broker_idle complement: an inter-wave gap a
+        worker measurably sat out decomposes into `idle` for the
+        instrumented stretch and broker_idle only for the remainder."""
+        recs = [
+            _rec("a", enqueue_t=0.0, dequeue_t=0.5, invoke_start_t=0.5,
+                 invoke_end_t=1.0, end_t=1.0),
+            _rec("b", enqueue_t=9.0, dequeue_t=9.5, invoke_start_t=9.5,
+                 invoke_end_t=10.0, end_t=10.0),
+        ]
+        spans = [(lifecycle.IDLE_STAGE, "worker-0", 1.0, 5.0)]
+        cp = attribution.critical_path(recs, spans, now=10.0)
+        assert cp["components"]["idle"] == 4.0          # measured [1, 5]
+        assert cp["components"]["broker_idle"] == 4.0   # residual [5, 9]
+        assert cp["coverage"] == 1.0
+
+    def test_idle_spans_do_not_launder_instrumentation_holes(self):
+        """A partial idle span must not rescue a span set with a real
+        instrumentation hole: only the measured stretch is claimed, the
+        hole still drags coverage under the floor and the report still
+        refuses to rank."""
+        recs = [_rec("gap", enqueue_t=0.0, dequeue_t=0.1,
+                     invoke_start_t=0.2, invoke_end_t=0.5,
+                     submit_t=9.5, apply_t=9.8, end_t=10.0)]
+        spans = [(lifecycle.IDLE_STAGE, "worker-0", 0.5, 1.5)]
+        rep = attribution.bottleneck_report(recs, spans, now=10.0)
+        assert rep["coverage"] < attribution.COVERAGE_FLOOR
+        assert rep["coverage_ok"] is False
+        assert "coverage" in rep["top"] and "incomplete" in rep["top"]
+
     def test_empty_inputs(self):
         rep = attribution.bottleneck_report([], [], now=0.0)
         assert rep["top"] == "no spans recorded"

@@ -1258,6 +1258,120 @@ def test_lock_order_same_name_nesting_is_reentrant():
 
 
 # ---------------------------------------------------------------------------
+# fixture units — r06 worker-pool shapes (lock-order, trace-span-discipline)
+# ---------------------------------------------------------------------------
+# The parallel-lifecycle round added two concurrency-sensitive shapes:
+# the batcher's demand-aware expect/cancel counter (engine threads touch
+# batcher._lock while the dispatcher thread holds it around stats), and
+# the worker's coalesced idle-span recording. These fixtures pin that
+# the SHIPPED shapes are clean AND that the bug-shaped variants a
+# refactor could reintroduce still trip the rules.
+
+
+def test_worker_pool_demand_counter_shape_is_clean():
+    # engine-side expect()/cancel_expected() + dispatcher-side stats
+    # bump, each under the single batcher lock: no ordering edge exists
+    src = dedent("""
+        import threading
+
+        class DeviceBatcher:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._expected = 0
+                self.stats = {"gathers": 0}  # guarded-by: _lock
+
+            def expect(self, n=1):
+                with self._lock:
+                    self._expected += n
+
+            def cancel_expected(self):
+                with self._lock:
+                    self._expected = max(0, self._expected - 1)
+
+            def _dispatch_loop(self):
+                with self._lock:
+                    self.stats["gathers"] += 1
+    """)
+    assert run_source(src, "tpu/batcher.py") == []
+
+
+def test_worker_pool_lock_order_inversion_still_trips():
+    # the regression a "hold the pool lock while announcing demand"
+    # refactor would create: worker pool lock -> batcher lock in one
+    # path, batcher lock -> pool lock in the drain path
+    src = dedent("""
+        import threading
+
+        class WorkerPool:
+            def __init__(self):
+                self._pool_lock = threading.Lock()
+                self._batcher_lock = threading.Lock()
+
+            def announce(self):
+                with self._pool_lock:
+                    with self._batcher_lock:
+                        pass
+
+            def drain(self):
+                with self._batcher_lock:
+                    with self._pool_lock:
+                        pass
+    """)
+    fs = run_source(src, "server/worker.py")
+    assert [f.rule for f in fs] == ["lock-order"]
+    assert "potential deadlock" in fs[0].message
+
+
+def test_worker_idle_span_recording_shape_is_clean():
+    # the shipped worker idle pattern: pipeline_record is a plain
+    # timestamped event (not a span context manager), so recording a
+    # coalesced idle interval on the next successful dequeue is NOT a
+    # bare-span violation — while real span entries stay `with`-guarded
+    src = dedent("""
+        from nomad_tpu.trace import lifecycle as _lifecycle
+        from nomad_tpu.utils import phases
+
+        class Worker:
+            def _run(self):
+                idle_t0 = None
+                while True:
+                    poll_t0 = _lifecycle.pipeline_now()
+                    ev = self.dequeue()
+                    if ev is None:
+                        if idle_t0 is None:
+                            idle_t0 = poll_t0
+                        continue
+                    if idle_t0 is not None:
+                        _lifecycle.pipeline_record(
+                            _lifecycle.IDLE_STAGE, "worker-0",
+                            idle_t0, _lifecycle.pipeline_now())
+                        idle_t0 = None
+                    with phases.track("worker_busy"):
+                        self._process(ev)
+    """)
+    assert run_source(src, "server/worker.py") == []
+
+
+def test_worker_idle_as_bare_span_still_trips():
+    # the tempting-but-wrong variant: opening a phases.track("idle")
+    # manager at idle start and parking it in a local — a worker that
+    # dies idle leaves the span open forever
+    src = dedent("""
+        from nomad_tpu.utils import phases
+
+        class Worker:
+            def _run(self):
+                cm = phases.track("idle")
+                cm.__enter__()
+                ev = self.dequeue()
+                cm.__exit__(None, None, None)
+    """)
+    fs = run_source(src, "server/worker.py")
+    assert [f.rule for f in fs] == ["trace-span-discipline"]
+    assert "phases.track" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
 # fixture units — condition-discipline
 # ---------------------------------------------------------------------------
 
