@@ -1,13 +1,18 @@
 """nomad-lint: AST invariant checkers for the repo's load-bearing rules.
 
-Four rules (see ``nomad_tpu/analysis/README.md``):
+Headline rules (full table in ``nomad_tpu/analysis/README.md``):
 
-  - ``jit-purity``       jax.jit-compiled functions (and their transitive
-                         same-module callees) stay host-effect free
-  - ``dtype-discipline`` no float64 creep in the integer parity encode path
-  - ``lock-discipline``  ``# guarded-by: <lock>``-annotated attributes are
-                         only written under that lock
-  - ``fsm-determinism``  FSM apply handlers never read wall clock or RNG
+  - ``jit-purity``        jax.jit-compiled functions (and their transitive
+                          same-module callees) stay host-effect free
+  - ``dtype-discipline``  no float64 creep in the integer parity encode path
+  - ``fsm-determinism``   FSM apply handlers never read wall clock or RNG
+  - ``lock-order``        whole-program lock acquisition-order cycles
+  - ``condition-discipline`` waits re-check predicates, notifies hold locks
+  - ``shared-state-discipline`` writes to attributes inferred shared across
+                          thread roots are proven lock-guarded
+                          (``# guarded-by:`` declarations stay
+                          authoritative; ``# race-ok: <reason>`` suppresses
+                          with a ratchet on stale claims)
 
 Run: ``python -m nomad_tpu.analysis [paths...]`` — exits non-zero on any
 finding not recorded in ``nomad_tpu/analysis/baseline.json`` and not

@@ -10,9 +10,23 @@ left as a silent credit new regressions could spend); 2 on bad usage.
 removed (the surgical version: it never ADDS entries, so it cannot
 launder a new finding into the baseline). ``--rule`` restricts the run
 to a comma-separated set of rules — baseline matching is restricted to
-the same rules so unrelated entries are not reported stale. ``--json``
-emits a machine-readable object with rendered findings and per-rule
-counts.
+the same rules so unrelated entries are not reported stale.
+``--changed-only`` scopes REPORTING (and baseline matching) to the
+given files for fast pre-commit runs, while the collect pass still sees
+the whole tree so cross-module rules keep their whole-program facts.
+
+``--json`` emits a machine-readable object:
+
+    {
+      "findings":       [{rule, file, line, message, rendered}, ...],
+      "counts":         {rule: int, ...},
+      "stale_baseline": [{rule, file, message}, ...],
+      "rule_wall_ms":   {rule: float, ...,    # per-rule wall time
+                         "call-graph": float} # shared interprocedural
+                                              # build (lock-order /
+                                              # condition-discipline /
+                                              # shared-state-discipline)
+    }
 """
 from __future__ import annotations
 
@@ -35,8 +49,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m nomad_tpu.analysis",
         description="nomad-lint: AST invariant checks "
-                    "(jit-purity, dtype-discipline, lock-discipline, "
-                    "lock-order, condition-discipline, fsm-determinism, ...)",
+                    "(jit-purity, dtype-discipline, lock-order, "
+                    "condition-discipline, shared-state-discipline, "
+                    "fsm-determinism, ...)",
     )
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/directories to lint (default: nomad_tpu)")
@@ -54,9 +69,17 @@ def main(argv=None) -> int:
                         help="only run/report these rules (repeatable or "
                              "comma-separated); baseline matching is "
                              "restricted to the same rules")
+    parser.add_argument("--changed-only", action="append", default=None,
+                        metavar="PATH",
+                        help="only report findings in these files "
+                             "(repeatable or comma-separated); the whole "
+                             "tree is still collected so cross-module "
+                             "rules stay whole-program. Baseline matching "
+                             "is restricted to the same files.")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit a JSON object: rendered findings, "
-                             "per-rule counts, stale baseline entries")
+                             "per-rule counts, stale baseline entries, "
+                             "per-rule wall time (rule_wall_ms)")
     args = parser.parse_args(argv)
 
     paths = args.paths or ["nomad_tpu"]
@@ -73,7 +96,24 @@ def main(argv=None) -> int:
             print("error: --rule given but empty", file=sys.stderr)
             return 2
 
-    findings = run_paths(paths, rel_to=os.getcwd())
+    only_rel = None
+    if args.changed_only:
+        changed = [c.strip() for part in args.changed_only
+                   for c in part.split(",") if c.strip()]
+        if not changed:
+            print("error: --changed-only given but empty", file=sys.stderr)
+            return 2
+        # deleted files are legitimate "changed" inputs: they simply
+        # cannot have findings, so they scope to nothing
+        only_rel = {
+            os.path.relpath(os.path.abspath(c), os.getcwd())
+            .replace(os.sep, "/")
+            for c in changed
+        }
+
+    timings: dict = {}
+    findings = run_paths(paths, rel_to=os.getcwd(), only_rel=only_rel,
+                         timings=timings)
     if rules is not None:
         findings = [f for f in findings if f.rule in rules]
 
@@ -88,6 +128,8 @@ def main(argv=None) -> int:
         baseline = load_baseline(baseline_path)
         if rules is not None:
             baseline = [e for e in baseline if e.get("rule") in rules]
+        if only_rel is not None:
+            baseline = [e for e in baseline if e.get("file") in only_rel]
         findings, stale = apply_baseline(findings, baseline)
 
     if args.prune:
@@ -129,6 +171,10 @@ def main(argv=None) -> int:
             ],
             "counts": counts,
             "stale_baseline": stale,
+            "rule_wall_ms": {
+                rule: round(sec * 1000.0, 3)
+                for rule, sec in sorted(timings.items())
+            },
         }, indent=2, sort_keys=True))
     else:
         for f in findings:

@@ -38,8 +38,9 @@ RULE = "condition-discipline"
 class ConditionDisciplineChecker:
     rule = RULE
 
-    def __init__(self) -> None:
-        self.analysis = WholeProgramLockAnalysis()
+    def __init__(self, analysis: Optional[WholeProgramLockAnalysis] = None
+                 ) -> None:
+        self.analysis = analysis or WholeProgramLockAnalysis()
         self._findings: Optional[List[Finding]] = None
 
     def collect(self, module: ParsedModule) -> None:

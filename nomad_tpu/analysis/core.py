@@ -146,35 +146,47 @@ def default_checkers() -> list:
     from .fault_injection_discipline import FaultInjectionDisciplineChecker
     from .fsm_determinism import FsmDeterminismChecker
     from .jit_purity import JitPurityChecker
-    from .lock_discipline import LockDisciplineChecker
-    from .lock_order import LockOrderChecker
+    from .lock_order import LockOrderChecker, WholeProgramLockAnalysis
     from .metrics_discipline import MetricsDisciplineChecker
     from .pipeline_stage_discipline import PipelineStageDisciplineChecker
     from .rpc_telemetry_discipline import RpcTelemetryDisciplineChecker
+    from .shared_state import SharedStateDisciplineChecker
     from .subprocess_discipline import SubprocessDisciplineChecker
     from .trace_span_discipline import TraceSpanDisciplineChecker
 
+    # ONE interprocedural call-graph build, shared by the three
+    # concurrency rules (add_module is idempotent, analyze() memoizes)
+    shared_analysis = WholeProgramLockAnalysis()
     return [
         JitPurityChecker(),
         DtypeDisciplineChecker(),
-        LockDisciplineChecker(),
         FsmDeterminismChecker(),
         TraceSpanDisciplineChecker(),
         PipelineStageDisciplineChecker(),
         FaultInjectionDisciplineChecker(),
         SubprocessDisciplineChecker(),
         MetricsDisciplineChecker(),
-        LockOrderChecker(),
-        ConditionDisciplineChecker(),
+        LockOrderChecker(analysis=shared_analysis),
+        ConditionDisciplineChecker(analysis=shared_analysis),
+        SharedStateDisciplineChecker(analysis=shared_analysis),
         RpcTelemetryDisciplineChecker(),
     ]
 
 
 def run_paths(paths: Sequence[str], rel_to: Optional[str] = None,
-              checkers: Optional[list] = None) -> List[Finding]:
+              checkers: Optional[list] = None,
+              only_rel: Optional[set] = None,
+              timings: Optional[Dict[str, float]] = None) -> List[Finding]:
     """Run every checker over the python files under ``paths``; returns
     suppression-filtered findings (baseline NOT applied — see
-    ``apply_baseline``). ``rel_to`` anchors display/baseline paths."""
+    ``apply_baseline``). ``rel_to`` anchors display/baseline paths.
+
+    ``only_rel`` restricts REPORTING to the given rel paths while the
+    collect pass still sees the whole tree (``--changed-only``: the
+    cross-module facts stay whole-program, the findings are scoped).
+    ``timings``, if given, accumulates per-rule wall seconds; the shared
+    call-graph build is reported separately under ``call-graph`` and
+    also included in whichever rule forced it."""
     rel_to = rel_to or os.getcwd()
     if checkers is None:
         checkers = default_checkers()
@@ -189,17 +201,37 @@ def run_paths(paths: Sequence[str], rel_to: Optional[str] = None,
         if module is not None:
             modules.append(module)
 
+    import time as _time
     for checker in checkers:
         collect = getattr(checker, "collect", None)
         if collect is not None:
+            t0 = _time.perf_counter()
             for module in modules:
                 collect(module)
+            if timings is not None:
+                rule = getattr(checker, "rule", type(checker).__name__)
+                timings[rule] = timings.get(rule, 0.0) \
+                    + _time.perf_counter() - t0
     for checker in checkers:
+        t0 = _time.perf_counter()
         for module in modules:
+            if only_rel is not None and module.rel not in only_rel:
+                continue
             for f in checker.check(module):
                 if f.rule not in suppressed_rules(module.lines, f.line) \
                         and "all" not in suppressed_rules(module.lines, f.line):
                     findings.append(f)
+        if timings is not None:
+            rule = getattr(checker, "rule", type(checker).__name__)
+            timings[rule] = timings.get(rule, 0.0) + _time.perf_counter() - t0
+    if timings is not None:
+        # surface the one-shot shared call-graph build on its own line
+        for checker in checkers:
+            wall = getattr(getattr(checker, "analysis", None),
+                           "analyze_wall_s", 0.0)
+            if wall:
+                timings["call-graph"] = max(timings.get("call-graph", 0.0),
+                                            wall)
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
     return findings
 

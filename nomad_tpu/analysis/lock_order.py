@@ -13,8 +13,9 @@ nomad-lockdep's static side. The pass:
    (``threading.Condition(self._lock)`` — acquiring the condition IS
    acquiring the lock).
 
-2. Builds a **conservative name-based interprocedural call graph** in
-   the same resolution style ``lock_discipline.py`` uses: ``self.m()``
+2. Builds a **conservative name-based interprocedural call graph**
+   (shared with ``condition-discipline`` and
+   ``shared-state-discipline`` — one instance per lint run): ``self.m()``
    resolves through the class (and by-name base classes), ``self.a.m()``
    and local ``x = ClassName(...); x.m()`` resolve through recorded
    constructor types, module aliases resolve through (relative) imports,
@@ -53,6 +54,7 @@ from __future__ import annotations
 
 import ast
 import os
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .core import Finding, ParsedModule, dotted_name
@@ -61,7 +63,8 @@ RULE = "lock-order"
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock"}
 _COND_CTORS = {"threading.Condition"}
-_FACTORY_LOCKS = {"witness_lock", "witness_rlock"}
+_FACTORY_LOCKS = {"witness_lock", "witness_rlock",
+                  "module_witness_lock", "module_witness_rlock"}
 _FACTORY_CONDS = {"witness_condition"}
 _FALLBACK_CAP = 3
 _MAX_DEPTH = 14
@@ -161,6 +164,8 @@ class WholeProgramLockAnalysis:
         self.callers: Dict[_Unit, List[Tuple[_Unit, Tuple[str, ...]]]] = {}
         # callback registry: attr name -> every unit ever assigned to it
         self.callback_attrs: Dict[str, List[_Unit]] = {}
+        # wall time of the one-shot analyze() build, for --json timings
+        self.analyze_wall_s = 0.0
 
     # -- collect ---------------------------------------------------------
 
@@ -711,6 +716,19 @@ class WholeProgramLockAnalysis:
 
         prescan(unit.node)
 
+        # nested `def` bodies are skipped by the main walk — a closure
+        # handed to Thread(target=...) runs with an EMPTY held set, not
+        # this frame's. But a nested function CALLED here runs inline on
+        # this thread: scan its body at the call site under the caller's
+        # current held set (lifecycle._emit_trace_spans's `emit` closure
+        # acquiring the span-ring lock is the canonical case).
+        nested_defs: Dict[str, ast.AST] = {}
+        for sub in ast.walk(unit.node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not unit.node:
+                nested_defs.setdefault(sub.name, sub)
+        inlining: Set[str] = set()
+
         def block(nodes: Iterable[ast.AST], held: Tuple[str, ...],
                   in_while: bool) -> None:
             for node in nodes:
@@ -744,6 +762,12 @@ class WholeProgramLockAnalysis:
                 if isinstance(node, ast.Call):
                     self._scan_call(unit, node, held, in_while, local_types,
                                     local_tables)
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id in nested_defs \
+                            and f.id not in inlining:
+                        inlining.add(f.id)
+                        block(nested_defs[f.id].body, held, in_while)
+                        inlining.discard(f.id)
                 block(ast.iter_child_nodes(node), held, in_while)
 
         block(ast.iter_child_nodes(unit.node), (), False)
@@ -783,6 +807,7 @@ class WholeProgramLockAnalysis:
         if self._analyzed:
             return
         self._analyzed = True
+        t0 = time.perf_counter()
         self._collect_callbacks()
         for u in self._units:
             self._scan_unit(u)
@@ -807,6 +832,7 @@ class WholeProgramLockAnalysis:
 
         for u in self._units:
             walk(u, (), (), 0)
+        self.analyze_wall_s = time.perf_counter() - t0
 
     def _add_edge(self, a: str, b: str, rel: str, lineno: int,
                   chain: Tuple[str, ...]) -> None:
@@ -913,8 +939,9 @@ class LockOrderChecker:
 
     rule = RULE
 
-    def __init__(self) -> None:
-        self.analysis = WholeProgramLockAnalysis()
+    def __init__(self, analysis: Optional[WholeProgramLockAnalysis] = None
+                 ) -> None:
+        self.analysis = analysis or WholeProgramLockAnalysis()
         self._findings: Optional[List[Finding]] = None
 
     def collect(self, module: ParsedModule) -> None:

@@ -499,7 +499,7 @@ class CrashReplay(ChurnReplay):
             new_leader = self._find_leader_proc(
                 timeout=_ELECTION_TIMEOUT_S, min_term=old_term)
         except RuntimeError:
-            self.errors.append(
+            self.errors.append(  # race-ok: GIL-atomic append; harness list, read after threads settle
                 f"failover: no new leader within {_ELECTION_TIMEOUT_S}s")
             return
         t_leader_ms = (time.monotonic() - t0) * 1000.0
@@ -550,7 +550,7 @@ class CrashReplay(ChurnReplay):
                 sp.restart()
                 sp.wait_ready()
             except (RuntimeError, OSError) as e:
-                self.errors.append(f"restart {nid}: {e!r}")
+                self.errors.append(f"restart {nid}: {e!r}")  # race-ok: GIL-atomic append; harness list, read after threads settle
                 return
         rejoined = False
         installs = 0
@@ -582,7 +582,7 @@ class CrashReplay(ChurnReplay):
                 if rejoined else None),
         )
         if not rejoined:
-            self.errors.append(
+            self.errors.append(  # race-ok: GIL-atomic append; harness list, read after threads settle
                 f"restarted {self._killed} did not catch up to snapshot "
                 f"index {snap_index} (installs={installs})"
             )
@@ -616,7 +616,7 @@ class CrashReplay(ChurnReplay):
             try:
                 allocs = sp.call("Alloc.List", no_forward=True, timeout=15.0)
             except (RPCError, OSError) as e:
-                self.errors.append(f"replica count {nid}: {e!r}")
+                self.errors.append(f"replica count {nid}: {e!r}")  # race-ok: GIL-atomic append; harness list, read after threads settle
                 counts[nid] = None
                 continue
             counts[nid] = sum(
@@ -655,6 +655,6 @@ class CrashReplay(ChurnReplay):
             try:
                 sp.terminate()
             except Exception as e:  # noqa: BLE001 — reap every process
-                self.errors.append(f"shutdown {sp.node_id}: {e!r}")
+                self.errors.append(f"shutdown {sp.node_id}: {e!r}")  # race-ok: GIL-atomic append; harness list, read after threads settle
         if self._owns_base:
             shutil.rmtree(self.base_dir, ignore_errors=True)

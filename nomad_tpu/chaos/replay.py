@@ -146,6 +146,7 @@ class ChurnReplay:
         warmup_counts: Tuple[int, ...] = (),
         autoscale: bool = False,
         lock_witness: bool = False,
+        race_witness: bool = False,
     ) -> None:
         self.seed = int(seed)
         kw = dict(trace_kwargs or {})
@@ -168,6 +169,11 @@ class ChurnReplay:
         # nomad-lockdep: arm the runtime lock witness for the whole run
         # and cross-check witnessed order edges against the static graph
         self.lock_witness = bool(lock_witness)
+        # nomad-race: arm the Eraser lockset witness too — any tracked
+        # shared field whose candidate lockset empties under churn fails
+        # the run, and runtime-witnessed sharing is cross-checked against
+        # the static inferred-shared set
+        self.race_witness = bool(race_witness)
 
         self._muted: Set[str] = set()
         self._mute_lock = threading.Lock()
@@ -208,7 +214,7 @@ class ChurnReplay:
     def _start_cluster(self) -> None:
         raft = InProcRaft()
         for i in range(self.n_servers):
-            self.servers.append(
+            self.servers.append(  # race-ok: bootstrap runs before the pump/nurse threads start
                 Server(self.config, raft=raft, name=f"chaos-s{i + 1}")
             )
         if self.autoscale:
@@ -229,7 +235,7 @@ class ChurnReplay:
         for _ in range(int(n)):
             node = mock.node()
             leader.register_node(node)
-            self.node_ids.append(node.id)
+            self.node_ids.append(node.id)  # race-ok: GIL-atomic append; replay thread is the only mutator
             added += 1
         self._autoscaled_nodes += added
         return added
@@ -364,7 +370,7 @@ class ChurnReplay:
                 except _RETRYABLE:
                     continue
                 except Exception as e:  # noqa: BLE001 — pump must survive
-                    self.errors.append(f"heartbeat pump: {e!r}")
+                    self.errors.append(f"heartbeat pump: {e!r}")  # race-ok: GIL-atomic append; harness list, read after threads settle
 
     def _nurse_deployments(self) -> None:
         """Client-health stand-in: no real clients run here, so the
@@ -379,7 +385,7 @@ class ChurnReplay:
             except _RETRYABLE:
                 continue
             except Exception as e:  # noqa: BLE001 — nurse must survive
-                self.errors.append(f"deployment nurse: {e!r}")
+                self.errors.append(f"deployment nurse: {e!r}")  # race-ok: GIL-atomic append; harness list, read after threads settle
 
     def _pump_deployments_once(self) -> None:
         from ..structs.structs import (
@@ -438,7 +444,7 @@ class ChurnReplay:
         leader = self._leader()
         for _ in range(self.n_nodes):
             n = mock.node()
-            self.node_ids.append(n.id)
+            self.node_ids.append(n.id)  # race-ok: bootstrap runs before the pump/nurse threads start
             leader.register_node(n)
         self._warmup(leader)
         # gauges measure the churn run, not boot/warmup
@@ -638,7 +644,7 @@ class ChurnReplay:
             for _ in range(int(a.get("node_count", 0))):
                 node = mock.node()
                 leader.register_node(node)
-                self.node_ids.append(node.id)
+                self.node_ids.append(node.id)  # race-ok: GIL-atomic append; replay thread is the only mutator
         elif ev.kind == "drain_node":
             node_id = self.node_ids[a["node_idx"] % len(self.node_ids)]
             self._leader().update_node_drain(node_id, True)
@@ -682,7 +688,7 @@ class ChurnReplay:
             except _RETRYABLE as e:
                 if attempt == _EVENT_RETRIES - 1:
                     self.events_degraded += 1
-                    self.errors.append(f"{ev.kind}@{ev.t:.2f}: {e!r}")
+                    self.errors.append(f"{ev.kind}@{ev.t:.2f}: {e!r}")  # race-ok: GIL-atomic append; harness list, read after threads settle
                     return
                 time.sleep(delay)
                 delay = min(delay * 2, 1.0)
@@ -709,7 +715,7 @@ class ChurnReplay:
             try:
                 self._leader().update_node_drain(node_id, None)
             except Exception as e:  # noqa: BLE001
-                self.errors.append(f"undrain {node_id}: {e!r}")
+                self.errors.append(f"undrain {node_id}: {e!r}")  # race-ok: GIL-atomic append; harness list, read after threads settle
         self._drained.clear()
 
         deadline = time.monotonic() + self.settle_timeout_s
@@ -727,7 +733,7 @@ class ChurnReplay:
                         self._leader_state()):
                     return True
             except _RETRYABLE as e:
-                self.errors.append(f"settle probe: {e!r}")
+                self.errors.append(f"settle probe: {e!r}")  # race-ok: GIL-atomic append; harness list, read after threads settle
                 time.sleep(0.2)
                 continue
             # drain/migrate health gating has no real clients here: one
@@ -845,6 +851,17 @@ class ChurnReplay:
             # armed BEFORE _boot so every factory-created lock in the
             # servers under churn is instrumented
             witness = _lw.arm()
+        race = None
+        if self.race_witness:
+            from ..rpc import transport as _transport
+            from ..trace import lifecycle as _lc
+            from ..utils import race_witness as _rw
+            # after any explicit lock-witness arm, so auto-arm bookkeeping
+            # stays correct; module stat tables are re-minted AFTER arming
+            # so they come out of the tracked factories
+            race = _rw.arm()
+            _lc.reset()
+            _transport.reset_rpc_stats()
         try:
             self._boot()
             t_run = time.monotonic()
@@ -876,8 +893,18 @@ class ChurnReplay:
                             build_static_graph())
                     ],
                 }
+            if race is not None:
+                from ..analysis.shared_state import build_static_shared
+                result["race_witness"] = {
+                    **race.stats(),
+                    "missing_from_static": sorted(
+                        race.cross_check(build_static_shared())),
+                }
             return result
         finally:
+            if race is not None:
+                from ..utils import race_witness as _rw
+                _rw.disarm()
             if witness is not None:
                 from ..utils import lock_witness as _lw
                 _lw.disarm()

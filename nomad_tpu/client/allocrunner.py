@@ -96,7 +96,7 @@ class AllocRunner:
                 vault_fn=self.vault_fn,
                 vault_addr=self.vault_addr,
             )
-            self.task_runners[task.name] = tr
+            self.task_runners[task.name] = tr  # race-ok: populated before the health-watch thread starts; Thread.start publishes
             handle = (recover_handles or {}).get(task.name)
             if handle is not None and not tr.recover(handle):
                 self.logger.info("task %s not recoverable; starting fresh", task.name)

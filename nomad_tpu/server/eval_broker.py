@@ -18,6 +18,7 @@ from ..structs.structs import Evaluation, generate_uuid
 from ..trace import capacity as _capacity
 from ..trace import lifecycle as _trace
 from ..utils.lock_witness import witness_rlock
+from ..utils.race_witness import tracked_dict
 
 FAILED_QUEUE = "_failed"
 
@@ -86,7 +87,8 @@ class EvalBroker:
         self.enabled = False
 
         # eval id -> delivery attempts
-        self.evals: Dict[str, int] = {}
+        self.evals: Dict[str, int] = tracked_dict(
+            "eval_broker.EvalBroker.evals", {})
         # (namespace, job id) -> eval id currently queued/outstanding
         self.job_evals: Dict[Tuple[str, str], str] = {}
         # (namespace, job id) -> heap of blocked-behind evals
@@ -94,7 +96,8 @@ class EvalBroker:
         # scheduler type -> ready heap
         self.ready: Dict[str, _PendingHeap] = {}
         # eval id -> unack record
-        self.unack: Dict[str, _Unack] = {}
+        self.unack: Dict[str, _Unack] = tracked_dict(
+            "eval_broker.EvalBroker.unack", {})
         # token -> eval to requeue on Ack
         self.requeue: Dict[str, Evaluation] = {}
         # eval id -> wait timer (Evaluation.wait_ns)

@@ -151,10 +151,11 @@ class InProcRaft:
             }
 
     def close(self) -> None:
-        if self.store is not None:
-            self.store.sync()
-            self.store.close()
-            self.store = None
+        with self._lock:
+            store, self.store = self.store, None
+        if store is not None:
+            store.sync()
+            store.close()
 
     def _elect(self, peer: int) -> None:
         old = self.leader_idx

@@ -460,7 +460,7 @@ class Server:
                     parity_sample_rate=self.config.parity_sample_rate,
                 ),
             )
-        self._leader_generation += 1
+        self._leader_generation += 1  # race-ok: leadership transitions run on the single raft notify thread
         gen = self._leader_generation
         self._schedule_leader_task(gen, self.config.unblock_failed_interval,
                                    self.blocked_evals.unblock_failed)
@@ -554,7 +554,7 @@ class Server:
             self.pipeline.set_enabled(False)
         self.autoscaler.set_enabled(False)
         self.flight.disarm()
-        self._leader_generation += 1  # invalidates in-flight leader timers
+        self._leader_generation += 1  # invalidates in-flight leader timers  # race-ok: leadership transitions run on the single raft notify thread
         with self._lock:
             for t in self._leader_timers:
                 t.cancel()
@@ -739,7 +739,7 @@ class Server:
         # first-job latency gauge (VERDICT r3 #3): time from the first
         # registration this process serves to its first plan commit
         if self._first_job_t0 is None:
-            self._first_job_t0 = time.monotonic()
+            self._first_job_t0 = time.monotonic()  # race-ok: first-registration gauge; a lost duplicate set lands ~the same t0
         # Consul Connect admission mutator: group services with a connect
         # stanza get their sidecar task + proxy port injected BEFORE the
         # job hits raft (job_endpoint_hook_connect.go:99)

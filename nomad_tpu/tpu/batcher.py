@@ -30,6 +30,7 @@ from ..chaos.injector import fire as chaos_fire
 from .engine import EncodedEval, _build_batched_scan, _round_up
 from .intscore import E27_ONE as _E27_NEUTRAL
 from ..utils.lock_witness import witness_lock
+from ..utils.race_witness import tracked_dict
 
 logger = logging.getLogger("nomad_tpu.tpu.batcher")
 
@@ -303,7 +304,7 @@ class DeviceBatcher:
         # Written by the dispatcher thread AND by scheduler workers on the
         # forced-kernel path (engine.compute_system_placements), so every
         # read-modify-write takes _lock (enforced by nomad-lint).
-        self.stats = {  # guarded-by: _lock
+        self.stats = tracked_dict("batcher.DeviceBatcher.stats", {  # guarded-by: _lock
             "dispatches": 0,
             "evals": 0,
             "max_batch_seen": 0,
@@ -329,7 +330,7 @@ class DeviceBatcher:
             "compute_ms_total": 0.0,
             "transfer_ms_total": 0.0,
             "d2h_bytes_total": 0,
-        }
+        })
         # Demand-aware gather (guarded-by: _lock): workers announce an
         # encode-in-flight destined for this batcher via expect(); the
         # gather loop keeps its window open while announced encodes are

@@ -1198,12 +1198,12 @@ class TpuPlacementEngine:
 
     def _scan_fn(self):
         if self._place_scan is None:
-            self._place_scan = _build_place_scan()
+            self._place_scan = _build_place_scan()  # race-ok: idempotent compile cache; duplicate builds are equal, ref swap atomic
         return self._place_scan
 
     def _forced_fn(self):
         if self._forced_kernel is None:
-            self._forced_kernel = _build_forced_kernel()
+            self._forced_kernel = _build_forced_kernel()  # race-ok: idempotent compile cache; duplicate builds are equal, ref swap atomic
         return self._forced_kernel
 
     def run_forced(self, enc: "EncodedEval"):
@@ -1277,7 +1277,7 @@ class TpuPlacementEngine:
         fn = self._chunk_scans.get(chunk)
         if fn is None:
             fn = _build_chunk_scan(chunk)
-            self._chunk_scans[chunk] = fn
+            self._chunk_scans[chunk] = fn  # race-ok: idempotent compile cache; duplicate builds are equal, ref swap atomic
         return fn
 
     def run_chunked(self, enc: "EncodedEval", chunk_k: int = 128,

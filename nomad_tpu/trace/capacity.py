@@ -31,12 +31,12 @@ import time
 from typing import Dict, Iterable, List, Optional
 
 from ..utils import metric_names, metrics
-from ..utils.lock_witness import witness_lock
+from ..utils.lock_witness import module_witness_lock
 
 _MAX_PENDING = 131072     # unblocked-but-not-yet-placed watermark cap
 _MAX_SAMPLES = 131072
 
-_lock = witness_lock("capacity._lock")
+_lock = module_witness_lock("capacity._lock")
 _pending: Dict[str, float] = {}     # eval id -> unblock stamp (monotonic)
 _place_ms: List[float] = []         # closed unblock->ack latencies
 _batches: List[int] = []            # per-flush coalesced batch sizes

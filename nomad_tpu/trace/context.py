@@ -44,7 +44,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
-from ..utils.lock_witness import witness_lock
+from ..utils.lock_witness import module_witness_lock
 
 #: ring capacity: at ~300B/span this bounds the table at ~20MB while
 #: retaining the full span set of a chaos run when the collector drains
@@ -79,7 +79,7 @@ def _new_id() -> str:
 _current: "contextvars.ContextVar[Optional[TraceContext]]" = \
     contextvars.ContextVar("nomad_trace_ctx", default=None)
 
-_lock = witness_lock("trace.context._lock")
+_lock = module_witness_lock("trace.context._lock")
 _spans: "deque[Dict[str, object]]" = deque(maxlen=RING_CAP)
 _seq = 0
 _dropped = 0

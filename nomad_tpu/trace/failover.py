@@ -24,11 +24,11 @@ import threading
 from typing import Dict, List, Optional
 
 from ..utils import metric_names
-from ..utils.lock_witness import witness_lock
+from ..utils.lock_witness import module_witness_lock
 
 _MAX_EPISODES = 64
 
-_lock = witness_lock("failover._lock")
+_lock = module_witness_lock("failover._lock")
 _episodes: List[Dict[str, object]] = []
 
 

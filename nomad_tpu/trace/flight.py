@@ -26,7 +26,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
-from ..utils import lock_witness, metrics
+from ..utils import lock_witness, metrics, race_witness
 from . import context, lifecycle
 from ..utils.lock_witness import witness_lock
 
@@ -88,12 +88,14 @@ class FlightRecorder:
         if self.interval_s <= 0 or self.armed:
             return
         self._stop.clear()
-        self._armed_t = _clock()
-        if self.spill_path and self._spill_fh is None:
-            try:
-                self._spill_fh = open(self.spill_path, "a", encoding="utf-8")
-            except OSError:
-                self._spill_fh = None
+        with self._lock:
+            self._armed_t = _clock()
+            if self.spill_path and self._spill_fh is None:
+                try:
+                    self._spill_fh = open(self.spill_path, "a",
+                                          encoding="utf-8")
+                except OSError:
+                    self._spill_fh = None
         self._thread = threading.Thread(
             target=self._run, name="flight-recorder", daemon=True
         )
@@ -105,10 +107,11 @@ class FlightRecorder:
         if t is not None:
             t.join(timeout=5.0)
         self._thread = None
-        if self._armed_t is not None:
-            self._armed_elapsed_s += _clock() - self._armed_t
-            self._armed_t = None
-        fh, self._spill_fh = self._spill_fh, None
+        with self._lock:
+            if self._armed_t is not None:
+                self._armed_elapsed_s += _clock() - self._armed_t
+                self._armed_t = None
+            fh, self._spill_fh = self._spill_fh, None
         if fh is not None:
             try:
                 fh.close()
@@ -292,6 +295,9 @@ def install_server_probes(rec: FlightRecorder, server) -> None:
     # counters when a witness is live (probes run OUTSIDE rec._lock, so
     # this adds no flight->witness order edge)
     rec.add_probe("lock_witness", lock_witness.stats)
+    # nomad-race: same shape — {"armed": 0} or field/access/violation
+    # counters when the Eraser lockset witness is live
+    rec.add_probe("race_witness", race_witness.stats)
     # wire-RPC method table totals + distributed-trace ring counters.
     # Imported here, not at module top: rpc/transport imports this
     # package (trace.context) at import time, so a top-level import

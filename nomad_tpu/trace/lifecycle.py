@@ -27,7 +27,8 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 from ..utils import metric_names, metrics
-from ..utils.lock_witness import witness_lock
+from ..utils.lock_witness import module_witness_lock
+from ..utils.race_witness import tracked_deque, tracked_dict
 from . import context as _xcontext
 
 _DONE_CAP = 2048
@@ -117,9 +118,9 @@ class EvalTrace:
         }
 
 
-_lock = witness_lock("lifecycle._lock")
-_inflight: Dict[str, EvalTrace] = {}
-_done: "deque[EvalTrace]" = deque(maxlen=_DONE_CAP)
+_lock = module_witness_lock("lifecycle._lock")
+_inflight: Dict[str, EvalTrace] = tracked_dict("lifecycle._inflight", {})
+_done: "deque[EvalTrace]" = tracked_deque("lifecycle._done", maxlen=_DONE_CAP)
 _counts: Dict[str, int] = {"ack": 0, "nack": 0, "failed": 0, "flush": 0}
 
 # -- pipeline stage spans ---------------------------------------------------
@@ -157,10 +158,13 @@ _pipe_epoch: float = 0.0
 
 def reset() -> None:
     """Drop all records (tests / broker re-enable)."""
-    global _pipe_epoch
+    # re-mint the rings through the factories so a race witness armed
+    # after import still gets tracked tables (the import-time ones
+    # predate arming)
+    global _inflight, _done, _pipe_epoch
     with _lock:
-        _inflight.clear()
-        _done.clear()
+        _inflight = tracked_dict("lifecycle._inflight", {})
+        _done = tracked_deque("lifecycle._done", maxlen=_DONE_CAP)
         for k in _counts:
             _counts[k] = 0
         # aux stages (wait_min_index, raft_fsm, ...) registered via

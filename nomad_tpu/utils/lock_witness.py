@@ -166,6 +166,15 @@ class LockWitness:
         with self._mu:
             return dict(self._edge_stacks)
 
+    def held_names_current(self) -> Tuple[str, ...]:
+        """Lock names the CALLING thread currently holds (dups collapsed,
+        acquisition order). The race witness intersects these per-field:
+        a shared field's candidate lockset is the intersection of every
+        accessor's held set at access time (Eraser)."""
+        ident = threading.get_ident()
+        with self._mu:
+            return tuple(dict.fromkeys(self._held.get(ident, ())))
+
     def held_snapshot(self) -> Dict[str, List[str]]:
         """Thread name -> held lock names, for the watchdog's stall dump."""
         with self._mu:
@@ -267,6 +276,94 @@ class _WitnessLock:
         return f"<witness {self._name} {self._inner!r}>"
 
 
+class _LazyWitnessLock:
+    """A permanent wrapper for MODULE-LEVEL locks. These are minted at
+    import time, before any witness can possibly be armed, so the
+    construction-time arming check the instance-lock factories use would
+    leave them plain forever — every held-set the race witness reads
+    would be missing them, and every module-table access would look
+    unlocked. Instead this wrapper consults the active witness on each
+    acquire/release: one global read per operation when disarmed, noise
+    next to the dict ops these locks guard."""
+
+    __slots__ = ("_name", "_inner", "_registered_with")
+
+    def __init__(self, name: str, inner) -> None:
+        self._name = name
+        self._inner = inner
+        self._registered_with: Optional[LockWitness] = None
+
+    def _witness(self) -> Optional["LockWitness"]:
+        w = _ACTIVE
+        if w is not None and w is not self._registered_with:
+            # benign race: double _register is an idempotent set.add
+            w._register(self._name)
+            self._registered_with = w
+        return w
+
+    # -- core protocol ---------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            w = self._witness()
+            if w is not None:
+                try:
+                    w.note_acquired(self._name, record_edges=blocking)
+                except LockOrderViolation:
+                    self._inner.release()
+                    raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        w = _ACTIVE
+        if w is not None:
+            # tolerant of arming mid-hold: note_released ignores names
+            # the witness never saw acquired
+            w.note_released(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        return bool(inner_locked()) if inner_locked is not None else False
+
+    # -- Condition integration (see _WitnessLock) ------------------------
+
+    def _is_owned(self) -> bool:
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        return self._inner.locked()
+
+    def _release_save(self):
+        save = getattr(self._inner, "_release_save", None)
+        state = save() if save is not None else self._inner.release()
+        w = _ACTIVE
+        if w is not None:
+            w.note_released(self._name)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        restore = getattr(self._inner, "_acquire_restore", None)
+        if restore is not None:
+            restore(state)
+        else:
+            self._inner.acquire()
+        w = self._witness()
+        if w is not None:
+            w.note_acquired(self._name, record_edges=True)
+
+    def __repr__(self) -> str:
+        return f"<lazy-witness {self._name} {self._inner!r}>"
+
+
 # -- the production-facing factories ----------------------------------------
 #
 # _ACTIVE is None almost always; lock creation sites pay one global read at
@@ -312,6 +409,19 @@ def witness_rlock(name: str):
     if w is None:
         return threading.RLock()
     return _WitnessLock(name, threading.RLock(), w)
+
+
+def module_witness_lock(name: str):
+    """A ``threading.Lock`` for MODULE-LEVEL state: lazily instrumented,
+    so a witness armed after import (the only possible order) still sees
+    it. Use ``witness_lock`` for instance locks — those are constructed
+    after arming and get the zero-overhead-when-disarmed wrapper."""
+    return _LazyWitnessLock(name, threading.Lock())
+
+
+def module_witness_rlock(name: str):
+    """``module_witness_lock`` with reentrant semantics."""
+    return _LazyWitnessLock(name, threading.RLock())
 
 
 def witness_condition(name: str, lock=None):

@@ -15,7 +15,7 @@ import math
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
-from .lock_witness import witness_lock
+from .lock_witness import module_witness_lock, witness_lock
 
 
 class LogHistogram:
@@ -330,7 +330,7 @@ _global = InmemSink()
 #: external push sinks fanned out alongside the inmem sink (go-metrics
 #: FanoutSink: inmem + statsd/statsite/datadog per telemetry config)
 _sinks: List[object] = []
-_sinks_lock = witness_lock("metrics._sinks_lock")
+_sinks_lock = module_witness_lock("metrics._sinks_lock")
 
 
 def register_sink(sink) -> None:

@@ -60,9 +60,9 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Tuple
-from .lock_witness import witness_lock
+from .lock_witness import module_witness_lock
 
-_lock = witness_lock("phases._lock")
+_lock = module_witness_lock("phases._lock")
 _intervals: Dict[str, List[Tuple[float, float]]] = {}
 _enabled = False
 

@@ -1,0 +1,469 @@
+"""nomad-race's dynamic side: an opt-in Eraser-style lockset witness.
+
+The static half (``nomad_tpu/analysis/shared_state.py``) *infers* which
+attributes are shared across thread roots and proves every write is
+guarded. This module is the runtime cross-check: hot shared containers
+are created through the factories here (``tracked_dict`` /
+``tracked_list`` / ``tracked_deque``), naming each field with the SAME
+``module.Class.attr`` key the static analyzer derives for it. When the
+witness is DISARMED (the default) the factories return plain builtin
+containers — production pays nothing, not even an isinstance check per
+access. When ARMED (``NOMAD_RACE_WITNESS=1`` at import, or ``arm()``
+before the containers are constructed) they return instrumented
+subclasses that report every read and mutation to the witness.
+
+Per field the witness runs the classic Eraser state machine:
+
+* first thread only  -> **exclusive** (no lockset yet; initialisation
+  writes are fine, there is a happens-before on thread start)
+* second thread reads, no writes since -> **shared** (read-only sharing
+  is benign; lockset tracked but empty lockset not reported)
+* any write once two threads are involved -> **shared-modified**: the
+  candidate lockset — seeded from the held set of the access that first
+  made the field shared, then intersected with every subsequent
+  accessor's held set — must stay non-empty. Held sets come from the
+  lock witness's per-thread bookkeeping (``held_names_current``), so
+  arming the race witness arms the lock witness too.
+
+An empty lockset in shared-modified fails FAST with
+:class:`RaceViolation` carrying both access stacks (this access's, plus
+the last recorded access from a different thread). At teardown
+:func:`RaceWitness.cross_check` verifies every runtime-witnessed shared
+field is in the static pass's inferred-shared set: the dynamic run
+validates that the static inference is a sound over-approximation.
+
+Locksets are keyed by lock NAME (lock-class semantics, like the lock
+witness): two instances of the same class share lock and field names, so
+cross-instance false negatives are possible — the static pass, which
+reasons per-class anyway, covers that direction.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from . import lock_witness as _lw
+
+
+class RaceViolation(RuntimeError):
+    """A write to a multi-thread-shared field happened with a candidate
+    lockset that intersected down to empty — no single lock protects
+    every access to this field."""
+
+
+def _fast_stack(limit: int = 12) -> Tuple[Tuple[str, int, str], ...]:
+    """Cheap stack capture: (filename, lineno, funcname) triples, no
+    source-line formatting. Formatting happens only on violation."""
+    frames: List[Tuple[str, int, str]] = []
+    f = sys._getframe(2)
+    while f is not None and len(frames) < limit:
+        co = f.f_code
+        frames.append((co.co_filename, f.f_lineno, co.co_name))
+        f = f.f_back
+    return tuple(frames)
+
+
+def _format_stack(frames: Tuple[Tuple[str, int, str], ...]) -> str:
+    return "\n".join(
+        f'  File "{fn}", line {ln}, in {fun}' for fn, ln, fun in frames
+    )
+
+
+class _FieldState:
+    __slots__ = (
+        "name", "state", "owner", "lockset", "dirty",
+        "reads", "writes", "threads", "last_other",
+    )
+
+    def __init__(self, name: str, owner: int) -> None:
+        self.name = name
+        self.state = "exclusive"  # exclusive | shared | shared-modified
+        self.owner = owner
+        self.lockset: Optional[FrozenSet[str]] = None
+        self.dirty = False        # any write while still exclusive
+        self.reads = 0
+        self.writes = 0
+        self.threads: Set[int] = {owner}
+        # (thread name, is_write, stack) of the most recent access — kept
+        # so a violation can show the OTHER side's stack too
+        self.last_other: Optional[Tuple[str, bool, Tuple]] = None
+
+
+class RaceWitness:
+    """Global witness state: per-field Eraser state machines."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._fields: Dict[str, _FieldState] = {}
+        self.accesses = 0
+        self.violations = 0
+
+    # -- bookkeeping (called from the tracked containers) ----------------
+
+    def note(self, name: str, is_write: bool) -> None:
+        if _ACTIVE is not self:
+            # tracked containers outlive the witness session: once this
+            # witness is disarmed the lock witness's held sets are gone
+            # too, so evaluating teardown accesses would report phantom
+            # races ("holding no locks" on a properly locked access)
+            return
+        ident = threading.get_ident()
+        lw = _lw.active()
+        held = lw.held_names_current() if lw is not None else ()
+        stack = _fast_stack()
+        with self._mu:
+            self.accesses += 1
+            st = self._fields.get(name)
+            if st is None:
+                st = self._fields[name] = _FieldState(name, ident)
+            st.threads.add(ident)
+            if is_write:
+                st.writes += 1
+            else:
+                st.reads += 1
+            if st.state == "exclusive":
+                if ident == st.owner:
+                    st.dirty = st.dirty or is_write
+                    st.last_other = (
+                        threading.current_thread().name, is_write, stack)
+                    return
+                # a second thread arrived: seed the candidate lockset from
+                # THIS access's held set (Eraser's initialisation refinement
+                # — unlocked writes during single-threaded init are benign)
+                st.lockset = frozenset(held)
+                st.state = ("shared-modified"
+                            if is_write or st.dirty else "shared")
+            else:
+                assert st.lockset is not None
+                st.lockset = st.lockset & frozenset(held)
+                if is_write and st.state == "shared":
+                    st.state = "shared-modified"
+            prior = st.last_other
+            st.last_other = (threading.current_thread().name, is_write, stack)
+            if st.state == "shared-modified" and not st.lockset:
+                self.violations += 1
+                st.state = "reported"  # one violation per field, not a storm
+                raise self._violation(st, is_write, held, stack, prior)
+
+    def _violation(self, st: _FieldState, is_write: bool,
+                   held: Tuple[str, ...],
+                   stack: Tuple, prior: Optional[Tuple]) -> RaceViolation:
+        kind = "write" if is_write else "read"
+        other = ("no prior access stack recorded" if prior is None else
+                 f"last access from thread {prior[0]!r} "
+                 f"({'write' if prior[1] else 'read'}):\n"
+                 f"{_format_stack(prior[2])}")
+        return RaceViolation(
+            f"data race on {st.name!r}: candidate lockset is EMPTY after "
+            f"{kind} on thread {threading.current_thread().name!r} "
+            f"(holding {list(held) or 'no locks'}); {len(st.threads)} "
+            f"threads have touched this field "
+            f"({st.reads} reads / {st.writes} writes).\n"
+            f"this access:\n{_format_stack(stack)}\n{other}"
+        )
+
+    # -- read side -------------------------------------------------------
+
+    def shared_fields(self) -> List[str]:
+        """Fields witnessed as touched by >= 2 threads."""
+        with self._mu:
+            return sorted(
+                name for name, st in self._fields.items()
+                if len(st.threads) > 1
+            )
+
+    def field_report(self) -> Dict[str, Dict[str, object]]:
+        with self._mu:
+            return {
+                name: {
+                    "state": st.state,
+                    "threads": len(st.threads),
+                    "reads": st.reads,
+                    "writes": st.writes,
+                    "lockset": sorted(st.lockset or ()),
+                }
+                for name, st in sorted(self._fields.items())
+            }
+
+    def stats(self) -> Dict[str, object]:
+        with self._mu:
+            shared = sum(1 for st in self._fields.values()
+                         if len(st.threads) > 1)
+            return {
+                "armed": 1,
+                "fields": len(self._fields),
+                "shared_fields": shared,
+                "accesses": self.accesses,
+                "violations": self.violations,
+            }
+
+    def cross_check(self, static_shared: Iterable[str]) -> List[str]:
+        """Runtime-witnessed shared fields MISSING from the static
+        analyzer's inferred-shared set — each one is a field the static
+        root inventory / call graph failed to see as concurrent. Empty
+        list == the static pass over-approximates runtime sharing."""
+        allowed = set(static_shared)
+        return [f for f in self.shared_fields() if f not in allowed]
+
+
+# -- instrumented containers -------------------------------------------------
+#
+# Subclasses of the builtins so everything (repr, json, copy, isinstance
+# checks in callers) keeps working. Only Python-level method calls are
+# noted; C-level fast paths that bypass the overrides (e.g. dict.copy on
+# the subclass) are unwitnessed reads — acceptable, the witness targets
+# mutation discipline.
+
+
+class _TrackedDict(dict):
+    __slots__ = ("_rw_name", "_rw")
+
+    def __init__(self, name: str, witness: RaceWitness, init=None) -> None:
+        super().__init__(init or {})
+        self._rw_name = name
+        self._rw = witness
+
+    def __getitem__(self, k):
+        self._rw.note(self._rw_name, False)
+        return super().__getitem__(k)
+
+    def get(self, k, default=None):
+        self._rw.note(self._rw_name, False)
+        return super().get(k, default)
+
+    def __contains__(self, k):
+        self._rw.note(self._rw_name, False)
+        return super().__contains__(k)
+
+    def __iter__(self):
+        self._rw.note(self._rw_name, False)
+        return super().__iter__()
+
+    def items(self):
+        self._rw.note(self._rw_name, False)
+        return super().items()
+
+    def values(self):
+        self._rw.note(self._rw_name, False)
+        return super().values()
+
+    def keys(self):
+        self._rw.note(self._rw_name, False)
+        return super().keys()
+
+    def __setitem__(self, k, v):
+        self._rw.note(self._rw_name, True)
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._rw.note(self._rw_name, True)
+        super().__delitem__(k)
+
+    def pop(self, *a):
+        self._rw.note(self._rw_name, True)
+        return super().pop(*a)
+
+    def popitem(self):
+        self._rw.note(self._rw_name, True)
+        return super().popitem()
+
+    def clear(self):
+        self._rw.note(self._rw_name, True)
+        super().clear()
+
+    def update(self, *a, **kw):
+        self._rw.note(self._rw_name, True)
+        super().update(*a, **kw)
+
+    def setdefault(self, k, default=None):
+        self._rw.note(self._rw_name, True)
+        return super().setdefault(k, default)
+
+    def __reduce__(self):  # pickle/deepcopy as a plain dict payload
+        return (dict, (dict(self),))
+
+
+class _TrackedList(list):
+    __slots__ = ("_rw_name", "_rw")
+
+    def __init__(self, name: str, witness: RaceWitness, init=()) -> None:
+        super().__init__(init)
+        self._rw_name = name
+        self._rw = witness
+
+    def __getitem__(self, i):
+        self._rw.note(self._rw_name, False)
+        return super().__getitem__(i)
+
+    def __iter__(self):
+        self._rw.note(self._rw_name, False)
+        return super().__iter__()
+
+    def __contains__(self, v):
+        self._rw.note(self._rw_name, False)
+        return super().__contains__(v)
+
+    def __setitem__(self, i, v):
+        self._rw.note(self._rw_name, True)
+        super().__setitem__(i, v)
+
+    def __delitem__(self, i):
+        self._rw.note(self._rw_name, True)
+        super().__delitem__(i)
+
+    def append(self, v):
+        self._rw.note(self._rw_name, True)
+        super().append(v)
+
+    def extend(self, it):
+        self._rw.note(self._rw_name, True)
+        super().extend(it)
+
+    def insert(self, i, v):
+        self._rw.note(self._rw_name, True)
+        super().insert(i, v)
+
+    def pop(self, *a):
+        self._rw.note(self._rw_name, True)
+        return super().pop(*a)
+
+    def remove(self, v):
+        self._rw.note(self._rw_name, True)
+        super().remove(v)
+
+    def clear(self):
+        self._rw.note(self._rw_name, True)
+        super().clear()
+
+    def sort(self, **kw):
+        self._rw.note(self._rw_name, True)
+        super().sort(**kw)
+
+    def __reduce__(self):
+        return (list, (list(self),))
+
+
+class _TrackedDeque(collections.deque):
+    # deque has no __dict__-free subclassing restriction; __slots__ not
+    # supported together with deque's layout on all builds, keep plain
+    def __init__(self, name: str, witness: RaceWitness,
+                 init=(), maxlen=None) -> None:
+        super().__init__(init, maxlen)
+        self._rw_name = name
+        self._rw = witness
+
+    def __iter__(self):
+        self._rw.note(self._rw_name, False)
+        return super().__iter__()
+
+    def __getitem__(self, i):
+        self._rw.note(self._rw_name, False)
+        return super().__getitem__(i)
+
+    def append(self, v):
+        self._rw.note(self._rw_name, True)
+        super().append(v)
+
+    def appendleft(self, v):
+        self._rw.note(self._rw_name, True)
+        super().appendleft(v)
+
+    def extend(self, it):
+        self._rw.note(self._rw_name, True)
+        super().extend(it)
+
+    def pop(self):
+        self._rw.note(self._rw_name, True)
+        return super().pop()
+
+    def popleft(self):
+        self._rw.note(self._rw_name, True)
+        return super().popleft()
+
+    def clear(self):
+        self._rw.note(self._rw_name, True)
+        super().clear()
+
+    def __reduce__(self):
+        return (collections.deque, (list(self), self.maxlen))
+
+
+# -- the production-facing factories ----------------------------------------
+
+_ACTIVE: Optional[RaceWitness] = None
+_active_mu = threading.Lock()
+_auto_armed_lw = False
+
+
+def arm(witness: Optional[RaceWitness] = None) -> RaceWitness:
+    """Install a witness. Containers created BEFORE arming stay plain —
+    arm before constructing the servers under test (and re-mint module
+    tables via their ``reset()`` hooks). Arms the lock witness too if it
+    is not already armed: locksets come from its per-thread held sets."""
+    global _ACTIVE, _auto_armed_lw
+    with _active_mu:
+        if _ACTIVE is not None and witness is not None and _ACTIVE is not witness:
+            raise RuntimeError("another RaceWitness is already armed; disarm first")
+        if _ACTIVE is None:
+            _ACTIVE = witness or RaceWitness()
+            if _lw.active() is None:
+                _lw.arm()
+                _auto_armed_lw = True
+        return _ACTIVE
+
+
+def disarm() -> None:
+    """Remove the witness. Disarms the lock witness only if :func:`arm`
+    armed it implicitly."""
+    global _ACTIVE, _auto_armed_lw
+    with _active_mu:
+        _ACTIVE = None
+        if _auto_armed_lw:
+            _lw.disarm()
+            _auto_armed_lw = False
+
+
+def active() -> Optional[RaceWitness]:
+    return _ACTIVE
+
+
+def tracked_dict(name: str, init=None) -> dict:
+    """A ``dict`` — instrumented iff a race witness is armed. ``name``
+    must be the static analyzer's key for the field
+    (``module.Class.attr`` / ``module._global``)."""
+    w = _ACTIVE
+    if w is None:
+        return dict(init or {})
+    return _TrackedDict(name, w, init)
+
+
+def tracked_list(name: str, init=()) -> list:
+    """A ``list`` — instrumented iff a race witness is armed."""
+    w = _ACTIVE
+    if w is None:
+        return list(init)
+    return _TrackedList(name, w, init)
+
+
+def tracked_deque(name: str, init=(), maxlen=None):
+    """A ``collections.deque`` — instrumented iff a race witness is
+    armed."""
+    w = _ACTIVE
+    if w is None:
+        return collections.deque(init, maxlen)
+    return _TrackedDeque(name, w, init, maxlen)
+
+
+def stats() -> Dict[str, object]:
+    """Flight-recorder probe: cheap, never raises."""
+    w = _ACTIVE
+    if w is None:
+        return {"armed": 0}
+    return w.stats()
+
+
+if os.environ.get("NOMAD_RACE_WITNESS") == "1":  # pragma: no cover - env gate
+    arm()

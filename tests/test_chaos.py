@@ -294,3 +294,47 @@ def test_witness_armed_churn_replay_sound_and_inversion_free():
     inv = result["invariants"]
     assert inv["lost"] == 0, inv["violations"]
     assert inv["converged"], inv["violations"]
+
+
+# ---------------------------------------------------------------------------
+# nomad-race: race-witness-armed churn replay
+# ---------------------------------------------------------------------------
+
+
+def test_race_witness_armed_churn_replay_race_free_and_sound():
+    """The same churn replay with the Eraser lockset witness armed: no
+    tracked shared field's candidate lockset may empty during the run,
+    and every field the runtime witnessed as cross-thread shared must be
+    in the static analyzer's inferred-shared set (dynamic soundness
+    check for shared-state-discipline's thread-root inventory)."""
+    from nomad_tpu.utils import lock_witness, race_witness
+
+    trace = generate_trace(
+        seed=13, duration_s=3.0, n_nodes=12, n_jobs=3, tg_count=3,
+        stop_frac=0.2, rollout_frac=0.2, n_drains=1, n_expiries=1,
+        n_hipri=1, n_fault_windows=2,
+    )
+    replay = ChurnReplay(
+        seed=13, trace=trace, n_servers=2, n_nodes=12,
+        config=ServerConfig(
+            num_schedulers=2,
+            heartbeat_min_ttl=1.2,
+            heartbeat_max_ttl=2.0,
+            eval_gc_interval=3600.0,
+        ),
+        settle_timeout_s=25.0,
+        race_witness=True,
+    )
+    result = replay.run()
+    assert race_witness.active() is None, "replay must disarm its witness"
+    assert lock_witness.active() is None, "auto-armed lock witness too"
+    rw = result["race_witness"]
+    assert rw["armed"] == 1
+    assert rw["violations"] == 0
+    # churn must actually drive the tracked hot fields cross-thread or
+    # the race check is vacuous
+    assert rw["shared_fields"] > 0, rw
+    assert rw["missing_from_static"] == [], rw["missing_from_static"]
+    inv = result["invariants"]
+    assert inv["lost"] == 0, inv["violations"]
+    assert inv["converged"], inv["violations"]

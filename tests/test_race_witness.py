@@ -1,0 +1,239 @@
+"""nomad-race's dynamic side (nomad_tpu/utils/race_witness.py).
+
+The contract under test:
+
+  * disarmed (the default) the tracked-container factories return PLAIN
+    builtins — zero instrumentation, zero overhead;
+  * armed, the Eraser lockset state machine refines per-field candidate
+    locksets from the lock witness's per-thread held sets and raises
+    :class:`RaceViolation` — carrying BOTH access stacks — the moment a
+    shared-modified field's lockset goes empty;
+  * single-threaded init writes never fire (initialisation refinement:
+    the candidate lockset seeds on the SECOND thread's arrival);
+  * one violation per field, not a storm;
+  * cross_check() reports exactly the runtime-witnessed shared fields
+    missing from a static inferred-shared set;
+  * arm() auto-arms the lock witness when needed and disarm() undoes
+    only what it armed.
+"""
+import collections
+import pickle
+import threading
+
+import pytest
+
+from nomad_tpu.utils import lock_witness, race_witness
+from nomad_tpu.utils.race_witness import (
+    RaceViolation,
+    RaceWitness,
+    tracked_deque,
+    tracked_dict,
+    tracked_list,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    race_witness.disarm()
+    lock_witness.disarm()
+    yield
+    race_witness.disarm()
+    lock_witness.disarm()
+
+
+# ---------------------------------------------------------------------------
+# pass-through
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_factories_return_plain_builtins():
+    d = tracked_dict("m.C.d", {"a": 1})
+    lst = tracked_list("m.C.l", [1, 2])
+    dq = tracked_deque("m.C.q", (1,), maxlen=4)
+    assert type(d) is dict and d == {"a": 1}
+    assert type(lst) is list and lst == [1, 2]
+    assert type(dq) is collections.deque and list(dq) == [1]
+    assert dq.maxlen == 4
+    assert race_witness.stats() == {"armed": 0}
+
+
+def test_armed_factories_track_and_plain_copies_pickle():
+    race_witness.arm()
+    d = tracked_dict("m.C.d", {"a": 1})
+    assert isinstance(d, dict) and d["a"] == 1
+    d["b"] = 2
+    blob = pickle.loads(pickle.dumps(d))
+    assert type(blob) is dict and blob == {"a": 1, "b": 2}
+    w = race_witness.active()
+    assert w.stats()["accesses"] >= 2
+    assert w.stats()["violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the Eraser state machine
+# ---------------------------------------------------------------------------
+
+
+def _run_in_thread(fn):
+    out = {}
+
+    def body():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the test
+            out["exc"] = e
+
+    t = threading.Thread(target=body)
+    t.start()
+    t.join()
+    return out.get("exc")
+
+
+def test_single_threaded_writes_never_fire():
+    race_witness.arm()
+    d = tracked_dict("m.C.d", {})
+    for i in range(100):
+        d[i] = i
+        d.pop(i)
+    assert race_witness.stats()["violations"] == 0
+    assert race_witness.active().shared_fields() == []
+
+
+def test_unlocked_cross_thread_write_raises_with_both_stacks():
+    race_witness.arm()
+    d = tracked_dict("m.C.d", {})
+    d["init"] = 1  # owner-thread write: field is dirty
+
+    exc = _run_in_thread(lambda: d.__setitem__("other", 2))
+    assert isinstance(exc, RaceViolation)
+    msg = str(exc)
+    assert "m.C.d" in msg and "EMPTY" in msg
+    assert "this access:" in msg and "last access" in msg
+    assert race_witness.stats()["violations"] == 1
+
+    # one violation per field, not a storm
+    exc = _run_in_thread(lambda: d.__setitem__("third", 3))
+    assert exc is None
+    assert race_witness.stats()["violations"] == 1
+
+
+def test_consistent_lock_discipline_is_silent():
+    race_witness.arm()  # auto-arms the lock witness
+    mu = lock_witness.witness_lock("fix.C._mu")
+    d = tracked_dict("fix.C.d", {})
+
+    def bump(k):
+        for i in range(50):
+            with mu:
+                d[k] = d.get(k, 0) + 1
+
+    with mu:
+        d["seed"] = 0
+    ts = [threading.Thread(target=bump, args=(f"k{j}",)) for j in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    st = race_witness.stats()
+    assert st["violations"] == 0
+    assert race_witness.active().shared_fields() == ["fix.C.d"]
+    rep = race_witness.active().field_report()["fix.C.d"]
+    assert rep["lockset"] == ["fix.C._mu"]
+
+
+def test_lockset_intersection_refines_across_two_locks():
+    race_witness.arm()
+    a = lock_witness.witness_lock("fix.C._a")
+    b = lock_witness.witness_lock("fix.C._b")
+    d = tracked_dict("fix.C.d2", {})
+
+    with a, b:
+        d["x"] = 1
+    # second thread holds only `a`: candidate lockset seeds to {a}
+    def second():
+        with a:
+            d.update(x=2)
+
+    exc = _run_in_thread(second)
+    assert exc is None
+    rep = race_witness.active().field_report()["fix.C.d2"]
+    assert rep["lockset"] == ["fix.C._a"]
+    assert race_witness.stats()["violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cross-check against the static inferred-shared set
+# ---------------------------------------------------------------------------
+
+
+def test_cross_check_reports_only_missing_fields():
+    race_witness.arm()
+    mu = lock_witness.witness_lock("fix.C._mu")
+    known = tracked_dict("fix.C.known", {})
+    unknown = tracked_dict("fix.C.unknown", {})
+
+    def touch():
+        with mu:
+            known["k"] = 1
+            unknown["u"] = 1
+
+    touch()
+    exc = _run_in_thread(touch)
+    assert exc is None
+    w = race_witness.active()
+    assert sorted(w.shared_fields()) == ["fix.C.known", "fix.C.unknown"]
+    assert w.cross_check({"fix.C.known", "other.key"}) == ["fix.C.unknown"]
+    assert w.cross_check(w.shared_fields()) == []
+
+
+# ---------------------------------------------------------------------------
+# arm/disarm lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_arm_auto_arms_lock_witness_and_disarm_undoes_it():
+    assert lock_witness.active() is None
+    race_witness.arm()
+    assert lock_witness.active() is not None
+    race_witness.disarm()
+    assert lock_witness.active() is None
+
+
+def test_disarm_leaves_preexisting_lock_witness_armed():
+    lock_witness.arm()
+    race_witness.arm()
+    race_witness.disarm()
+    assert lock_witness.active() is not None
+
+
+def test_double_arm_same_witness_is_idempotent():
+    w = race_witness.arm()
+    assert race_witness.arm() is w
+    with pytest.raises(RuntimeError):
+        race_witness.arm(RaceWitness())
+
+
+# ---------------------------------------------------------------------------
+# tracked list / deque coverage
+# ---------------------------------------------------------------------------
+
+
+def test_tracked_list_mutations_are_witnessed():
+    race_witness.arm()
+    lst = tracked_list("fix.C.lst", [1])
+    lst.append(2)
+    lst[:] = [x for x in lst if x > 1]
+    lst.extend([3, 4])
+    lst.pop()
+    w = race_witness.active()
+    assert w._fields["fix.C.lst"].writes >= 4
+    assert list(lst) == [2, 3]
+
+
+def test_tracked_deque_respects_maxlen_and_witnesses():
+    race_witness.arm()
+    dq = tracked_deque("fix.C.dq", (), maxlen=2)
+    for i in range(5):
+        dq.append(i)
+    assert list(dq) == [3, 4]
+    assert race_witness.active()._fields["fix.C.dq"].writes == 5

@@ -865,3 +865,68 @@ class TestLockWitnessStress:
             )
         finally:
             lock_witness.disarm()
+
+    def test_race_witness_armed_flood_is_race_free_and_sound(self):
+        """nomad-race's dynamic side under the same eval flood: arm the
+        Eraser lockset witness, flood a real server, and require (a) no
+        empty-lockset violation on any tracked hot field and (b) every
+        field RUNTIME-witnessed as cross-thread shared to be in the
+        static analyzer's inferred-shared set — the soundness proof for
+        shared-state-discipline's thread-root inventory."""
+        from nomad_tpu.analysis.shared_state import build_static_shared
+        from nomad_tpu.rpc import transport
+        from nomad_tpu.server.fsm import NODE_REGISTER
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.trace import lifecycle
+        from nomad_tpu.utils import lock_witness, metrics, race_witness
+
+        metrics.global_sink().reset()
+        witness = race_witness.arm()  # auto-arms the lock witness
+        try:
+            # module tables re-mint through the tracked factories only
+            # AFTER arming — the import-time ones predate the witness
+            lifecycle.reset()
+            transport.reset_rpc_stats()
+            server = Server(ServerConfig(
+                num_schedulers=4, device_batch=0,
+                heartbeat_min_ttl=3600, heartbeat_max_ttl=7200,
+            ))
+            server.start()
+            try:
+                for i in range(12):
+                    n = mock.node()
+                    n.name = f"race-{i}"
+                    n.compute_class()
+                    server.raft_apply(NODE_REGISTER, n)
+                jobs = []
+                for i in range(8):
+                    j = mock.job()
+                    j.id = f"race-{i}"
+                    j.task_groups[0].count = 8
+                    j.task_groups[0].tasks[0].resources.cpu = 20
+                    j.task_groups[0].tasks[0].resources.memory_mb = 32
+                    jobs.append(j)
+                expected = sum(tg.count for j in jobs for tg in j.task_groups)
+                for j in jobs:
+                    server.register_job(j)
+                spin_until(
+                    lambda: server.fsm.state.count_allocs_desired_run()
+                    >= expected,
+                    timeout=120, msg=f"{expected} raced placements",
+                )
+            finally:
+                server.stop()
+
+            stats = witness.stats()
+            assert stats["violations"] == 0, witness.field_report()
+            # the flood must actually drive the tracked hot fields from
+            # concurrent threads — a zero-access run proves nothing
+            assert stats["accesses"] > 100, stats
+            assert stats["shared_fields"] > 0, stats
+            missing = witness.cross_check(build_static_shared())
+            assert not missing, (
+                "runtime-witnessed shared fields the static root "
+                f"inventory never inferred as concurrent: {missing}"
+            )
+        finally:
+            race_witness.disarm()
