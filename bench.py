@@ -1496,6 +1496,157 @@ def bench_capacity_pressure(name="capacity-pressure-5K", seed=0,
     }
 
 
+# ---------------------------------------------------------------------------
+# serve-100Kwatch: the read-serving config — a 5K-thread blocking-watcher
+# army over real RPC against the 3-process cluster while the churn trace
+# runs, gated on wakeup tail latency, zero lost wakeups, and followers
+# carrying the majority of the read traffic as allow_stale local serves
+# ---------------------------------------------------------------------------
+
+def bench_serve_watch(name="serve-100Kwatch", seed=0, duration_s=22.0,
+                      n_nodes=60, n_watchers=5120, settle_timeout_s=240.0):
+    """Park >=5K concurrent blocking queries (``Eval.GetEval`` with
+    ``min_query_index``) across three real server processes — two thirds
+    pinned to FOLLOWERS as ``allow_stale`` reads served by the
+    follower's own FSM and watch hub — and drive churn underneath. A
+    beacon writer commits rotating key groups through ``Eval.Update``
+    (which returns the raft index) into a ledger; every watch return is
+    judged against it: covered commit -> wakeup (latency = return -
+    max(park, commit)), deadline-shaped return sitting on an old covered
+    commit -> LOST (gate: zero). Concurrency is sampled from per-replica
+    ``Watch.Stats`` each tick, not assumed from thread count. The name
+    is the 100K-capacity claim (hub registry bound per replica); the
+    seed-0 config proves the serving path at 5K real parked threads,
+    which is where this container's core count stops lying."""
+    from nomad_tpu.chaos import SLOGate, SLOThresholds
+    from nomad_tpu.chaos.trace import generate_trace, trace_to_jsonable
+    from nomad_tpu.watch.serve import ServeReplay
+
+    # no leader kill (watchers pin replicas by role) and no fault
+    # windows (per-process injector); churn here is load, not failure
+    # churn here is background load, not the product under test (the
+    # placement SLOs live in chaos-churn-5K): sized so the replica
+    # schedulers converge on one core while the serving army eats a
+    # fixed ~220 RPCs/s of the same GIL
+    trace = generate_trace(
+        seed=seed, duration_s=duration_s, n_nodes=n_nodes,
+        n_jobs=16, tg_count=16, stop_frac=0.2, rollout_frac=0.15,
+        n_drains=1, n_expiries=1, n_hipri=1, n_fault_windows=0,
+        leader_kill=False,
+    )
+    log(f"{name}: {len(trace)} trace events over {duration_s:.0f}s, "
+        f"{n_nodes} nodes, 3 server processes, {n_watchers} watchers, "
+        f"seed {seed}")
+    replay = ServeReplay(
+        seed=seed, trace=trace, n_servers=3, n_nodes=n_nodes,
+        settle_timeout_s=settle_timeout_s, n_watchers=n_watchers,
+    )
+    t0 = time.monotonic()
+    result = replay.run()
+    wall = time.monotonic() - t0
+
+    serve = result.get("serve") or {}
+    # base gate: the cluster must still place work under the army (the
+    # latency/throughput bars live in chaos-churn-5K; serving is the
+    # product under test here)
+    gate = SLOGate(SLOThresholds(
+        eval_ms_p99_max=None,
+        slowest_inflight_ms_max=None,
+        throughput_min_allocs_per_s=1.0,
+    ))
+    slo = gate.evaluate(result)
+    wake = serve.get("wakeup_ms") or {}
+    serve_checks = [
+        {"name": "concurrent_watchers",
+         "observed": serve.get("peak_concurrent_watchers", 0),
+         "bound": ">= 5000",
+         "passed": serve.get("peak_concurrent_watchers", 0) >= 5000},
+        {"name": "lost_wakeups",
+         "observed": serve.get("lost_wakeups", -1),
+         "bound": "== 0",
+         "passed": serve.get("lost_wakeups", -1) == 0},
+        {"name": "wakeup_p99_ms",
+         "observed": wake.get("p99"),
+         "bound": "<= 2000",
+         "passed": (wake.get("p99") is not None
+                    and wake.get("p99") <= 2000.0)},
+        {"name": "follower_read_share",
+         "observed": serve.get("follower_read_share", 0.0),
+         "bound": ">= 0.5",
+         "passed": serve.get("follower_read_share", 0.0) >= 0.5},
+        {"name": "stragglers",
+         "observed": serve.get("stragglers", -1),
+         "bound": "== 0",
+         "passed": serve.get("stragglers", -1) == 0},
+    ]
+    passed = slo["passed"] and all(c["passed"] for c in serve_checks)
+    record = {
+        "config": name,
+        "seed": seed,
+        "wall_s": round(wall, 2),
+        "passed": passed,
+        "slo": slo,
+        "serve_checks": serve_checks,
+        "result": result,
+        "trace": trace_to_jsonable(trace),
+    }
+    write_artifact(name, record)
+    stitched = _stitched_headline(result)
+    rpc_wait_share = None
+    for e in ((result.get("stitched") or {}).get("report") or {}).get(
+            "entries") or []:
+        if e.get("component") == "rpc_wait":
+            rpc_wait_share = e.get("share")
+    status = "PASS" if passed else "FAIL"
+    log(f"{name}: {status} — peak {serve.get('peak_concurrent_watchers')} "
+        f"parked watchers, {serve.get('wakeups')} wakeups "
+        f"(p99 {wake.get('p99')}ms, max {wake.get('max')}ms), "
+        f"{serve.get('lost_wakeups')} lost, coalesce ratio "
+        f"{serve.get('coalesce_ratio')}, follower read share "
+        f"{serve.get('follower_read_share')}, rpc_wait share "
+        f"{rpc_wait_share}")
+    for check in serve_checks + slo["checks"]:
+        log(f"  check[{check['name']}]: observed={check['observed']} "
+            f"bound={check['bound']} passed={check['passed']}")
+    headline = {
+        "config": name,
+        "passed": passed,
+        "slo_passed": slo["passed"],
+        "serve_checks": serve_checks,
+        "n_watchers": serve.get("n_watchers"),
+        "peak_concurrent_watchers": serve.get("peak_concurrent_watchers"),
+        "wakeups": serve.get("wakeups"),
+        "lost_wakeups": serve.get("lost_wakeups"),
+        "spurious_wakeups": serve.get("spurious_wakeups"),
+        "wakeup_ms": wake,
+        "coalesce_ratio": serve.get("coalesce_ratio"),
+        "reads_total": serve.get("reads_total"),
+        "reads_by_role": serve.get("reads_by_role"),
+        "follower_read_share": serve.get("follower_read_share"),
+        "beacon_commits": serve.get("beacon_commits"),
+        "total_allocs": result.get("total_allocs"),
+        "throughput_allocs_per_s": result.get("throughput_allocs_per_s"),
+        "invariants": result.get("invariants"),
+        "rpc_wait_share": rpc_wait_share,
+        "stitched": stitched,
+        "wall_s": round(wall, 2),
+    }
+    # round record at the repo root, written atomically by the bench
+    # itself (same lesson as BENCH_r06: the run's own data must survive
+    # an outer-harness timeout)
+    try:
+        root = os.path.dirname(os.path.abspath(__file__))
+        tmp = os.path.join(root, ".SERVE_r01.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(dict(headline, round="r01"), f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, os.path.join(root, "SERVE_r01.json"))
+    except OSError as e:
+        log(f"SERVE_r01.json write failed: {e}")
+    return headline
+
+
 def _diagnostic(fn, *args, **kwargs):
     """Run one diagnostic bench in isolation: a failure is reported but
     never skips later diagnostics or breaks the headline JSON line. The
@@ -1543,6 +1694,10 @@ def main():
     # saturated-regime config: blocked-eval storms + autoscaler drain —
     # gated on unblock-to-place latency and drain-to-zero
     capacity_pressure = _diagnostic(bench_capacity_pressure)
+    # read-serving config: 5K parked blocking watchers + follower stale
+    # reads under churn — gated on wakeup tail, zero lost wakeups, and
+    # follower read share; writes SERVE_r01.json at the repo root itself
+    serve_watch = _diagnostic(bench_serve_watch)
 
     # HEADLINE: end-to-end system C1M replay (jobs -> broker -> workers ->
     # eval-batched engine -> plan queue -> raft/FSM), one chip.
@@ -1619,6 +1774,7 @@ def main():
             "chaos_churn": chaos_churn,
             "chaos_crash": chaos_crash,
             "capacity_pressure": capacity_pressure,
+            "serve_100kwatch": serve_watch,
         },
     }
     write_artifact("headline", record)

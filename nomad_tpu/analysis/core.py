@@ -141,6 +141,7 @@ def iter_py_files(paths: Sequence[str]) -> List[str]:
 
 
 def default_checkers() -> list:
+    from .blocking_read_discipline import BlockingReadDisciplineChecker
     from .condition_discipline import ConditionDisciplineChecker
     from .dtype_discipline import DtypeDisciplineChecker
     from .fault_injection_discipline import FaultInjectionDisciplineChecker
@@ -170,6 +171,7 @@ def default_checkers() -> list:
         ConditionDisciplineChecker(analysis=shared_analysis),
         SharedStateDisciplineChecker(analysis=shared_analysis),
         RpcTelemetryDisciplineChecker(),
+        BlockingReadDisciplineChecker(),
     ]
 
 

@@ -30,6 +30,11 @@ The points mirror the failure surfaces the churn harness shakes:
                      — a fault on the coalesced unblock-storm re-enqueue:
                      the staged batch parks and retries on a bounded
                      backoff timer instead of reaching the broker.
+``watch_notify``     ``watch/hub.WatchHub.notify`` — a dropped/delayed
+                     post-apply watch notification: parked blocking
+                     queries lose at most one flush window of wakeups and
+                     degrade to their ``max_query_time`` deadline
+                     re-query; the apply path that notified is untouched.
 ==================  ========================================================
 
 Determinism: each armed point draws from its own ``random.Random`` seeded
@@ -57,6 +62,7 @@ POINTS = (
     "raft_apply",
     "heartbeat",
     "unblock_enqueue",
+    "watch_notify",
 )
 
 MODES = ("fail", "delay")

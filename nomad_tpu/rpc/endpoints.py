@@ -13,6 +13,8 @@ from typing import List, Optional
 
 from ..structs.structs import Allocation, Job, Node
 from ..trace import context as xtrace
+from ..watch.blocking import blocking_read
+from ..watch.stale import read_meta
 from . import transport
 from .transport import RPCClient, RPCServer
 
@@ -27,6 +29,21 @@ def bind_server(server, rpc: RPCServer) -> None:
         # state forever (empty, on a crash-restarted follower)
         return server.fsm.state
 
+    def serve_read(table, run, query_opts, key=None):
+        """The one funnel every read endpoint routes through
+        (lint: blocking-read-discipline). Without ``query_opts`` the
+        response is the legacy bare result — old callers are untouched.
+        With a QueryOptions the read gets reference blocking semantics
+        (min_query_index park on the watch hub, max_query_time deadline)
+        and returns ``[result, QueryMeta]`` with the index stamped under
+        the same lock hold as the query."""
+        if query_opts is None:
+            return run(state())
+        return blocking_read(
+            state, server.watch_hub, run, table, query_opts, key=key,
+            meta=read_meta(server, rpc),
+        )
+
     # -- Status --------------------------------------------------------
     rpc.register("Status.ping", lambda: "pong")
     rpc.register("Status.leader", lambda: list(rpc.leader_addr or rpc.addr))
@@ -39,12 +56,22 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Node.UpdateDrain", server.update_node_drain)
     rpc.register("Node.UpdateEligibility", server.update_node_eligibility)
     rpc.register("Node.UpdateAlloc", server.update_allocs_from_client)
-    rpc.register("Node.List",
-                 lambda: [n.without_secret() for n in state().nodes()])
+    rpc.register(
+        "Node.List",
+        lambda query_opts=None: serve_read(
+            "nodes",
+            lambda s: [n.without_secret() for n in s.nodes()],
+            query_opts,
+        ),
+    )
     rpc.register(
         "Node.GetNode",
-        lambda node_id: (lambda n: n.without_secret() if n else None)(
-            state().node_by_id(node_id)
+        lambda node_id, query_opts=None: serve_read(
+            "nodes",
+            lambda s: (lambda n: n.without_secret() if n else None)(
+                s.node_by_id(node_id)
+            ),
+            query_opts, key=node_id,
         ),
     )
 
@@ -61,23 +88,55 @@ def bind_server(server, rpc: RPCServer) -> None:
         allocs, index = state().blocking_query(run, min_index, timeout=timeout)
         return [allocs, index]
 
+    # blocking-read-waiver: pre-watch long-poll protocol — carries its own
+    # min_index/timeout args through StateStore.blocking_query, and the
+    # client agents' pull loop depends on the bare [allocs, index] shape
     rpc.register("Node.GetClientAllocs", get_client_allocs)
     rpc.register("Node.DeriveVaultToken", server.derive_vault_token)
 
     # -- Job -----------------------------------------------------------
     rpc.register("Job.Register", server.register_job)
     rpc.register("Job.Deregister", server.deregister_job)
-    rpc.register("Job.GetJob", lambda ns, job_id: state().job_by_id(ns, job_id))
-    rpc.register("Job.List", lambda: state().jobs())
+    rpc.register(
+        "Job.GetJob",
+        lambda ns, job_id, query_opts=None: serve_read(
+            "jobs", lambda s: s.job_by_id(ns, job_id),
+            query_opts, key=(ns, job_id),
+        ),
+    )
+    rpc.register(
+        "Job.List",
+        lambda query_opts=None: serve_read(
+            "jobs", lambda s: s.jobs(), query_opts,
+        ),
+    )
     rpc.register(
         "Job.Allocations",
-        lambda ns, job_id: state().allocs_by_job(ns, job_id, True),
+        lambda ns, job_id, query_opts=None: serve_read(
+            "allocs", lambda s: s.allocs_by_job(ns, job_id, True), query_opts,
+        ),
     )
-    rpc.register("Job.Evaluations",
-                 lambda ns, job_id: state().evals_by_job(ns, job_id))
-    rpc.register("Job.GetJobVersions",
-                 lambda ns, job_id: state().job_versions.get((ns, job_id), []))
-    rpc.register("Job.Summary", lambda ns, job_id: state().job_summary(ns, job_id))
+    rpc.register(
+        "Job.Evaluations",
+        lambda ns, job_id, query_opts=None: serve_read(
+            "evals", lambda s: s.evals_by_job(ns, job_id), query_opts,
+        ),
+    )
+    rpc.register(
+        "Job.GetJobVersions",
+        lambda ns, job_id, query_opts=None: serve_read(
+            "jobs", lambda s: s.job_versions.get((ns, job_id), []),
+            query_opts, key=(ns, job_id),
+        ),
+    )
+    rpc.register(
+        "Job.Summary",
+        lambda ns, job_id, query_opts=None: serve_read(
+            # summaries are alloc-status rollups: the allocs table is
+            # what moves them, so that's the watched table
+            "allocs", lambda s: s.job_summary(ns, job_id), query_opts,
+        ),
+    )
     # write endpoints the HTTP agent reaches through leader_forward when
     # serving on a follower (reference job_endpoint.go Evaluate/Dispatch/
     # Revert/Stable, alloc_endpoint.go Stop, node_endpoint.go Evaluate,
@@ -91,10 +150,24 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("System.GC", server.force_gc)
 
     # -- Eval ----------------------------------------------------------
-    rpc.register("Eval.GetEval", lambda eval_id: state().eval_by_id(eval_id))
-    rpc.register("Eval.List", lambda: state().evals())
-    rpc.register("Eval.Allocations",
-                 lambda eval_id: state().allocs_by_eval(eval_id))
+    rpc.register(
+        "Eval.GetEval",
+        lambda eval_id, query_opts=None: serve_read(
+            "evals", lambda s: s.eval_by_id(eval_id), query_opts, key=eval_id,
+        ),
+    )
+    rpc.register(
+        "Eval.List",
+        lambda query_opts=None: serve_read(
+            "evals", lambda s: s.evals(), query_opts,
+        ),
+    )
+    rpc.register(
+        "Eval.Allocations",
+        lambda eval_id, query_opts=None: serve_read(
+            "allocs", lambda s: s.allocs_by_eval(eval_id), query_opts,
+        ),
+    )
 
     # -- worker protocol (follower workers dequeue from the leader's
     #    broker and submit plans to its queue: worker.go:161 Eval.Dequeue,
@@ -136,14 +209,35 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Plan.Submit", plan_submit)
 
     # -- Alloc ---------------------------------------------------------
-    rpc.register("Alloc.GetAlloc", lambda alloc_id: state().alloc_by_id(alloc_id))
-    rpc.register("Alloc.List", lambda: state().allocs())
+    rpc.register(
+        "Alloc.GetAlloc",
+        lambda alloc_id, query_opts=None: serve_read(
+            "allocs", lambda s: s.alloc_by_id(alloc_id),
+            query_opts, key=alloc_id,
+        ),
+    )
+    rpc.register(
+        "Alloc.List",
+        lambda query_opts=None: serve_read(
+            "allocs", lambda s: s.allocs(), query_opts,
+        ),
+    )
 
     # -- Deployment ----------------------------------------------------
     dw = server.deployment_watcher
-    rpc.register("Deployment.List", lambda: state().deployments())
-    rpc.register("Deployment.GetDeployment",
-                 lambda deployment_id: state().deployment_by_id(deployment_id))
+    rpc.register(
+        "Deployment.List",
+        lambda query_opts=None: serve_read(
+            "deployments", lambda s: s.deployments(), query_opts,
+        ),
+    )
+    rpc.register(
+        "Deployment.GetDeployment",
+        lambda deployment_id, query_opts=None: serve_read(
+            "deployments", lambda s: s.deployment_by_id(deployment_id),
+            query_opts, key=deployment_id,
+        ),
+    )
     rpc.register("Deployment.Promote", dw.promote)
     rpc.register("Deployment.Pause", dw.pause)
     rpc.register("Deployment.Fail", dw.fail)
@@ -153,6 +247,8 @@ def bind_server(server, rpc: RPCServer) -> None:
     rpc.register("Periodic.Force", server.periodic_dispatcher.force_launch)
 
     # -- ACL federation (leader.go:997/:1138 replication source) -------
+    # blocking-read-waiver: cross-region replication pull with its own
+    # cursor protocol; replicators poll, they never park
     rpc.register("ACL.ListReplication", server.list_acl_for_replication)
 
     # -- Operator ------------------------------------------------------
@@ -188,6 +284,11 @@ def bind_server(server, rpc: RPCServer) -> None:
         return out
 
     rpc.register("Trace.Export", trace_export)
+
+    # -- Watch (nomad-watch hub introspection) -------------------------
+    # THIS replica's parked-watcher depth + wakeup/coalesce counters;
+    # like RaftStats, probers of a specific replica pass no_forward=True
+    rpc.register("Watch.Stats", server.watch_hub.stats)
 
 
 class RemoteServerProxy:

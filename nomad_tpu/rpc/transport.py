@@ -356,6 +356,11 @@ class RPCServer:
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
+            # the watch serving bench parks thousands of persistent
+            # watcher connections that dial in bursts; socketserver's
+            # default backlog of 5 turns that storm into SYN drops and
+            # client-side connect timeouts (kernel caps by somaxconn)
+            request_queue_size = 1024
 
             def handle_error(self, request, client_address):
                 # peer-side tear-downs stay quiet; anything else reaching
@@ -427,17 +432,24 @@ class RPCServer:
                     req_region = req.get("region")
                     if req_region and req_region != self.region:
                         result = self._forward_region(req_region, method, body)
-                    # leader forwarding (rpc.go:409): followers proxy writes
+                    # leader forwarding (rpc.go:409): followers proxy writes.
+                    # "stale" is the allowStale read flag: the follower
+                    # answers from its own FSM instead of forwarding, and
+                    # the endpoint stamps measured follower_lag into
+                    # QueryMeta (watch/stale.py)
                     elif (
                         not self.is_leader()
                         and self.leader_addr is not None
                         and self.leader_addr != self.addr
                         and method not in self.LOCAL_ONLY
                         and not req.get("no_forward")
+                        and not req.get("stale")
                     ):
                         sattrs["forwarded"] = True
                         result = self._forward(method, body)
                     else:
+                        if req.get("stale") and not self.is_leader():
+                            sattrs["stale"] = True
                         result = fn(*body)
                     resp = {"seq": seq, "error": None, "body": result}
                 except Exception as e:  # noqa: BLE001
@@ -543,11 +555,15 @@ class RPCClient:
         region: Optional[str] = None,
         timeout: Optional[float] = None,
         no_retry: bool = False,
+        stale: bool = False,
     ) -> Any:
         """``timeout`` overrides the connection timeout for this call;
         ``no_retry`` disables the reconnect-resend (required for
         non-idempotent calls like Plan.Submit, where a resend would
-        enqueue the work twice)."""
+        enqueue the work twice); ``stale`` marks an allowStale read the
+        receiving replica serves locally instead of leader-forwarding
+        (older peers ignore the unknown envelope field and forward as
+        before — wire-compatible)."""
         peer = f"{self.addr[0]}:{self.addr[1]}"
         # the outbound span is opened BEFORE the envelope is built so
         # inject() carries this span's id: the server's handler span
@@ -562,6 +578,8 @@ class RPCClient:
                     req["no_forward"] = True
                 if region:
                     req["region"] = region
+                if stale:
+                    req["stale"] = True
                 tctx = xtrace.inject()
                 if tctx is not None:
                     req[TRACE_KEY] = tctx
@@ -589,6 +607,12 @@ class RPCClient:
                     try:
                         _send_frame(sock, payload, peer, method)
                         frame = _recv_frame(sock, peer, method)
+                    except (ConnectionError, OSError):
+                        # a retry that dies mid-exchange leaves a request
+                        # outstanding on this socket; keeping it would let
+                        # the late response answer the NEXT call
+                        self._close_locked()
+                        raise
                     finally:
                         if timeout is not None:
                             try:
@@ -597,6 +621,16 @@ class RPCClient:
                                 pass
                 attrs["resp_bytes"] = len(frame)
                 resp = decode(frame)
+                if resp.get("seq") != req["seq"]:
+                    # late response from an abandoned exchange (e.g. a
+                    # timeout that didn't tear the connection down) — it
+                    # belongs to a PREVIOUS request, and every frame after
+                    # it is off by one: poison, drop the connection
+                    self._close_locked()
+                    raise RPCError(
+                        f"response seq mismatch for {method}: got "
+                        f"{resp.get('seq')!r}, expected {req['seq']}"
+                    )
             if resp.get("error"):
                 raise RPCError(resp["error"])
             return resp.get("body")

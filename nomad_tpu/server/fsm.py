@@ -66,6 +66,13 @@ class NomadFSM:
         # followers) so GC cutoffs survive leader transitions
         # (reference fsm.go witnesses inside Apply).
         self.timetable = None
+        # blocking-query wakeups (watch/hub.WatchHub), attached by the
+        # server on EVERY replica — followers notify their local hub so
+        # stale reads park/wake against follower state. Standalone FSMs
+        # (unit tests, parity oracles) leave it None and skip notify.
+        # (annotated so the static lock-order graph types the attribute
+        # and sees the apply -> hub._lock edge the runtime witness sees)
+        self.watch_hub: Optional["WatchHub"] = None
 
     def apply(self, index: int, entry_type: str, payload) -> object:
         handler = _DISPATCH.get(entry_type)
@@ -73,7 +80,14 @@ class NomadFSM:
             raise ValueError(f"unknown log entry type {entry_type!r}")
         if self.timetable is not None:
             self.timetable.witness(index)
-        return handler(self, index, payload)
+        result = handler(self, index, payload)
+        # notify AFTER the write is materialized, outside the dispatch
+        # table (the hub's coalescing timer/clock must stay unreachable
+        # from the fsm-determinism roots — notify only signals, it never
+        # feeds state back into handlers)
+        if self.watch_hub is not None:
+            self.watch_hub.notify(index, _watch_touched(entry_type, payload))
+        return result
 
     # -- handlers ----------------------------------------------------------
 
@@ -318,6 +332,10 @@ class NomadFSM:
 
     def restore(self, snapshot: StateStore) -> None:
         self.state = snapshot
+        # the whole store changed identity: every parked watcher must
+        # re-query against the NEW tables, whatever it was watching
+        if self.watch_hub is not None:
+            self.watch_hub.notify_all(snapshot.latest_index)
 
 
 # Every handler reachable from this table replays on every replica from
@@ -358,3 +376,99 @@ _DISPATCH: Dict[str, Callable] = {
     VAULT_ACCESSOR_DELETE: NomadFSM._apply_vault_accessor_delete,
     AUTOPILOT_CONFIG: NomadFSM._apply_autopilot_config,
 }
+
+
+# -- watch-hub touch maps ----------------------------------------------------
+#
+# Which (table, key) pairs each entry type dirties, for post-apply watch
+# notification. ``key=None`` means a bulk write to the table (wakes every
+# watcher of it, row-level ones included). Key conventions match the read
+# endpoints' subscriptions: nodes/evals/allocs/deployments key on their id,
+# jobs on (namespace, id). The map errs TOWARD waking: a spurious wake
+# costs one re-query; a missed one strands a watcher until its deadline —
+# hence the unknown-entry fallback notifies every table.
+
+_WATCH_ALL = tuple((t, None) for t in (
+    "nodes", "jobs", "evals", "allocs", "deployments",
+))
+
+
+def _touched_plan_results(payload):
+    # allocs stay a bulk touch: dense placements can carry thousands of
+    # ids per plan and enumerating them on the apply hot path costs more
+    # than the spurious row-watcher re-queries it would save. Evals and
+    # deployments are few per plan, so those enumerate precisely — a plan
+    # storm must not wake every parked row-level eval watcher (the serve
+    # bench measures exactly this).
+    out = [("allocs", None)]
+    eval_id = payload.get("eval_id", "")
+    out.append(("evals", eval_id or None))
+    for ev in payload.get("preemption_evals") or ():
+        out.append(("evals", ev.id))
+    dep = payload.get("deployment")
+    if dep is not None:
+        out.append(("deployments", dep.id))
+    for upd in payload.get("deployment_updates") or ():
+        out.append(("deployments", upd.deployment_id))
+    return out
+
+
+_WATCH_TOUCHED = {
+    NODE_REGISTER: lambda p: [("nodes", p.id)],
+    NODE_DEREGISTER: lambda p: [("nodes", p)],
+    NODE_STATUS_UPDATE: lambda p: [("nodes", p[0])],
+    NODE_DRAIN_UPDATE: lambda p: [("nodes", p[0])],
+    NODE_ELIGIBILITY_UPDATE: lambda p: [("nodes", p[0])],
+    BATCH_NODE_UPDATE_DRAIN: lambda p: [("nodes", nid) for nid in p],
+    JOB_REGISTER: lambda p: [("jobs", (p.namespace, p.id))],
+    JOB_DEREGISTER: lambda p: [("jobs", (p[0], p[1]))],
+    EVAL_UPDATE: lambda p: [("evals", ev.id) for ev in p],
+    EVAL_DELETE: lambda p: (
+        [("evals", eid) for eid in p[0]] + [("allocs", aid) for aid in p[1]]
+    ),
+    ALLOC_UPDATE: lambda p: [("allocs", a.id) for a in p],
+    ALLOC_CLIENT_UPDATE: lambda p: [("allocs", a.id) for a in p],
+    ALLOC_UPDATE_DESIRED_TRANSITION: lambda p: (
+        [("allocs", aid) for aid in p[0]] + [("evals", ev.id) for ev in p[1] or ()]
+    ),
+    APPLY_PLAN_RESULTS: _touched_plan_results,
+    APPLY_PLAN_RESULTS_BATCH: lambda p: [
+        t for payload in p for t in _touched_plan_results(payload)
+    ],
+    DEPLOYMENT_STATUS_UPDATE: lambda p: (
+        [("deployments", p[0].deployment_id)]
+        + ([("jobs", (p[1].namespace, p[1].id))] if p[1] is not None else [])
+        + ([("evals", p[2].id)] if p[2] is not None else [])
+    ),
+    DEPLOYMENT_PROMOTE: lambda p: (
+        [("deployments", p[0]), ("allocs", None)]
+        + ([("evals", p[3].id)] if p[3] is not None else [])
+    ),
+    DEPLOYMENT_ALLOC_HEALTH: lambda p: (
+        [("deployments", p[0]), ("allocs", None)]
+        + ([("evals", p[5].id)] if p[5] is not None else [])
+    ),
+    DEPLOYMENT_DELETE: lambda p: [("deployments", did) for did in p],
+    JOB_STABILITY: lambda p: [("jobs", (p[0], p[1]))],
+    PERIODIC_LAUNCH: lambda p: [("jobs", (p[0], p[1]))],
+    # config/ACL/vault entries touch no watched read table
+    SCHEDULER_CONFIG: lambda p: (),
+    AUTOPILOT_CONFIG: lambda p: (),
+    ACL_POLICY_UPSERT: lambda p: (),
+    ACL_POLICY_DELETE: lambda p: (),
+    ACL_TOKEN_UPSERT: lambda p: (),
+    ACL_TOKEN_DELETE: lambda p: (),
+    ACL_TOKEN_BOOTSTRAP: lambda p: (),
+    VAULT_ACCESSOR_UPSERT: lambda p: (),
+    VAULT_ACCESSOR_DELETE: lambda p: (),
+}
+
+
+def _watch_touched(entry_type: str, payload):
+    fn = _WATCH_TOUCHED.get(entry_type)
+    if fn is None:
+        return _WATCH_ALL
+    try:
+        return fn(payload)
+    except Exception:  # noqa: BLE001 — never let a notify map break apply
+        return _WATCH_ALL

@@ -193,6 +193,15 @@ class ServerConfig:
     # briefly and stays async, sustained saturation falls back to the
     # classic synchronous path (counted as nomad.pipeline.backpressure)
     pipeline_backpressure_wait_s: float = 0.02
+    # -- watch hub / blocking queries (nomad_tpu/watch) ----------------
+    # wakeup coalescing window: raft applies landing within it merge
+    # into ONE flush, so an apply storm wakes each parked blocking query
+    # once per window instead of once per write. 0 = synchronous wakeups
+    # (per-apply, the reference's channel-close-per-write behavior)
+    watch_coalesce_ms: float = 5.0
+    # bound on parked watchers per replica; subscribe past it refuses
+    # (WatchLimitError) and the read degrades to plain polling
+    watch_max_watchers: int = 100_000
     # federation (reference leader.go:997/:1138): non-authoritative
     # regions' leaders mirror ACL policies and GLOBAL tokens from the
     # authoritative region. Empty authoritative_region (or equal to our
@@ -215,6 +224,16 @@ class Server:
         self.logger = logging.getLogger(f"nomad_tpu.server.{name}")
 
         self.fsm = NomadFSM()
+        # watch hub on EVERY replica (not leader-gated): followers notify
+        # their local hub as entries replicate, which is what lets stale
+        # reads park on a follower with min_query_index honored
+        from ..watch.hub import WatchHub
+
+        self.watch_hub = WatchHub(
+            coalesce_ms=self.config.watch_coalesce_ms,
+            max_watchers=self.config.watch_max_watchers,
+        )
+        self.fsm.watch_hub = self.watch_hub
         self.raft = raft or InProcRaft()
         self.eval_broker = EvalBroker()
         self.blocked_evals = BlockedEvals(
@@ -420,6 +439,8 @@ class Server:
             self.planner.stop()
         if self.device_batcher is not None:
             self.device_batcher.stop()
+        # wake every parked blocking query and stop the flusher thread
+        self.watch_hub.close()
         self._revoke_leadership()
 
     # -- leadership ------------------------------------------------------

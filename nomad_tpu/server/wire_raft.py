@@ -849,6 +849,7 @@ class WireRaft:
                 self._step_down_locked(term)
             self.leader_id = leader_id
             self._election_deadline = self._random_deadline()
+            self._last_contact = time.monotonic()
             # a FRESH node (empty log, no snapshot) joining an established
             # cluster: everything already committed is pre-join history —
             # its peer set came from gossip bootstrap, so historical
@@ -903,6 +904,7 @@ class WireRaft:
             self._step_down_locked(term)
             self.leader_id = leader_id
             self._election_deadline = self._random_deadline()
+            self._last_contact = time.monotonic()
             if last_index <= self._snapshot_index:
                 return self.current_term
             if self._config_replay_boundary == 0:
@@ -941,6 +943,15 @@ class WireRaft:
 
     # -- introspection ---------------------------------------------------
 
+    def last_contact_age_s(self) -> float:
+        """Seconds since the last leader contact (AppendEntries /
+        InstallSnapshot) — the follower_lag measure stale reads stamp
+        into QueryMeta. 0 while leading (we ARE the contact)."""
+        with self._lock:
+            if self.state == LEADER:
+                return 0.0
+            return max(time.monotonic() - self._last_contact, 0.0)
+
     def stats(self, peer: int = 0) -> dict:
         with self._lock:
             return {
@@ -953,4 +964,8 @@ class WireRaft:
                 "num_peers": len(self.peers),
                 "snapshot_index": self._snapshot_index,
                 "snapshots_installed": self._snapshots_installed,
+                "last_contact_age_s": (
+                    0.0 if self.state == LEADER
+                    else max(time.monotonic() - self._last_contact, 0.0)
+                ),
             }

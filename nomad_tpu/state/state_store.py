@@ -314,6 +314,16 @@ class StateStore:
             self._cond.wait_for(lambda: self.latest_index > min_index, timeout=timeout)
             return run(self), self.latest_index
 
+    def read_with_index(self, run: Callable[["StateStore"], object]):
+        """Run a read and capture ``latest_index`` under ONE lock hold, so
+        the returned index is exactly the version the result reflects — a
+        write landing between the query and a separate index read would
+        otherwise be falsely covered by the stamped index, and a client
+        chaining it as ``min_query_index`` would never see that write
+        (the watch layer's QueryMeta stamping relies on this)."""
+        with self._lock:
+            return run(self), self.latest_index
+
     def _bump(self, index: Optional[int] = None) -> int:
         if index is None:
             index = self.latest_index + 1

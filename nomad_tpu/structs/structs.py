@@ -1720,3 +1720,37 @@ class SchedulerConfiguration:
     preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
     create_index: int = 0
     modify_index: int = 0
+
+
+@dataclass
+class QueryOptions:
+    """Read-RPC options (reference structs/structs.go QueryOptions).
+
+    ``min_query_index`` > 0 turns the read into a blocking query: the
+    server parks the request until the target table moves past that
+    index or ``max_query_time`` elapses. ``allow_stale`` lets any
+    server — leader or follower — answer from its local FSM instead of
+    forwarding to the leader.
+    """
+
+    min_query_index: int = 0
+    max_query_time: float = 0.0
+    allow_stale: bool = False
+
+
+@dataclass
+class QueryMeta:
+    """Response metadata stamped on every read served with QueryOptions
+    (reference structs/structs.go QueryMeta).
+
+    ``index`` is the state-store index the result is consistent with —
+    clients chain it back as the next ``min_query_index``.
+    ``follower_lag_ms`` is only meaningful on stale reads: how far
+    behind the leader's heartbeat stream this replica was when it
+    answered.
+    """
+
+    index: int = 0
+    known_leader: bool = False
+    last_contact_ms: float = 0.0
+    follower_lag_ms: float = 0.0

@@ -291,6 +291,8 @@ def install_server_probes(rec: FlightRecorder, server) -> None:
         },
     )
     rec.add_probe("encode_cache", _encode_cache_probe())
+    # nomad-watch: parked-watcher depth, wakeup/coalesce counters
+    rec.add_probe("watch", server.watch_hub.stats)
     # nomad-lockdep: {"armed": 0} when disarmed; lock/edge/violation
     # counters when a witness is live (probes run OUTSIDE rec._lock, so
     # this adds no flight->witness order edge)

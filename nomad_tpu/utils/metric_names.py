@@ -71,6 +71,9 @@ FAMILIES: Dict[str, str] = {
                  "counters (family_sample/family_counter — the method "
                  "enum is bounded by bind_server's registry), inflight "
                  "gauge",
+    "nomad.watch": "blocking-query watch hub: watchers gauge, "
+                   "wakeups/dropped_notifies/rejected_subscribes "
+                   "counters",
 }
 
 

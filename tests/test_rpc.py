@@ -97,6 +97,28 @@ def test_rpc_call_and_error():
         rpc.stop()
 
 
+def test_timed_out_call_cannot_poison_the_next_response():
+    """A call whose reconnect retry ALSO dies must tear the connection
+    down: leaving it open strands an in-flight request whose late
+    response would answer the NEXT call on the socket (seq desync —
+    observed live as a heartbeat TTL float delivered to a Watch.Stats
+    caller under GIL starvation)."""
+    rpc = RPCServer()
+    rpc.register("Slow.echo", lambda x, delay: (time.sleep(delay), x)[1])
+    rpc.start()
+    try:
+        c = RPCClient(*rpc.addr)
+        with pytest.raises(OSError):
+            # first attempt and the retry both time out; the server is
+            # still cooking the retried request when this call returns
+            c.call("Slow.echo", "A", 1.5, timeout=0.3)
+        # the poisoned-connection failure mode was this returning "A"
+        assert c.call("Slow.echo", "B", 0.0, timeout=5.0) == "B"
+        c.close()
+    finally:
+        rpc.stop()
+
+
 def test_follower_forwards_to_leader():
     """Writes against a follower transparently reach the leader
     (rpc.go:409 forward)."""

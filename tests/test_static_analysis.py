@@ -1891,3 +1891,102 @@ def test_rpc_telemetry_exempts_transport_itself():
     """)
     assert run_source(src, "rpc/transport.py") == []
     assert run_source(src, "plugins/transport.py") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-read-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_read_flags_unrouted_read_endpoint():
+    # a read-shaped endpoint that answers straight from the store: no
+    # QueryMeta, no min_query_index — the bug shape the funnel removed
+    src = dedent("""
+        def bind(rpc, server):
+            rpc.register("Job.List", lambda: server.fsm.state.jobs())
+            rpc.register("Eval.GetEval", lambda i: server.fsm.state.eval_by_id(i))
+    """)
+    fs = run_source(src, "rpc/endpoints.py")
+    flagged = [f for f in fs if f.rule == "blocking-read-discipline"]
+    assert len(flagged) == 2
+    assert any("Job.List" in f.message for f in flagged)
+    assert any("Eval.GetEval" in f.message for f in flagged)
+
+
+def test_blocking_read_accepts_funnel_and_waiver():
+    src = dedent("""
+        def bind(rpc, server):
+            def serve_read(table, run, query_opts, key=None):
+                return run(server.fsm.state)
+
+            rpc.register(
+                "Job.List",
+                lambda query_opts=None: serve_read(
+                    "jobs", lambda s: s.jobs(), query_opts),
+            )
+
+            def get_client_allocs(node_id, min_index, timeout):
+                return server.fsm.state.allocs_by_node(node_id)
+
+            # blocking-read-waiver: pre-watch long-poll with its own
+            # min_index protocol
+            rpc.register("Node.GetClientAllocs", get_client_allocs)
+
+            # write endpoints are out of scope for the funnel entirely
+            rpc.register("Job.Register", server.register_job)
+    """)
+    assert [f for f in run_source(src, "rpc/endpoints.py")
+            if f.rule == "blocking-read-discipline"] == []
+
+
+def test_blocking_read_scopes_endpoint_rule_to_endpoint_modules():
+    # the same unrouted register outside an endpoints.py module is some
+    # other registry's business (test harnesses, plugin tables)
+    src = dedent("""
+        def wire(rpc, server):
+            rpc.register("Job.List", lambda: server.fsm.state.jobs())
+    """)
+    assert [f for f in run_source(src, "server/harness.py")
+            if f.rule == "blocking-read-discipline"] == []
+
+
+def test_blocking_read_flags_state_writing_hub_callback():
+    src = dedent("""
+        def wire(hub, server):
+            hub.add_callback(
+                lambda tables, index: server.fsm.state.upsert_evals(index, []))
+    """)
+    fs = run_source(src, "server/wiring.py")
+    assert any(f.rule == "blocking-read-discipline"
+               and "upsert_evals" in f.message for f in fs)
+
+
+def test_blocking_read_flags_lock_taking_hub_callback():
+    src = dedent("""
+        def wire(watch_hub, store):
+            def observer(tables, index):
+                with store._lock:
+                    return len(store.evals)
+
+            watch_hub.add_callback(observer)
+    """)
+    fs = run_source(src, "server/wiring.py")
+    assert any(f.rule == "blocking-read-discipline"
+               and "store._lock" in f.message for f in fs)
+
+
+def test_blocking_read_accepts_observer_callback():
+    # pure observation — counters, appends — is the blessed callback
+    # shape; non-hub add_callback receivers are out of scope entirely
+    src = dedent("""
+        def wire(hub, rec, metrics, seen, server):
+            hub.add_callback(lambda tables, index: seen.append(index))
+
+            def observer(tables, index):
+                metrics.incr_counter("nomad.watch.observed", len(tables))
+
+            hub.add_callback(observer)
+            rec.add_callback(lambda: server.fsm.state.upsert_evals(0, []))
+    """)
+    assert [f for f in run_source(src, "server/wiring.py")
+            if f.rule == "blocking-read-discipline"] == []
